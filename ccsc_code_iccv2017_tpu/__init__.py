@@ -1,0 +1,20 @@
+"""ccsc_code_iccv2017_tpu — a TPU-native Consensus Convolutional Sparse
+Coding framework (JAX / XLA / pjit / shard_map).
+
+A from-scratch rebuild of the capabilities of the ICCV 2017 CCSC
+reference (Choudhury, Swanson, Heide, Wetzstein, Heidrich), designed
+TPU-first: rfft-diagonalized ADMM, batched per-frequency solves on the
+MXU, consensus data-parallelism as a `pmean` over a device mesh.
+"""
+from . import config, ops
+from .config import (
+    GEOM_2D,
+    GEOM_3D,
+    GEOM_HYPERSPECTRAL,
+    GEOM_LIGHTFIELD,
+    LearnConfig,
+    ProblemGeom,
+    SolveConfig,
+)
+
+__version__ = "0.1.0"

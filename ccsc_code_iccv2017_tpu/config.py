@@ -1,0 +1,144 @@
+"""Typed configuration for the CCSC-TPU framework.
+
+The reference hardcodes every algorithm constant at call sites scattered
+through nine solver files (e.g. rho=500/50 in
+2D/admm_learn_conv2D_large_dParallel.m:98,150,153, rho=5000/1 in
+2D/admm_learn_conv2D_large_dzParallel.m:99,112,154, gamma heuristics in
+2D/Inpainting/admm_solve_conv2D_weighted_sampling.m:36-37). This module
+lifts all of them into frozen dataclasses so every solver variant is a
+config, not a file.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ProblemGeom:
+    """Geometry of one CCSC problem family, dimension-generic.
+
+    The reference implements four learners (2D / 2-3D hyperspectral / 3D
+    video / 4D lightfield) as separate 350-430 line files; they differ
+    only in this geometry:
+
+    - ``spatial_support``: spatial filter support over which the FFT is
+      taken, e.g. (11, 11) for 2D (2D/learn_kernels_2D_large.m:15),
+      (11, 11, 11) for 3D video (3D/learn_kernels_3D.m:15).
+    - ``reduce_shape``: extra filter/data dims *shared* by one 2D code
+      map — the 31 wavelengths of the hyperspectral learner
+      (2-3D/DictionaryLearning/admm_learn.m:13-16) or the 5x5 angular
+      views of the lightfield learner
+      (4D/admm_learn_conv4D_lightfield.m:18-20). Empty for 2D/3D.
+    - ``num_filters``: k, the filter-bank size.
+
+    Canonical array layouts (TPU-friendly: batch leading, FFT axes
+    trailing so rfftn applies to the innermost axes):
+
+    ==========  =========================================
+    data b      [n, *reduce, *spatial]
+    filters d   [k, *reduce, *spatial_support]
+    codes z     [n, k, *spatial_padded]
+    Dz          [n, *reduce, *spatial_padded]
+    ==========  =========================================
+    """
+
+    spatial_support: Tuple[int, ...]
+    num_filters: int
+    reduce_shape: Tuple[int, ...] = ()
+
+    @property
+    def ndim_spatial(self) -> int:
+        return len(self.spatial_support)
+
+    @property
+    def ndim_reduce(self) -> int:
+        return len(self.reduce_shape)
+
+    @property
+    def reduce_size(self) -> int:
+        return math.prod(self.reduce_shape) if self.reduce_shape else 1
+
+    @property
+    def psf_radius(self) -> Tuple[int, ...]:
+        # floor(psf_s/2) per spatial dim
+        # (2D/admm_learn_conv2D_large_dParallel.m:15)
+        return tuple(s // 2 for s in self.spatial_support)
+
+    def padded_shape(self, data_spatial: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Spatial shape after symmetric zero padding by psf_radius.
+
+        size_x = sb + 2*psf_radius
+        (2D/admm_learn_conv2D_large_dParallel.m:16).
+        """
+        return tuple(
+            s + 2 * r for s, r in zip(data_spatial, self.psf_radius)
+        )
+
+    @property
+    def filter_shape(self) -> Tuple[int, ...]:
+        return (self.num_filters, *self.reduce_shape, *self.spatial_support)
+
+
+# Geometry presets matching the reference's four families.
+GEOM_2D = lambda k=100, s=11: ProblemGeom((s, s), k)
+GEOM_HYPERSPECTRAL = lambda k=100, s=11, w=31: ProblemGeom((s, s), k, (w,))
+GEOM_3D = lambda k=49, s=11, t=11: ProblemGeom((s, s, t), k)
+GEOM_LIGHTFIELD = lambda k=49, s=11, a=5: ProblemGeom((s, s), k, (a, a))
+
+
+@dataclasses.dataclass(frozen=True)
+class LearnConfig:
+    """Hyperparameters of the consensus dictionary learners.
+
+    Defaults follow 2D/learn_kernels_2D_large.m:15-24 and the rho
+    constants hardcoded inside admm_learn_conv2D_large_dzParallel.m
+    (rho_d=5000 at :99,112, rho_z=1 at :154; the dParallel variant uses
+    500/50 at :98,150,153). ``max_it_d``/``max_it_z`` are the fixed
+    inner ADMM iteration counts (dParallel.m:75-76, dzParallel.m:75-76).
+    """
+
+    lambda_residual: float = 1.0
+    lambda_prior: float = 1.0
+    max_it: int = 20
+    tol: float = 1e-3
+    max_it_d: int = 5
+    max_it_z: int = 10
+    rho_d: float = 5000.0
+    rho_z: float = 1.0
+    # Number of consensus blocks N; data batch n is split into N blocks
+    # of ni = n/N images (dzParallel.m:11-12). On a device mesh this is
+    # the size of the 'block' axis.
+    num_blocks: int = 1
+    dtype: str = "float32"
+    verbose: str = "brief"  # 'none' | 'brief' | 'all'
+
+
+@dataclasses.dataclass(frozen=True)
+class SolveConfig:
+    """Hyperparameters of the reconstruction (coding) solvers.
+
+    ``gamma_factor``/``gamma_ratio`` encode the per-app gamma heuristic
+    ``g = factor * lambda_prior / max(b); gamma = [g/ratio, g]``:
+    inpainting 60/100 (admm_solve_conv2D_weighted_sampling.m:36-37),
+    Poisson 20/5 (admm_solve_conv_poisson.m:34-35), video deblur 500/1
+    (admm_solve_video_weighted_sampling.m:36-37), demosaic/view-synth
+    60/100 (admm_solve_conv23D_weighted_sampling.m:30-31).
+    """
+
+    lambda_residual: float = 5.0
+    lambda_prior: float = 2.0
+    max_it: int = 100
+    tol: float = 1e-3
+    gamma_factor: float = 60.0
+    gamma_ratio: float = 100.0
+    # Scale the quadratic coupling rho by the reduce size (sw), as the
+    # reference does for wavelength/angular-shared codes
+    # (2-3D admm_learn.m:311, demosaic :126).
+    scale_rho_by_reduce: bool = True
+    # Gradient smoothness weight on the dirac channel (Poisson deconv,
+    # admm_solve_conv_poisson.m:174).
+    lambda_smooth: float = 0.5
+    dtype: str = "float32"
+    verbose: str = "brief"

@@ -1,0 +1,3 @@
+from . import fourier, freq_solvers, proxes
+
+__all__ = ["fourier", "freq_solvers", "proxes"]

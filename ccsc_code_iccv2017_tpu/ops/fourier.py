@@ -1,0 +1,158 @@
+"""Fourier-domain convolution operators for CCSC, dimension-generic.
+
+The reference diagonalizes every convolution by FFT (fft2/fftn/psf2otf,
+e.g. 2D/admm_learn_conv2D_large_dParallel.m:24,41; fftn in
+3D/admm_learn_conv3D_large.m:43-55; psf2otf in
+2D/Inpainting/admm_solve_conv2D_weighted_sampling.m:155-168). Here we
+use real FFTs (rfftn) — the data, codes and filters are all real, so
+the half-spectrum carries everything and halves both memory and compute
+versus the reference's full complex FFTs.
+
+Layout convention (see config.ProblemGeom): FFT axes are ALWAYS the
+trailing ``ndim_s`` axes. Frequency-flat forms put the flattened
+frequency axis last: dhat [k, W, F], zhat [n, k, F], bhat [n, W, F]
+with W = prod(reduce_shape) (1 if none).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def spatial_axes(x: jnp.ndarray, ndim_s: int) -> Tuple[int, ...]:
+    return tuple(range(x.ndim - ndim_s, x.ndim))
+
+
+def rfft_len(spatial_shape: Sequence[int]) -> int:
+    """Number of rfftn frequency bins for a spatial shape."""
+    s = tuple(spatial_shape)
+    return math.prod(s[:-1]) * (s[-1] // 2 + 1)
+
+
+def rfftn_spatial(x: jnp.ndarray, ndim_s: int) -> jnp.ndarray:
+    return jnp.fft.rfftn(x, axes=spatial_axes(x, ndim_s))
+
+
+def irfftn_spatial(
+    xh: jnp.ndarray, spatial_shape: Sequence[int]
+) -> jnp.ndarray:
+    ndim_s = len(spatial_shape)
+    return jnp.fft.irfftn(
+        xh, s=tuple(spatial_shape), axes=tuple(range(xh.ndim - ndim_s, xh.ndim))
+    )
+
+
+def pad_spatial(
+    x: jnp.ndarray,
+    radius: Sequence[int],
+    mode: str = "zero",
+) -> jnp.ndarray:
+    """Pad the trailing len(radius) spatial axes by radius on both sides.
+
+    ``zero`` matches padarray(b, psf_radius, 0, 'both')
+    (2D/admm_learn_conv2D_large_dParallel.m:23); ``symmetric`` matches
+    padarray(smooth_init, psf_radius, 'symmetric', 'both')
+    (admm_solve_conv2D_weighted_sampling.m:25).
+    """
+    ndim_s = len(radius)
+    pad = [(0, 0)] * (x.ndim - ndim_s) + [(r, r) for r in radius]
+    if mode == "zero":
+        return jnp.pad(x, pad)
+    if mode == "symmetric":
+        return jnp.pad(x, pad, mode="symmetric")
+    raise ValueError(f"unknown pad mode {mode!r}")
+
+
+def crop_spatial(x: jnp.ndarray, radius: Sequence[int]) -> jnp.ndarray:
+    """Undo pad_spatial: crop radius from both sides of trailing axes."""
+    sl = [slice(None)] * (x.ndim - len(radius)) + [
+        slice(r, d - r) for r, d in zip(radius, x.shape[-len(radius):])
+    ]
+    return x[tuple(sl)]
+
+
+def circ_embed(
+    psf: jnp.ndarray, spatial_shape: Sequence[int]
+) -> jnp.ndarray:
+    """Zero-pad a centered filter to ``spatial_shape`` and roll its
+    center to the origin — the spatial-domain half of MATLAB psf2otf
+    (used at admm_solve_conv2D_weighted_sampling.m:161 and, written out
+    manually as padarray+circshift, at admm_learn_conv2D_large_dParallel.m:38-39).
+
+    The filter support occupies the trailing len(spatial_shape) axes.
+    """
+    ndim_s = len(spatial_shape)
+    support = psf.shape[-ndim_s:]
+    pad = [(0, 0)] * (psf.ndim - ndim_s) + [
+        (0, full - s) for full, s in zip(spatial_shape, support)
+    ]
+    x = jnp.pad(psf, pad)
+    shift = tuple(-(s // 2) for s in support)
+    return jnp.roll(x, shift, axis=tuple(range(x.ndim - ndim_s, x.ndim)))
+
+
+def circ_extract(
+    x: jnp.ndarray, support: Sequence[int]
+) -> jnp.ndarray:
+    """Inverse of circ_embed: roll the origin back to the filter center
+    and crop the support (KernelConstraintProj 'Get support' step,
+    admm_learn_conv2D_large_dParallel.m:208-209)."""
+    ndim_s = len(support)
+    axes = tuple(range(x.ndim - ndim_s, x.ndim))
+    shift = tuple(s // 2 for s in support)
+    rolled = jnp.roll(x, shift, axis=axes)
+    sl = [slice(None)] * (x.ndim - ndim_s) + [slice(0, s) for s in support]
+    return rolled[tuple(sl)]
+
+
+def psf2otf(
+    psf: jnp.ndarray, spatial_shape: Sequence[int]
+) -> jnp.ndarray:
+    """rfftn of the origin-centered embedding of ``psf``.
+
+    Matches MATLAB psf2otf up to the half-spectrum (reference:
+    admm_solve_conv2D_weighted_sampling.m:155-162).
+    """
+    return rfftn_spatial(circ_embed(psf, spatial_shape), len(spatial_shape))
+
+
+def freq_flatten(xh: jnp.ndarray, ndim_s: int) -> jnp.ndarray:
+    """Collapse the trailing ndim_s frequency axes into one F axis."""
+    return xh.reshape(*xh.shape[: xh.ndim - ndim_s], -1)
+
+
+def freq_unflatten(
+    xf: jnp.ndarray, freq_shape: Sequence[int]
+) -> jnp.ndarray:
+    return xf.reshape(*xf.shape[:-1], *freq_shape)
+
+
+def rfreq_shape(spatial_shape: Sequence[int]) -> Tuple[int, ...]:
+    s = tuple(spatial_shape)
+    return (*s[:-1], s[-1] // 2 + 1)
+
+
+def apply_dictionary(
+    dhat: jnp.ndarray, zhat: jnp.ndarray
+) -> jnp.ndarray:
+    """Dz in the frequency domain.
+
+    dhat: [k, W, F] filter spectra; zhat: [n, k, F] code spectra
+    -> [n, W, F] reconstruction spectra. This is the
+    ``sum(dhat .* z_hat, 3)`` of the reference
+    (admm_solve_conv2D_weighted_sampling.m:84) generalized to the
+    wavelength/angular-shared-code case
+    (2-3D admm_learn.m:108, 4D :252-261), expressed as one einsum so
+    XLA maps it onto the MXU as a batched matmul over frequencies.
+    """
+    return jnp.einsum("kwf,nkf->nwf", dhat, zhat)
+
+
+def apply_dictionary_adjoint(
+    dhat: jnp.ndarray, rhat: jnp.ndarray
+) -> jnp.ndarray:
+    """D^H r: dhat [k, W, F], rhat [n, W, F] -> [n, k, F]."""
+    return jnp.einsum("kwf,nwf->nkf", jnp.conj(dhat), rhat)

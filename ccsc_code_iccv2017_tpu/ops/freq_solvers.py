@@ -1,0 +1,170 @@
+"""Per-frequency linear solvers — the hot path of CCSC.
+
+After FFT diagonalization both ADMM subproblems decouple into one tiny
+linear system per frequency (SURVEY.md section 0):
+
+- z-subproblem: (Gamma + A_f^H A_f) x_f = rhs_f with A_f the W x K
+  matrix of filter spectra at frequency f (W = prod(reduce_shape); W=1
+  when the FFT covers all data dims, making the system rank-1 and the
+  reference's Sherman-Morrison closed form exact —
+  solve_conv_term_Z, 2D/admm_learn_conv2D_large_dParallel.m:278-303).
+- d-subproblem: (rho I_K + Z_f^H Z_f) x_f = rhs_f with Z_f the Ni x K
+  matrix of code spectra, inverted by the Woodbury identity through a
+  Ni x Ni system (precompute_H_hat_D, dParallel.m:221-237).
+
+DESIGN DIVERGENCE (documented, deliberate): for W > 1 the reference
+replaces the exact K x K solve by a scalar diagonal approximation
+(2-3D/DictionaryLearning/admm_learn.m:317-319, 4D lightfield :327-332,
+video deblur admm_solve_video_weighted_sampling.m:155-156, and the
+per-channel variant in admm_solve_conv_poisson.m:185-186). We solve the
+subproblem EXACTLY via the Woodbury identity with a W x W inner system
+— same asymptotic cost, strictly better ADMM subproblem accuracy.
+
+TPU note: batched complex Hermitian factorizations are routed through a
+real 2m x 2m block embedding ([[Re,-Im],[Im,Re]] is symmetric PD when
+the complex matrix is Hermitian PD), because XLA's TPU linalg lowering
+is real-only. The per-frequency applications themselves are einsums —
+batched matmuls on the MXU.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+
+def hermitian_inverse(G: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of a batch of Hermitian positive-definite complex
+    matrices via the real block embedding (TPU-safe).
+
+    G: [..., m, m] complex -> G^{-1} [..., m, m] complex.
+    """
+    m = G.shape[-1]
+    re, im = jnp.real(G), jnp.imag(G)
+    top = jnp.concatenate([re, -im], axis=-1)
+    bot = jnp.concatenate([im, re], axis=-1)
+    R = jnp.concatenate([top, bot], axis=-2)  # [..., 2m, 2m] sym PD
+    eye = jnp.broadcast_to(jnp.eye(2 * m, dtype=R.dtype), R.shape)
+    Rinv = jnp.linalg.solve(R, eye)
+    return Rinv[..., :m, :m] + 1j * Rinv[..., m:, :m]
+
+
+class ZSolveKernel(NamedTuple):
+    """Precomputed spectra for the z-subproblem solve.
+
+    Precomputed once per dictionary update (the reference's
+    precompute_H_hat_Z, dParallel.m:239-250) and reused across all
+    inner ADMM iterations.
+
+    dhat:      [K, W, F] filter spectra.
+    dinv:      [K, F] real — 1/diag(Gamma), Gamma_k(f) = rho + extra_k(f).
+    minv:      [F, W, W] complex — (I_W + A Gamma^{-1} A^H)^{-1};
+               None when W == 1 (scalar path).
+    minv_diag: [F] real — the W == 1 scalar 1/(1 + sum_k |d_k|^2/Gamma_k);
+               None when W > 1.
+    """
+
+    dhat: jnp.ndarray
+    dinv: jnp.ndarray
+    minv: Optional[jnp.ndarray]
+    minv_diag: Optional[jnp.ndarray]
+
+
+def precompute_z_kernel(
+    dhat: jnp.ndarray,
+    rho: float,
+    extra_diag: Optional[jnp.ndarray] = None,
+) -> ZSolveKernel:
+    """Build the per-frequency inverse factors for the z-solve.
+
+    dhat: [K, W, F]; extra_diag: optional [K, F] real, added to rho on
+    the diagonal (gradient regularization of the dirac channel in the
+    Poisson solver, admm_solve_conv_poisson.m:165-176).
+    """
+    K, W, F = dhat.shape
+    gamma = rho + (extra_diag if extra_diag is not None else 0.0)
+    gamma = jnp.broadcast_to(jnp.asarray(gamma, jnp.float32), (K, F))
+    dinv = 1.0 / gamma
+    if W == 1:
+        # scalar inner system: 1 + sum_k |d_k|^2 / Gamma_k
+        m = 1.0 + jnp.sum(
+            (jnp.abs(dhat[:, 0, :]) ** 2) * dinv, axis=0
+        )
+        return ZSolveKernel(dhat, dinv, None, 1.0 / m)
+    # M_f = I_W + A Gamma^{-1} A^H, A = dhat[:, :, f].T (W x K)
+    M = jnp.einsum("kvf,kf,kwf->fvw", dhat, dinv, jnp.conj(dhat))
+    M = M + jnp.eye(W, dtype=M.dtype)
+    return ZSolveKernel(dhat, dinv, hermitian_inverse(M), None)
+
+
+def solve_z(
+    kernel: ZSolveKernel,
+    xi1_hat: jnp.ndarray,
+    xi2_hat: jnp.ndarray,
+    rho: float,
+) -> jnp.ndarray:
+    """Solve (Gamma + A^H A) x = A^H xi1 + rho * xi2 per frequency.
+
+    xi1_hat: [N, W, F] data-side target spectra; xi2_hat: [N, K, F]
+    sparsity-side target spectra -> [N, K, F] code spectra.
+
+    Woodbury: x = Ginv rhs - Ginv A^H Minv A Ginv rhs, Ginv = Gamma^{-1}.
+    Exact generalization of the reference's Sherman-Morrison
+    (solve_conv_term, admm_solve_conv2D_weighted_sampling.m:170-190).
+    """
+    dhat, dinv = kernel.dhat, kernel.dinv
+    rhs = jnp.einsum("kwf,nwf->nkf", jnp.conj(dhat), xi1_hat) + rho * xi2_hat
+    g = dinv[None] * rhs  # Gamma^{-1} rhs, [N, K, F]
+    t = jnp.einsum("kwf,nkf->nwf", dhat, g)  # A Ginv rhs
+    if kernel.minv is None:
+        s = kernel.minv_diag[None, None, :] * t
+    else:
+        s = jnp.einsum("fvw,nwf->nvf", kernel.minv, t)
+    return g - dinv[None] * jnp.einsum("kwf,nwf->nkf", jnp.conj(dhat), s)
+
+
+class DSolveKernel(NamedTuple):
+    """Precomputed factors for the d-subproblem (dictionary update).
+
+    zhat: [Ni, K, F] code spectra of the local consensus block.
+    ginv: [F, Ni, Ni] complex — (rho I_Ni + Z Z^H)^{-1}, the Woodbury
+          inner inverse (reference precompute_H_hat_D keeps the full
+          K x K inverse per frequency, dParallel.m:235; keeping the
+          Ni x Ni factor and applying Z/Z^H as einsums is both smaller
+          for K > Ni and MXU-batched).
+    """
+
+    zhat: jnp.ndarray
+    ginv: jnp.ndarray
+
+
+def precompute_d_kernel(zhat: jnp.ndarray, rho: float) -> DSolveKernel:
+    """zhat: [Ni, K, F]."""
+    Ni = zhat.shape[0]
+    G = jnp.einsum("nkf,mkf->fnm", zhat, jnp.conj(zhat))
+    G = G + rho * jnp.eye(Ni, dtype=G.dtype)
+    return DSolveKernel(zhat, hermitian_inverse(G))
+
+
+def solve_d(
+    kernel: DSolveKernel,
+    b_hat: jnp.ndarray,
+    xi_hat: jnp.ndarray,
+    rho: float,
+) -> jnp.ndarray:
+    """Solve (rho I_K + Z^H Z) x = Z^H b + rho * xi per frequency.
+
+    b_hat: [Ni, W, F] data spectra; xi_hat: [K, W, F] target filter
+    spectra -> [K, W, F] new filter spectra. The W axis is a pure batch
+    axis here: wavelength/angular filter slices share the same code
+    Gram (2-3D admm_learn.m:289-295 reuses one ``opt`` per frequency
+    across all sw wavelengths).
+
+    Woodbury: x = (r - Z^H (rho I + Z Z^H)^{-1} Z r) / rho with
+    r = Z^H b + rho * xi  (solve_conv_term_D, dParallel.m:252-276).
+    """
+    zhat, ginv = kernel.zhat, kernel.ginv
+    r = jnp.einsum("nkf,nwf->kwf", jnp.conj(zhat), b_hat) + rho * xi_hat
+    t = jnp.einsum("nkf,kwf->nwf", zhat, r)
+    s = jnp.einsum("fnm,mwf->nwf", ginv, t)
+    return (r - jnp.einsum("nkf,nwf->kwf", jnp.conj(zhat), s)) / rho

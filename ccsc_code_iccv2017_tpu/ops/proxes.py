@@ -1,0 +1,94 @@
+"""Proximal operators of the CCSC objective, dimension-generic.
+
+Each of these exists in 4-9 near-identical copies across the reference
+solver files (SURVEY.md section 2.6); here each is implemented once as a
+pure jittable function.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from . import fourier
+
+
+def soft_threshold(u: jnp.ndarray, theta) -> jnp.ndarray:
+    """l1 prox: max(0, 1 - theta/|u|) .* u
+    (ProxSparse, 2D/admm_learn_conv2D_large_dParallel.m:32).
+
+    Written multiplication-free in |u| to avoid the 0/0 at u == 0.
+    """
+    return jnp.sign(u) * jnp.maximum(jnp.abs(u) - theta, 0.0)
+
+
+def kernel_constraint_proj(
+    d_full: jnp.ndarray,
+    support: Sequence[int],
+    spatial_shape: Sequence[int],
+    norm_over_reduce: bool = False,
+) -> jnp.ndarray:
+    """Project full-domain filters onto {supp(d) in support, ||d|| <= 1}.
+
+    Mirrors KernelConstraintProj (admm_learn_conv2D_large_dParallel.m:
+    201-219): extract the centered support, scale each filter onto the
+    unit l2 ball if outside it, re-embed at the origin.
+
+    d_full: [k, *reduce, *spatial_padded]. The reference norms over the
+    spatial dims only, so each (filter, reduce-slice) is projected
+    independently (2-3D admm_learn.m:246 norms per wavelength slice);
+    ``norm_over_reduce=True`` instead norms jointly over reduce+spatial
+    (one ball per filter).
+    """
+    ndim_s = len(support)
+    d_sup = fourier.circ_extract(d_full, support)
+    if norm_over_reduce:
+        axes = tuple(range(1, d_sup.ndim))
+    else:
+        axes = tuple(range(d_sup.ndim - ndim_s, d_sup.ndim))
+    sq = jnp.sum(d_sup * d_sup, axis=axes, keepdims=True)
+    scale = jnp.where(sq >= 1.0, 1.0 / jnp.sqrt(jnp.maximum(sq, 1e-30)), 1.0)
+    d_proj = d_sup * scale
+    return fourier.circ_embed(d_proj, spatial_shape)
+
+
+def masked_quadratic_prox(
+    u: jnp.ndarray, theta, MtM: jnp.ndarray, Mtb: jnp.ndarray
+) -> jnp.ndarray:
+    """Weighted data prox (Mtb + u/theta) ./ (MtM + 1/theta)
+    (ProxDataMasked, admm_solve_conv2D_weighted_sampling.m:29).
+
+    MtM is the padded squared mask, Mtb the padded masked data (with any
+    smooth-init offset already subtracted, :146-153).
+    """
+    return (Mtb + u / theta) / (MtM + 1.0 / theta)
+
+
+def poisson_prox(
+    u: jnp.ndarray, theta, mask: jnp.ndarray, I_padded: jnp.ndarray
+) -> jnp.ndarray:
+    """Exact Poisson negative-log-likelihood prox on observed pixels,
+    identity elsewhere (prox_data_masked,
+    2D/Poisson_deconv/admm_solve_conv_poisson.m:193-205):
+
+        p = 0.5 * (u - theta + sqrt((u - theta)^2 + 4 theta I))
+    """
+    p = 0.5 * (u - theta + jnp.sqrt((u - theta) ** 2 + 4.0 * theta * I_padded))
+    return jnp.where(mask > 0, p, u)
+
+
+def skip_channels(
+    u_proxed: jnp.ndarray, u_raw: jnp.ndarray, channel_mask: Optional[jnp.ndarray]
+) -> jnp.ndarray:
+    """Pass selected filter channels through un-proxed.
+
+    The Poisson solver exempts the appended dirac channel from the
+    sparsity prox (admm_solve_conv_poisson.m:84). channel_mask is a
+    [k] bool array, True = apply prox. u_* have the channel axis at
+    position 1 ([n, k, *spatial]).
+    """
+    if channel_mask is None:
+        return u_proxed
+    shape = (1, -1) + (1,) * (u_proxed.ndim - 2)
+    m = channel_mask.reshape(shape)
+    return jnp.where(m, u_proxed, u_raw)

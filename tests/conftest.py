@@ -1,0 +1,23 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; XLA's host-platform
+device-count flag is the fake cluster (SURVEY.md section 4).
+
+Note: this image's sitecustomize force-registers the experimental
+'axon' TPU platform before conftest runs, so setting JAX_PLATFORMS in
+the environment is not enough — we override via jax.config, which works
+as long as no backend has been initialized yet.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
