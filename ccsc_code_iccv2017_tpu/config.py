@@ -133,10 +133,12 @@ class SolveConfig:
     tol: float = 1e-3
     gamma_factor: float = 60.0
     gamma_ratio: float = 100.0
-    # Scale the quadratic coupling rho by the reduce size (sw), as the
-    # reference does for wavelength/angular-shared codes
-    # (2-3D admm_learn.m:311, demosaic :126).
-    scale_rho_by_reduce: bool = True
+    # Compat flag: scale the quadratic coupling rho by the reduce size
+    # (sw) as the reference does for wavelength/angular-shared codes
+    # (2-3D admm_learn.m:311, demosaic :126). Off by default — our
+    # exact Woodbury z-solve needs no such compensation (the reference
+    # pairs the scaling with a diagonal-approximate solve).
+    scale_rho_by_reduce: bool = False
     # Gradient smoothness weight on the dirac channel (Poisson deconv,
     # admm_solve_conv_poisson.m:174).
     lambda_smooth: float = 0.5

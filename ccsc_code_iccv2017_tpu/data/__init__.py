@@ -1,0 +1,3 @@
+from . import images
+
+__all__ = ["images"]

@@ -1,0 +1,165 @@
+"""Image dataset loading and contrast normalization.
+
+Rebuild of the reference's image_helpers/CreateImages.m (725 LoC, a
+single function with a mode switch) as small composable numpy
+functions. The modes actually exercised by the reference drivers are
+'none' (reconstruction apps), 'local_cn' (2D learning,
+learn_kernels_2D_large.m:8-11) and the global ZERO_MEAN flag
+(CreateImages.m:652-657); the whitening family lives in
+data.whitening.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".tif", ".tiff", ".ppm", ".pgm")
+
+
+def gaussian_kernel(size: int = 13, sigma: float = 3 * 1.591) -> np.ndarray:
+    """MATLAB fspecial('gaussian',[13 13],3*1.591)
+    (CreateImages.m:306) — the local_cn smoothing kernel."""
+    r = (size - 1) / 2
+    y, x = np.mgrid[-r : r + 1, -r : r + 1]
+    k = np.exp(-(x * x + y * y) / (2.0 * sigma * sigma))
+    return (k / k.sum()).astype(np.float64)
+
+
+def rconv2(x: np.ndarray, k: np.ndarray) -> np.ndarray:
+    """'same' 2-D convolution with reflected-edge padding
+    (image_helpers/rconv2.m:47-58)."""
+    from scipy.signal import convolve2d
+
+    ry, rx = k.shape[0] // 2, k.shape[1] // 2
+    xp = np.pad(x, ((ry, ry), (rx, rx)), mode="symmetric")
+    return convolve2d(xp, k, mode="valid")
+
+
+def local_contrast_normalize(img: np.ndarray) -> np.ndarray:
+    """The reference's 'local_cn' mode (CreateImages.m:299-370):
+    subtract a local Gaussian mean and divide by a local std that is
+    floored at its own median (median of nonzeros if the median is 0).
+    """
+    k = gaussian_kernel()
+    dim = img.astype(np.float64)
+    lmn = rconv2(dim, k)
+    lmnsq = rconv2(dim * dim, k)
+    lvar = np.maximum(lmnsq - lmn * lmn, 0.0)
+    lstd = np.sqrt(lvar)
+    th = np.median(lstd)
+    if th == 0:
+        nz = lstd[lstd > 0]
+        th = np.median(nz) if nz.size else 0.0
+    lstd = np.maximum(lstd, th)
+    lstd[lstd == 0] = np.finfo(np.float64).eps
+    return ((dim - lmn) / lstd).astype(np.float32)
+
+
+def to_gray(img: np.ndarray) -> np.ndarray:
+    """rgb2gray with MATLAB's ITU-R 601 weights (CreateImages.m:266-277),
+    output in [0, 1]."""
+    is_int = np.issubdtype(img.dtype, np.integer)
+    if img.ndim == 2:
+        g = img.astype(np.float32)
+    else:
+        w = np.array([0.2989, 0.5870, 0.1140], np.float32)
+        g = img[..., :3].astype(np.float32) @ w
+    if is_int:
+        g = g / 255.0
+    return g
+
+
+def _list_image_files(path: str) -> List[str]:
+    files = [
+        f
+        for f in sorted(os.listdir(path))
+        if f.lower().endswith(IMG_EXTS)
+    ]
+    # numeric-aware sort so 2.jpg < 10.jpg, like MATLAB dir listings of
+    # the shipped fixtures (2D/Inpainting/Test/0..9.jpg)
+    def keyf(f):
+        stem = os.path.splitext(f)[0]
+        return (0, int(stem)) if stem.isdigit() else (1, stem)
+
+    try:
+        files.sort(key=keyf)
+    except ValueError:
+        pass
+    return [os.path.join(path, f) for f in files]
+
+
+def load_image_list(
+    path: str,
+    contrast_normalize: str = "none",
+    zero_mean: bool = False,
+    color: str = "gray",
+    limit: Optional[int] = None,
+) -> List[np.ndarray]:
+    """Load a folder of images as a list of [H, W] float32 arrays —
+    the CreateImagesList.m variant, for images of differing sizes
+    (used by the Poisson driver, reconstruct_poisson_noise.m:15)."""
+    from PIL import Image
+
+    out = []
+    for f in _list_image_files(path)[: limit if limit else None]:
+        img = np.asarray(Image.open(f))
+        if color == "gray":
+            img = to_gray(img)
+        else:
+            raise NotImplementedError(f"color mode {color!r}")
+        if contrast_normalize == "local_cn":
+            img = local_contrast_normalize(img)
+        elif contrast_normalize != "none":
+            raise NotImplementedError(
+                f"contrast mode {contrast_normalize!r}"
+            )
+        if zero_mean:
+            img = img - img.mean()
+        out.append(img.astype(np.float32))
+    return out
+
+
+def load_images(
+    path: str,
+    contrast_normalize: str = "none",
+    zero_mean: bool = False,
+    color: str = "gray",
+    square: bool = False,
+    limit: Optional[int] = None,
+    size: Optional[Sequence[int]] = None,
+) -> np.ndarray:
+    """CreateImages.m equivalent: folder -> [n, H, W] float32.
+
+    ``square`` center-crops to the smaller dimension (the reference
+    pads, CreateImages.m:665-699; cropping avoids fabricating pixels);
+    ``size`` resizes after load.
+    """
+    imgs = load_image_list(path, contrast_normalize, zero_mean, color, limit)
+    if size is not None:
+        from PIL import Image
+
+        imgs = [
+            np.asarray(
+                Image.fromarray(i).resize(
+                    (size[1], size[0]), Image.BILINEAR
+                )
+            )
+            for i in imgs
+        ]
+    if square:
+        imgs2 = []
+        for i in imgs:
+            s = min(i.shape)
+            y0 = (i.shape[0] - s) // 2
+            x0 = (i.shape[1] - s) // 2
+            imgs2.append(i[y0 : y0 + s, x0 : x0 + s])
+        imgs = imgs2
+    shapes = {i.shape for i in imgs}
+    if len(shapes) > 1:
+        raise ValueError(
+            f"images differ in size {shapes}; use load_image_list or "
+            "square/size options"
+        )
+    return np.stack(imgs).astype(np.float32)

@@ -1,0 +1,13 @@
+from . import common, learn, reconstruct
+from .learn import LearnResult, learn as learn_dictionary
+from .reconstruct import ReconResult, ReconstructionProblem, reconstruct
+
+__all__ = [
+    "common",
+    "learn",
+    "reconstruct",
+    "LearnResult",
+    "learn_dictionary",
+    "ReconResult",
+    "ReconstructionProblem",
+]

@@ -1,0 +1,120 @@
+"""Shared model-level plumbing: problem setup, spectra, objectives.
+
+Everything here is layout glue between the user-facing arrays
+(config.ProblemGeom layouts) and the frequency-flat forms the
+ops.freq_solvers consume.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..config import ProblemGeom
+from ..ops import fourier
+
+
+class FreqGeom(NamedTuple):
+    """Static frequency-domain geometry for one problem instance."""
+
+    spatial_shape: Tuple[int, ...]  # padded spatial shape
+    freq_shape: Tuple[int, ...]  # rfft spectrum shape
+    num_freq: int  # F = prod(freq_shape)
+    reduce_shape: Tuple[int, ...]
+    reduce_size: int  # W
+
+    @classmethod
+    def create(
+        cls, geom: ProblemGeom, data_spatial: Sequence[int], pad: bool = True
+    ) -> "FreqGeom":
+        sp = (
+            geom.padded_shape(tuple(data_spatial))
+            if pad
+            else tuple(data_spatial)
+        )
+        fs = fourier.rfreq_shape(sp)
+        import math
+
+        return cls(sp, fs, math.prod(fs), geom.reduce_shape, geom.reduce_size)
+
+
+def filters_to_freq(d: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
+    """Support-domain filters [k, *reduce, *support] -> dhat [k, W, F]."""
+    dh = fourier.psf2otf(d, fg.spatial_shape)
+    ndim_s = len(fg.spatial_shape)
+    k = d.shape[0]
+    return dh.reshape(k, fg.reduce_size, fg.num_freq)
+
+
+def full_filters_to_freq(d_full: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
+    """Full-domain (origin-centered) filters [k, *reduce, *spatial] ->
+    dhat [k, W, F]."""
+    ndim_s = len(fg.spatial_shape)
+    dh = fourier.rfftn_spatial(d_full, ndim_s)
+    return dh.reshape(d_full.shape[0], fg.reduce_size, fg.num_freq)
+
+
+def data_to_freq(b_pad: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
+    """Padded data [n, *reduce, *spatial] -> bhat [n, W, F]."""
+    ndim_s = len(fg.spatial_shape)
+    bh = fourier.rfftn_spatial(b_pad, ndim_s)
+    return bh.reshape(b_pad.shape[0], fg.reduce_size, fg.num_freq)
+
+
+def codes_to_freq(z: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
+    """Codes [n, k, *spatial] -> zhat [n, k, F]."""
+    zh = fourier.rfftn_spatial(z, len(fg.spatial_shape))
+    return zh.reshape(z.shape[0], z.shape[1], fg.num_freq)
+
+
+def codes_from_freq(zhat: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
+    zh = zhat.reshape(*zhat.shape[:-1], *fg.freq_shape)
+    return fourier.irfftn_spatial(zh, fg.spatial_shape)
+
+
+def recon_from_freq(
+    dhat: jnp.ndarray, zhat: jnp.ndarray, fg: FreqGeom
+) -> jnp.ndarray:
+    """Dz in real space: [n, *reduce, *spatial] (reduce axes restored)."""
+    Dzh = fourier.apply_dictionary(dhat, zhat)  # [n, W, F]
+    Dzh = Dzh.reshape(Dzh.shape[0], *fg.reduce_shape, *fg.freq_shape)
+    return fourier.irfftn_spatial(Dzh, fg.spatial_shape)
+
+
+def data_fidelity(
+    Dz: jnp.ndarray,
+    b: jnp.ndarray,
+    radius: Sequence[int],
+    lambda_residual: float,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """lambda_res/2 * || mask .* (crop(Dz) - b) ||^2
+    (objectiveFunction, 2D/admm_learn_conv2D_large_dParallel.m:305-324).
+    """
+    r = fourier.crop_spatial(Dz, radius) - b
+    if mask is not None:
+        r = mask * r
+    return 0.5 * lambda_residual * jnp.sum(r * r)
+
+
+def l1_penalty(z: jnp.ndarray, lambda_prior: float) -> jnp.ndarray:
+    return lambda_prior * jnp.sum(jnp.abs(z))
+
+
+def rel_change(new: jnp.ndarray, old: jnp.ndarray) -> jnp.ndarray:
+    """||new - old|| / ||new|| — the reference's termination metric
+    (dParallel.m:186-188)."""
+    return jnp.linalg.norm((new - old).ravel()) / jnp.maximum(
+        jnp.linalg.norm(new.ravel()), 1e-30
+    )
+
+
+def psnr(x: jnp.ndarray, ref: jnp.ndarray, crop: Sequence[int] = ()) -> jnp.ndarray:
+    """PSNR against a [0,1] reference, optionally cropping a border as
+    the reference does (admm_solve_conv2D_weighted_sampling.m:109-121).
+    """
+    if crop:
+        x = fourier.crop_spatial(x, crop)
+        ref = fourier.crop_spatial(ref, crop)
+    mse = jnp.mean((x - ref) ** 2)
+    return 10.0 * jnp.log10(1.0 / jnp.maximum(mse, 1e-12))

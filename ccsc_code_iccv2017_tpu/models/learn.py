@@ -1,0 +1,275 @@
+"""Consensus dictionary learning, dimension-generic and mesh-parallel.
+
+One learner covers the reference's four (2D/2-3D/3D/4D) 350-430 line
+learner files (SURVEY.md section 2.1) via config.ProblemGeom. The
+algorithm is the block-consensus ADMM of
+2D/admm_learn_conv2D_large_dzParallel.m (the memory-bounded "real CCSC"
+variant, which keeps codes block-local — SURVEY.md section 7 picks it
+as the one to generalize):
+
+outer iteration i (dzParallel.m:90-194):
+  d-pass  — precompute per-block code Grams (:96-100), then max_it_d
+            consensus iterations: global kernel prox on Dbar+Udbar
+            (:107), per-block dual update + Woodbury solve (:110-113),
+            consensus average (:115-121).
+  z-pass  — precompute filter spectra (:142-144), then max_it_z
+            per-block sparse-coding iterations: soft-threshold prox,
+            dual update, Sherman-Morrison/Woodbury solve (:150-158).
+
+Parallel structure: each device holds L = N/ndev consensus blocks as a
+leading axis; per-block solves are (unnamed) vmaps over L, and the
+consensus average is a local mean over L followed by one `lax.psum`
+over the mesh axis 'block' — the all-reduce that rides ICI
+(SURVEY.md section 2.5 maps dzParallel.m:115-121 to exactly this). On a
+single device (no mesh) the same code runs with the psum elided.
+
+Both inner loops are `lax.scan`s so an entire outer step jits into one
+XLA program.
+
+DOCUMENTED DIVERGENCES (intent over bug, SURVEY.md section 5): the
+z-pass codes against the projected consensus dictionary rather than
+block 1's local unprojected copy (dzParallel.m:143 uses dup{1}); the
+objective sums residuals over ALL blocks rather than only the
+loop-escaped last block (dzParallel.m:320); each block gets an
+independent random z init rather than one shared randn
+(dzParallel.m:44-47).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import LearnConfig, ProblemGeom
+from ..ops import fourier, freq_solvers, proxes
+from . import common
+
+
+class LearnState(NamedTuple):
+    """Learner state on one device. Block-local fields carry a leading
+    local-block axis [L, ...]; consensus fields (dbar/udbar) do not —
+    they are replicated across the mesh."""
+
+    d_local: jnp.ndarray  # [L, k, *reduce, *spatial] full-domain filters
+    dual_d: jnp.ndarray  # [L, k, *reduce, *spatial]
+    dbar: jnp.ndarray  # [k, *reduce, *spatial] consensus average
+    udbar: jnp.ndarray  # [k, *reduce, *spatial] consensus dual average
+    z: jnp.ndarray  # [L, ni, k, *spatial] block-local codes
+    dual_z: jnp.ndarray  # [L, ni, k, *spatial]
+
+
+class OuterMetrics(NamedTuple):
+    obj_d: jnp.ndarray  # global objective after the d-pass
+    obj_z: jnp.ndarray  # global objective after the z-pass
+    d_diff: jnp.ndarray  # rel change of the consensus dictionary
+    z_diff: jnp.ndarray  # rel change of codes (global norm)
+
+
+def init_state(
+    key: jax.Array,
+    geom: ProblemGeom,
+    fg: common.FreqGeom,
+    num_blocks: int,
+    ni: int,
+    dtype=jnp.float32,
+) -> LearnState:
+    """Random init matching the reference's shapes: randn filters
+    embedded at the origin (dzParallel.m:38-42), randn codes (:44-47),
+    zero duals (:79-86). Returns global state with the FULL block axis
+    [N, ...]; the driver reshapes to [ndev, L, ...] sharding as needed.
+    """
+    kd, kz = jax.random.split(key)
+    d0 = jax.random.normal(kd, geom.filter_shape, dtype)
+    d_full = fourier.circ_embed(d0, fg.spatial_shape)
+    d_locals = jnp.broadcast_to(d_full, (num_blocks, *d_full.shape))
+    z0 = jax.random.normal(
+        kz, (num_blocks, ni, geom.num_filters, *fg.spatial_shape), dtype
+    )
+    return LearnState(
+        d_locals,
+        jnp.zeros_like(d_locals),
+        d_full,
+        jnp.zeros_like(d_full),
+        z0,
+        jnp.zeros_like(z0),
+    )
+
+
+def _psum(x, axis_name: Optional[str]):
+    return x if axis_name is None else jax.lax.psum(x, axis_name)
+
+
+def outer_step(
+    state: LearnState,
+    b_blocks: jnp.ndarray,
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    fg: common.FreqGeom,
+    num_blocks: int,
+    axis_name: Optional[str] = None,
+) -> Tuple[LearnState, OuterMetrics]:
+    """One outer consensus iteration over this device's L local blocks.
+
+    b_blocks: [L, ni, *reduce, *data_spatial] (unpadded). ``num_blocks``
+    is the GLOBAL block count N; with a mesh, L = N / num_devices and
+    cross-device coupling is the psum over ``axis_name``.
+    """
+    support = geom.spatial_support
+    radius = geom.psf_radius
+
+    b_pad = fourier.pad_spatial(b_blocks, radius)
+    bhat = jax.vmap(lambda bp: common.data_to_freq(bp, fg))(b_pad)  # [L,ni,W,F]
+
+    prox_kernel = lambda u: proxes.kernel_constraint_proj(
+        u, support, fg.spatial_shape
+    )
+
+    def objective(z, dhat):
+        def one(zl, bl):
+            zhat = common.codes_to_freq(zl, fg)
+            Dz = common.recon_from_freq(dhat, zhat, fg)
+            return common.data_fidelity(
+                Dz, bl, radius, cfg.lambda_residual
+            ) + common.l1_penalty(zl, cfg.lambda_prior)
+
+        return _psum(jnp.sum(jax.vmap(one)(z, b_blocks)), axis_name)
+
+    # ---------------- d-pass (dzParallel.m:95-135) -------------------
+    zhat = jax.vmap(lambda zl: common.codes_to_freq(zl, fg))(state.z)
+    dkern = jax.vmap(
+        lambda zh: freq_solvers.precompute_d_kernel(zh, cfg.rho_d)
+    )(zhat)
+
+    def consensus_mean(x_l):
+        """mean over ALL N blocks: local sum over L + psum over mesh."""
+        return _psum(jnp.sum(x_l, 0), axis_name) / num_blocks
+
+    def d_iter(carry, _):
+        d_local, dual_d, dbar, udbar = carry
+        u = prox_kernel(dbar + udbar)  # global prox (dzParallel.m:107)
+        dual_d = dual_d + (d_local - u[None])
+        xi_full = u[None] - dual_d  # [L, k, *red, *sp]
+        xi_hat = jax.vmap(lambda x: common.full_filters_to_freq(x, fg))(
+            xi_full
+        )
+        dhat = jax.vmap(
+            lambda kern, bh, xh: freq_solvers.solve_d(kern, bh, xh, cfg.rho_d)
+        )(dkern, bhat, xi_hat)
+        d_new = jax.vmap(lambda dh: _filters_from_freq(dh, fg))(dhat)
+        dbar_new = consensus_mean(d_new)  # the all-reduce (:115-121)
+        udbar_new = consensus_mean(dual_d)
+        return (d_new, dual_d, dbar_new, udbar_new), None
+
+    (d_local, dual_d, dbar, udbar), _ = jax.lax.scan(
+        d_iter,
+        (state.d_local, state.dual_d, state.dbar, state.udbar),
+        None,
+        length=cfg.max_it_d,
+    )
+    d_diff = common.rel_change(dbar, state.dbar)
+
+    # consensus dictionary used for coding (projected -> feasible)
+    d_proj = prox_kernel(dbar + udbar)
+    dhat_z = common.full_filters_to_freq(d_proj, fg)
+    obj_d = objective(state.z, dhat_z)
+
+    # ---------------- z-pass (dzParallel.m:140-172) ------------------
+    zkern = freq_solvers.precompute_z_kernel(dhat_z, cfg.rho_z)
+    theta = cfg.lambda_prior / cfg.rho_z
+
+    def z_iter(carry, _):
+        z, dual_z = carry
+        u2 = proxes.soft_threshold(z + dual_z, theta)
+        dual_z = dual_z + (z - u2)
+        xi2 = u2 - dual_z
+        xi2_hat = jax.vmap(lambda x: common.codes_to_freq(x, fg))(xi2)
+        zhat_new = jax.vmap(
+            lambda bh, xh: freq_solvers.solve_z(zkern, bh, xh, cfg.rho_z)
+        )(bhat, xi2_hat)
+        z_new = jax.vmap(lambda zh: common.codes_from_freq(zh, fg))(zhat_new)
+        return (z_new, dual_z), None
+
+    (z, dual_z), _ = jax.lax.scan(
+        z_iter, (state.z, state.dual_z), None, length=cfg.max_it_z
+    )
+    num = _psum(jnp.sum((z - state.z) ** 2), axis_name)
+    den = _psum(jnp.sum(z * z), axis_name)
+    z_diff = jnp.sqrt(num) / jnp.maximum(jnp.sqrt(den), 1e-30)
+    obj_z = objective(z, dhat_z)
+
+    new_state = LearnState(d_local, dual_d, dbar, udbar, z, dual_z)
+    return new_state, OuterMetrics(obj_d, obj_z, d_diff, z_diff)
+
+
+def eval_block(
+    state: LearnState,
+    b_blocks: jnp.ndarray,
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    fg: common.FreqGeom,
+    axis_name: Optional[str] = None,
+    with_outputs: bool = True,
+):
+    """(global objective, support filters, cropped per-block Dz).
+
+    ``with_outputs=False`` skips materializing the Dz reconstructions
+    (the largest tensors) for objective-only evaluations.
+    """
+    d_proj = proxes.kernel_constraint_proj(
+        state.dbar + state.udbar, geom.spatial_support, fg.spatial_shape
+    )
+    dhat = common.full_filters_to_freq(d_proj, fg)
+
+    def one(zl, bl):
+        zhat = common.codes_to_freq(zl, fg)
+        Dz = common.recon_from_freq(dhat, zhat, fg)
+        obj = common.data_fidelity(
+            Dz, bl, geom.psf_radius, cfg.lambda_residual
+        ) + common.l1_penalty(zl, cfg.lambda_prior)
+        if not with_outputs:
+            return obj, jnp.zeros((), Dz.dtype)
+        return obj, fourier.crop_spatial(Dz, geom.psf_radius)
+
+    objs, Dz = jax.vmap(one)(state.z, b_blocks)
+    obj = _psum(jnp.sum(objs), axis_name)
+    d_sup = extract_filters(d_proj, geom)
+    return obj, d_sup, Dz
+
+
+def _filters_from_freq(dhat: jnp.ndarray, fg: common.FreqGeom) -> jnp.ndarray:
+    """dhat [K, W, F] -> full-domain real filters [k, *reduce, *spatial]."""
+    dh = dhat.reshape(dhat.shape[0], *fg.reduce_shape, *fg.freq_shape)
+    return fourier.irfftn_spatial(dh, fg.spatial_shape)
+
+
+def extract_filters(dbar_proj: jnp.ndarray, geom: ProblemGeom) -> jnp.ndarray:
+    """Full-domain consensus filters -> support-domain [k,*reduce,*support]
+    (the final circshift+crop, dzParallel.m:202-203)."""
+    return fourier.circ_extract(dbar_proj, geom.spatial_support)
+
+
+class LearnResult(NamedTuple):
+    d: jnp.ndarray  # [k, *reduce, *support] learned filters
+    z: jnp.ndarray  # [N, ni, k, *spatial] final codes (block-major)
+    Dz: jnp.ndarray  # [n, *reduce, *data_spatial] reconstructions
+    trace: dict
+
+
+def learn(
+    b: jnp.ndarray,
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    key: Optional[jax.Array] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> LearnResult:
+    """Learn a filter bank from data b [n, *reduce, *data_spatial].
+
+    n is split into cfg.num_blocks consensus blocks. With ``mesh``
+    (1-D, axis 'block') blocks are sharded over devices and the
+    consensus average rides ICI; otherwise blocks run locally.
+    """
+    from ..parallel import consensus
+
+    return consensus.learn(b, geom, cfg, key=key, mesh=mesh)

@@ -1,0 +1,283 @@
+"""Generic CCSC reconstruction (sparse coding with fixed dictionary).
+
+One solver covers the reference's five reconstruction apps
+(SURVEY.md section 2.2) as configuration, not code:
+
+==================  =============================================
+Inpainting          gaussian data term + random mask
+                    (2D/Inpainting/admm_solve_conv2D_weighted_sampling.m)
+Poisson deconv      poisson data term + appended dirac channel with
+                    gradient regularization, no sparsity on dirac
+                    (2D/Poisson_deconv/admm_solve_conv_poisson.m)
+Demosaicing         gaussian + reduce dims (31 wavelengths) + no pad
+                    (2-3D/Demosaicing/admm_solve_conv23D_weighted_sampling.m)
+Video deblurring    gaussian + blur OTF composed into the solve
+                    operator + prepended dirac (3D data)
+                    (3D/Deblurring/admm_solve_video_weighted_sampling.m)
+View synthesis      demosaicing solver with 5x5 angular views in the
+                    wavelength role
+                    (4D/ViewSynthesis/admm_solve_conv_weighted_sampling_lf.m)
+==================  =============================================
+
+The ADMM skeleton is the reference's 2-function consensus form
+(admm_solve_conv2D_weighted_sampling.m:81-139): v1 = Dz (data side),
+v2 = z (sparsity side), scaled duals, and one exact per-frequency solve.
+
+DOCUMENTED DIVERGENCES from the reference (intent over bug, SURVEY.md
+section 5): (a) per-frequency solves are exact (see ops.freq_solvers);
+(b) the dirac channel itself gets the gradient regularization and the
+sparsity exemption — the reference applies both to filter channel 1
+while appending the dirac last (admm_solve_conv_poisson.m:84,175
+vs :7); (c) rho is not scaled by the reduce size since the exact
+Woodbury solve needs no such compensation (compat flag
+SolveConfig.scale_rho_by_reduce restores it).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ProblemGeom, SolveConfig
+from ..ops import fourier, freq_solvers, proxes
+from . import common
+
+
+@dataclasses.dataclass(frozen=True)
+class ReconstructionProblem:
+    """Static structure of a reconstruction app."""
+
+    geom: ProblemGeom
+    data_term: str = "gaussian"  # 'gaussian' | 'poisson'
+    dirac: str = "none"  # 'none' | 'append' | 'prepend'
+    grad_reg_dirac: bool = False
+    sparsify_dirac: bool = True
+    pad: bool = True  # demosaic/view-synth run unpadded (ref :5)
+    clamp_nonneg: bool = False  # poisson clamps negatives (ref :131)
+
+    def __post_init__(self):
+        if self.grad_reg_dirac and self.dirac == "none":
+            raise ValueError("grad_reg_dirac requires a dirac channel")
+        if not self.sparsify_dirac and self.dirac == "none":
+            raise ValueError("sparsify_dirac=False requires a dirac channel")
+
+
+class ReconTrace(NamedTuple):
+    obj_vals: jnp.ndarray  # [max_it + 1]
+    psnr_vals: jnp.ndarray  # [max_it + 1] (0 when x_orig is None)
+    diff_vals: jnp.ndarray  # [max_it + 1]
+    num_iters: jnp.ndarray  # scalar int
+
+
+class ReconResult(NamedTuple):
+    z: jnp.ndarray  # [n, k, *spatial_padded]
+    recon: jnp.ndarray  # [n, *reduce, *data_spatial]
+    trace: ReconTrace
+
+
+def _add_dirac(d: jnp.ndarray, geom: ProblemGeom, where: str) -> jnp.ndarray:
+    """Append/prepend an identity (dirac) filter channel
+    (admm_solve_conv_poisson.m:4-7, admm_solve_video_weighted_sampling.m:5-7).
+    """
+    shape = (1, *geom.reduce_shape, *geom.spatial_support)
+    center = tuple([0] * (1 + geom.ndim_reduce)) + tuple(
+        s // 2 for s in geom.spatial_support
+    )
+    dirac = jnp.zeros(shape, d.dtype).at[center].set(1.0)
+    return (
+        jnp.concatenate([d, dirac], 0)
+        if where == "append"
+        else jnp.concatenate([dirac, d], 0)
+    )
+
+
+def _grad_diag(fg: common.FreqGeom, lambda_smooth: float) -> jnp.ndarray:
+    """lambda_smooth * sum_dims |OTF(forward difference)|^2, flat [F]
+    (the TG term, admm_solve_conv_poisson.m:165-176)."""
+    ndim_s = len(fg.spatial_shape)
+    tg = jnp.zeros(fg.freq_shape, jnp.float32)
+    for ax in range(ndim_s):
+        shape = [1] * ndim_s
+        shape[ax] = 2
+        diff = jnp.array([1.0, -1.0]).reshape(shape)
+        otf = fourier.psf2otf(diff, fg.spatial_shape)
+        tg = tg + jnp.abs(otf) ** 2
+    return lambda_smooth * tg.reshape(-1)
+
+
+def reconstruct(
+    b: jnp.ndarray,
+    d: jnp.ndarray,
+    prob: ReconstructionProblem,
+    cfg: SolveConfig,
+    mask: Optional[jnp.ndarray] = None,
+    smooth_init: Optional[jnp.ndarray] = None,
+    blur_psf: Optional[jnp.ndarray] = None,
+    x_orig: Optional[jnp.ndarray] = None,
+) -> ReconResult:
+    """Solve the coding problem for a batch of observations.
+
+    b: [n, *reduce, *data_spatial] observations (masked entries can hold
+    anything; they are multiplied by the mask).
+    d: [k, *reduce, *support] dictionary (support domain).
+    mask: same shape as b; None = fully observed.
+    smooth_init: low-frequency offset subtracted before coding and added
+    back to the reconstruction (admm_solve_conv2D_weighted_sampling.m:25).
+    blur_psf: spatial PSF composed into the solve operator; the final
+    reconstruction uses the clean filters — this is what makes coding
+    deconvolve (admm_solve_video_weighted_sampling.m:109,124-132).
+    x_orig: ground truth for the PSNR trace.
+    """
+    geom = prob.geom
+    return _reconstruct_jit(
+        b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("prob", "cfg"))
+def _reconstruct_jit(
+    b, d, prob: ReconstructionProblem, cfg: SolveConfig, mask, smooth_init, blur_psf, x_orig
+):
+    geom = prob.geom
+    ndim_s = geom.ndim_spatial
+    data_spatial = b.shape[-ndim_s:]
+    radius = geom.psf_radius if prob.pad else (0,) * ndim_s
+    fg = common.FreqGeom.create(geom, data_spatial, pad=prob.pad)
+    n = b.shape[0]
+
+    if prob.dirac != "none":
+        d = _add_dirac(d, geom, prob.dirac)
+    K = d.shape[0]
+    dirac_idx = 0 if prob.dirac == "prepend" else K - 1
+
+    # --- spectra ----------------------------------------------------
+    dhat_clean = common.filters_to_freq(d, fg)  # [K, W, F]
+    if blur_psf is not None:
+        blur_otf = fourier.psf2otf(blur_psf, fg.spatial_shape).reshape(-1)
+        dhat_solve = dhat_clean * blur_otf[None, None, :]
+    else:
+        dhat_solve = dhat_clean
+
+    # --- data-side constants ---------------------------------------
+    M = (
+        jnp.ones_like(b)
+        if mask is None
+        else mask.astype(b.dtype)
+    )
+    B_pad = fourier.pad_spatial(b, radius)
+    M_pad = fourier.pad_spatial(M, radius)
+    smoothinit = (
+        fourier.pad_spatial(smooth_init, radius, mode="symmetric")
+        if smooth_init is not None
+        else jnp.zeros_like(B_pad)
+    )
+    if prob.data_term == "gaussian":
+        MtM = M_pad * M_pad
+        Mtb = B_pad * M_pad - smoothinit * M_pad
+    else:  # poisson keeps raw counts (admm_solve_conv_poisson.m:135-141)
+        MtM = M_pad
+        Mtb = B_pad * M_pad
+
+    # --- gamma heuristic (per-app constants, SolveConfig docstring) -
+    # max over OBSERVED data only: masked entries of b may hold anything
+    g = cfg.gamma_factor * cfg.lambda_prior / jnp.maximum(jnp.max(M * b), 1e-30)
+    gamma1 = g / cfg.gamma_ratio
+    gamma2 = g
+    rho = cfg.gamma_ratio * (fg.reduce_size if cfg.scale_rho_by_reduce else 1.0)
+    # rho = gamma2/gamma1 is a static python float only if gamma_ratio
+    # static; gamma cancels in the ratio so rho is static. Weights of
+    # the two prox terms stay dynamic (depend on max(b)).
+
+    extra_diag = None
+    if prob.grad_reg_dirac:
+        tg = _grad_diag(fg, cfg.lambda_smooth)  # [F]
+        extra_diag = jnp.zeros((K, fg.num_freq)).at[dirac_idx].set(tg)
+
+    kern = freq_solvers.precompute_z_kernel(dhat_solve, rho, extra_diag)
+
+    channel_mask = None
+    if not prob.sparsify_dirac and prob.dirac != "none":
+        channel_mask = jnp.ones((K,), bool).at[dirac_idx].set(False)
+
+    theta1 = cfg.lambda_residual / gamma1
+    theta2 = cfg.lambda_prior / gamma2
+
+    def data_prox(u):
+        if prob.data_term == "gaussian":
+            return proxes.masked_quadratic_prox(u, theta1, MtM, Mtb)
+        return proxes.poisson_prox(u, theta1, MtM, Mtb)
+
+    def Dz_real(zhat, dhat):
+        return common.recon_from_freq(dhat, zhat, fg)
+
+    def objective(z, zhat):
+        Dz = Dz_real(zhat, dhat_solve)
+        r = fourier.crop_spatial(Dz + smoothinit, radius) - b
+        r = fourier.crop_spatial(M_pad, radius) * r
+        return (
+            0.5 * cfg.lambda_residual * jnp.sum(r * r)
+            + cfg.lambda_prior * jnp.sum(jnp.abs(z))
+        )
+
+    def psnr_of(zhat):
+        if x_orig is None:
+            return jnp.float32(0.0)
+        Dz = Dz_real(zhat, dhat_clean) + smoothinit
+        rec = fourier.crop_spatial(Dz, radius)
+        return common.psnr(rec, x_orig, geom.psf_radius)
+
+    z_shape = (n, K, *fg.spatial_shape)
+    x_shape = (n, *geom.reduce_shape, *fg.spatial_shape)
+
+    def body(state):
+        i, z, zhat, d1, d2, obj_t, psnr_t, diff_t, _ = state
+        v1 = Dz_real(zhat, dhat_solve)
+        u1 = data_prox(v1 - d1)
+        u2_raw = z - d2
+        u2 = proxes.skip_channels(
+            proxes.soft_threshold(u2_raw, theta2), u2_raw, channel_mask
+        )
+        d1 = d1 - (v1 - u1)
+        d2 = d2 - (z - u2)
+        xi1_hat = common.data_to_freq(u1 + d1, fg)
+        xi2_hat = common.codes_to_freq(u2 + d2, fg)
+        zhat_new = freq_solvers.solve_z(kern, xi1_hat, xi2_hat, rho)
+        z_new = common.codes_from_freq(zhat_new, fg)
+        diff = common.rel_change(z_new, z)
+        obj_t = obj_t.at[i + 1].set(objective(z_new, zhat_new))
+        psnr_t = psnr_t.at[i + 1].set(psnr_of(zhat_new))
+        diff_t = diff_t.at[i + 1].set(diff)
+        return (i + 1, z_new, zhat_new, d1, d2, obj_t, psnr_t, diff_t, diff)
+
+    def cond(state):
+        i, *_, diff = state
+        return jnp.logical_and(i < cfg.max_it, diff >= cfg.tol)
+
+    z0 = jnp.zeros(z_shape, b.dtype)
+    zhat0 = common.codes_to_freq(z0, fg)
+    obj_t = jnp.zeros(cfg.max_it + 1).at[0].set(objective(z0, zhat0))
+    psnr_t = jnp.zeros(cfg.max_it + 1).at[0].set(psnr_of(zhat0))
+    diff_t = jnp.zeros(cfg.max_it + 1)
+    state = (
+        jnp.int32(0),
+        z0,
+        zhat0,
+        jnp.zeros(x_shape, b.dtype),
+        jnp.zeros(z_shape, b.dtype),
+        obj_t,
+        psnr_t,
+        diff_t,
+        jnp.float32(jnp.inf),
+    )
+    i, z, zhat, *_ , obj_t, psnr_t, diff_t, _ = jax.lax.while_loop(
+        cond, body, state
+    )
+
+    Dz = Dz_real(zhat, dhat_clean) + smoothinit
+    recon = fourier.crop_spatial(Dz, radius)
+    if prob.clamp_nonneg:
+        recon = jnp.maximum(recon, 0.0)
+    return ReconResult(z, recon, ReconTrace(obj_t, psnr_t, diff_t, i))

@@ -1,0 +1,3 @@
+from . import consensus, mesh
+
+__all__ = ["consensus", "mesh"]

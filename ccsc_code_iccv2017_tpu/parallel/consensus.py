@@ -1,0 +1,197 @@
+"""Consensus execution: local blocks on one device, sharded blocks on a
+mesh — same per-device step either way.
+
+The per-device math lives in models.learn.outer_step: each device holds
+L = N/ndev consensus blocks on a leading axis, and cross-device
+coupling is exactly one `lax.psum` over the mesh axis 'block' per
+consensus average (the TPU analog of the Dbar/Udbar sums at
+2D/admm_learn_conv2D_large_dzParallel.m:115-121). Without a mesh the
+psum is elided and L = N — the reference's serial `for nn=1:N` loop
+(dzParallel.m:96-158), but batched so all N solves land on the MXU
+together.
+
+Sharding layout: block-local state fields are P('block') on the leading
+axis; the consensus variables dbar/udbar are replicated (P()) — they
+are the same on every device by construction, which is what makes the
+global kernel prox a purely local computation.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import LearnConfig, ProblemGeom
+from ..models import common, learn as learn_mod
+from . import mesh as mesh_lib
+
+try:  # jax >= 0.6 moved shard_map out of experimental
+    from jax import shard_map as _shard_map_mod  # type: ignore
+
+    shard_map = _shard_map_mod
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+
+def _state_specs(batched: bool = True):
+    blk = P("block") if batched else P()
+    return learn_mod.LearnState(
+        d_local=blk, dual_d=blk, dbar=P(), udbar=P(), z=blk, dual_z=blk
+    )
+
+
+def make_outer_step(
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    fg: common.FreqGeom,
+    mesh: Optional[Mesh] = None,
+):
+    """Jitted outer step. Input state is the global view: block-local
+    fields [N, ...], consensus fields unbatched."""
+    if mesh is None:
+        step = functools.partial(
+            learn_mod.outer_step,
+            geom=geom,
+            cfg=cfg,
+            fg=fg,
+            num_blocks=cfg.num_blocks,
+            axis_name=None,
+        )
+        return jax.jit(step)
+
+    step = functools.partial(
+        learn_mod.outer_step,
+        geom=geom,
+        cfg=cfg,
+        fg=fg,
+        num_blocks=cfg.num_blocks,
+        axis_name="block",
+    )
+    metrics_specs = learn_mod.OuterMetrics(P(), P(), P(), P())
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(_state_specs(), P("block")),
+        out_specs=(_state_specs(), metrics_specs),
+    )
+    return jax.jit(sharded)
+
+
+def make_eval_fn(
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    fg: common.FreqGeom,
+    mesh: Optional[Mesh] = None,
+    with_outputs: bool = True,
+):
+    """Jitted (objective, support filters, per-block Dz) evaluation.
+
+    ``with_outputs=False`` builds an objective-only variant that never
+    materializes the Dz reconstructions."""
+    if mesh is None:
+        f = functools.partial(
+            learn_mod.eval_block,
+            geom=geom,
+            cfg=cfg,
+            fg=fg,
+            axis_name=None,
+            with_outputs=with_outputs,
+        )
+        return jax.jit(f)
+    f = functools.partial(
+        learn_mod.eval_block,
+        geom=geom,
+        cfg=cfg,
+        fg=fg,
+        axis_name="block",
+        with_outputs=with_outputs,
+    )
+    return jax.jit(
+        shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(_state_specs(), P("block")),
+            out_specs=(P(), P(), P("block")),
+        )
+    )
+
+
+def learn(
+    b: jnp.ndarray,
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    key: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+) -> learn_mod.LearnResult:
+    """Driver: Python outer loop around the jitted consensus step, with
+    the reference's trace protocol (obj_vals_d / obj_vals_z / tim_vals,
+    dParallel.m:62-71) and its rel-change termination (:186-188).
+    """
+    ndim_s = geom.ndim_spatial
+    n = b.shape[0]
+    N = cfg.num_blocks
+    if n % N:
+        raise ValueError(f"n={n} not divisible by num_blocks={N}")
+    ni = n // N
+    if mesh is not None and N % mesh.devices.size:
+        raise ValueError(
+            f"num_blocks={N} not divisible by mesh size {mesh.devices.size}"
+        )
+    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:])
+    b_blocks = b.reshape(N, ni, *b.shape[1:])
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    state = learn_mod.init_state(key, geom, fg, N, ni, b.dtype)
+
+    if mesh is not None:
+        specs = _state_specs()
+        state = jax.tree.map(
+            lambda x, s: jax.device_put(
+                x, jax.sharding.NamedSharding(mesh, s)
+            ),
+            state,
+            specs,
+        )
+        b_blocks = jax.device_put(b_blocks, mesh_lib.block_sharding(mesh))
+
+    step = make_outer_step(geom, cfg, fg, mesh)
+    eval_fn = make_eval_fn(geom, cfg, fg, mesh)
+    obj_fn = make_eval_fn(geom, cfg, fg, mesh, with_outputs=False)
+
+    obj0 = float(obj_fn(state, b_blocks)[0])
+    trace = {
+        "obj_vals_d": [obj0],
+        "obj_vals_z": [obj0],
+        "tim_vals": [0.0],
+        "d_diff": [0.0],
+        "z_diff": [0.0],
+    }
+    t_total = 0.0
+    for i in range(cfg.max_it):
+        t0 = time.perf_counter()
+        state, m = step(state, b_blocks)
+        jax.block_until_ready(state.z)
+        t_total += time.perf_counter() - t0
+        obj_d, obj_z = float(m.obj_d), float(m.obj_z)
+        d_diff, z_diff = float(m.d_diff), float(m.z_diff)
+        trace["obj_vals_d"].append(obj_d)
+        trace["obj_vals_z"].append(obj_z)
+        trace["tim_vals"].append(t_total)
+        trace["d_diff"].append(d_diff)
+        trace["z_diff"].append(z_diff)
+        if cfg.verbose in ("brief", "all"):
+            print(
+                f"Iter {i + 1}, Obj_d {obj_d:.4g}, Obj_z {obj_z:.4g}, "
+                f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}, t {t_total:.2f}s"
+            )
+        if d_diff < cfg.tol and z_diff < cfg.tol:
+            break
+
+    _, d_sup, Dz = eval_fn(state, b_blocks)
+    Dz = Dz.reshape(n, *Dz.shape[2:])
+    return learn_mod.LearnResult(d_sup, state.z, Dz, trace)

@@ -1,0 +1,39 @@
+"""Device-mesh helpers.
+
+The reference's only inter-block data movement is MATLAB cell-array
+assignment in one address space (SURVEY.md section 2.5); the TPU-native
+equivalent is a `jax.sharding.Mesh` whose 'block' axis carries the
+consensus blocks, with `lax.pmean` riding ICI (and DCN across hosts —
+jax.make_mesh orders devices so the innermost axes map to ICI links).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def block_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the 'block' (consensus / data-parallel) axis."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return jax.make_mesh((len(devices),), ("block",), devices=devices)
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (block) axis; replicate the rest."""
+    return NamedSharding(mesh, P("block"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_blocks(tree, mesh: Mesh):
+    """Place every array in ``tree`` with its leading axis sharded over
+    the mesh 'block' axis."""
+    s = block_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, s), tree)

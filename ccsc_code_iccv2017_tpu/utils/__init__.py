@@ -1,0 +1,3 @@
+from . import io_mat
+
+__all__ = ["io_mat"]

@@ -1,0 +1,76 @@
+"""Interop with the reference's .mat artifacts.
+
+The reference ships pretrained filter banks (SURVEY.md L1 assets):
+2D/Filters/Filters_ours_2D_large.mat (d: 11x11x100),
+2-3D/Filters/2D-3D-Hyperspectral.mat (11x11x31x100),
+3D/Filters/3D_video_filters.mat (11x11x11x49),
+4D/Filters/4d_filters_lightfield.mat (11x11x5x5x49). These let the
+reconstruction apps run without training, and serve as fixtures for
+end-to-end tests.
+
+MATLAB lays filters out spatial-first, filter-index last; our canonical
+layout is [k, *reduce, *spatial] (config.ProblemGeom).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _loadmat(path: str) -> dict:
+    import scipy.io
+
+    try:
+        return scipy.io.loadmat(path)
+    except NotImplementedError:  # v7.3 (HDF5) files
+        import h5py
+
+        out = {}
+        with h5py.File(path, "r") as f:
+            for k in f.keys():
+                if isinstance(f[k], h5py.Dataset):
+                    out[k] = np.array(f[k]).T  # h5py is C-order transpose
+        return out
+
+
+def load_filters_2d(path: str) -> np.ndarray:
+    """[s, s, k] -> [k, s, s] float32."""
+    d = _loadmat(path)["d"]
+    return np.ascontiguousarray(np.transpose(d, (2, 0, 1))).astype(np.float32)
+
+
+def load_filters_hyperspectral(path: str) -> np.ndarray:
+    """[s, s, w, k] -> [k, w, s, s] float32."""
+    d = _loadmat(path)["d"]
+    return np.ascontiguousarray(np.transpose(d, (3, 2, 0, 1))).astype(
+        np.float32
+    )
+
+
+def load_filters_3d(path: str) -> np.ndarray:
+    """[s, s, t, k] -> [k, s, s, t] float32 (all three dims spatial)."""
+    d = _loadmat(path)["d"]
+    return np.ascontiguousarray(np.transpose(d, (3, 0, 1, 2))).astype(
+        np.float32
+    )
+
+
+def load_filters_lightfield(path: str) -> np.ndarray:
+    """[s, s, a1, a2, k] -> [k, a1, a2, s, s] float32."""
+    d = _loadmat(path)["d"]
+    return np.ascontiguousarray(np.transpose(d, (4, 2, 3, 0, 1))).astype(
+        np.float32
+    )
+
+
+def save_filters(path: str, d: np.ndarray, trace: dict | None = None) -> None:
+    """Save learned filters (+ optional trace) in a loadmat-compatible
+    container, mirroring the reference's terminal-state save
+    (2D/learn_kernels_2D_large.m:45)."""
+    import scipy.io
+
+    payload = {"d": np.asarray(d)}
+    if trace is not None:
+        payload["iterations"] = {
+            k: np.asarray(v) for k, v in trace.items()
+        }
+    scipy.io.savemat(path, payload)

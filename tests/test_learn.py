@@ -1,0 +1,109 @@
+"""Integration tests for the consensus learner (CPU, virtual 8-device
+mesh — SURVEY.md section 4's fake-cluster strategy)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+from ccsc_code_iccv2017_tpu.models.learn import learn
+from ccsc_code_iccv2017_tpu.parallel.mesh import block_mesh
+
+
+def _toy_data(n=8, size=20, seed=0):
+    """Images synthesized from a ground-truth 2-filter dictionary so
+    learning has structure to find."""
+    r = np.random.default_rng(seed)
+    imgs = []
+    for _ in range(n):
+        x = np.zeros((size, size), np.float32)
+        for _ in range(6):
+            i, j = r.integers(2, size - 2, 2)
+            x[i, j] = r.normal()
+        # blur with a random edge filter
+        from scipy.signal import convolve2d
+
+        f = r.normal(size=(3, 3)).astype(np.float32)
+        imgs.append(convolve2d(x, f, mode="same"))
+    return jnp.asarray(np.stack(imgs))
+
+
+CFG = dict(
+    max_it=4,
+    max_it_d=3,
+    max_it_z=3,
+    rho_d=500.0,
+    rho_z=10.0,
+    lambda_prior=0.1,
+    verbose="none",
+)
+
+
+def test_objective_decreases():
+    b = _toy_data()
+    geom = ProblemGeom((5, 5), 8)
+    res = learn(b, geom, LearnConfig(num_blocks=2, **CFG))
+    obj = res.trace["obj_vals_z"]
+    assert obj[-1] < 0.5 * obj[0]
+    # filters feasible: unit ball, support preserved
+    norms = np.sqrt(np.sum(np.asarray(res.d) ** 2, axis=(1, 2)))
+    assert np.all(norms <= 1.0 + 1e-4)
+    assert res.d.shape == (8, 5, 5)
+    assert res.Dz.shape == b.shape
+
+
+def test_mesh_matches_single_device():
+    """Consensus over a sharded 'block' mesh must reproduce the local
+    path exactly — the collective IS the cell-array sum
+    (dzParallel.m:115-121 -> psum)."""
+    b = _toy_data()
+    geom = ProblemGeom((5, 5), 8)
+    cfg = LearnConfig(num_blocks=4, **CFG)
+    res_local = learn(b, geom, cfg)
+    res_mesh = learn(b, geom, cfg, mesh=block_mesh(4))
+    np.testing.assert_allclose(
+        np.asarray(res_local.d), np.asarray(res_mesh.d), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        res_local.trace["obj_vals_z"],
+        res_mesh.trace["obj_vals_z"],
+        rtol=1e-4,
+    )
+
+
+def test_blocks_per_device_gt_one():
+    """N=8 blocks on a 4-device mesh (L=2 per device)."""
+    b = _toy_data()
+    geom = ProblemGeom((5, 5), 4)
+    cfg = LearnConfig(num_blocks=8, **CFG)
+    res_local = learn(b, geom, cfg)
+    res_mesh = learn(b, geom, cfg, mesh=block_mesh(4))
+    np.testing.assert_allclose(
+        np.asarray(res_local.d), np.asarray(res_mesh.d), atol=2e-5
+    )
+
+
+def test_learn_3d_geometry():
+    """Dimension-generic: 3 spatial FFT dims (the 3D video learner,
+    3D/admm_learn_conv3D_large.m)."""
+    r = np.random.default_rng(3)
+    b = jnp.asarray(r.normal(size=(4, 10, 10, 10)).astype(np.float32))
+    geom = ProblemGeom((3, 3, 3), 4)
+    res = learn(b, geom, LearnConfig(num_blocks=2, **CFG))
+    assert res.d.shape == (4, 3, 3, 3)
+    obj = res.trace["obj_vals_z"]
+    assert obj[-1] < obj[0]
+
+
+def test_learn_reduce_geometry():
+    """Wavelength-shared codes (the 2-3D hyperspectral learner,
+    2-3D/DictionaryLearning/admm_learn.m:13-16): filters carry a
+    4-wavelength axis, codes are 2-D."""
+    r = np.random.default_rng(4)
+    b = jnp.asarray(r.normal(size=(4, 4, 12, 12)).astype(np.float32))
+    geom = ProblemGeom((5, 5), 6, reduce_shape=(4,))
+    res = learn(b, geom, LearnConfig(num_blocks=2, **CFG))
+    assert res.d.shape == (6, 4, 5, 5)
+    obj = res.trace["obj_vals_z"]
+    assert obj[-1] < obj[0]
+    assert res.z.shape[2] == 6  # codes have no wavelength axis

