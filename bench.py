@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Benchmark: consensus ADMM dictionary-learning throughput on TPU.
+
+The BASELINE.json north-star is the 2D learning workload of
+2D/learn_kernels_2D_large.m (100 filters of 11x11, consensus blocks,
+20 outer iterations) with target "<5 min end-to-end on a v5e-8".
+This benchmark runs the same outer-step shape on ONE chip and reports
+outer iterations/sec; vs_baseline is measured pace divided by the
+north-star pace (20 iters / 300 s), i.e. > 1.0 beats the target pace.
+
+Prints exactly one JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Env knobs: CCSC_BENCH_N (images, default 128), CCSC_BENCH_SIZE (image
+side, default 100), CCSC_BENCH_K (filters, default 100),
+CCSC_BENCH_BLOCKS (default 8), CCSC_BENCH_ITERS (timed outer
+iterations, default 3).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+from ccsc_code_iccv2017_tpu.models import common, learn as learn_mod
+from ccsc_code_iccv2017_tpu.parallel import consensus
+
+
+def main():
+    n = int(os.environ.get("CCSC_BENCH_N", 128))
+    size = int(os.environ.get("CCSC_BENCH_SIZE", 100))
+    k = int(os.environ.get("CCSC_BENCH_K", 100))
+    blocks = int(os.environ.get("CCSC_BENCH_BLOCKS", 8))
+    iters = int(os.environ.get("CCSC_BENCH_ITERS", 3))
+
+    geom = ProblemGeom((11, 11), k)
+    cfg = LearnConfig(
+        max_it=iters,
+        max_it_d=5,
+        max_it_z=10,
+        num_blocks=blocks,
+        rho_d=5000.0,
+        rho_z=1.0,
+        verbose="none",
+    )
+    fg = common.FreqGeom.create(geom, (size, size))
+
+    key = jax.random.PRNGKey(0)
+    ni = n // blocks
+    # synthetic data on device — the benchmark measures the solver, not IO
+    b_blocks = jax.random.normal(
+        jax.random.PRNGKey(1), (blocks, ni, size, size), jnp.float32
+    )
+    state = learn_mod.init_state(key, geom, fg, blocks, ni)
+
+    step = consensus.make_outer_step(geom, cfg, fg, mesh=None)
+
+    # warmup / compile. NB: jax.block_until_ready is a no-op on the
+    # axon TPU platform — a scalar readback is the only reliable fence.
+    s1, m0 = step(state, b_blocks)
+    float(m0.obj_z)
+
+    t0 = time.perf_counter()
+    cur = s1
+    for _ in range(iters):
+        cur, m = step(cur, b_blocks)
+    float(m.obj_z)  # fences the whole chain
+    dt = time.perf_counter() - t0
+
+    iters_per_sec = iters / dt
+    target_pace = 20.0 / 300.0  # north-star: 20 outer iters in 5 min
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"2D consensus ADMM outer iters/sec "
+                    f"(k={k} 11x11 filters, n={n}x{size}^2, "
+                    f"{blocks} blocks, 1 chip)"
+                ),
+                "value": round(iters_per_sec, 4),
+                "unit": "outer_iters/sec",
+                "vs_baseline": round(iters_per_sec / target_pace, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

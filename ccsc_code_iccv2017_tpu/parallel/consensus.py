@@ -175,10 +175,11 @@ def learn(
     for i in range(cfg.max_it):
         t0 = time.perf_counter()
         state, m = step(state, b_blocks)
-        jax.block_until_ready(state.z)
-        t_total += time.perf_counter() - t0
+        # scalar readbacks double as the device fence (block_until_ready
+        # is a no-op on the axon TPU platform)
         obj_d, obj_z = float(m.obj_d), float(m.obj_z)
         d_diff, z_diff = float(m.d_diff), float(m.z_diff)
+        t_total += time.perf_counter() - t0
         trace["obj_vals_d"].append(obj_d)
         trace["obj_vals_z"].append(obj_z)
         trace["tim_vals"].append(t_total)
