@@ -1,0 +1,17 @@
+"""Experiment drivers (the reference's L5 layer, SURVEY.md section 2.4),
+as importable modules with CLIs:
+
+  python -m ccsc_code_iccv2017_tpu.apps.<name> --help
+
+========================  =========================================
+learn_2d                  2D/learn_kernels_2D_large.m
+inpaint_2d                2D/Inpainting/reconstruct_2D_subsampling.m
+poisson_2d                2D/Poisson_deconv/reconstruct_poisson_noise.m
+learn_hyperspectral       2-3D/DictionaryLearning/learn_hyperspectral.m
+demosaic_hyperspectral    2-3D/Demosaicing/reconstruct_subsampling_hyperspectral.m
+learn_3d                  3D/learn_kernels_3D.m
+deblur_video              3D/Deblurring/reconstruct_subsampling_video.m
+learn_4d                  4D/learn_kernels_4D.m
+view_synthesis            4D/ViewSynthesis/reconstruct_subsampling_lightfield.m
+========================  =========================================
+"""

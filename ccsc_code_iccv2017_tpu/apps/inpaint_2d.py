@@ -1,0 +1,106 @@
+"""2D inpainting / subsampled reconstruction driver — rebuild of
+2D/Inpainting/reconstruct_2D_subsampling.m (SURVEY.md section 2.4 #24).
+
+Reference protocol: CreateImages('none') -> random 50% mask -> masked
+coding with the shipped filter bank (lambda_res=5.0, lambda=2.0,
+max_it=100, tol=1e-3) -> PSNR + 16-bit PNG outputs
+(reconstruct_2D_subsampling.m:13-95).
+
+DIVERGENCE (documented): the reference driver passes 9 args to a
+10-parameter solver — smooth_init is missing and the script errors
+as shipped (SURVEY.md section 5). We build the intended smooth offset
+as a normalized-convolution Gaussian fill of the observed pixels (the
+demosaic driver's warm-fill pattern,
+reconstruct_subsampling_hyperspectral.m:46-55); without it, zero-mean
+filters cannot carry the DC band.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data", required=True, help="test image folder")
+    p.add_argument("--filters", required=True, help=".mat or .npz filter bank")
+    p.add_argument("--keep", type=float, default=0.5, help="observed fraction")
+    p.add_argument("--lambda-residual", type=float, default=5.0)
+    p.add_argument("--lambda-prior", type=float, default=2.0)
+    p.add_argument("--max-it", type=int, default=100)
+    p.add_argument("--tol", type=float, default=1e-3)
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--size", type=int, default=None)
+    p.add_argument("--out-dir", default=None, help="write 16-bit PNGs here")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def smooth_fill(b: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """Normalized-convolution Gaussian fill of the observed pixels."""
+    from ..data.images import gaussian_kernel, rconv2
+
+    k = gaussian_kernel(13, 3 * 1.591)
+    out = np.empty_like(b)
+    for i in range(b.shape[0]):
+        out[i] = rconv2(b[i] * mask[i], k) / np.maximum(
+            rconv2(mask[i], k), 1e-6
+        )
+    return out.astype(np.float32)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import jax.numpy as jnp
+
+    from .. import ProblemGeom, SolveConfig
+    from ..data.images import load_images
+    from ..models.reconstruct import ReconstructionProblem, reconstruct
+    from ..utils.io_mat import load_filters_2d
+
+    d = load_filters_2d(args.filters)
+    size = (args.size, args.size) if args.size else None
+    b = load_images(args.data, limit=args.limit, size=size)
+    rng = np.random.default_rng(args.seed)
+    mask = (rng.random(b.shape) < args.keep).astype(np.float32)
+    sm = smooth_fill(b, mask)
+
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    cfg = SolveConfig(
+        lambda_residual=args.lambda_residual,
+        lambda_prior=args.lambda_prior,
+        max_it=args.max_it,
+        tol=args.tol,
+    )
+    res = reconstruct(
+        jnp.asarray(b * mask),
+        jnp.asarray(d),
+        ReconstructionProblem(geom),
+        cfg,
+        mask=jnp.asarray(mask),
+        smooth_init=jnp.asarray(sm),
+        x_orig=jnp.asarray(b),
+    )
+    ni = int(res.trace.num_iters)
+    psnr = float(res.trace.psnr_vals[ni])
+    print(f"{b.shape[0]} images, {ni} iterations, PSNR {psnr:.2f} dB")
+
+    if args.out_dir:
+        os.makedirs(args.out_dir, exist_ok=True)
+        from PIL import Image
+
+        rec = np.clip(np.asarray(res.recon), 0.0, 1.0)
+        for i in range(rec.shape[0]):
+            # 16-bit PNG outputs like the reference (:92-95)
+            arr = (rec[i] * 65535.0).astype(np.uint16)
+            Image.fromarray(arr).save(
+                os.path.join(args.out_dir, f"recon_{i}.png")
+            )
+        print(f"wrote {rec.shape[0]} PNGs to {args.out_dir}")
+    return res
+
+
+if __name__ == "__main__":
+    main()

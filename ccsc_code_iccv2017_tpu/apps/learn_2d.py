@@ -1,0 +1,100 @@
+"""2D dictionary learning driver — the rebuild of
+2D/learn_kernels_2D_large.m (SURVEY.md section 2.4 #23).
+
+Reference protocol: CreateImages(path,'local_cn',1,'gray') -> consensus
+learner (kernel [11,11,100], lambda_res=lambda=1.0, max_it=20,
+tol=1e-3, ni=100/block) -> save Filters_ours_2D_large.mat
+(learn_kernels_2D_large.m:8-45).
+
+Usage:
+    python -m ccsc_code_iccv2017_tpu.apps.learn_2d --data DIR \
+        [--filters 100 --support 11 --blocks 8 --out filters.mat]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data", required=True, help="image folder")
+    p.add_argument("--filters", type=int, default=100)
+    p.add_argument("--support", type=int, default=11)
+    p.add_argument("--blocks", type=int, default=8)
+    p.add_argument("--max-it", type=int, default=20)
+    p.add_argument("--max-it-d", type=int, default=5)
+    p.add_argument("--max-it-z", type=int, default=10)
+    p.add_argument("--tol", type=float, default=1e-3)
+    p.add_argument("--lambda-residual", type=float, default=1.0)
+    p.add_argument("--lambda-prior", type=float, default=1.0)
+    p.add_argument("--rho-d", type=float, default=5000.0)
+    p.add_argument("--rho-z", type=float, default=1.0)
+    p.add_argument("--contrast", default="local_cn")
+    p.add_argument("--size", type=int, default=None, help="resize side")
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--mesh", type=int, default=0, help="devices (0=off)")
+    p.add_argument("--out", default="Filters_ours_2D_large.mat")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--verbose", default="brief")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+
+    from .. import ProblemGeom, LearnConfig
+    from ..data.images import load_images
+    from ..models.learn import learn
+    from ..parallel.mesh import block_mesh
+    from ..utils.io_mat import save_filters
+
+    t0 = time.time()
+    size = (args.size, args.size) if args.size else None
+    b = load_images(
+        args.data,
+        contrast_normalize=args.contrast,
+        zero_mean=True,
+        square=args.size is None,
+        size=size,
+        limit=args.limit,
+    )
+    print(f"loaded {b.shape[0]} images {b.shape[1:]} in {time.time()-t0:.1f}s")
+
+    geom = ProblemGeom((args.support, args.support), args.filters)
+    cfg = LearnConfig(
+        lambda_residual=args.lambda_residual,
+        lambda_prior=args.lambda_prior,
+        max_it=args.max_it,
+        max_it_d=args.max_it_d,
+        max_it_z=args.max_it_z,
+        tol=args.tol,
+        rho_d=args.rho_d,
+        rho_z=args.rho_z,
+        num_blocks=args.blocks,
+        verbose=args.verbose,
+    )
+    mesh = block_mesh(args.mesh) if args.mesh else None
+    res = learn(
+        jnp.asarray(b),
+        geom,
+        cfg,
+        key=jax.random.PRNGKey(args.seed),
+        mesh=mesh,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+    )
+    save_filters(args.out, res.d, res.trace)
+    print(
+        f"saved {res.d.shape} filters to {args.out}; total "
+        f"{time.time()-t0:.1f}s, solver {res.trace['tim_vals'][-1]:.1f}s"
+    )
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -263,6 +263,8 @@ def learn(
     cfg: LearnConfig,
     key: Optional[jax.Array] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 5,
 ) -> LearnResult:
     """Learn a filter bank from data b [n, *reduce, *data_spatial].
 
@@ -272,4 +274,12 @@ def learn(
     """
     from ..parallel import consensus
 
-    return consensus.learn(b, geom, cfg, key=key, mesh=mesh)
+    return consensus.learn(
+        b,
+        geom,
+        cfg,
+        key=key,
+        mesh=mesh,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+    )

@@ -126,11 +126,19 @@ def learn(
     cfg: LearnConfig,
     key: Optional[jax.Array] = None,
     mesh: Optional[Mesh] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 5,
 ) -> learn_mod.LearnResult:
     """Driver: Python outer loop around the jitted consensus step, with
     the reference's trace protocol (obj_vals_d / obj_vals_z / tim_vals,
     dParallel.m:62-71) and its rel-change termination (:186-188).
+
+    ``checkpoint_dir`` enables atomic mid-run snapshots every
+    ``checkpoint_every`` outer iterations and resume-on-restart (full
+    ADMM state including duals — see utils.checkpoint).
     """
+    from ..utils import checkpoint as ckpt
+
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
     N = cfg.num_blocks
@@ -147,6 +155,20 @@ def learn(
     if key is None:
         key = jax.random.PRNGKey(0)
     state = learn_mod.init_state(key, geom, fg, N, ni, b.dtype)
+    start_it = 0
+    resumed_trace = None
+    if checkpoint_dir is not None:
+        snap = ckpt.load(checkpoint_dir)
+        if snap is not None:
+            fields, resumed_trace, start_it = snap
+            expect = {f: getattr(state, f).shape for f in state._fields}
+            got = {k: v.shape for k, v in fields.items()}
+            if expect != got:
+                raise ValueError(
+                    f"checkpoint shapes {got} do not match problem {expect}"
+                )
+            state = learn_mod.LearnState(**fields)
+            print(f"resumed from {checkpoint_dir} at iteration {start_it}")
 
     if mesh is not None:
         specs = _state_specs()
@@ -163,16 +185,19 @@ def learn(
     eval_fn = make_eval_fn(geom, cfg, fg, mesh)
     obj_fn = make_eval_fn(geom, cfg, fg, mesh, with_outputs=False)
 
-    obj0 = float(obj_fn(state, b_blocks)[0])
-    trace = {
-        "obj_vals_d": [obj0],
-        "obj_vals_z": [obj0],
-        "tim_vals": [0.0],
-        "d_diff": [0.0],
-        "z_diff": [0.0],
-    }
-    t_total = 0.0
-    for i in range(cfg.max_it):
+    if resumed_trace is not None:
+        trace = resumed_trace
+    else:
+        obj0 = float(obj_fn(state, b_blocks)[0])
+        trace = {
+            "obj_vals_d": [obj0],
+            "obj_vals_z": [obj0],
+            "tim_vals": [0.0],
+            "d_diff": [0.0],
+            "z_diff": [0.0],
+        }
+    t_total = trace["tim_vals"][-1]
+    for i in range(start_it, cfg.max_it):
         t0 = time.perf_counter()
         state, m = step(state, b_blocks)
         # scalar readbacks double as the device fence (block_until_ready
@@ -190,9 +215,13 @@ def learn(
                 f"Iter {i + 1}, Obj_d {obj_d:.4g}, Obj_z {obj_z:.4g}, "
                 f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}, t {t_total:.2f}s"
             )
+        if checkpoint_dir is not None and (i + 1) % checkpoint_every == 0:
+            ckpt.save(checkpoint_dir, state, trace, i + 1)
         if d_diff < cfg.tol and z_diff < cfg.tol:
             break
 
+    if checkpoint_dir is not None:
+        ckpt.save(checkpoint_dir, state, trace, cfg.max_it)
     _, d_sup, Dz = eval_fn(state, b_blocks)
     Dz = Dz.reshape(n, *Dz.shape[2:])
     return learn_mod.LearnResult(d_sup, state.z, Dz, trace)
