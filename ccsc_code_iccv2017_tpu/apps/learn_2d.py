@@ -88,7 +88,7 @@ def main(argv=None):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
-    save_filters(args.out, res.d, res.trace)
+    save_filters(args.out, res.d, res.trace, layout="2d")
     print(
         f"saved {res.d.shape} filters to {args.out}; total "
         f"{time.time()-t0:.1f}s, solver {res.trace['tim_vals'][-1]:.1f}s"

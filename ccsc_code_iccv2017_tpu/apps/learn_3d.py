@@ -1,0 +1,89 @@
+"""3D (video) dictionary learning — rebuild of 3D/learn_kernels_3D.m
+(SURVEY.md section 2.4 #28).
+
+Reference protocol: load contrast-normalized movie -> 64 random crops
+of 50^3 (learn_kernels_3D.m:35-44) -> consensus learner with kernel
+[11,11,11,49], max_it=20, tol=1e-2, ni=sqrt(n) blocks
+(admm_learn_conv3D_large.m:11-12). The full_movie_localCN.mat blob is
+absent; --synthetic generates drifting-texture clips, --movie extracts
+from an mp4.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--movie", help="mp4/avi to extract frames from")
+    src.add_argument("--synthetic", action="store_true")
+    p.add_argument("--clips", type=int, default=16)
+    p.add_argument("--clip-size", type=int, default=24)
+    p.add_argument("--clip-frames", type=int, default=None)
+    p.add_argument("--filters", type=int, default=49)
+    p.add_argument("--support", type=int, default=11)
+    p.add_argument("--support-t", type=int, default=11)
+    p.add_argument("--blocks", type=int, default=4)
+    p.add_argument("--max-it", type=int, default=20)
+    p.add_argument("--tol", type=float, default=1e-2)
+    p.add_argument("--rho-d", type=float, default=5000.0)
+    p.add_argument("--rho-z", type=float, default=1.0)
+    p.add_argument("--mesh", type=int, default=0)
+    p.add_argument("--out", default="3D_video_filters.mat")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--verbose", default="brief")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+
+    from .. import ProblemGeom, LearnConfig
+    from ..data import volumes
+    from ..models.learn import learn
+    from ..parallel.mesh import block_mesh
+    from ..utils.io_mat import save_filters
+
+    ct = args.clip_frames or args.clip_size
+    if args.synthetic:
+        b = volumes.synthetic_video(
+            n=args.clips, side=args.clip_size, frames=ct, seed=args.seed
+        )
+    else:
+        vol = volumes.extract_movie(
+            args.movie, side=100, contrast_normalize=True
+        )
+        b = volumes.random_volume_crops(
+            vol, args.clips, (args.clip_size, args.clip_size, ct), args.seed
+        )
+    print(f"clips: {b.shape}")
+
+    geom = ProblemGeom(
+        (args.support, args.support, args.support_t), args.filters
+    )
+    cfg = LearnConfig(
+        max_it=args.max_it,
+        max_it_d=5,
+        max_it_z=10,
+        tol=args.tol,
+        rho_d=args.rho_d,
+        rho_z=args.rho_z,
+        num_blocks=args.blocks,
+        verbose=args.verbose,
+    )
+    mesh = block_mesh(args.mesh) if args.mesh else None
+    res = learn(
+        jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(args.seed), mesh=mesh
+    )
+    save_filters(args.out, res.d, res.trace, layout="3d")
+    print(f"saved {res.d.shape} filters to {args.out}")
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""4D lightfield dictionary learning — rebuild of 4D/learn_kernels_4D.m
+(SURVEY.md section 2.4 #30).
+
+Reference protocol: 64 random 50x50x5x5 sub-lightfields
+(learn_kernels_4D_extract_patches.m:41-53) -> consensus learner with
+kernel [11,11,5,5,49] — FFT over the two SPATIAL dims only, 2-D code
+maps shared across the 5x5 angular views
+(admm_learn_conv4D_lightfield.m:18-20,43-47). The food_localCN blob is
+absent (.MISSING_LARGE_BLOBS); --synthetic generates a disparity-
+shifted lightfield.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--mat", help=".mat with lightfield [x y a1 a2] or [a1 a2 x y]")
+    src.add_argument("--synthetic", action="store_true")
+    p.add_argument("--patches", type=int, default=16)
+    p.add_argument("--patch-size", type=int, default=24)
+    p.add_argument("--views", type=int, default=5)
+    p.add_argument("--filters", type=int, default=49)
+    p.add_argument("--support", type=int, default=11)
+    p.add_argument("--blocks", type=int, default=4)
+    p.add_argument("--max-it", type=int, default=20)
+    p.add_argument("--tol", type=float, default=1e-3)
+    p.add_argument("--rho-d", type=float, default=500.0)
+    p.add_argument("--rho-z", type=float, default=50.0)
+    p.add_argument("--mesh", type=int, default=0)
+    p.add_argument("--out", default="4d_filters_lightfield.mat")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--verbose", default="brief")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+
+    from .. import ProblemGeom, LearnConfig
+    from ..data import volumes
+    from ..models.learn import learn
+    from ..parallel.mesh import block_mesh
+    from ..utils.io_mat import save_filters
+
+    if args.synthetic:
+        lf = volumes.synthetic_lightfield(
+            views=args.views, side=max(64, args.patch_size + 8), seed=args.seed
+        )
+    else:
+        from ..utils.io_mat import _loadmat
+
+        raw = list(_loadmat(args.mat).items())
+        arrs = [v for k, v in raw if hasattr(v, "ndim") and v.ndim == 4]
+        if not arrs:
+            raise ValueError("no 4-D array found in .mat")
+        lf = arrs[0].astype(np.float32)
+        if lf.shape[0] > lf.shape[2]:  # [x y a1 a2] -> [a1 a2 x y]
+            lf = np.transpose(lf, (2, 3, 0, 1))
+    b = volumes.random_lightfield_patches(
+        lf, args.patches, spatial=args.patch_size, seed=args.seed
+    )
+    print(f"patches: {b.shape}")
+
+    geom = ProblemGeom(
+        (args.support, args.support),
+        args.filters,
+        (b.shape[1], b.shape[2]),
+    )
+    cfg = LearnConfig(
+        max_it=args.max_it,
+        max_it_d=5,
+        max_it_z=10,
+        tol=args.tol,
+        rho_d=args.rho_d,
+        rho_z=args.rho_z,
+        num_blocks=args.blocks,
+        verbose=args.verbose,
+    )
+    mesh = block_mesh(args.mesh) if args.mesh else None
+    res = learn(
+        jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(args.seed), mesh=mesh
+    )
+    save_filters(args.out, res.d, res.trace, layout="lightfield")
+    print(f"saved {res.d.shape} filters to {args.out}")
+    return res
+
+
+if __name__ == "__main__":
+    main()

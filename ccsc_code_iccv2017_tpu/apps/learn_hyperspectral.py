@@ -1,0 +1,106 @@
+"""Hyperspectral dictionary learning — rebuild of
+2-3D/DictionaryLearning/learn_hyperspectral.m (SURVEY.md section 2.4 #26).
+
+Reference protocol: load training cubes -> Gaussian smooth_init
+(imfilter, learn_hyperspectral.m:16-17) -> masked ADMM learner with
+kernel [11,11,31,100], max_it=40, tol=1e-3 (:30) -> save. The
+training_data.mat blob is absent from the reference
+(SURVEY.md section 5); --synthetic generates demo cubes instead.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--data", help="folder of band images (groups of --bands)")
+    src.add_argument("--mat", help=".mat with variable 'b' [x y w n]")
+    src.add_argument("--synthetic", action="store_true")
+    p.add_argument("--bands", type=int, default=31)
+    p.add_argument("--filters", type=int, default=100)
+    p.add_argument("--support", type=int, default=11)
+    p.add_argument("--max-it", type=int, default=40)
+    p.add_argument("--tol", type=float, default=1e-3)
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--out", default="hyperspectral_filters.mat")
+    p.add_argument("--init", default=None, help="warm-start filter .mat")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--verbose", default="brief")
+    return p
+
+
+def gaussian_smooth_init(b: np.ndarray, sigma: float = 4.773) -> np.ndarray:
+    """Per-band Gaussian lowpass (learn_hyperspectral.m:16-17)."""
+    from scipy.ndimage import gaussian_filter
+
+    out = np.empty_like(b)
+    for n in range(b.shape[0]):
+        for w in range(b.shape[1]):
+            out[n, w] = gaussian_filter(b[n, w], sigma, mode="nearest")
+    return out
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+
+    from .. import ProblemGeom, LearnConfig
+    from ..data import volumes
+    from ..models.learn_masked import learn_masked
+    from ..utils.io_mat import load_filters_hyperspectral, save_filters
+
+    if args.synthetic:
+        b = volumes.synthetic_hyperspectral(
+            n=args.limit or 4, bands=args.bands, seed=args.seed
+        )
+    elif args.mat:
+        from ..utils.io_mat import _loadmat
+
+        raw = _loadmat(args.mat)["b"]  # [x y w n]
+        b = np.transpose(raw, (3, 2, 0, 1)).astype(np.float32)
+        if args.limit:
+            b = b[: args.limit]
+    else:
+        b = volumes.load_hyperspectral_dir(
+            args.data, bands=args.bands, limit=args.limit
+        )
+    print(f"training cubes: {b.shape}")
+    sm = gaussian_smooth_init(b)
+
+    geom = ProblemGeom(
+        (args.support, args.support), args.filters, (b.shape[1],)
+    )
+    cfg = LearnConfig(
+        lambda_residual=1.0,
+        lambda_prior=1.0,
+        max_it=args.max_it,
+        max_it_d=10,
+        max_it_z=10,
+        tol=args.tol,
+        verbose=args.verbose,
+    )
+    init_d = (
+        jnp.asarray(load_filters_hyperspectral(args.init))
+        if args.init
+        else None
+    )
+    res = learn_masked(
+        jnp.asarray(b),
+        geom,
+        cfg,
+        smooth_init=jnp.asarray(sm),
+        init_d=init_d,
+        key=jax.random.PRNGKey(args.seed),
+    )
+    save_filters(args.out, res.d, res.trace, layout="hyperspectral")
+    print(f"saved {res.d.shape} filters to {args.out}")
+    return res
+
+
+if __name__ == "__main__":
+    main()

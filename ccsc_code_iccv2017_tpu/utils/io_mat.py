@@ -62,13 +62,46 @@ def load_filters_lightfield(path: str) -> np.ndarray:
     )
 
 
-def save_filters(path: str, d: np.ndarray, trace: dict | None = None) -> None:
-    """Save learned filters (+ optional trace) in a loadmat-compatible
-    container, mirroring the reference's terminal-state save
-    (2D/learn_kernels_2D_large.m:45)."""
+# our layout [k, *reduce, *spatial] <-> MATLAB layout (spatial-first,
+# filter-index last) per family
+_TO_MATLAB = {
+    "2d": (1, 2, 0),  # [k,s,s] -> [s,s,k]
+    "hyperspectral": (2, 3, 1, 0),  # [k,w,s,s] -> [s,s,w,k]
+    "3d": (1, 2, 3, 0),  # [k,x,y,t] -> [x,y,t,k]
+    "lightfield": (3, 4, 1, 2, 0),  # [k,a1,a2,x,y] -> [x,y,a1,a2,k]
+}
+
+
+def infer_layout(d: np.ndarray) -> str:
+    """Best-effort family inference from filter shape. 4-D is ambiguous
+    (hyperspectral [k,w,s,s] vs video [k,x,y,t]); prefer hyperspectral
+    when the reduce dim differs from the trailing square support."""
+    if d.ndim == 3:
+        return "2d"
+    if d.ndim == 5:
+        return "lightfield"
+    if d.ndim == 4:
+        k, a, b, c = d.shape
+        return "3d" if a == b == c else "hyperspectral"
+    raise ValueError(f"cannot infer filter family from shape {d.shape}")
+
+
+def save_filters(
+    path: str,
+    d: np.ndarray,
+    trace: dict | None = None,
+    layout: str | None = None,
+) -> None:
+    """Save learned filters (+ optional trace) in the REFERENCE's .mat
+    layout (spatial-first, filter-index last), mirroring the terminal
+    save at 2D/learn_kernels_2D_large.m:45 — so files round-trip
+    through load_filters_* and are interchangeable with the MATLAB
+    artifacts."""
     import scipy.io
 
-    payload = {"d": np.asarray(d)}
+    d = np.asarray(d)
+    layout = layout or infer_layout(d)
+    payload = {"d": np.transpose(d, _TO_MATLAB[layout])}
     if trace is not None:
         payload["iterations"] = {
             k: np.asarray(v) for k, v in trace.items()
