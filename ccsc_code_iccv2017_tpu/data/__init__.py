@@ -1,3 +1,3 @@
-from . import images
+from . import images, volumes, whitening
 
-__all__ = ["images"]
+__all__ = ["images", "volumes", "whitening"]

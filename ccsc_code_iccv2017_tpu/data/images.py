@@ -112,9 +112,16 @@ def load_image_list(
         if contrast_normalize == "local_cn":
             img = local_contrast_normalize(img)
         elif contrast_normalize != "none":
-            raise NotImplementedError(
-                f"contrast mode {contrast_normalize!r}"
-            )
+            from . import whitening
+
+            if contrast_normalize in whitening.PER_IMAGE_MODES:
+                img = whitening.PER_IMAGE_MODES[contrast_normalize](img)
+            elif contrast_normalize in whitening.STACK_MODES:
+                pass  # applied on the assembled stack in load_images
+            else:
+                raise NotImplementedError(
+                    f"contrast mode {contrast_normalize!r}"
+                )
         if zero_mean:
             img = img - img.mean()
         out.append(img.astype(np.float32))
@@ -162,4 +169,9 @@ def load_images(
             f"images differ in size {shapes}; use load_image_list or "
             "square/size options"
         )
-    return np.stack(imgs).astype(np.float32)
+    stack = np.stack(imgs).astype(np.float32)
+    from . import whitening
+
+    if contrast_normalize in whitening.STACK_MODES:
+        stack = whitening.STACK_MODES[contrast_normalize](stack)
+    return stack

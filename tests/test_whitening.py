@@ -1,0 +1,117 @@
+"""Tests for the whitening / contrast-normalization family
+(CreateImages.m modes + contrast_normalization helpers)."""
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.data import whitening
+from ccsc_code_iccv2017_tpu.data.images import (
+    gaussian_kernel,
+    local_contrast_normalize,
+    rconv2,
+)
+
+
+def _stack(n=6, side=24, seed=0):
+    from scipy.ndimage import gaussian_filter
+
+    r = np.random.default_rng(seed)
+    return np.stack(
+        [
+            gaussian_filter(r.normal(size=(side, side)), 1.5).astype(
+                np.float32
+            )
+            for _ in range(n)
+        ]
+    )
+
+
+def test_rconv2_matches_reflect_conv():
+    r = np.random.default_rng(1)
+    x = r.normal(size=(10, 10))
+    k = r.normal(size=(3, 3))
+    out = rconv2(x, k)
+    from scipy.signal import convolve2d
+
+    ref = convolve2d(np.pad(x, 1, mode="symmetric"), k, mode="valid")
+    np.testing.assert_allclose(out, ref, rtol=1e-10)
+    assert out.shape == x.shape
+
+
+def test_local_cn_normalizes_contrast():
+    """After local CN, local std should be much flatter than before."""
+    r = np.random.default_rng(2)
+    # image with wildly varying local contrast
+    img = np.concatenate(
+        [r.normal(size=(32, 16)) * 5.0, r.normal(size=(32, 16)) * 0.1],
+        axis=1,
+    ).astype(np.float32)
+    out = local_contrast_normalize(img)
+    k = gaussian_kernel()
+    def local_std(x):
+        m = rconv2(x.astype(np.float64), k)
+        v = np.maximum(rconv2(x.astype(np.float64) ** 2, k) - m * m, 0)
+        return np.sqrt(v)
+    s_in = local_std(img)
+    s_out = local_std(out)
+    ratio_in = s_in[:, :12].mean() / s_in[:, 20:].mean()
+    ratio_out = s_out[:, :12].mean() / s_out[:, 20:].mean()
+    # the median-floored std (CreateImages.m:336-348) fully normalizes
+    # regions ABOVE the median and leaves low-contrast regions divided
+    # by the floor, so the ratio shrinks but does not reach 1
+    assert ratio_in > 10
+    assert ratio_out < 0.5 * ratio_in
+    # high-contrast half is normalized to ~unit local std
+    assert 0.3 < s_out[:, :12].mean() < 3.0
+
+
+def test_zca_image_whitening_decorrelates():
+    X = _stack(n=8)
+    Xw = whitening.zca_whiten_images(X, eps=1e-6)
+    F = Xw.reshape(8, -1).astype(np.float64)
+    F -= F.mean(axis=0)
+    G = F @ F.T / F.shape[1]
+    off = G - np.diag(np.diag(G))
+    assert np.abs(off).max() < np.abs(np.diag(G)).mean() * 0.2
+
+
+def test_pca_whitening_flattens_spectrum():
+    X = _stack(n=8, seed=3)
+    Xw = whitening.pca_whiten_images(X, eps=1e-6)
+    Fw = Xw.reshape(8, -1).astype(np.float64)
+    s = np.linalg.svd(Fw - Fw.mean(0), compute_uv=False)
+    # nonzero singular values nearly equal after whitening
+    s = s[s > s[0] * 1e-3]
+    assert s.min() / s.max() > 0.5
+
+
+def test_inv_f_whiten_dewhiten_roundtrip():
+    """dewhiten is a right-inverse on the whitened range: re-whitening
+    its output reproduces the whitened image (exact recovery of x is
+    impossible — the rho*exp(-(rho/f0)^4) filter suppresses DC and the
+    far high band below float precision)."""
+    img = _stack(n=1, side=32, seed=4)[0]
+    w = whitening.inv_f_whiten(img)
+    back = whitening.inv_f_dewhiten(w)
+    w2 = whitening.inv_f_whiten(back)
+    np.testing.assert_allclose(w2, w, atol=2e-3 * np.abs(w).max())
+
+
+def test_sep_mean():
+    X = _stack(n=5, seed=5)
+    C, mu = whitening.sep_mean(X)
+    np.testing.assert_allclose(C.mean(axis=0), 0.0, atol=1e-6)
+    np.testing.assert_allclose(C + mu, X, rtol=1e-4, atol=1e-6)
+
+
+def test_laplacian_and_box_modes_run():
+    img = _stack(n=1, seed=6)[0]
+    lap = whitening.laplacian_cn(img)
+    assert lap.shape == img.shape and np.isfinite(lap).all()
+    box = whitening.box_cn(img, size=5)
+    assert box.shape == img.shape and np.isfinite(box).all()
+
+
+def test_zca_patch_whitening_runs():
+    X = _stack(n=4, seed=7)
+    out = whitening.zca_whiten_patches(X, patch=5, num_patches=2000)
+    assert out.shape == X.shape and np.isfinite(out).all()
