@@ -1,0 +1,126 @@
+"""ctypes bindings for the native (C++) data-preprocessing runtime.
+
+Builds native/libccsc_data.so on first use (g++ via make) and falls
+back to the numpy implementations transparently if the toolchain or
+library is unavailable. The native path runs local contrast
+normalization as two separable Gaussian passes with a std::thread pool
+across images — identical results to data.images.local_contrast_
+normalize (the CreateImages.m:299-370 formula), several times faster on
+large batches.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libccsc_data.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.ccsc_local_cn.restype = ctypes.c_int
+            lib.ccsc_local_cn.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.c_double,
+                ctypes.c_int,
+            ]
+            lib.ccsc_zero_mean.restype = ctypes.c_int
+            lib.ccsc_zero_mean.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int,
+            ]
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def local_cn_batch(
+    imgs: np.ndarray,
+    ksize: int = 13,
+    sigma: float = 3 * 1.591,
+    nthreads: int = 0,
+) -> np.ndarray:
+    """Local contrast normalization of [n, H, W] float32 images.
+
+    Uses the native threaded path when available, else the numpy
+    reference implementation. Returns a new array.
+    """
+    imgs = np.ascontiguousarray(imgs, np.float32)
+    if imgs.ndim == 2:
+        imgs = imgs[None]
+    lib = _load()
+    if lib is None:
+        from .images import local_contrast_normalize
+
+        return np.stack([local_contrast_normalize(i) for i in imgs])
+    out = imgs.copy()
+    rc = lib.ccsc_local_cn(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.shape[0],
+        out.shape[1],
+        out.shape[2],
+        ksize,
+        sigma,
+        nthreads,
+    )
+    if rc != 0:
+        raise RuntimeError(f"ccsc_local_cn failed with code {rc}")
+    return out
+
+
+def zero_mean_batch(imgs: np.ndarray, nthreads: int = 0) -> np.ndarray:
+    imgs = np.ascontiguousarray(imgs, np.float32)
+    lib = _load()
+    if lib is None:
+        return imgs - imgs.mean(
+            axis=tuple(range(1, imgs.ndim)), keepdims=True
+        )
+    out = imgs.copy()
+    rc = lib.ccsc_zero_mean(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.shape[0],
+        int(np.prod(out.shape[1:])),
+        nthreads,
+    )
+    if rc != 0:
+        raise RuntimeError(f"ccsc_zero_mean failed with code {rc}")
+    return out

@@ -1,0 +1,83 @@
+"""Visualization: filter mosaics and iterate panels.
+
+Rebuild of the reference's display_func (filter mosaic + original vs
+iterate panels, 2D/admm_learn_conv2D_large_dParallel.m:326-369) for
+headless use: figures are written to files (matplotlib Agg) instead of
+live windows, so 'verbose=all'-style monitoring works in TPU jobs.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def filter_mosaic(d: np.ndarray, pad: int = 1) -> np.ndarray:
+    """Tile support-domain filters [k, *extra, s1, s2] into one 2-D
+    mosaic (takes the first slice of any extra dims, like the
+    reference's inds{...}=10 slicing, dParallel.m:358-366)."""
+    d = np.asarray(d)
+    while d.ndim > 3:
+        d = d[:, 0]
+    k, s1, s2 = d.shape
+    grid = int(math.ceil(math.sqrt(k)))
+    out = np.zeros(
+        (grid * (s1 + pad) + pad, grid * (s2 + pad) + pad), d.dtype
+    )
+    for j in range(k):
+        r, c = divmod(j, grid)
+        out[
+            pad + r * (s1 + pad) : pad + r * (s1 + pad) + s1,
+            pad + c * (s2 + pad) : pad + c * (s2 + pad) + s2,
+        ] = d[j]
+    return out
+
+
+def save_filter_mosaic(path: str, d: np.ndarray, title: str = "") -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    m = filter_mosaic(d)
+    fig, ax = plt.subplots(figsize=(6, 6))
+    ax.imshow(m, cmap="gray")
+    ax.set_axis_off()
+    if title:
+        ax.set_title(title)
+    fig.savefig(path, bbox_inches="tight", dpi=120)
+    plt.close(fig)
+
+
+def save_iterate_panel(
+    path: str,
+    originals: Sequence[np.ndarray],
+    iterates: Sequence[np.ndarray],
+    title: str = "",
+) -> None:
+    """Side-by-side original vs current-iterate panels (the 3x2 grid of
+    display_func, dParallel.m:333-352). 2-D slices are taken from
+    higher-dimensional inputs."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    def to2d(x):
+        x = np.asarray(x)
+        while x.ndim > 2:
+            x = x[..., x.shape[-1] // 2] if x.shape[-1] < x.shape[0] else x[0]
+        return x
+
+    n = min(len(originals), len(iterates), 3)
+    fig, axes = plt.subplots(n, 2, figsize=(7, 3.2 * n), squeeze=False)
+    for i in range(n):
+        axes[i][0].imshow(to2d(originals[i]), cmap="gray")
+        axes[i][0].set_title("orig" if i == 0 else "")
+        axes[i][1].imshow(to2d(iterates[i]), cmap="gray")
+        axes[i][1].set_title(title if i == 0 else "")
+        for a in axes[i]:
+            a.set_axis_off()
+    fig.savefig(path, bbox_inches="tight", dpi=120)
+    plt.close(fig)

@@ -1,0 +1,173 @@
+// ccsc_data — native data-preprocessing runtime for the CCSC TPU
+// framework.
+//
+// The reference's data layer is MATLAB (image_helpers/CreateImages.m);
+// its local contrast normalization (:299-370) is the per-image hot
+// loop when preparing large training sets (the north-star run
+// preprocesses ~1k images before any TPU work starts). This library
+// implements that path natively: separable Gaussian filtering with
+// reflected boundaries (exactly rconv2.m:47-58 semantics — the 2-D
+// Gaussian kernel is separable, so two 1-D passes reproduce the full
+// 13x13 convolution), the median-floored std normalization, and a
+// std::thread worker pool across images.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+//
+// Build: make -C native   (produces libccsc_data.so)
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// reflect index into [0, n) with symmetric (half-sample) padding:
+// -1 -> 0, -2 -> 1, n -> n-1, n+1 -> n-2 (MATLAB padarray 'symmetric')
+inline int reflect(int i, int n) {
+  while (i < 0 || i >= n) {
+    if (i < 0) i = -i - 1;
+    if (i >= n) i = 2 * n - i - 1;
+  }
+  return i;
+}
+
+// 1-D Gaussian taps matching fspecial('gaussian',[k k],sigma) rows
+// (the 2-D kernel is the outer product of these, normalized overall).
+std::vector<double> gaussian_taps(int size, double sigma) {
+  std::vector<double> t(size);
+  double r = (size - 1) / 2.0;
+  double s = 0.0;
+  for (int i = 0; i < size; ++i) {
+    double x = i - r;
+    t[i] = std::exp(-(x * x) / (2.0 * sigma * sigma));
+    s += t[i];
+  }
+  for (auto& v : t) v /= s;
+  return t;
+}
+
+// separable same-size convolution with symmetric boundaries
+void sep_conv(const double* src, double* dst, int h, int w,
+              const std::vector<double>& taps, std::vector<double>& tmp) {
+  int r = (int)taps.size() / 2;
+  // horizontal pass into tmp
+  for (int y = 0; y < h; ++y) {
+    for (int x = 0; x < w; ++x) {
+      double acc = 0.0;
+      for (int k = -r; k <= r; ++k)
+        acc += taps[k + r] * src[y * w + reflect(x + k, w)];
+      tmp[y * w + x] = acc;
+    }
+  }
+  // vertical pass into dst
+  for (int y = 0; y < h; ++y) {
+    for (int x = 0; x < w; ++x) {
+      double acc = 0.0;
+      for (int k = -r; k <= r; ++k)
+        acc += taps[k + r] * tmp[reflect(y + k, h) * w + x];
+      dst[y * w + x] = acc;
+    }
+  }
+}
+
+void local_cn_one(float* img, int h, int w, const std::vector<double>& taps) {
+  const int npx = h * w;
+  std::vector<double> dim(npx), lmn(npx), lsq(npx), tmp(npx), sq(npx);
+  for (int i = 0; i < npx; ++i) {
+    dim[i] = img[i];
+    sq[i] = dim[i] * dim[i];
+  }
+  sep_conv(dim.data(), lmn.data(), h, w, taps, tmp);
+  sep_conv(sq.data(), lsq.data(), h, w, taps, tmp);
+  std::vector<double> lstd(npx);
+  for (int i = 0; i < npx; ++i) {
+    double v = lsq[i] - lmn[i] * lmn[i];
+    lstd[i] = v > 0.0 ? std::sqrt(v) : 0.0;
+  }
+  // median floor (CreateImages.m:336-348); median of nonzeros if the
+  // median itself is zero
+  std::vector<double> sorted(lstd);
+  auto mid = sorted.begin() + npx / 2;
+  std::nth_element(sorted.begin(), mid, sorted.end());
+  double th = *mid;
+  if (th == 0.0) {
+    std::vector<double> nz;
+    nz.reserve(npx);
+    for (double v : lstd)
+      if (v > 0.0) nz.push_back(v);
+    if (!nz.empty()) {
+      auto m2 = nz.begin() + nz.size() / 2;
+      std::nth_element(nz.begin(), m2, nz.end());
+      th = *m2;
+    }
+  }
+  const double eps = 2.220446049250313e-16;
+  for (int i = 0; i < npx; ++i) {
+    double s = std::max(lstd[i], th);
+    if (s == 0.0) s = eps;
+    img[i] = (float)((dim[i] - lmn[i]) / s);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// In-place local contrast normalization of a batch of images.
+// imgs: [n, h, w] float32 C-contiguous. Returns 0 on success.
+int ccsc_local_cn(float* imgs, int64_t n, int64_t h, int64_t w,
+                  int ksize, double sigma, int nthreads) {
+  if (!imgs || n <= 0 || h <= 0 || w <= 0 || ksize <= 0 || !(sigma > 0))
+    return 1;
+  auto taps = gaussian_taps(ksize, sigma);
+  if (nthreads <= 0)
+    nthreads = (int)std::thread::hardware_concurrency();
+  nthreads = std::max(1, std::min<int>(nthreads, (int)n));
+  std::atomic<int64_t> next(0);
+  std::vector<std::thread> pool;
+  pool.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) {
+    pool.emplace_back([&]() {
+      while (true) {
+        int64_t i = next.fetch_add(1);
+        if (i >= n) break;
+        local_cn_one(imgs + i * h * w, (int)h, (int)w, taps);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
+// Batch zero-mean (per image), threaded. imgs: [n, h*w].
+int ccsc_zero_mean(float* imgs, int64_t n, int64_t npx, int nthreads) {
+  if (!imgs || n <= 0 || npx <= 0) return 1;
+  if (nthreads <= 0)
+    nthreads = (int)std::thread::hardware_concurrency();
+  nthreads = std::max(1, std::min<int>(nthreads, (int)n));
+  std::atomic<int64_t> next(0);
+  std::vector<std::thread> pool;
+  for (int t = 0; t < nthreads; ++t) {
+    pool.emplace_back([&]() {
+      while (true) {
+        int64_t i = next.fetch_add(1);
+        if (i >= n) break;
+        float* p = imgs + i * npx;
+        double mu = 0.0;
+        for (int64_t j = 0; j < npx; ++j) mu += p[j];
+        mu /= (double)npx;
+        for (int64_t j = 0; j < npx; ++j) p[j] = (float)(p[j] - mu);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
+int ccsc_version() { return 1; }
+
+}  // extern "C"

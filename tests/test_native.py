@@ -1,0 +1,38 @@
+"""Native (C++) preprocessing runtime vs the numpy reference path."""
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.data import native
+from ccsc_code_iccv2017_tpu.data.images import local_contrast_normalize
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++?)"
+)
+
+
+def test_local_cn_matches_numpy():
+    r = np.random.default_rng(0)
+    imgs = r.normal(size=(4, 48, 48)).astype(np.float32)
+    out_c = native.local_cn_batch(imgs)
+    out_py = np.stack([local_contrast_normalize(i) for i in imgs])
+    # small differences: float32 accumulation + lower-middle vs averaged
+    # median convention
+    np.testing.assert_allclose(out_c, out_py, atol=5e-3)
+
+
+def test_zero_mean_batch():
+    r = np.random.default_rng(1)
+    imgs = (r.normal(size=(3, 16, 16)) + 5.0).astype(np.float32)
+    out = native.zero_mean_batch(imgs)
+    np.testing.assert_allclose(out.mean(axis=(1, 2)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(
+        out, imgs - imgs.mean(axis=(1, 2), keepdims=True), atol=1e-5
+    )
+
+
+def test_input_not_mutated():
+    r = np.random.default_rng(2)
+    imgs = r.normal(size=(2, 20, 20)).astype(np.float32)
+    keep = imgs.copy()
+    native.local_cn_batch(imgs)
+    np.testing.assert_array_equal(imgs, keep)
