@@ -109,18 +109,55 @@ def outer_step(
     fg: common.FreqGeom,
     num_blocks: int,
     axis_name: Optional[str] = None,
+    freq_axis_name: Optional[str] = None,
+    num_freq_shards: int = 1,
 ) -> Tuple[LearnState, OuterMetrics]:
     """One outer consensus iteration over this device's L local blocks.
 
     b_blocks: [L, ni, *reduce, *data_spatial] (unpadded). ``num_blocks``
     is the GLOBAL block count N; with a mesh, L = N / num_devices and
     cross-device coupling is the psum over ``axis_name``.
+
+    ``freq_axis_name`` enables FREQUENCY-AXIS parallelism (the tensor/
+    sequence-parallel analog of SURVEY.md section 2.5: the reference's
+    per-frequency independence of both linear solves,
+    dParallel.m:232-235, is the shardable axis). Each device solves an
+    F/num_freq_shards slice of the spectrum — the Gram inverses and all
+    per-frequency matmuls split that way — and one tiled `all_gather`
+    per inner iteration reassembles the spectrum for the (replicated)
+    FFT boundary. Frequency plays the role sequence plays in all-to-all
+    context parallelism.
     """
     support = geom.spatial_support
     radius = geom.psf_radius
 
+    if fg.num_freq % num_freq_shards:
+        raise ValueError(
+            f"num_freq={fg.num_freq} not divisible by "
+            f"num_freq_shards={num_freq_shards}"
+        )
+    f_local = fg.num_freq // num_freq_shards
+
+    def fslice(x):
+        """Take this device's slice of the trailing frequency axis."""
+        if freq_axis_name is None:
+            return x
+        idx = jax.lax.axis_index(freq_axis_name)
+        return jax.lax.dynamic_slice_in_dim(
+            x, idx * f_local, f_local, axis=x.ndim - 1
+        )
+
+    def fgather(x):
+        """Reassemble the full spectrum from per-device slices."""
+        if freq_axis_name is None:
+            return x
+        return jax.lax.all_gather(
+            x, freq_axis_name, axis=x.ndim - 1, tiled=True
+        )
+
     b_pad = fourier.pad_spatial(b_blocks, radius)
     bhat = jax.vmap(lambda bp: common.data_to_freq(bp, fg))(b_pad)  # [L,ni,W,F]
+    bhat_l = fslice(bhat)
 
     prox_kernel = lambda u: proxes.kernel_constraint_proj(
         u, support, fg.spatial_shape
@@ -138,9 +175,10 @@ def outer_step(
 
     # ---------------- d-pass (dzParallel.m:95-135) -------------------
     zhat = jax.vmap(lambda zl: common.codes_to_freq(zl, fg))(state.z)
+    zhat_l = fslice(zhat)
     dkern = jax.vmap(
         lambda zh: freq_solvers.precompute_d_kernel(zh, cfg.rho_d)
-    )(zhat)
+    )(zhat_l)
 
     def consensus_mean(x_l):
         """mean over ALL N blocks: local sum over L + psum over mesh."""
@@ -151,12 +189,16 @@ def outer_step(
         u = prox_kernel(dbar + udbar)  # global prox (dzParallel.m:107)
         dual_d = dual_d + (d_local - u[None])
         xi_full = u[None] - dual_d  # [L, k, *red, *sp]
-        xi_hat = jax.vmap(lambda x: common.full_filters_to_freq(x, fg))(
-            xi_full
+        xi_hat = fslice(
+            jax.vmap(lambda x: common.full_filters_to_freq(x, fg))(xi_full)
         )
-        dhat = jax.vmap(
-            lambda kern, bh, xh: freq_solvers.solve_d(kern, bh, xh, cfg.rho_d)
-        )(dkern, bhat, xi_hat)
+        dhat = fgather(
+            jax.vmap(
+                lambda kern, bh, xh: freq_solvers.solve_d(
+                    kern, bh, xh, cfg.rho_d
+                )
+            )(dkern, bhat_l, xi_hat)
+        )
         d_new = jax.vmap(lambda dh: _filters_from_freq(dh, fg))(dhat)
         dbar_new = consensus_mean(d_new)  # the all-reduce (:115-121)
         udbar_new = consensus_mean(dual_d)
@@ -176,7 +218,7 @@ def outer_step(
     obj_d = objective(state.z, dhat_z)
 
     # ---------------- z-pass (dzParallel.m:140-172) ------------------
-    zkern = freq_solvers.precompute_z_kernel(dhat_z, cfg.rho_z)
+    zkern = freq_solvers.precompute_z_kernel(fslice(dhat_z), cfg.rho_z)
     theta = cfg.lambda_prior / cfg.rho_z
 
     def z_iter(carry, _):
@@ -184,10 +226,16 @@ def outer_step(
         u2 = proxes.soft_threshold(z + dual_z, theta)
         dual_z = dual_z + (z - u2)
         xi2 = u2 - dual_z
-        xi2_hat = jax.vmap(lambda x: common.codes_to_freq(x, fg))(xi2)
-        zhat_new = jax.vmap(
-            lambda bh, xh: freq_solvers.solve_z(zkern, bh, xh, cfg.rho_z)
-        )(bhat, xi2_hat)
+        xi2_hat = fslice(
+            jax.vmap(lambda x: common.codes_to_freq(x, fg))(xi2)
+        )
+        zhat_new = fgather(
+            jax.vmap(
+                lambda bh, xh: freq_solvers.solve_z(
+                    zkern, bh, xh, cfg.rho_z
+                )
+            )(bhat_l, xi2_hat)
+        )
         z_new = jax.vmap(lambda zh: common.codes_from_freq(zh, fg))(zhat_new)
         return (z_new, dual_z), None
 

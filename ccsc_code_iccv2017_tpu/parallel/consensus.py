@@ -51,7 +51,11 @@ def make_outer_step(
     mesh: Optional[Mesh] = None,
 ):
     """Jitted outer step. Input state is the global view: block-local
-    fields [N, ...], consensus fields unbatched."""
+    fields [N, ...], consensus fields unbatched.
+
+    With a 2-D ('block', 'freq') mesh the step additionally shards the
+    per-frequency solves over the 'freq' axis (models.learn.outer_step
+    freq_axis_name) — DP x TP."""
     if mesh is None:
         step = functools.partial(
             learn_mod.outer_step,
@@ -63,6 +67,8 @@ def make_outer_step(
         )
         return jax.jit(step)
 
+    has_freq = "freq" in mesh.axis_names
+    nf = mesh.shape["freq"] if has_freq else 1
     step = functools.partial(
         learn_mod.outer_step,
         geom=geom,
@@ -70,6 +76,8 @@ def make_outer_step(
         fg=fg,
         num_blocks=cfg.num_blocks,
         axis_name="block",
+        freq_axis_name="freq" if has_freq else None,
+        num_freq_shards=nf,
     )
     metrics_specs = learn_mod.OuterMetrics(P(), P(), P(), P())
     sharded = shard_map(
@@ -77,6 +85,7 @@ def make_outer_step(
         mesh=mesh,
         in_specs=(_state_specs(), P("block")),
         out_specs=(_state_specs(), metrics_specs),
+        check_vma=not has_freq,
     )
     return jax.jit(sharded)
 
@@ -145,10 +154,12 @@ def learn(
     if n % N:
         raise ValueError(f"n={n} not divisible by num_blocks={N}")
     ni = n // N
-    if mesh is not None and N % mesh.devices.size:
-        raise ValueError(
-            f"num_blocks={N} not divisible by mesh size {mesh.devices.size}"
-        )
+    if mesh is not None:
+        nb = mesh.shape.get("block", mesh.devices.size)
+        if N % nb:
+            raise ValueError(
+                f"num_blocks={N} not divisible by mesh 'block' axis {nb}"
+            )
     fg = common.FreqGeom.create(geom, b.shape[-ndim_s:])
     b_blocks = b.reshape(N, ni, *b.shape[1:])
 

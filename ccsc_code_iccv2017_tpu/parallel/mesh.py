@@ -23,6 +23,20 @@ def block_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     return jax.make_mesh((len(devices),), ("block",), devices=devices)
 
 
+def block_freq_mesh(num_block: int, num_freq: int, devices=None) -> Mesh:
+    """2-D mesh ('block', 'freq'): consensus data parallelism x
+    frequency-axis tensor parallelism. 'freq' is innermost so the
+    per-inner-iteration all_gather of spectrum slices rides the
+    fastest ICI links; the once-per-d-iteration consensus psum crosses
+    the outer axis."""
+    if devices is None:
+        devices = jax.devices()
+    devices = devices[: num_block * num_freq]
+    return jax.make_mesh(
+        (num_block, num_freq), ("block", "freq"), devices=devices
+    )
+
+
 def block_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (block) axis; replicate the rest."""
     return NamedSharding(mesh, P("block"))

@@ -107,3 +107,23 @@ def test_learn_reduce_geometry():
     obj = res.trace["obj_vals_z"]
     assert obj[-1] < obj[0]
     assert res.z.shape[2] == 6  # codes have no wavelength axis
+
+
+def test_block_freq_mesh_matches_single_device():
+    """DP x TP: 2-D ('block','freq') mesh — frequency-sharded solves
+    with all_gather reassembly — must match the local path exactly."""
+    from ccsc_code_iccv2017_tpu.parallel.mesh import block_freq_mesh
+
+    b = _toy_data()
+    geom = ProblemGeom((5, 5), 8)
+    cfg = LearnConfig(num_blocks=2, **CFG)
+    res_local = learn(b, geom, cfg)
+    res_mesh = learn(b, geom, cfg, mesh=block_freq_mesh(2, 4))
+    np.testing.assert_allclose(
+        np.asarray(res_local.d), np.asarray(res_mesh.d), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        res_local.trace["obj_vals_z"],
+        res_mesh.trace["obj_vals_z"],
+        rtol=1e-4,
+    )
