@@ -1,0 +1,99 @@
+"""Pallas TPU kernels for the CCSC hot path.
+
+The z-subproblem's rank-1 Sherman-Morrison solve (solve_conv_term_Z,
+2D/admm_learn_conv2D_large_dParallel.m:278-303; SURVEY.md lists it as
+hot loop (a)) is bandwidth-bound: per frequency it reads dhat [K],
+xi1 [1], xi2 [K] and writes z [K] with only ~6K real FLOPs of
+elementwise work. The XLA path materializes the intermediate rhs
+[N, K, F] in HBM between einsums; this kernel fuses rhs assembly, the
+K-reduction, and the rank-1 correction into one VMEM-resident pass per
+(n, F-tile), eliminating the intermediate HBM round-trips.
+
+Complex arithmetic is hand-split into re/im planes (TPU-friendly; the
+axon platform rejects complex buffers at kernel boundaries anyway —
+see freq_solvers module docstring). Layout: K on sublanes (padded to a
+multiple of 8), frequency on lanes (tiles of F_TILE).
+
+Use via solve_z_rank1_pallas; freq_solvers.solve_z remains the generic
+path (W > 1, extra_diag, CPU).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+F_TILE = 512  # lanes per grid step (multiple of 128)
+
+
+@functools.partial(jax.jit, static_argnames=("rho", "interpret"))
+def solve_z_rank1_pallas(
+    dhat: jnp.ndarray,
+    xi1_hat: jnp.ndarray,
+    xi2_hat: jnp.ndarray,
+    rho: float,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused rank-1 z-solve. dhat [K, F] complex, xi1_hat [N, F],
+    xi2_hat [N, K, F] -> [N, K, F] complex. Matches
+    freq_solvers.solve_z for W == 1 exactly:
+      (rho I + d d^H) z = conj(d) xi1 + rho xi2 per frequency.
+    """
+    K, F = dhat.shape
+    N = xi1_hat.shape[0]
+    Kp = -(-K // 8) * 8  # pad sublanes to a multiple of 8
+    Fp = -(-F // F_TILE) * F_TILE
+
+    def pad2(x, kdim):
+        pads = [(0, 0)] * x.ndim
+        if kdim is not None:
+            pads[kdim] = (0, Kp - K)
+        pads[-1] = (0, Fp - F)
+        return jnp.pad(x, pads)
+
+    dre = pad2(jnp.real(dhat), 0)
+    dim = pad2(jnp.imag(dhat), 0)
+    x1re = pad2(jnp.real(xi1_hat), None)[:, None, :]  # [N, 1, Fp]
+    x1im = pad2(jnp.imag(xi1_hat), None)[:, None, :]
+    x2re = pad2(jnp.real(xi2_hat), 1)
+    x2im = pad2(jnp.imag(xi2_hat), 1)
+
+    def kernel(dre_ref, dim_ref, x1re_ref, x1im_ref, x2re_ref, x2im_ref,
+               zre_ref, zim_ref):
+        dr = dre_ref[:]
+        di = dim_ref[:]
+        x1r = x1re_ref[0]  # [1, T]
+        x1i = x1im_ref[0]
+        # rhs = conj(d) * xi1 + rho * xi2
+        rre = dr * x1r + di * x1i + rho * x2re_ref[0]
+        rim = dr * x1i - di * x1r + rho * x2im_ref[0]
+        # s = sum_k d_k * rhs_k (complex); padded rows contribute zero
+        sre = jnp.sum(dr * rre - di * rim, axis=0, keepdims=True)
+        sim = jnp.sum(dr * rim + di * rre, axis=0, keepdims=True)
+        denom = rho + jnp.sum(dr * dr + di * di, axis=0, keepdims=True)
+        cre = sre / denom
+        cim = sim / denom
+        # z = (rhs - conj(d) * c) / rho
+        zre_ref[0] = (rre - (dr * cre + di * cim)) / rho
+        zim_ref[0] = (rim - (dr * cim - di * cre)) / rho
+
+    grid = (N, Fp // F_TILE)
+    dspec = pl.BlockSpec((Kp, F_TILE), lambda n, f: (0, f))
+    x1spec = pl.BlockSpec((1, 1, F_TILE), lambda n, f: (n, 0, f))
+    x2spec = pl.BlockSpec((1, Kp, F_TILE), lambda n, f: (n, 0, f))
+
+    out_shape = [
+        jax.ShapeDtypeStruct((N, Kp, Fp), jnp.float32),
+        jax.ShapeDtypeStruct((N, Kp, Fp), jnp.float32),
+    ]
+    zre, zim = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[dspec, dspec, x1spec, x1spec, x2spec, x2spec],
+        out_specs=[x2spec, x2spec],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(dre, dim, x1re, x1im, x2re, x2im)
+    return (zre[:, :K, :F] + 1j * zim[:, :K, :F]).astype(jnp.complex64)
