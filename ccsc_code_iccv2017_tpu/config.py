@@ -113,6 +113,16 @@ class LearnConfig:
     num_blocks: int = 1
     dtype: str = "float32"
     verbose: str = "brief"  # 'none' | 'brief' | 'all'
+    # Evaluate the objective each outer iteration (costs an extra Dz
+    # reconstruction). None = only when verbose != 'none', matching the
+    # reference (dParallel.m:126-129,161-167).
+    track_objective: Optional[bool] = None
+
+    @property
+    def with_objective(self) -> bool:
+        if self.track_objective is None:
+            return self.verbose != "none"
+        return self.track_objective
 
 
 @dataclasses.dataclass(frozen=True)

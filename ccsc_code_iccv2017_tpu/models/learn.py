@@ -164,6 +164,12 @@ def outer_step(
     )
 
     def objective(z, dhat):
+        # matching the reference, the objective is only evaluated when
+        # monitoring wants it (dParallel.m:126-129,161-167) — it costs
+        # an extra Dz reconstruction (two FFT passes) per call
+        if not cfg.with_objective:
+            return jnp.float32(0.0)
+
         def one(zl, bl):
             zhat = common.codes_to_freq(zl, fg)
             Dz = common.recon_from_freq(dhat, zhat, fg)

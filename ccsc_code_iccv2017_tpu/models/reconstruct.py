@@ -117,6 +117,7 @@ def reconstruct(
     smooth_init: Optional[jnp.ndarray] = None,
     blur_psf: Optional[jnp.ndarray] = None,
     x_orig: Optional[jnp.ndarray] = None,
+    mesh=None,
 ) -> ReconResult:
     """Solve the coding problem for a batch of observations.
 
@@ -130,16 +131,73 @@ def reconstruct(
     reconstruction uses the clean filters — this is what makes coding
     deconvolve (admm_solve_video_weighted_sampling.m:109,124-132).
     x_orig: ground truth for the PSNR trace.
+    mesh: optional 1-D mesh (any single axis name): the batch n is
+    sharded over devices — per-image coding is embarrassingly parallel
+    (the reference's driver loop over images,
+    reconstruct_2D_subsampling.m:35-60). n must divide by mesh size;
+    the gamma heuristic and PSNR/objective traces become per-shard
+    aggregates via psum.
     """
-    geom = prob.geom
-    return _reconstruct_jit(
-        b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig
+    if mesh is None:
+        return _reconstruct_jit(
+            b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig
+        )
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map
+
+    axis = mesh.axis_names[0]
+    ndev = mesh.devices.size
+    if b.shape[0] % ndev:
+        raise ValueError(
+            f"batch {b.shape[0]} not divisible by mesh size {ndev}"
+        )
+
+    def shard_step(b_l, mask_l, sm_l, xo_l):
+        # global observed max so the gamma heuristic matches the
+        # unsharded run exactly
+        m_l = b_l if mask_l is None else mask_l * b_l
+        b_max = jax.lax.pmax(jnp.max(m_l), axis)
+        res = _reconstruct_jit(
+            b_l, d, prob, cfg, mask_l, sm_l, blur_psf, xo_l, b_max
+        )
+        # traces are per-shard; average them so the out_spec can be
+        # replicated
+        tr = ReconTrace(
+            jax.lax.pmean(res.trace.obj_vals, axis),
+            jax.lax.pmean(res.trace.psnr_vals, axis),
+            jax.lax.pmean(res.trace.diff_vals, axis),
+            jax.lax.pmax(res.trace.num_iters, axis),
+        )
+        return ReconResult(res.z, res.recon, tr)
+
+    bs = P(axis)
+    out_specs = ReconResult(
+        P(axis), P(axis), ReconTrace(P(), P(), P(), P())
     )
+    fn = shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(bs, bs if mask is not None else P(), bs if smooth_init is not None else P(), bs if x_orig is not None else P()),
+        out_specs=out_specs,
+        # the while_loop carry mixes varying (data-derived) and
+        # invarying (zero-init) components; skip vma tracking
+        check_vma=False,
+    )
+    return jax.jit(fn)(b, mask, smooth_init, x_orig)
 
 
 @functools.partial(jax.jit, static_argnames=("prob", "cfg"))
 def _reconstruct_jit(
-    b, d, prob: ReconstructionProblem, cfg: SolveConfig, mask, smooth_init, blur_psf, x_orig
+    b,
+    d,
+    prob: ReconstructionProblem,
+    cfg: SolveConfig,
+    mask,
+    smooth_init,
+    blur_psf,
+    x_orig,
+    b_max=None,
 ):
     geom = prob.geom
     ndim_s = geom.ndim_spatial
@@ -183,7 +241,9 @@ def _reconstruct_jit(
 
     # --- gamma heuristic (per-app constants, SolveConfig docstring) -
     # max over OBSERVED data only: masked entries of b may hold anything
-    g = cfg.gamma_factor * cfg.lambda_prior / jnp.maximum(jnp.max(M * b), 1e-30)
+    if b_max is None:
+        b_max = jnp.max(M * b)
+    g = cfg.gamma_factor * cfg.lambda_prior / jnp.maximum(b_max, 1e-30)
     gamma1 = g / cfg.gamma_ratio
     gamma2 = g
     rho = cfg.gamma_ratio * (fg.reduce_size if cfg.scale_rho_by_reduce else 1.0)

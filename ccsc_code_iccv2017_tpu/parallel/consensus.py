@@ -29,12 +29,7 @@ from ..config import LearnConfig, ProblemGeom
 from ..models import common, learn as learn_mod
 from . import mesh as mesh_lib
 
-try:  # jax >= 0.6 moved shard_map out of experimental
-    from jax import shard_map as _shard_map_mod  # type: ignore
-
-    shard_map = _shard_map_mod
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from .mesh import shard_map
 
 
 def _state_specs(batched: bool = True):
@@ -199,7 +194,11 @@ def learn(
     if resumed_trace is not None:
         trace = resumed_trace
     else:
-        obj0 = float(obj_fn(state, b_blocks)[0])
+        obj0 = (
+            float(obj_fn(state, b_blocks)[0])
+            if cfg.with_objective
+            else 0.0
+        )
         trace = {
             "obj_vals_d": [obj0],
             "obj_vals_z": [obj0],

@@ -13,6 +13,29 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6 moved shard_map out of experimental
+    from jax import shard_map as _sm
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _sm(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _sm_old
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _sm_old(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
 
 def block_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     """1-D mesh over the 'block' (consensus / data-parallel) axis."""

@@ -36,6 +36,7 @@ CFG = dict(
     rho_z=10.0,
     lambda_prior=0.1,
     verbose="none",
+    track_objective=True,
 )
 
 
