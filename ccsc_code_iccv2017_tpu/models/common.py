@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from ..config import ProblemGeom
@@ -101,20 +102,40 @@ def l1_penalty(z: jnp.ndarray, lambda_prior: float) -> jnp.ndarray:
     return lambda_prior * jnp.sum(jnp.abs(z))
 
 
-def rel_change(new: jnp.ndarray, old: jnp.ndarray) -> jnp.ndarray:
+def rel_change(
+    new: jnp.ndarray, old: jnp.ndarray, axis_name: Optional[str] = None
+) -> jnp.ndarray:
     """||new - old|| / ||new|| — the reference's termination metric
-    (dParallel.m:186-188)."""
-    return jnp.linalg.norm((new - old).ravel()) / jnp.maximum(
-        jnp.linalg.norm(new.ravel()), 1e-30
-    )
+    (dParallel.m:186-188).
+
+    axis_name: when the arrays are shards of a mesh-distributed whole,
+    the norms are reduced across that mesh axis so every shard sees
+    the GLOBAL metric (identical termination decisions).
+    """
+    num = jnp.sum((new - old) ** 2)
+    den = jnp.sum(new**2)
+    if axis_name is not None:
+        num = jax.lax.psum(num, axis_name)
+        den = jax.lax.psum(den, axis_name)
+    return jnp.sqrt(num) / jnp.maximum(jnp.sqrt(den), 1e-30)
 
 
-def psnr(x: jnp.ndarray, ref: jnp.ndarray, crop: Sequence[int] = ()) -> jnp.ndarray:
+def psnr(
+    x: jnp.ndarray,
+    ref: jnp.ndarray,
+    crop: Sequence[int] = (),
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
     """PSNR against a [0,1] reference, optionally cropping a border as
     the reference does (admm_solve_conv2D_weighted_sampling.m:109-121).
+
+    axis_name: mesh axis holding equal-sized batch shards; the mse is
+    pmean'd over it, which equals the global mse.
     """
     if crop:
         x = fourier.crop_spatial(x, crop)
         ref = fourier.crop_spatial(ref, crop)
     mse = jnp.mean((x - ref) ** 2)
+    if axis_name is not None:
+        mse = jax.lax.pmean(mse, axis_name)
     return 10.0 * jnp.log10(1.0 / jnp.maximum(mse, 1e-12))

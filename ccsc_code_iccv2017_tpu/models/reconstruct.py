@@ -131,63 +131,77 @@ def reconstruct(
     reconstruction uses the clean filters — this is what makes coding
     deconvolve (admm_solve_video_weighted_sampling.m:109,124-132).
     x_orig: ground truth for the PSNR trace.
-    mesh: optional 1-D mesh (any single axis name): the batch n is
-    sharded over devices — per-image coding is embarrassingly parallel
-    (the reference's driver loop over images,
-    reconstruct_2D_subsampling.m:35-60). n must divide by mesh size;
-    the gamma heuristic and PSNR/objective traces become per-shard
-    aggregates via psum.
+    mesh: optional mesh: the batch n is sharded over the FIRST mesh
+    axis — per-image coding is embarrassingly parallel (the
+    reference's driver loop over images,
+    reconstruct_2D_subsampling.m:35-60). n must divide by that axis'
+    size. The gamma heuristic, the termination test, and all traces
+    are computed GLOBALLY via collectives inside the solve, so the
+    sharded run matches the unsharded one (same stopping iteration,
+    same objective values) up to float reduction order.
     """
     if mesh is None:
         return _reconstruct_jit(
             b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig
         )
+    axis = mesh.axis_names[0]
+    ndev = mesh.shape[axis]
+    if b.shape[0] % ndev:
+        raise ValueError(
+            f"batch {b.shape[0]} not divisible by mesh axis "
+            f"'{axis}' size {ndev}"
+        )
+    fn = _sharded_reconstruct_fn(
+        prob,
+        cfg,
+        mesh,
+        axis,
+        mask is not None,
+        smooth_init is not None,
+        x_orig is not None,
+    )
+    return fn(b, d, mask, smooth_init, blur_psf, x_orig)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_reconstruct_fn(
+    prob, cfg, mesh, axis, has_mask, has_sm, has_xo
+):
+    """Build (once per static config) the jitted shard_map'd solver —
+    reconstruct() is called per frame by app drivers, so the callable
+    must be cached or every call re-traces and re-compiles."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import shard_map
 
-    axis = mesh.axis_names[0]
-    ndev = mesh.devices.size
-    if b.shape[0] % ndev:
-        raise ValueError(
-            f"batch {b.shape[0]} not divisible by mesh size {ndev}"
+    def shard_step(b_l, d, mask_l, sm_l, blur, xo_l):
+        return _reconstruct_jit(
+            b_l, d, prob, cfg, mask_l, sm_l, blur, xo_l, axis_name=axis
         )
 
-    def shard_step(b_l, mask_l, sm_l, xo_l):
-        # global observed max so the gamma heuristic matches the
-        # unsharded run exactly
-        m_l = b_l if mask_l is None else mask_l * b_l
-        b_max = jax.lax.pmax(jnp.max(m_l), axis)
-        res = _reconstruct_jit(
-            b_l, d, prob, cfg, mask_l, sm_l, blur_psf, xo_l, b_max
-        )
-        # traces are per-shard; average them so the out_spec can be
-        # replicated
-        tr = ReconTrace(
-            jax.lax.pmean(res.trace.obj_vals, axis),
-            jax.lax.pmean(res.trace.psnr_vals, axis),
-            jax.lax.pmean(res.trace.diff_vals, axis),
-            jax.lax.pmax(res.trace.num_iters, axis),
-        )
-        return ReconResult(res.z, res.recon, tr)
-
-    bs = P(axis)
-    out_specs = ReconResult(
-        P(axis), P(axis), ReconTrace(P(), P(), P(), P())
-    )
+    bs, rep = P(axis), P()
     fn = shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(bs, bs if mask is not None else P(), bs if smooth_init is not None else P(), bs if x_orig is not None else P()),
-        out_specs=out_specs,
+        in_specs=(
+            bs,
+            rep,
+            bs if has_mask else rep,
+            bs if has_sm else rep,
+            rep,
+            bs if has_xo else rep,
+        ),
+        # traces are computed with global collectives inside, hence
+        # identical on every shard: replicated out_spec is exact
+        out_specs=ReconResult(bs, bs, ReconTrace(rep, rep, rep, rep)),
         # the while_loop carry mixes varying (data-derived) and
         # invarying (zero-init) components; skip vma tracking
         check_vma=False,
     )
-    return jax.jit(fn)(b, mask, smooth_init, x_orig)
+    return jax.jit(fn)
 
 
-@functools.partial(jax.jit, static_argnames=("prob", "cfg"))
+@functools.partial(jax.jit, static_argnames=("prob", "cfg", "axis_name"))
 def _reconstruct_jit(
     b,
     d,
@@ -197,8 +211,20 @@ def _reconstruct_jit(
     smooth_init,
     blur_psf,
     x_orig,
-    b_max=None,
+    axis_name=None,
 ):
+    """axis_name: when set (called inside shard_map over a batch
+    shard), every batch-wide scalar — gamma's max(b), the objective,
+    PSNR's mse, the rel-change termination metric — is reduced across
+    shards, so all shards take identical trip counts and the result
+    matches the unsharded run."""
+
+    def gsum(x):
+        return jax.lax.psum(x, axis_name) if axis_name else x
+
+    def gmax(x):
+        return jax.lax.pmax(x, axis_name) if axis_name else x
+
     geom = prob.geom
     ndim_s = geom.ndim_spatial
     data_spatial = b.shape[-ndim_s:]
@@ -241,8 +267,7 @@ def _reconstruct_jit(
 
     # --- gamma heuristic (per-app constants, SolveConfig docstring) -
     # max over OBSERVED data only: masked entries of b may hold anything
-    if b_max is None:
-        b_max = jnp.max(M * b)
+    b_max = gmax(jnp.max(M * b))
     g = cfg.gamma_factor * cfg.lambda_prior / jnp.maximum(b_max, 1e-30)
     gamma1 = g / cfg.gamma_ratio
     gamma2 = g
@@ -278,8 +303,8 @@ def _reconstruct_jit(
         r = fourier.crop_spatial(Dz + smoothinit, radius) - b
         r = fourier.crop_spatial(M_pad, radius) * r
         return (
-            0.5 * cfg.lambda_residual * jnp.sum(r * r)
-            + cfg.lambda_prior * jnp.sum(jnp.abs(z))
+            0.5 * cfg.lambda_residual * gsum(jnp.sum(r * r))
+            + cfg.lambda_prior * gsum(jnp.sum(jnp.abs(z)))
         )
 
     def psnr_of(zhat):
@@ -287,7 +312,7 @@ def _reconstruct_jit(
             return jnp.float32(0.0)
         Dz = Dz_real(zhat, dhat_clean) + smoothinit
         rec = fourier.crop_spatial(Dz, radius)
-        return common.psnr(rec, x_orig, geom.psf_radius)
+        return common.psnr(rec, x_orig, geom.psf_radius, axis_name)
 
     z_shape = (n, K, *fg.spatial_shape)
     x_shape = (n, *geom.reduce_shape, *fg.spatial_shape)
@@ -306,7 +331,7 @@ def _reconstruct_jit(
         xi2_hat = common.codes_to_freq(u2 + d2, fg)
         zhat_new = freq_solvers.solve_z(kern, xi1_hat, xi2_hat, rho)
         z_new = common.codes_from_freq(zhat_new, fg)
-        diff = common.rel_change(z_new, z)
+        diff = common.rel_change(z_new, z, axis_name)
         obj_t = obj_t.at[i + 1].set(objective(z_new, zhat_new))
         psnr_t = psnr_t.at[i + 1].set(psnr_of(zhat_new))
         diff_t = diff_t.at[i + 1].set(diff)

@@ -1,0 +1,33 @@
+"""Platform selection under the axon sitecustomize.
+
+The TPU image's sitecustomize force-registers the axon TPU platform
+and overrides JAX_PLATFORMS for every python process, so a caller's
+``JAX_PLATFORMS=cpu`` (e.g. the driver's virtual-device mesh dryrun)
+would still dial the TPU tunnel. Calling
+:func:`honor_jax_platforms_env` before any backend initializes
+re-asserts the environment's choice via jax.config.
+"""
+import os
+import sys
+
+
+def honor_jax_platforms_env() -> None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment, if set.
+
+    Must run before any JAX backend initializes (i.e. before the first
+    device lookup or computation). No-op when the variable is unset.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception as e:  # pragma: no cover - defensive
+        print(
+            f"[ccsc] warning: could not re-assert JAX_PLATFORMS={plat!r}"
+            f" ({type(e).__name__}: {e}); the run may use the default"
+            " platform instead",
+            file=sys.stderr,
+        )
