@@ -35,6 +35,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--mesh", type=int, default=0, help="devices (0=off)")
     p.add_argument("--out", default="Filters_ours_2D_large.mat")
+    p.add_argument(
+        "--init-filters",
+        default=None,
+        help="warm-start dictionary .mat (e.g. a previous --out)",
+    )
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
@@ -51,7 +56,7 @@ def main(argv=None):
     from ..data.images import load_images
     from ..models.learn import learn
     from ..parallel.mesh import block_mesh
-    from ..utils.io_mat import save_filters
+    from ..utils.io_mat import load_filters_2d, save_filters
 
     t0 = time.time()
     size = (args.size, args.size) if args.size else None
@@ -79,6 +84,9 @@ def main(argv=None):
         verbose=args.verbose,
     )
     mesh = block_mesh(args.mesh) if args.mesh else None
+    init_d = (
+        load_filters_2d(args.init_filters) if args.init_filters else None
+    )
     res = learn(
         jnp.asarray(b),
         geom,
@@ -87,6 +95,7 @@ def main(argv=None):
         mesh=mesh,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        init_d=init_d,
     )
     save_filters(args.out, res.d, res.trace, layout="2d")
     print(

@@ -71,6 +71,83 @@ def to_gray(img: np.ndarray) -> np.ndarray:
     return g
 
 
+def _to_unit_rgb(img: np.ndarray) -> np.ndarray:
+    """uint8/float image -> float32 RGB in [0, 1] (CreateImages.m:259)."""
+    rgb = img[..., :3] if img.ndim == 3 else np.stack([img] * 3, -1)
+    rgb = rgb.astype(np.float32)
+    if np.issubdtype(img.dtype, np.integer):
+        rgb = rgb / 255.0
+    return rgb
+
+
+def rgb_to_ycbcr(rgb: np.ndarray) -> np.ndarray:
+    """MATLAB rgb2ycbcr on [0,1] floats (CreateImages.m:262): ITU-R 601
+    full-to-studio-swing matrix, output still scaled to [0,1]."""
+    m = np.array(
+        [
+            [65.481, 128.553, 24.966],
+            [-37.797, -74.203, 112.0],
+            [112.0, -93.786, -18.214],
+        ],
+        np.float32,
+    )
+    off = np.array([16.0, 128.0, 128.0], np.float32)
+    return (rgb @ m.T + off) / 255.0
+
+
+def rgb_to_hsv(rgb: np.ndarray) -> np.ndarray:
+    """MATLAB rgb2hsv on [0,1] floats (CreateImages.m:265)."""
+    import colorsys  # noqa: F401  (documents the standard formula used)
+
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    v = rgb.max(-1)
+    c = v - rgb.min(-1)
+    s = np.where(v > 0, c / np.maximum(v, 1e-30), 0.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        hr = np.where(c > 0, ((g - b) / np.maximum(c, 1e-30)) % 6.0, 0.0)
+        hg = np.where(c > 0, (b - r) / np.maximum(c, 1e-30) + 2.0, 0.0)
+        hb = np.where(c > 0, (r - g) / np.maximum(c, 1e-30) + 4.0, 0.0)
+    h = np.where(v == r, hr, np.where(v == g, hg, hb)) / 6.0
+    return np.stack([h, s, v], -1).astype(np.float32)
+
+
+def convert_color(img: np.ndarray, color: str) -> np.ndarray:
+    """CreateImages.m:253-281 color dispatch: 'gray' -> [H,W],
+    'rgb'/'ycbcr'/'hsv' -> [H,W,3] float32 in [0,1]-scale."""
+    if color == "gray":
+        return to_gray(img)
+    if color == "rgb":
+        return _to_unit_rgb(img)
+    if color == "ycbcr":
+        return rgb_to_ycbcr(_to_unit_rgb(img))
+    if color == "hsv":
+        return rgb_to_hsv(_to_unit_rgb(img))
+    raise NotImplementedError(f"color mode {color!r}")
+
+
+def _per_channel(fn, img: np.ndarray) -> np.ndarray:
+    """Apply a [H,W]->[H,W] transform per color channel, as the
+    reference's CN loops do (CreateImages.m:320-324 `for j=1:num_colors`).
+    """
+    if img.ndim == 2:
+        return fn(img)
+    return np.stack([fn(img[..., c]) for c in range(img.shape[-1])], -1)
+
+
+def select_frames(
+    items: Sequence, frames: Optional[Sequence] = None
+) -> list:
+    """The reference's image_frames={A,B,C} stride selection
+    (CreateImages.m:100-107): MATLAB `A:B:C`, 1-based inclusive; C may
+    be the string 'end'."""
+    if frames is None:
+        return list(items)
+    start, step, stop = frames
+    n = len(items)
+    stop = n if isinstance(stop, str) and stop == "end" else min(int(stop), n)
+    return [items[i] for i in range(int(start) - 1, stop, int(step))]
+
+
 def _list_image_files(path: str) -> List[str]:
     files = [
         f
@@ -96,26 +173,28 @@ def load_image_list(
     zero_mean: bool = False,
     color: str = "gray",
     limit: Optional[int] = None,
+    frames: Optional[Sequence] = None,
 ) -> List[np.ndarray]:
-    """Load a folder of images as a list of [H, W] float32 arrays —
-    the CreateImagesList.m variant, for images of differing sizes
-    (used by the Poisson driver, reconstruct_poisson_noise.m:15)."""
+    """Load a folder of images as a list of [H, W] (gray) or [H, W, 3]
+    (rgb/ycbcr/hsv) float32 arrays — the CreateImagesList.m variant,
+    for images of differing sizes (used by the Poisson driver,
+    reconstruct_poisson_noise.m:15). ``frames`` is the reference's
+    {A,B,C} stride selection over the sorted file list."""
     from PIL import Image
 
+    files = select_frames(_list_image_files(path), frames)
     out = []
-    for f in _list_image_files(path)[: limit if limit else None]:
-        img = np.asarray(Image.open(f))
-        if color == "gray":
-            img = to_gray(img)
-        else:
-            raise NotImplementedError(f"color mode {color!r}")
+    for f in files[: limit if limit else None]:
+        img = convert_color(np.asarray(Image.open(f)), color)
         if contrast_normalize == "local_cn":
-            img = local_contrast_normalize(img)
+            img = _per_channel(local_contrast_normalize, img)
         elif contrast_normalize != "none":
             from . import whitening
 
             if contrast_normalize in whitening.PER_IMAGE_MODES:
-                img = whitening.PER_IMAGE_MODES[contrast_normalize](img)
+                img = _per_channel(
+                    whitening.PER_IMAGE_MODES[contrast_normalize], img
+                )
             elif contrast_normalize in whitening.STACK_MODES:
                 pass  # applied on the assembled stack in load_images
             else:
@@ -128,6 +207,17 @@ def load_image_list(
     return out
 
 
+def _resize(img: np.ndarray, size: Sequence[int]) -> np.ndarray:
+    from PIL import Image
+
+    def one(ch):
+        return np.asarray(
+            Image.fromarray(ch).resize((size[1], size[0]), Image.BILINEAR)
+        )
+
+    return _per_channel(one, img)
+
+
 def load_images(
     path: str,
     contrast_normalize: str = "none",
@@ -136,29 +226,25 @@ def load_images(
     square: bool = False,
     limit: Optional[int] = None,
     size: Optional[Sequence[int]] = None,
+    frames: Optional[Sequence] = None,
 ) -> np.ndarray:
-    """CreateImages.m equivalent: folder -> [n, H, W] float32.
+    """CreateImages.m equivalent: folder -> [n, H, W] float32 (gray)
+    or [n, H, W, 3] (rgb/ycbcr/hsv, CreateImages.m:253-281).
 
     ``square`` center-crops to the smaller dimension (the reference
     pads, CreateImages.m:665-699; cropping avoids fabricating pixels);
-    ``size`` resizes after load.
+    ``size`` resizes after load; ``frames`` strides the file list
+    (CreateImages.m:100-107).
     """
-    imgs = load_image_list(path, contrast_normalize, zero_mean, color, limit)
+    imgs = load_image_list(
+        path, contrast_normalize, zero_mean, color, limit, frames
+    )
     if size is not None:
-        from PIL import Image
-
-        imgs = [
-            np.asarray(
-                Image.fromarray(i).resize(
-                    (size[1], size[0]), Image.BILINEAR
-                )
-            )
-            for i in imgs
-        ]
+        imgs = [_resize(i, size) for i in imgs]
     if square:
         imgs2 = []
         for i in imgs:
-            s = min(i.shape)
+            s = min(i.shape[:2])
             y0 = (i.shape[0] - s) // 2
             x0 = (i.shape[1] - s) // 2
             imgs2.append(i[y0 : y0 + s, x0 : x0 + s])
@@ -173,7 +259,13 @@ def load_images(
     from . import whitening
 
     if contrast_normalize in whitening.STACK_MODES:
-        stack = whitening.STACK_MODES[contrast_normalize](stack)
+        mode = whitening.STACK_MODES[contrast_normalize]
+        if stack.ndim == 4:  # color: whiten each channel's stack
+            stack = np.stack(
+                [mode(stack[..., c]) for c in range(stack.shape[-1])], -1
+            )
+        else:
+            stack = mode(stack)
     return stack
 
 

@@ -319,12 +319,14 @@ def learn(
     mesh: Optional[jax.sharding.Mesh] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 5,
+    init_d: Optional[jnp.ndarray] = None,
 ) -> LearnResult:
     """Learn a filter bank from data b [n, *reduce, *data_spatial].
 
     n is split into cfg.num_blocks consensus blocks. With ``mesh``
     (1-D, axis 'block') blocks are sharded over devices and the
     consensus average rides ICI; otherwise blocks run locally.
+    ``init_d`` [k, *reduce, *support] warm-starts the dictionary.
     """
     from ..parallel import consensus
 
@@ -336,4 +338,5 @@ def learn(
         mesh=mesh,
         checkpoint_dir=checkpoint_dir,
         checkpoint_every=checkpoint_every,
+        init_d=init_d,
     )

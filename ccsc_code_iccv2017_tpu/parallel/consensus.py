@@ -132,6 +132,7 @@ def learn(
     mesh: Optional[Mesh] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 5,
+    init_d: Optional[jnp.ndarray] = None,
 ) -> learn_mod.LearnResult:
     """Driver: Python outer loop around the jitted consensus step, with
     the reference's trace protocol (obj_vals_d / obj_vals_z / tim_vals,
@@ -140,6 +141,12 @@ def learn(
     ``checkpoint_dir`` enables atomic mid-run snapshots every
     ``checkpoint_every`` outer iterations and resume-on-restart (full
     ADMM state including duals — see utils.checkpoint).
+
+    ``init_d`` [k, *reduce, *support] warm-starts the dictionary (every
+    block's local copy and the consensus average). The reference's
+    consensus learners declare this parameter but never read it
+    (dParallel.m:4, SURVEY.md section 5); the intent — wired in the
+    hyperspectral learner, admm_learn.m:50-58 — is implemented here.
     """
     from ..utils import checkpoint as ckpt
 
@@ -161,6 +168,18 @@ def learn(
     if key is None:
         key = jax.random.PRNGKey(0)
     state = learn_mod.init_state(key, geom, fg, N, ni, b.dtype)
+    if init_d is not None:
+        if tuple(init_d.shape) != tuple(geom.filter_shape):
+            raise ValueError(
+                f"init_d shape {init_d.shape} != {geom.filter_shape}"
+            )
+        from ..ops import fourier
+
+        d_full = fourier.circ_embed(jnp.asarray(init_d, b.dtype), fg.spatial_shape)
+        state = state._replace(
+            d_local=jnp.broadcast_to(d_full, state.d_local.shape),
+            dbar=d_full,
+        )
     start_it = 0
     resumed_trace = None
     if checkpoint_dir is not None:

@@ -1,0 +1,101 @@
+"""Color conversion and frame-selection paths of the image loader
+(CreateImages.m:100-107 frame striding, :253-281 color dispatch)."""
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.data import images as I
+
+REF = "/root/reference"
+
+
+def _rgb(seed=0, h=20, w=24):
+    r = np.random.default_rng(seed)
+    return (r.random((h, w, 3)) * 255).astype(np.uint8)
+
+
+def test_ycbcr_matches_matlab_constants():
+    # pure colors against MATLAB rgb2ycbcr([1 0 0; 0 1 0; 0 0 1; 1 1 1])
+    rgb = np.array(
+        [[[1, 0, 0], [0, 1, 0], [0, 0, 1], [1, 1, 1]]], np.float32
+    )
+    out = I.rgb_to_ycbcr(rgb) * 255.0
+    expect = np.array(
+        [
+            [81.481, 90.203, 240.0],
+            [144.553, 53.797, 34.214],
+            [40.966, 240.0, 109.786],
+            [235.0, 128.0, 128.0],
+        ],
+        np.float32,
+    )
+    np.testing.assert_allclose(out[0], expect, atol=1e-2)
+
+
+def test_hsv_matches_colorsys():
+    import colorsys
+
+    rgb = _rgb(1).astype(np.float32) / 255.0
+    out = I.rgb_to_hsv(rgb)
+    for y in range(0, 20, 7):
+        for x in range(0, 24, 9):
+            h, s, v = colorsys.rgb_to_hsv(*rgb[y, x])
+            np.testing.assert_allclose(
+                out[y, x], [h, s, v], atol=1e-6, err_msg=f"{y},{x}"
+            )
+
+
+def test_convert_color_shapes_and_gray_equiv():
+    img = _rgb(2)
+    assert I.convert_color(img, "gray").shape == (20, 24)
+    for mode in ("rgb", "ycbcr", "hsv"):
+        out = I.convert_color(img, mode)
+        assert out.shape == (20, 24, 3) and out.dtype == np.float32
+    np.testing.assert_allclose(
+        I.convert_color(img, "rgb") @ [0.2989, 0.5870, 0.1140],
+        I.convert_color(img, "gray"),
+        atol=1e-5,
+    )
+
+
+def test_per_channel_local_cn_color_load():
+    b = I.load_images(
+        f"{REF}/2D/Inpainting/Test",
+        contrast_normalize="local_cn",
+        color="rgb",
+        limit=2,
+        size=(32, 32),
+    )
+    assert b.shape == (2, 32, 32, 3)
+    assert np.isfinite(b).all()
+    # per-channel CN: each channel separately normalized, so channel
+    # means are near zero independently
+    assert abs(b[..., 0].mean()) < 0.2 and abs(b[..., 2].mean()) < 0.2
+
+
+def test_select_frames_matlab_semantics():
+    items = list("abcdefghij")
+    # MATLAB 1:2:7 -> indices 1,3,5,7 (1-based)
+    assert I.select_frames(items, (1, 2, 7)) == ["a", "c", "e", "g"]
+    # 'end' sentinel
+    assert I.select_frames(items, (8, 1, "end")) == ["h", "i", "j"]
+    # stop beyond length clamps
+    assert I.select_frames(items, (9, 1, 99)) == ["i", "j"]
+    assert I.select_frames(items, None) == items
+
+
+def test_frames_in_loader():
+    all_f = I.load_image_list(f"{REF}/2D/Inpainting/Test")
+    some = I.load_image_list(f"{REF}/2D/Inpainting/Test", frames=(1, 3, "end"))
+    assert len(some) == len(all_f[::3])
+    np.testing.assert_array_equal(some[1], all_f[3])
+
+
+def test_color_stack_whitening_per_channel():
+    b = I.load_images(
+        f"{REF}/2D/Inpainting/Test",
+        contrast_normalize="PCA_whitening",
+        color="rgb",
+        limit=4,
+        size=(24, 24),
+    )
+    assert b.shape == (4, 24, 24, 3) and np.isfinite(b).all()
