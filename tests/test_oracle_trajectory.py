@@ -1,0 +1,172 @@
+"""Independent NumPy oracle of the consensus ADMM iteration.
+
+The framework's outer step (models/learn.py::outer_step) re-derives the
+reference's update order (2D/admm_learn_conv2D_large_dzParallel.m:90-194):
+global kernel prox -> per-block dual update -> per-block frequency solve
+-> consensus average for the d-pass; soft-threshold prox -> dual update
+-> Sherman-Morrison solve for the z-pass. This oracle re-implements that
+iteration from the math alone — full complex FFTs, dense per-frequency
+``np.linalg.solve`` (no Woodbury/Sherman-Morrison/rfft tricks) and
+explicit Python loops — and checks the jitted learner reproduces its
+trajectory state-for-state over several outer iterations.
+
+This is the integration-level counterpart of tests/test_ops.py's
+per-solve dense checks: it pins the *composition* (update order, dual
+bookkeeping, consensus averaging), which is where the reference's
+convergence behavior lives (SURVEY.md section 7 "Hard parts").
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+from ccsc_code_iccv2017_tpu.models import common, learn as learn_mod
+
+
+# ------------------------- NumPy oracle ------------------------------
+
+def _circ_embed_np(psf, spatial_shape):
+    ndim_s = len(spatial_shape)
+    support = psf.shape[-ndim_s:]
+    pad = [(0, 0)] * (psf.ndim - ndim_s) + [
+        (0, full - s) for full, s in zip(spatial_shape, support)
+    ]
+    x = np.pad(psf, pad)
+    shift = tuple(-(s // 2) for s in support)
+    return np.roll(x, shift, axis=tuple(range(x.ndim - ndim_s, x.ndim)))
+
+
+def _circ_extract_np(x, support):
+    ndim_s = len(support)
+    axes = tuple(range(x.ndim - ndim_s, x.ndim))
+    rolled = np.roll(x, tuple(s // 2 for s in support), axis=axes)
+    sl = [slice(None)] * (x.ndim - ndim_s) + [slice(0, s) for s in support]
+    return rolled[tuple(sl)]
+
+
+def _kernel_proj_np(d_full, support, spatial_shape):
+    ndim_s = len(support)
+    d_sup = _circ_extract_np(d_full, support)
+    axes = tuple(range(d_sup.ndim - ndim_s, d_sup.ndim))
+    sq = np.sum(d_sup * d_sup, axis=axes, keepdims=True)
+    scale = np.where(sq >= 1.0, 1.0 / np.sqrt(np.maximum(sq, 1e-30)), 1.0)
+    return _circ_embed_np(d_sup * scale, spatial_shape)
+
+
+def _soft_np(u, theta):
+    return np.sign(u) * np.maximum(np.abs(u) - theta, 0.0)
+
+
+def oracle_outer_step(state, b_blocks, geom, cfg, spatial_shape):
+    """One outer consensus iteration, dense NumPy, full complex FFTs."""
+    L, ni = b_blocks.shape[:2]
+    K = geom.num_filters
+    support = geom.spatial_support
+    radius = geom.psf_radius
+    ndim_s = len(spatial_shape)
+    fft_axes = tuple(range(-ndim_s, 0))
+    F = int(np.prod(spatial_shape))
+
+    d_local, dual_d, dbar, udbar, z, dual_z = [
+        np.array(v, np.float64) for v in state
+    ]
+
+    pad = [(0, 0), (0, 0)] + [(r, r) for r in radius]
+    b_pad = np.pad(b_blocks.astype(np.float64), pad)
+    bhat = np.fft.fftn(b_pad, axes=fft_axes).reshape(L, ni, F)
+
+    # ---- d-pass: Gram fixed at the incoming codes ----
+    zhat = np.fft.fftn(z, axes=fft_axes).reshape(L, ni, K, F)
+
+    for _ in range(cfg.max_it_d):
+        u = _kernel_proj_np(dbar + udbar, support, spatial_shape)
+        dual_d = dual_d + (d_local - u[None])
+        xi = u[None] - dual_d
+        xi_hat = np.fft.fftn(xi, axes=fft_axes).reshape(L, K, F)
+        d_new_hat = np.empty_like(xi_hat)
+        for l in range(L):
+            for f in range(F):
+                Z = zhat[l, :, :, f]  # [ni, K]
+                A = cfg.rho_d * np.eye(K) + Z.conj().T @ Z
+                rhs = Z.conj().T @ bhat[l, :, f] + cfg.rho_d * xi_hat[l, :, f]
+                d_new_hat[l, :, f] = np.linalg.solve(A, rhs)
+        d_local = np.real(
+            np.fft.ifftn(
+                d_new_hat.reshape(L, K, *spatial_shape), axes=fft_axes
+            )
+        )
+        dbar = np.mean(d_local, axis=0)
+        udbar = np.mean(dual_d, axis=0)
+
+    # ---- z-pass: dictionary fixed at the projected consensus ----
+    d_proj = _kernel_proj_np(dbar + udbar, support, spatial_shape)
+    dhat = np.fft.fftn(d_proj, axes=fft_axes).reshape(K, F)
+    theta = cfg.lambda_prior / cfg.rho_z
+
+    for _ in range(cfg.max_it_z):
+        u2 = _soft_np(z + dual_z, theta)
+        dual_z = dual_z + (z - u2)
+        xi2 = u2 - dual_z
+        xi2_hat = np.fft.fftn(xi2, axes=fft_axes).reshape(L, ni, K, F)
+        z_new_hat = np.empty_like(xi2_hat)
+        for l in range(L):
+            for n in range(ni):
+                for f in range(F):
+                    d = dhat[:, f]
+                    A = cfg.rho_z * np.eye(K) + np.outer(d.conj(), d)
+                    rhs = d.conj() * bhat[l, n, f] + cfg.rho_z * xi2_hat[l, n, :, f]
+                    z_new_hat[l, n, :, f] = np.linalg.solve(A, rhs)
+        z = np.real(
+            np.fft.ifftn(
+                z_new_hat.reshape(L, ni, K, *spatial_shape), axes=fft_axes
+            )
+        )
+
+    return d_local, dual_d, dbar, udbar, z, dual_z
+
+
+def test_outer_step_matches_numpy_oracle():
+    geom = ProblemGeom((3, 3), 4)
+    cfg = LearnConfig(
+        max_it=3,
+        max_it_d=2,
+        max_it_z=2,
+        num_blocks=2,
+        rho_d=50.0,
+        rho_z=2.0,
+        lambda_residual=1.0,
+        lambda_prior=1.0,
+        verbose="none",
+    )
+    L, ni, size = 2, 2, 8
+    fg = common.FreqGeom.create(geom, (size, size))
+
+    b_blocks = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (L, ni, size, size)),
+        np.float32,
+    )
+    state = learn_mod.init_state(jax.random.PRNGKey(0), geom, fg, L, ni)
+
+    step = jax.jit(
+        lambda s, b: learn_mod.outer_step(
+            s, b, geom=geom, cfg=cfg, fg=fg, num_blocks=L, axis_name=None
+        )
+    )
+
+    np_state = tuple(np.array(v, np.float64) for v in state)
+    jx_state = state
+    for it in range(cfg.max_it):
+        np_state = oracle_outer_step(
+            np_state, b_blocks, geom, cfg, fg.spatial_shape
+        )
+        jx_state, _ = step(jx_state, jnp.asarray(b_blocks))
+        for name, a, b in zip(
+            learn_mod.LearnState._fields, jx_state, np_state
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float64),
+                b,
+                atol=5e-4,
+                rtol=5e-4,
+                err_msg=f"outer iter {it}, field {name}",
+            )
