@@ -57,26 +57,38 @@ def local_contrast_normalize(img: np.ndarray) -> np.ndarray:
     return ((dim - lmn) / lstd).astype(np.float32)
 
 
+def _int_scale(dtype) -> float:
+    """Full-scale value of an integer image dtype (255 for uint8,
+    65535 for uint16 TIFFs, ...)."""
+    return float(np.iinfo(dtype).max)
+
+
 def to_gray(img: np.ndarray) -> np.ndarray:
     """rgb2gray with MATLAB's ITU-R 601 weights (CreateImages.m:266-277),
     output in [0, 1]."""
     is_int = np.issubdtype(img.dtype, np.integer)
+    if img.ndim == 3 and img.shape[-1] == 2:  # gray + alpha (PIL 'LA')
+        img = img[..., 0]
     if img.ndim == 2:
         g = img.astype(np.float32)
     else:
         w = np.array([0.2989, 0.5870, 0.1140], np.float32)
         g = img[..., :3].astype(np.float32) @ w
     if is_int:
-        g = g / 255.0
+        g = g / _int_scale(img.dtype)
     return g
 
 
 def _to_unit_rgb(img: np.ndarray) -> np.ndarray:
-    """uint8/float image -> float32 RGB in [0, 1] (CreateImages.m:259)."""
+    """integer/float image -> float32 RGB in [0, 1] (CreateImages.m:259).
+    Gray and gray+alpha inputs are replicated to 3 channels; RGBA drops
+    alpha; integer dtypes are scaled by their full-scale value."""
+    if img.ndim == 3 and img.shape[-1] == 2:  # gray + alpha (PIL 'LA')
+        img = img[..., 0]
     rgb = img[..., :3] if img.ndim == 3 else np.stack([img] * 3, -1)
     rgb = rgb.astype(np.float32)
     if np.issubdtype(img.dtype, np.integer):
-        rgb = rgb / 255.0
+        rgb = rgb / _int_scale(img.dtype)
     return rgb
 
 
@@ -96,9 +108,8 @@ def rgb_to_ycbcr(rgb: np.ndarray) -> np.ndarray:
 
 
 def rgb_to_hsv(rgb: np.ndarray) -> np.ndarray:
-    """MATLAB rgb2hsv on [0,1] floats (CreateImages.m:265)."""
-    import colorsys  # noqa: F401  (documents the standard formula used)
-
+    """MATLAB rgb2hsv on [0,1] floats (CreateImages.m:265); the standard
+    colorsys.rgb_to_hsv formula, vectorized (see tests/test_color.py)."""
     r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
     v = rgb.max(-1)
     c = v - rgb.min(-1)
@@ -144,8 +155,18 @@ def select_frames(
         return list(items)
     start, step, stop = frames
     n = len(items)
-    stop = n if isinstance(stop, str) and stop == "end" else min(int(stop), n)
-    return [items[i] for i in range(int(start) - 1, stop, int(step))]
+
+    def resolve(v):
+        return n if isinstance(v, str) and v == "end" else int(v)
+
+    start, stop, step = resolve(start), resolve(stop), int(step)
+    if step == 0:
+        raise ValueError("frame stride B must be nonzero")
+    if step > 0:
+        idx = range(start - 1, min(stop, n), step)
+    else:  # MATLAB 7:-2:1 -> items 7,5,3,1 (inclusive of the stop)
+        idx = range(min(start, n) - 1, stop - 2, step)
+    return [items[i] for i in idx if 0 <= i < n]
 
 
 def _list_image_files(path: str) -> List[str]:
@@ -218,6 +239,22 @@ def _resize(img: np.ndarray, size: Sequence[int]) -> np.ndarray:
     return _per_channel(one, img)
 
 
+def channels_to_reduce(stack: np.ndarray) -> np.ndarray:
+    """[n, H, W, C] -> [n, C, H, W]: color channels as the model's
+    reduce axis (b = [n, *reduce, *spatial], config.ProblemGeom) so a
+    color stack feeds learn()/reconstruct() with
+    ProblemGeom(support, k, reduce_shape=(C,)) — channels share one
+    code map the way wavelengths do (2-3D admm_learn.m:13-16)."""
+    return np.moveaxis(stack, -1, 1)
+
+
+def channels_to_batch(stack: np.ndarray) -> np.ndarray:
+    """[n, H, W, C] -> [n*C, H, W]: each channel coded independently,
+    the reference's per-channel driver loop
+    (reconstruct_subsampling_lightfield.m:25 loops rgb)."""
+    return np.moveaxis(stack, -1, 1).reshape(-1, *stack.shape[1:-1])
+
+
 def load_images(
     path: str,
     contrast_normalize: str = "none",
@@ -227,9 +264,17 @@ def load_images(
     limit: Optional[int] = None,
     size: Optional[Sequence[int]] = None,
     frames: Optional[Sequence] = None,
+    layout: str = "channels_last",
 ) -> np.ndarray:
     """CreateImages.m equivalent: folder -> [n, H, W] float32 (gray)
-    or [n, H, W, 3] (rgb/ycbcr/hsv, CreateImages.m:253-281).
+    or, for color modes (rgb/ycbcr/hsv, CreateImages.m:253-281), an
+    array whose channel placement is picked by ``layout``:
+
+    - 'channels_last': [n, H, W, 3] (the loader-level parity layout);
+    - 'reduce':        [n, 3, H, W] — the model layout
+      b = [n, *reduce, *spatial]; pair with
+      ProblemGeom(support, k, reduce_shape=(3,));
+    - 'batch':         [n*3, H, W] — channels coded independently.
 
     ``square`` center-crops to the smaller dimension (the reference
     pads, CreateImages.m:665-699; cropping avoids fabricating pixels);
@@ -266,6 +311,20 @@ def load_images(
             )
         else:
             stack = mode(stack)
+    return _apply_layout(stack, layout)
+
+
+def _apply_layout(stack: np.ndarray, layout: str) -> np.ndarray:
+    if layout not in ("channels_last", "reduce", "batch"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "reduce":
+        # gray gets a singleton reduce axis so the shape contract
+        # [n, *reduce, *spatial] holds for every color mode
+        return (
+            stack[:, None] if stack.ndim == 3 else channels_to_reduce(stack)
+        )
+    if layout == "batch" and stack.ndim == 4:
+        return channels_to_batch(stack)
     return stack
 
 
@@ -282,13 +341,43 @@ def load_images_native(
     native library is unavailable."""
     from . import native
 
+    # Match load_images' pipeline order exactly: CN (original
+    # resolution) -> resize -> square crop -> layout. size/square are
+    # deferred so CN sees the same pixels as the numpy path.
+    layout = kwargs.pop("layout", "channels_last")
+    size = kwargs.pop("size", None)
+    square = kwargs.pop("square", False)
     stack = load_images(path, "none", False, **kwargs)
+    is_color = stack.ndim == 4
+    # the kernel consumes [*, H, W] planes: fold color into the batch
+    planes = (
+        np.ascontiguousarray(np.moveaxis(stack, -1, 1)).reshape(
+            -1, *stack.shape[1:3]
+        )
+        if is_color
+        else stack
+    )
     if contrast_normalize == "local_cn":
-        stack = native.local_cn_batch(stack)
+        planes = native.local_cn_batch(planes)
     elif contrast_normalize != "none":
         raise NotImplementedError(
             f"native path supports none/local_cn, got {contrast_normalize!r}"
         )
     if zero_mean:
-        stack = native.zero_mean_batch(stack)
-    return stack
+        planes = native.zero_mean_batch(planes)
+    if is_color:
+        stack = np.moveaxis(
+            planes.reshape(stack.shape[0], stack.shape[-1], *stack.shape[1:3]),
+            1,
+            -1,
+        )
+    else:
+        stack = planes
+    if size is not None:
+        stack = np.stack([_resize(i, size) for i in stack])
+    if square:
+        s = min(stack.shape[1:3])
+        y0 = (stack.shape[1] - s) // 2
+        x0 = (stack.shape[2] - s) // 2
+        stack = stack[:, y0 : y0 + s, x0 : x0 + s]
+    return _apply_layout(stack.astype(np.float32), layout)

@@ -1,11 +1,16 @@
 """Color conversion and frame-selection paths of the image loader
 (CreateImages.m:100-107 frame striding, :253-281 color dispatch)."""
+import os
+
 import numpy as np
 import pytest
 
 from ccsc_code_iccv2017_tpu.data import images as I
 
 REF = "/root/reference"
+needs_ref = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference not mounted"
+)
 
 
 def _rgb(seed=0, h=20, w=24):
@@ -57,6 +62,7 @@ def test_convert_color_shapes_and_gray_equiv():
     )
 
 
+@needs_ref
 def test_per_channel_local_cn_color_load():
     b = I.load_images(
         f"{REF}/2D/Inpainting/Test",
@@ -81,8 +87,60 @@ def test_select_frames_matlab_semantics():
     # stop beyond length clamps
     assert I.select_frames(items, (9, 1, 99)) == ["i", "j"]
     assert I.select_frames(items, None) == items
+    # negative strides are inclusive of the stop, like MATLAB 7:-2:1
+    assert I.select_frames(items, (7, -2, 1)) == ["g", "e", "c", "a"]
+    assert I.select_frames(items, ("end", -3, 1)) == ["j", "g", "d", "a"]
+    # start beyond length clamps for descending strides
+    assert I.select_frames(items, (99, -4, 1)) == ["j", "f", "b"]
+    with pytest.raises(ValueError):
+        I.select_frames(items, (1, 0, 5))
 
 
+def test_gray_alpha_and_uint16_inputs():
+    r = np.random.default_rng(5)
+    la = (r.random((6, 7, 2)) * 255).astype(np.uint8)  # gray + alpha
+    assert I.convert_color(la, "rgb").shape == (6, 7, 3)
+    assert I.convert_color(la, "hsv").shape == (6, 7, 3)
+    assert I.convert_color(la, "gray").shape == (6, 7)
+    np.testing.assert_allclose(
+        I.convert_color(la, "gray"), la[..., 0] / 255.0, atol=1e-6
+    )
+    u16 = (r.random((6, 7, 3)) * 65535).astype(np.uint16)
+    rgb = I.convert_color(u16, "rgb")
+    assert rgb.max() <= 1.0 and rgb.min() >= 0.0
+    assert I.convert_color(u16, "gray").max() <= 1.0
+
+
+def test_color_layouts():
+    stack = np.arange(2 * 4 * 5 * 3, dtype=np.float32).reshape(2, 4, 5, 3)
+    red = I.channels_to_reduce(stack)
+    assert red.shape == (2, 3, 4, 5)
+    np.testing.assert_array_equal(red[1, 2], stack[1, :, :, 2])
+    bat = I.channels_to_batch(stack)
+    assert bat.shape == (6, 4, 5)
+    np.testing.assert_array_equal(bat[5], stack[1, :, :, 2])
+    # gray stacks: 'reduce' inserts the singleton axis, 'batch' is id
+    gray = np.zeros((2, 4, 5), np.float32)
+    assert I._apply_layout(gray, "reduce").shape == (2, 1, 4, 5)
+    assert I._apply_layout(gray, "batch").shape == (2, 4, 5)
+    with pytest.raises(ValueError):
+        I._apply_layout(gray, "nope")
+
+
+@needs_ref
+def test_native_loader_color_layout_matches_numpy():
+    kw = dict(color="rgb", limit=2, size=(24, 24), layout="reduce")
+    a = I.load_images(
+        f"{REF}/2D/Inpainting/Test", contrast_normalize="local_cn", **kw
+    )
+    b = I.load_images_native(
+        f"{REF}/2D/Inpainting/Test", contrast_normalize="local_cn", **kw
+    )
+    assert a.shape == b.shape == (2, 3, 24, 24)
+    np.testing.assert_allclose(a, b, atol=2e-5)
+
+
+@needs_ref
 def test_frames_in_loader():
     all_f = I.load_image_list(f"{REF}/2D/Inpainting/Test")
     some = I.load_image_list(f"{REF}/2D/Inpainting/Test", frames=(1, 3, "end"))
@@ -90,6 +148,7 @@ def test_frames_in_loader():
     np.testing.assert_array_equal(some[1], all_f[3])
 
 
+@needs_ref
 def test_color_stack_whitening_per_channel():
     b = I.load_images(
         f"{REF}/2D/Inpainting/Test",
