@@ -11,31 +11,41 @@ north-star pace (20 iters / 300 s), i.e. > 1.0 beats the target pace.
 Prints exactly one JSON line:
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
+Robustness: the measured workload runs in a SUBPROCESS with a watchdog
+(the axon TPU tunnel can wedge and hang a client indefinitely; a hung
+bench would record nothing for the round). If the TPU attempt times
+out or dies, the bench reruns on CPU and says so in the metric name —
+a degraded-but-present number beats a hang.
+
 Env knobs: CCSC_BENCH_N (images, default 128), CCSC_BENCH_SIZE (image
 side, default 100), CCSC_BENCH_K (filters, default 100),
 CCSC_BENCH_BLOCKS (default 8), CCSC_BENCH_ITERS (timed outer
-iterations, default 3).
+iterations, default 3), CCSC_BENCH_TIMEOUT (seconds per attempt,
+default 900), CCSC_BENCH_INPROCESS=1 (skip the watchdog wrapper).
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
-
-honor_jax_platforms_env()
-
-import jax
-import jax.numpy as jnp
-
-from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
-from ccsc_code_iccv2017_tpu.models import common, learn as learn_mod
-from ccsc_code_iccv2017_tpu.parallel import consensus
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
 
-def main():
+def run_workload():
+    """The measured workload. Runs in-process; called in the child."""
+    from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+    from ccsc_code_iccv2017_tpu.models import common, learn as learn_mod
+    from ccsc_code_iccv2017_tpu.parallel import consensus
+
     n = int(os.environ.get("CCSC_BENCH_N", 128))
     size = int(os.environ.get("CCSC_BENCH_SIZE", 100))
     k = int(os.environ.get("CCSC_BENCH_K", 100))
@@ -76,19 +86,87 @@ def main():
     float(m.obj_z)  # fences the whole chain
     dt = time.perf_counter() - t0
 
-    iters_per_sec = iters / dt
+    platform = jax.devices()[0].platform
+    return {
+        "iters_per_sec": iters / dt,
+        "n": n,
+        "size": size,
+        "k": k,
+        "blocks": blocks,
+        "platform": platform,
+    }
+
+
+def emit(r, degraded=False):
     target_pace = 20.0 / 300.0  # north-star: 20 outer iters in 5 min
+    suffix = (
+        f", DEGRADED: TPU unreachable, ran on {r['platform']}"
+        if degraded
+        else ", 1 chip"
+    )
     print(
         json.dumps(
             {
                 "metric": (
                     f"2D consensus ADMM outer iters/sec "
-                    f"(k={k} 11x11 filters, n={n}x{size}^2, "
-                    f"{blocks} blocks, 1 chip)"
+                    f"(k={r['k']} 11x11 filters, n={r['n']}x{r['size']}^2, "
+                    f"{r['blocks']} blocks{suffix})"
                 ),
-                "value": round(iters_per_sec, 4),
+                "value": round(r["iters_per_sec"], 4),
                 "unit": "outer_iters/sec",
-                "vs_baseline": round(iters_per_sec / target_pace, 3),
+                "vs_baseline": round(r["iters_per_sec"] / target_pace, 3),
+            }
+        )
+    )
+
+
+def attempt(extra_env, timeout):
+    """Run the workload in a watched subprocess; return dict or None."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["CCSC_BENCH_INPROCESS"] = "1"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        return None
+    for line in out.stdout.splitlines()[::-1]:
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return None
+
+
+def main():
+    if os.environ.get("CCSC_BENCH_INPROCESS"):
+        print(json.dumps(run_workload()))
+        return
+    timeout = float(os.environ.get("CCSC_BENCH_TIMEOUT", 900))
+    r = attempt({}, timeout)
+    if r is not None:
+        emit(r, degraded=r["platform"] not in ("tpu", "axon"))
+        return
+    # TPU attempt hung or crashed — degrade to CPU so the round still
+    # records a number (and says so).
+    r = attempt({"JAX_PLATFORMS": "cpu"}, timeout)
+    if r is not None:
+        emit(r, degraded=True)
+        return
+    print(
+        json.dumps(
+            {
+                "metric": "2D consensus ADMM outer iters/sec (FAILED: "
+                "no backend completed within timeout)",
+                "value": 0.0,
+                "unit": "outer_iters/sec",
+                "vs_baseline": 0.0,
             }
         )
     )
