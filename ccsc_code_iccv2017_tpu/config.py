@@ -117,6 +117,10 @@ class LearnConfig:
     # reconstruction). None = only when verbose != 'none', matching the
     # reference (dParallel.m:126-129,161-167).
     track_objective: Optional[bool] = None
+    # Route the W == 1 z-solve through the fused Pallas TPU kernel
+    # (ops.pallas_kernels; interpret mode off-TPU). Bit-compatible with
+    # the einsum path up to float reassociation.
+    use_pallas: bool = False
 
     @property
     def with_objective(self) -> bool:
@@ -154,3 +158,5 @@ class SolveConfig:
     lambda_smooth: float = 0.5
     dtype: str = "float32"
     verbose: str = "brief"
+    # Route the W == 1 z-solve through the fused Pallas TPU kernel.
+    use_pallas: bool = False

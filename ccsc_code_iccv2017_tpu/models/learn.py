@@ -238,7 +238,7 @@ def outer_step(
         zhat_new = fgather(
             jax.vmap(
                 lambda bh, xh: freq_solvers.solve_z(
-                    zkern, bh, xh, cfg.rho_z
+                    zkern, bh, xh, cfg.rho_z, use_pallas=cfg.use_pallas
                 )
             )(bhat_l, xi2_hat)
         )

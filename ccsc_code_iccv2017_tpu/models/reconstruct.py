@@ -329,7 +329,9 @@ def _reconstruct_jit(
         d2 = d2 - (z - u2)
         xi1_hat = common.data_to_freq(u1 + d1, fg)
         xi2_hat = common.codes_to_freq(u2 + d2, fg)
-        zhat_new = freq_solvers.solve_z(kern, xi1_hat, xi2_hat, rho)
+        zhat_new = freq_solvers.solve_z(
+            kern, xi1_hat, xi2_hat, rho, use_pallas=cfg.use_pallas
+        )
         z_new = common.codes_from_freq(zhat_new, fg)
         diff = common.rel_change(z_new, z, axis_name)
         obj_t = obj_t.at[i + 1].set(objective(z_new, zhat_new))

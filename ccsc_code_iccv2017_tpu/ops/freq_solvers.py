@@ -97,11 +97,19 @@ def precompute_z_kernel(
     return ZSolveKernel(dhat, dinv, hermitian_inverse(M), None)
 
 
+def _pallas_interpret() -> bool:
+    """Interpret mode off only on real TPU backends (tpu / axon)."""
+    import jax
+
+    return jax.default_backend() not in ("tpu", "axon")
+
+
 def solve_z(
     kernel: ZSolveKernel,
     xi1_hat: jnp.ndarray,
     xi2_hat: jnp.ndarray,
     rho: float,
+    use_pallas: bool = False,
 ) -> jnp.ndarray:
     """Solve (Gamma + A^H A) x = A^H xi1 + rho * xi2 per frequency.
 
@@ -111,7 +119,22 @@ def solve_z(
     Woodbury: x = Ginv rhs - Ginv A^H Minv A Ginv rhs, Ginv = Gamma^{-1}.
     Exact generalization of the reference's Sherman-Morrison
     (solve_conv_term, admm_solve_conv2D_weighted_sampling.m:170-190).
+
+    ``use_pallas`` routes the W == 1 case through the fused Pallas
+    kernel (ops.pallas_kernels; interpret mode off-TPU); W > 1 always
+    takes the einsum path.
     """
+    if use_pallas and kernel.minv is None:
+        from . import pallas_kernels
+
+        return pallas_kernels.solve_z_rank1_pallas(
+            kernel.dhat[:, 0, :],
+            xi1_hat[:, 0, :],
+            xi2_hat,
+            rho,
+            dinv=kernel.dinv,
+            interpret=_pallas_interpret(),
+        )
     dhat, dinv = kernel.dhat, kernel.dinv
     rhs = jnp.einsum("kwf,nwf->nkf", jnp.conj(dhat), xi1_hat) + rho * xi2_hat
     g = dinv[None] * rhs  # Gamma^{-1} rhs, [N, K, F]

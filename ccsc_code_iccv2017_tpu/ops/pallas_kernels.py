@@ -3,23 +3,33 @@
 The z-subproblem's rank-1 Sherman-Morrison solve (solve_conv_term_Z,
 2D/admm_learn_conv2D_large_dParallel.m:278-303; SURVEY.md lists it as
 hot loop (a)) is bandwidth-bound: per frequency it reads dhat [K],
-xi1 [1], xi2 [K] and writes z [K] with only ~6K real FLOPs of
+xi1 [1], xi2 [K] and writes z [K] with only ~10K real FLOPs of
 elementwise work. The XLA path materializes the intermediate rhs
 [N, K, F] in HBM between einsums; this kernel fuses rhs assembly, the
 K-reduction, and the rank-1 correction into one VMEM-resident pass per
 (n, F-tile), eliminating the intermediate HBM round-trips.
+
+The kernel implements the full W == 1 case of freq_solvers.solve_z,
+including a per-(filter, frequency) diagonal Gamma (the gradient
+regularization of the dirac channel, admm_solve_conv_poisson.m:165-176)
+supplied as its precomputed reciprocal ``dinv``:
+
+    z = g - Ginv conj(d) * (sum_k d_k g_k) / (1 + sum_k |d_k|^2 Ginv_k)
+    with g = Ginv (conj(d) xi1 + rho xi2),  Ginv = diag(dinv).
 
 Complex arithmetic is hand-split into re/im planes (TPU-friendly; the
 axon platform rejects complex buffers at kernel boundaries anyway —
 see freq_solvers module docstring). Layout: K on sublanes (padded to a
 multiple of 8), frequency on lanes (tiles of F_TILE).
 
-Use via solve_z_rank1_pallas; freq_solvers.solve_z remains the generic
-path (W > 1, extra_diag, CPU).
+Use via freq_solvers.solve_z(..., use_pallas=True) or directly through
+solve_z_rank1_pallas; the einsum path remains the generic fallback
+(W > 1, CPU compile).
 """
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,12 +44,14 @@ def solve_z_rank1_pallas(
     xi1_hat: jnp.ndarray,
     xi2_hat: jnp.ndarray,
     rho: float,
+    dinv: Optional[jnp.ndarray] = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Fused rank-1 z-solve. dhat [K, F] complex, xi1_hat [N, F],
     xi2_hat [N, K, F] -> [N, K, F] complex. Matches
     freq_solvers.solve_z for W == 1 exactly:
-      (rho I + d d^H) z = conj(d) xi1 + rho xi2 per frequency.
+      (Gamma + d d^H) z = conj(d) xi1 + rho xi2 per frequency,
+    Gamma = diag(1/dinv) (defaults to rho I when dinv is None).
     """
     K, F = dhat.shape
     N = xi1_hat.shape[0]
@@ -53,31 +65,37 @@ def solve_z_rank1_pallas(
         pads[-1] = (0, Fp - F)
         return jnp.pad(x, pads)
 
+    if dinv is None:
+        dinv = jnp.full((K, F), 1.0 / rho, jnp.float32)
     dre = pad2(jnp.real(dhat), 0)
     dim = pad2(jnp.imag(dhat), 0)
+    gin = pad2(dinv.astype(jnp.float32), 0)
     x1re = pad2(jnp.real(xi1_hat), None)[:, None, :]  # [N, 1, Fp]
     x1im = pad2(jnp.imag(xi1_hat), None)[:, None, :]
     x2re = pad2(jnp.real(xi2_hat), 1)
     x2im = pad2(jnp.imag(xi2_hat), 1)
 
-    def kernel(dre_ref, dim_ref, x1re_ref, x1im_ref, x2re_ref, x2im_ref,
-               zre_ref, zim_ref):
+    def kernel(dre_ref, dim_ref, gin_ref, x1re_ref, x1im_ref, x2re_ref,
+               x2im_ref, zre_ref, zim_ref):
         dr = dre_ref[:]
         di = dim_ref[:]
+        gi = gin_ref[:]
         x1r = x1re_ref[0]  # [1, T]
         x1i = x1im_ref[0]
-        # rhs = conj(d) * xi1 + rho * xi2
-        rre = dr * x1r + di * x1i + rho * x2re_ref[0]
-        rim = dr * x1i - di * x1r + rho * x2im_ref[0]
-        # s = sum_k d_k * rhs_k (complex); padded rows contribute zero
-        sre = jnp.sum(dr * rre - di * rim, axis=0, keepdims=True)
-        sim = jnp.sum(dr * rim + di * rre, axis=0, keepdims=True)
-        denom = rho + jnp.sum(dr * dr + di * di, axis=0, keepdims=True)
-        cre = sre / denom
-        cim = sim / denom
-        # z = (rhs - conj(d) * c) / rho
-        zre_ref[0] = (rre - (dr * cre + di * cim)) / rho
-        zim_ref[0] = (rim - (dr * cim - di * cre)) / rho
+        # g = Ginv * (conj(d) * xi1 + rho * xi2); padded rows have
+        # d == 0 so they contribute rho * Ginv * xi2 == 0 to the sums
+        gre = gi * (dr * x1r + di * x1i + rho * x2re_ref[0])
+        gim = gi * (dr * x1i - di * x1r + rho * x2im_ref[0])
+        # t = sum_k d_k * g_k (complex)
+        tre = jnp.sum(dr * gre - di * gim, axis=0, keepdims=True)
+        tim = jnp.sum(dr * gim + di * gre, axis=0, keepdims=True)
+        denom = 1.0 + jnp.sum((dr * dr + di * di) * gi, axis=0,
+                              keepdims=True)
+        sre = tre / denom
+        sim = tim / denom
+        # z = g - Ginv * conj(d) * s
+        zre_ref[0] = gre - gi * (dr * sre + di * sim)
+        zim_ref[0] = gim - gi * (dr * sim - di * sre)
 
     grid = (N, Fp // F_TILE)
     dspec = pl.BlockSpec((Kp, F_TILE), lambda n, f: (0, f))
@@ -91,9 +109,9 @@ def solve_z_rank1_pallas(
     zre, zim = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[dspec, dspec, x1spec, x1spec, x2spec, x2spec],
+        in_specs=[dspec, dspec, dspec, x1spec, x1spec, x2spec, x2spec],
         out_specs=[x2spec, x2spec],
         out_shape=out_shape,
         interpret=interpret,
-    )(dre, dim, x1re, x1im, x2re, x2im)
+    )(dre, dim, gin, x1re, x1im, x2re, x2im)
     return (zre[:, :K, :F] + 1j * zim[:, :K, :F]).astype(jnp.complex64)
