@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=5)
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture an XLA profiler trace (TensorBoard/xprof dir)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--verbose", default="brief")
     return p
@@ -96,6 +101,7 @@ def main(argv=None):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         init_d=init_d,
+        profile_dir=args.profile_dir,
     )
     save_filters(args.out, res.d, res.trace, layout="2d")
     print(

@@ -320,13 +320,15 @@ def learn(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 5,
     init_d: Optional[jnp.ndarray] = None,
+    profile_dir: Optional[str] = None,
 ) -> LearnResult:
     """Learn a filter bank from data b [n, *reduce, *data_spatial].
 
     n is split into cfg.num_blocks consensus blocks. With ``mesh``
     (1-D, axis 'block') blocks are sharded over devices and the
     consensus average rides ICI; otherwise blocks run locally.
-    ``init_d`` [k, *reduce, *support] warm-starts the dictionary.
+    ``init_d`` [k, *reduce, *support] warm-starts the dictionary;
+    ``profile_dir`` captures an XLA profiler trace of the solve.
     """
     from ..parallel import consensus
 
@@ -339,4 +341,5 @@ def learn(
         checkpoint_dir=checkpoint_dir,
         checkpoint_every=checkpoint_every,
         init_d=init_d,
+        profile_dir=profile_dir,
     )

@@ -133,10 +133,14 @@ def learn(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 5,
     init_d: Optional[jnp.ndarray] = None,
+    profile_dir: Optional[str] = None,
 ) -> learn_mod.LearnResult:
     """Driver: Python outer loop around the jitted consensus step, with
     the reference's trace protocol (obj_vals_d / obj_vals_z / tim_vals,
     dParallel.m:62-71) and its rel-change termination (:186-188).
+
+    ``profile_dir`` captures an XLA profiler trace of the whole solve
+    (utils.profiling.xla_trace) for TensorBoard/xprof inspection.
 
     ``checkpoint_dir`` enables atomic mid-run snapshots every
     ``checkpoint_every`` outer iterations and resume-on-restart (full
@@ -225,29 +229,34 @@ def learn(
             "d_diff": [0.0],
             "z_diff": [0.0],
         }
+    from ..utils import profiling
+
     t_total = trace["tim_vals"][-1]
-    for i in range(start_it, cfg.max_it):
-        t0 = time.perf_counter()
-        state, m = step(state, b_blocks)
-        # scalar readbacks double as the device fence (block_until_ready
-        # is a no-op on the axon TPU platform)
-        obj_d, obj_z = float(m.obj_d), float(m.obj_z)
-        d_diff, z_diff = float(m.d_diff), float(m.z_diff)
-        t_total += time.perf_counter() - t0
-        trace["obj_vals_d"].append(obj_d)
-        trace["obj_vals_z"].append(obj_z)
-        trace["tim_vals"].append(t_total)
-        trace["d_diff"].append(d_diff)
-        trace["z_diff"].append(z_diff)
-        if cfg.verbose in ("brief", "all"):
-            print(
-                f"Iter {i + 1}, Obj_d {obj_d:.4g}, Obj_z {obj_z:.4g}, "
-                f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}, t {t_total:.2f}s"
-            )
-        if checkpoint_dir is not None and (i + 1) % checkpoint_every == 0:
-            ckpt.save(checkpoint_dir, state, trace, i + 1)
-        if d_diff < cfg.tol and z_diff < cfg.tol:
-            break
+    with profiling.xla_trace(profile_dir):
+        for i in range(start_it, cfg.max_it):
+            t0 = time.perf_counter()
+            with profiling.annotate(f"ccsc_outer_{i}"):
+                state, m = step(state, b_blocks)
+                # scalar readbacks double as the device fence
+                # (block_until_ready is a no-op on the axon platform)
+                obj_d, obj_z = float(m.obj_d), float(m.obj_z)
+                d_diff, z_diff = float(m.d_diff), float(m.z_diff)
+            t_total += time.perf_counter() - t0
+            trace["obj_vals_d"].append(obj_d)
+            trace["obj_vals_z"].append(obj_z)
+            trace["tim_vals"].append(t_total)
+            trace["d_diff"].append(d_diff)
+            trace["z_diff"].append(z_diff)
+            if cfg.verbose in ("brief", "all"):
+                print(
+                    f"Iter {i + 1}, Obj_d {obj_d:.4g}, Obj_z {obj_z:.4g}, "
+                    f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}, "
+                    f"t {t_total:.2f}s"
+                )
+            if checkpoint_dir is not None and (i + 1) % checkpoint_every == 0:
+                ckpt.save(checkpoint_dir, state, trace, i + 1)
+            if d_diff < cfg.tol and z_diff < cfg.tol:
+                break
 
     if checkpoint_dir is not None:
         ckpt.save(checkpoint_dir, state, trace, cfg.max_it)

@@ -1,0 +1,82 @@
+"""Profiling and tracing utilities.
+
+The reference's only instrumentation is tic/toc accumulation into the
+``iterations`` struct (2D/admm_learn_conv2D_large_dParallel.m:62-71,
+174-176) plus wall-clock prints in the drivers
+(learn_kernels_2D_large.m:25,29,48). That protocol is preserved as the
+trace dict in parallel.consensus.learn; this module is the TPU-native
+layer the reference lacks (SURVEY.md section 5 "No profiler
+integration"):
+
+- ``xla_trace(log_dir)``: programmatic XLA/xprof capture around any
+  code region (view in TensorBoard or xprof; on TPU this records
+  per-HLO device timelines, so the solver's einsum/FFT mix can be
+  inspected without guessing).
+- ``annotate(name)``: named host-side trace span, nests inside
+  ``xla_trace`` captures.
+- ``SectionTimers``: accumulating named wall-clock timers for
+  host-side phases (data load / compile / step loop) — the tic/toc
+  equivalent, as a reusable object instead of scattered locals.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture an XLA profiler trace into ``log_dir`` (no-op if None).
+
+    Works on CPU and TPU backends; the trace directory is what
+    TensorBoard's profile plugin / xprof expects.
+    """
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named span visible in profiler timelines (and a no-cost
+    context manager when no capture is active)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class SectionTimers:
+    """Accumulating wall-clock timers keyed by section name.
+
+    >>> timers = SectionTimers()
+    >>> with timers.section("load"):
+    ...     load()
+    >>> timers.report()   # {'load': 1.23}
+    """
+
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> Dict[str, float]:
+        return dict(self.totals)
+
+    def __str__(self) -> str:
+        return "  ".join(
+            f"{k}={v:.2f}s/{self.counts[k]}x"
+            for k, v in sorted(self.totals.items())
+        )
