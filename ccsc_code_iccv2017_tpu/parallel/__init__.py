@@ -1,3 +1,3 @@
-from . import consensus, mesh
+from . import consensus, distributed, mesh
 
 __all__ = ["consensus", "mesh"]

@@ -1,0 +1,116 @@
+"""Multi-host (multi-process) execution.
+
+The reference has no distributed backend at all — its "communication"
+is cell-array assignment in one MATLAB address space (SURVEY.md
+sections 2.5, 5). This module is the TPU-native equivalent of what an
+NCCL/MPI backend would have been: process bootstrap, a mesh whose axes
+are laid out so collectives ride the right fabric, and per-host data
+ingestion into globally-sharded arrays.
+
+Design (How-to-Scale-Your-Model recipe): the consensus 'block' axis is
+the OUTER mesh axis and spans hosts — it carries exactly one
+psum(k * s^2 filter tensor) per d-iteration (dzParallel.m:115-121), a
+tiny, latency-tolerant all-reduce that is safe on DCN. The 'freq' axis
+is INNER and stays within a host's ICI domain — it carries the
+per-inner-iteration spectrum all_gathers, which are bandwidth-hungry
+and must not cross DCN. jax.make_mesh orders devices so the trailing
+mesh axes map to the fastest links, which gives exactly this layout.
+
+Single-process use degrades gracefully: every function below works
+unchanged in one process (including under
+--xla_force_host_platform_device_count=8 CPU simulation).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bootstrap multi-process JAX (jax.distributed.initialize).
+
+    On TPU pods all three arguments resolve automatically from the
+    environment; pass them explicitly for CPU/GPU clusters. No-op if
+    the runtime is already initialized or single-process with no
+    coordinator configured.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    if coordinator_address is None and num_processes is None:
+        # TPU pod: env provides everything; bare single host: skip.
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass  # single-process — nothing to bootstrap
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def multihost_block_mesh(freq_shards: int = 1) -> Mesh:
+    """Global ('block'[, 'freq']) mesh over ALL processes' devices.
+
+    'block' spans hosts (DCN-safe: one small psum per d-iteration);
+    'freq' subdivides each host's devices (ICI-bound all_gathers).
+    ``freq_shards`` must divide the per-process device count.
+    """
+    devs = jax.devices()  # global, process-major ordering
+    n = len(devs)
+    per_proc = n // jax.process_count()
+    if freq_shards > 1:
+        if per_proc % freq_shards:
+            raise ValueError(
+                f"freq_shards={freq_shards} does not divide the "
+                f"per-process device count {per_proc}"
+            )
+        return jax.make_mesh(
+            (n // freq_shards, freq_shards), ("block", "freq"), devices=devs
+        )
+    return jax.make_mesh((n,), ("block",), devices=devs)
+
+
+def process_block_slice(num_blocks: int) -> slice:
+    """Which consensus blocks THIS process should load.
+
+    Data loading is per-host (SURVEY.md section 5: host<->device traffic
+    is only data loading and checkpointing): each process reads its own
+    slice of the dataset from storage; no host ever materializes the
+    global batch.
+    """
+    pc, pid = jax.process_count(), jax.process_index()
+    if num_blocks % pc:
+        raise ValueError(
+            f"num_blocks={num_blocks} not divisible by process count {pc}"
+        )
+    per = num_blocks // pc
+    return slice(pid * per, (pid + 1) * per)
+
+
+def global_block_array(
+    local_blocks: np.ndarray, mesh: Mesh
+) -> jax.Array:
+    """Assemble a globally block-sharded array from per-process data.
+
+    local_blocks: [N_local, ...] — this process's consensus blocks
+    (its process_block_slice of the dataset). Returns a global array
+    [N_global, ...] sharded P('block') over ``mesh`` without any host
+    ever holding the full data (jax.make_array_from_process_local_data).
+    """
+    sharding = NamedSharding(mesh, P("block"))
+    global_shape = (
+        local_blocks.shape[0] * jax.process_count(),
+        *local_blocks.shape[1:],
+    )
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_blocks), global_shape
+    )
