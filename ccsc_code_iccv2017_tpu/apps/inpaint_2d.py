@@ -39,16 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def smooth_fill(b: np.ndarray, mask: np.ndarray) -> np.ndarray:
-    """Normalized-convolution Gaussian fill of the observed pixels."""
-    from ..data.images import gaussian_kernel, rconv2
+    """Normalized-convolution Gaussian fill of the observed pixels
+    (native threaded path with numpy fallback)."""
+    from ..data.native import smooth_fill_batch
 
-    k = gaussian_kernel(13, 3 * 1.591)
-    out = np.empty_like(b)
-    for i in range(b.shape[0]):
-        out[i] = rconv2(b[i] * mask[i], k) / np.maximum(
-            rconv2(mask[i], k), 1e-6
-        )
-    return out.astype(np.float32)
+    return smooth_fill_batch(b, mask)
 
 
 def main(argv=None):

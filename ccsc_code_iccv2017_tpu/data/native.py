@@ -34,15 +34,17 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception:
+        # always invoke make: it is a no-op when the .so is newer than
+        # the source, and rebuilds a stale library after source updates
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            if not os.path.exists(_LIB_PATH):
                 return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
@@ -63,8 +65,19 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64,
                 ctypes.c_int,
             ]
+            lib.ccsc_smooth_fill.restype = ctypes.c_int
+            lib.ccsc_smooth_fill.argtypes = [
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.c_double,
+                ctypes.c_int,
+            ]
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
             _lib = None
         return _lib
 
@@ -104,6 +117,53 @@ def local_cn_batch(
     )
     if rc != 0:
         raise RuntimeError(f"ccsc_local_cn failed with code {rc}")
+    return out
+
+
+def smooth_fill_batch(
+    imgs: np.ndarray,
+    mask: np.ndarray,
+    ksize: int = 13,
+    sigma: float = 3 * 1.591,
+    nthreads: int = 0,
+) -> np.ndarray:
+    """Normalized-convolution Gaussian fill G*(b.m)/max(G*m, 1e-6) of
+    [n, H, W] masked images — the reconstruction apps' smooth_init warm
+    start. Native threaded path when available, else the rconv2-based
+    numpy reference. Returns a new array."""
+    imgs = np.ascontiguousarray(imgs, np.float32)
+    mask = np.ascontiguousarray(mask, np.float32)
+    if imgs.shape != mask.shape:
+        raise ValueError(f"shape mismatch {imgs.shape} vs {mask.shape}")
+    if imgs.ndim == 2:
+        return smooth_fill_batch(imgs[None], mask[None], ksize, sigma,
+                                 nthreads)[0]
+    lib = _load()
+    if lib is None:
+        from .images import gaussian_kernel, rconv2
+
+        k = gaussian_kernel(ksize, sigma)
+        return np.stack(
+            [
+                (
+                    rconv2(b * m, k) / np.maximum(rconv2(m, k), 1e-6)
+                ).astype(np.float32)
+                for b, m in zip(imgs, mask)
+            ]
+        )
+    out = imgs.copy()
+    rc = lib.ccsc_smooth_fill(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.shape[0],
+        out.shape[1],
+        out.shape[2],
+        ksize,
+        sigma,
+        nthreads,
+    )
+    if rc != 0:
+        raise RuntimeError(f"ccsc_smooth_fill failed with code {rc}")
     return out
 
 

@@ -143,6 +143,48 @@ int ccsc_local_cn(float* imgs, int64_t n, int64_t h, int64_t w,
   return 0;
 }
 
+// Normalized-convolution Gaussian fill of masked images, threaded:
+// out = G*(img .* mask) / max(G*mask, eps) — the smooth_init warm
+// start of the reconstruction drivers (the intended offset the
+// reference's inpainting driver fails to pass, SURVEY.md section 5;
+// Gaussian smoothing per reconstruct_subsampling_hyperspectral.m:46-55).
+// imgs/mask: [n, h, w] float32 C-contiguous; imgs overwritten in place.
+int ccsc_smooth_fill(float* imgs, const float* mask, int64_t n, int64_t h,
+                     int64_t w, int ksize, double sigma, int nthreads) {
+  if (!imgs || !mask || n <= 0 || h <= 0 || w <= 0 || ksize <= 0 ||
+      !(sigma > 0))
+    return 1;
+  auto taps = gaussian_taps(ksize, sigma);
+  if (nthreads <= 0)
+    nthreads = (int)std::thread::hardware_concurrency();
+  nthreads = std::max(1, std::min<int>(nthreads, (int)n));
+  std::atomic<int64_t> next(0);
+  std::vector<std::thread> pool;
+  pool.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) {
+    pool.emplace_back([&]() {
+      const int64_t npx = h * w;
+      std::vector<double> bm(npx), m(npx), num(npx), den(npx), tmp(npx);
+      while (true) {
+        int64_t i = next.fetch_add(1);
+        if (i >= n) break;
+        float* img = imgs + i * npx;
+        const float* mk = mask + i * npx;
+        for (int64_t j = 0; j < npx; ++j) {
+          m[j] = mk[j];
+          bm[j] = img[j] * m[j];
+        }
+        sep_conv(bm.data(), num.data(), (int)h, (int)w, taps, tmp);
+        sep_conv(m.data(), den.data(), (int)h, (int)w, taps, tmp);
+        for (int64_t j = 0; j < npx; ++j)
+          img[j] = (float)(num[j] / std::max(den[j], 1e-6));
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
 // Batch zero-mean (per image), threaded. imgs: [n, h*w].
 int ccsc_zero_mean(float* imgs, int64_t n, int64_t npx, int nthreads) {
   if (!imgs || n <= 0 || npx <= 0) return 1;

@@ -36,3 +36,28 @@ def test_input_not_mutated():
     keep = imgs.copy()
     native.local_cn_batch(imgs)
     np.testing.assert_array_equal(imgs, keep)
+
+
+def test_smooth_fill_matches_numpy():
+    from ccsc_code_iccv2017_tpu.data.images import gaussian_kernel, rconv2
+
+    r = np.random.default_rng(3)
+    b = r.uniform(0.0, 1.0, (3, 32, 32)).astype(np.float32)
+    mask = (r.uniform(size=b.shape) > 0.5).astype(np.float32)
+    out_c = native.smooth_fill_batch(b, mask)
+    k = gaussian_kernel()
+    out_py = np.stack(
+        [
+            rconv2(bi * mi, k) / np.maximum(rconv2(mi, k), 1e-6)
+            for bi, mi in zip(b, mask)
+        ]
+    )
+    np.testing.assert_allclose(out_c, out_py, atol=2e-5)
+    assert np.isfinite(out_c).all()
+    # fully observed mask degenerates to plain Gaussian smoothing
+    ones = np.ones_like(b)
+    np.testing.assert_allclose(
+        native.smooth_fill_batch(b, ones),
+        np.stack([rconv2(bi, k) for bi in b]),
+        atol=2e-5,
+    )
