@@ -1,3 +1,3 @@
-from . import consensus, distributed, mesh
+from . import consensus, distributed, mesh, streaming
 
 __all__ = ["consensus", "mesh"]

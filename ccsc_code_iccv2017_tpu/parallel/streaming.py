@@ -1,0 +1,234 @@
+"""Host-streaming consensus learning: one block on device at a time.
+
+The CCSC paper's memory claim (SURVEY.md section 0) is that consensus
+splitting bounds working memory to ONE block's codes — the reference
+realizes it by keeping per-block cells in host RAM and touching one at
+a time (dzParallel.m:96-158). models.learn instead keeps every block
+live on device (fastest when z fits in HBM; shardable over a mesh when
+a pod is available). This module is the single-chip big-data path: all
+block state (codes, duals, local dictionaries, code-Gram factors)
+lives in HOST memory as numpy, and the device only ever holds one
+block's tensors plus the consensus variables.
+
+Exactness: streaming is NOT an approximation. The z-pass decouples
+across blocks (no cross-block terms), so running each block's full
+inner scan alone is identical to the interleaved order. The d-pass
+couples blocks only through the consensus averages Dbar/Udbar
+(dzParallel.m:115-121), which are formed after all blocks' solves in
+each d-iteration — the same barrier this loop reproduces. The result
+matches models.learn bit-for-bit up to float reduction order
+(tests/test_streaming.py).
+
+Cost model: per outer iteration the host<->device traffic is
+O(max_it_d * N * (|zhat| + |ginv|)) for the d-pass and O(N * |z|) for
+the z-pass — the price of an HBM footprint independent of n. On real
+TPU hosts this rides PCIe; overlap is left to XLA's async dispatch
+(transfers for block nn+1 begin while nn computes).
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import LearnConfig, ProblemGeom
+from ..models import common, learn as learn_mod
+from ..ops import fourier, freq_solvers, proxes
+
+
+def _jit_pieces(geom: ProblemGeom, cfg: LearnConfig, fg: common.FreqGeom):
+    support = geom.spatial_support
+
+    @jax.jit
+    def f_bhat(b_nn):
+        return common.data_to_freq(
+            fourier.pad_spatial(b_nn, geom.psf_radius), fg
+        )
+
+    @jax.jit
+    def f_dkern(z_nn):
+        zhat = common.codes_to_freq(z_nn, fg)
+        return freq_solvers.precompute_d_kernel(zhat, cfg.rho_d)
+
+    @jax.jit
+    def f_prox(dbar, udbar):
+        return proxes.kernel_constraint_proj(
+            dbar + udbar, support, fg.spatial_shape
+        )
+
+    @jax.jit
+    def f_d_block(kern, bhat_nn, d_local, dual_d, u):
+        dual_d = dual_d + (d_local - u)
+        xi_hat = common.full_filters_to_freq(u - dual_d, fg)
+        dhat = freq_solvers.solve_d(kern, bhat_nn, xi_hat, cfg.rho_d)
+        d_new = learn_mod._filters_from_freq(dhat, fg)
+        return d_new, dual_d
+
+    @jax.jit
+    def f_z_block(z, dual_z, bhat_nn, dhat_z):
+        zkern = freq_solvers.precompute_z_kernel(dhat_z, cfg.rho_z)
+        theta = cfg.lambda_prior / cfg.rho_z
+
+        def z_iter(carry, _):
+            zc, du = carry
+            u2 = proxes.soft_threshold(zc + du, theta)
+            du = du + (zc - u2)
+            xi2_hat = common.codes_to_freq(u2 - du, fg)
+            zhat_new = freq_solvers.solve_z(
+                zkern, bhat_nn, xi2_hat, cfg.rho_z,
+                use_pallas=cfg.use_pallas,
+            )
+            return (common.codes_from_freq(zhat_new, fg), du), None
+
+        (z_new, dual_new), _ = jax.lax.scan(
+            z_iter, (z, dual_z), None, length=cfg.max_it_z
+        )
+        return z_new, dual_new
+
+    @jax.jit
+    def f_full_dhat(d_proj):
+        return common.full_filters_to_freq(d_proj, fg)
+
+    @jax.jit
+    def f_obj_block(z_nn, b_nn, dhat):
+        zhat = common.codes_to_freq(z_nn, fg)
+        Dz = common.recon_from_freq(dhat, zhat, fg)
+        return common.data_fidelity(
+            Dz, b_nn, geom.psf_radius, cfg.lambda_residual
+        ) + common.l1_penalty(z_nn, cfg.lambda_prior)
+
+    return f_bhat, f_dkern, f_prox, f_d_block, f_z_block, f_full_dhat, f_obj_block
+
+
+def learn_streaming(
+    b: np.ndarray,
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    key: Optional[jax.Array] = None,
+) -> learn_mod.LearnResult:
+    """models.learn semantics with host-resident block state.
+
+    b: [n, *reduce, *data_spatial] numpy (host). Device memory use is
+    O(one block), independent of n.
+    """
+    ndim_s = geom.ndim_spatial
+    n = b.shape[0]
+    N = cfg.num_blocks
+    if n % N:
+        raise ValueError(f"n={n} not divisible by num_blocks={N}")
+    ni = n // N
+    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:])
+    b_blocks = np.asarray(b, np.float32).reshape(N, ni, *b.shape[1:])
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    # identical init to models.learn.init_state (shared across blocks /
+    # independent z per block), pulled to host
+    state0 = learn_mod.init_state(key, geom, fg, N, ni, jnp.float32)
+    # np.array (copy): host buffers are mutated block-by-block below
+    d_local = np.array(state0.d_local)
+    dual_d = np.array(state0.dual_d)
+    dbar = jnp.asarray(state0.dbar)
+    udbar = jnp.asarray(state0.udbar)
+    z = np.array(state0.z)
+    dual_z = np.array(state0.dual_z)
+
+    (
+        f_bhat, f_dkern, f_prox, f_d_block, f_z_block, f_full_dhat,
+        f_obj_block,
+    ) = _jit_pieces(geom, cfg, fg)
+
+    trace = {
+        "obj_vals_d": [0.0],
+        "obj_vals_z": [0.0],
+        "tim_vals": [0.0],
+        "d_diff": [0.0],
+        "z_diff": [0.0],
+    }
+    t_total = 0.0
+    for i in range(cfg.max_it):
+        t0 = time.perf_counter()
+        dbar_prev = dbar
+
+        # ---- d-pass: Grams fixed at incoming codes -----------------
+        # (kernels stay on host; one lives on device at a time)
+        kerns = [jax.tree.map(np.asarray, f_dkern(z[nn])) for nn in range(N)]
+        for _ in range(cfg.max_it_d):
+            u = f_prox(dbar, udbar)
+            d_sum = None
+            du_sum = None
+            for nn in range(N):
+                bhat_nn = f_bhat(b_blocks[nn])
+                d_new, du_new = f_d_block(
+                    jax.tree.map(jnp.asarray, kerns[nn]),
+                    bhat_nn,
+                    jnp.asarray(d_local[nn]),
+                    jnp.asarray(dual_d[nn]),
+                    u,
+                )
+                d_local[nn] = np.asarray(d_new)
+                dual_d[nn] = np.asarray(du_new)
+                d_sum = d_new if d_sum is None else d_sum + d_new
+                du_sum = du_new if du_sum is None else du_sum + du_new
+            dbar = d_sum / N
+            udbar = du_sum / N
+        del kerns
+        d_diff = float(common.rel_change(dbar, dbar_prev))
+
+        d_proj = f_prox(dbar, udbar)
+        dhat_z = f_full_dhat(d_proj)
+
+        # ---- z-pass: blocks fully independent ----------------------
+        num = 0.0
+        den = 0.0
+        obj_z = 0.0
+        for nn in range(N):
+            bhat_nn = f_bhat(b_blocks[nn])
+            z_new, du_new = f_z_block(
+                jnp.asarray(z[nn]), jnp.asarray(dual_z[nn]), bhat_nn, dhat_z
+            )
+            z_new_h = np.asarray(z_new)
+            num += float(np.sum((z_new_h - z[nn]) ** 2))
+            den += float(np.sum(z_new_h * z_new_h))
+            z[nn] = z_new_h
+            dual_z[nn] = np.asarray(du_new)
+            if cfg.with_objective:
+                obj_z += float(
+                    f_obj_block(jnp.asarray(z[nn]), jnp.asarray(b_blocks[nn]), dhat_z)
+                )
+        z_diff = float(np.sqrt(num) / max(np.sqrt(den), 1e-30))
+        t_total += time.perf_counter() - t0
+        trace["obj_vals_z"].append(obj_z)
+        trace["obj_vals_d"].append(obj_z)
+        trace["tim_vals"].append(t_total)
+        trace["d_diff"].append(d_diff)
+        trace["z_diff"].append(z_diff)
+        if cfg.verbose in ("brief", "all"):
+            print(
+                f"Iter {i + 1}, Obj_z {obj_z:.4g}, Diff_d {d_diff:.3g}, "
+                f"Diff_z {z_diff:.3g}, t {t_total:.2f}s"
+            )
+        if d_diff < cfg.tol and z_diff < cfg.tol:
+            break
+
+    # final outputs, streamed per block
+    d_sup = learn_mod.extract_filters(np.asarray(d_proj), geom)
+    Dz = np.empty(
+        (N, ni, *geom.reduce_shape, *b.shape[-ndim_s:]), np.float32
+    )
+
+    @jax.jit
+    def f_dz_block(z_nn):
+        zhat = common.codes_to_freq(z_nn, fg)
+        full = common.recon_from_freq(dhat_z, zhat, fg)
+        return fourier.crop_spatial(full, geom.psf_radius)
+
+    for nn in range(N):
+        Dz[nn] = np.asarray(f_dz_block(jnp.asarray(z[nn])))
+    return learn_mod.LearnResult(
+        np.asarray(d_sup), z, Dz.reshape(n, *Dz.shape[2:]), trace
+    )
