@@ -21,7 +21,9 @@ Env knobs: CCSC_BENCH_N (images, default 128), CCSC_BENCH_SIZE (image
 side, default 100), CCSC_BENCH_K (filters, default 100),
 CCSC_BENCH_BLOCKS (default 8), CCSC_BENCH_ITERS (timed outer
 iterations, default 3), CCSC_BENCH_TIMEOUT (seconds per attempt,
-default 900), CCSC_BENCH_INPROCESS=1 (skip the watchdog wrapper).
+default 900), CCSC_BENCH_INPROCESS=1 (skip the watchdog wrapper),
+CCSC_BENCH_PALLAS=1 (route the z-solve through the fused Pallas
+kernel — for on-chip A/B against the default einsum path).
 """
 import json
 import os
@@ -52,6 +54,7 @@ def run_workload():
     blocks = int(os.environ.get("CCSC_BENCH_BLOCKS", 8))
     iters = int(os.environ.get("CCSC_BENCH_ITERS", 3))
 
+    use_pallas = os.environ.get("CCSC_BENCH_PALLAS") == "1"
     geom = ProblemGeom((11, 11), k)
     cfg = LearnConfig(
         max_it=iters,
@@ -61,6 +64,7 @@ def run_workload():
         rho_d=5000.0,
         rho_z=1.0,
         verbose="none",
+        use_pallas=use_pallas,
     )
     fg = common.FreqGeom.create(geom, (size, size))
 

@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="capture an XLA profiler trace (TensorBoard/xprof dir)",
     )
+    p.add_argument(
+        "--streaming",
+        action="store_true",
+        help="host-streaming mode: one consensus block on device at a "
+        "time (bounded HBM; parallel.streaming)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--verbose", default="brief")
     return p
@@ -92,17 +98,27 @@ def main(argv=None):
     init_d = (
         load_filters_2d(args.init_filters) if args.init_filters else None
     )
-    res = learn(
-        jnp.asarray(b),
-        geom,
-        cfg,
-        key=jax.random.PRNGKey(args.seed),
-        mesh=mesh,
-        checkpoint_dir=args.checkpoint_dir,
-        checkpoint_every=args.checkpoint_every,
-        init_d=init_d,
-        profile_dir=args.profile_dir,
-    )
+    if args.streaming:
+        if mesh is not None or init_d is not None or args.checkpoint_dir:
+            raise SystemExit(
+                "--streaming is single-device and does not combine with "
+                "--mesh/--init-filters/--checkpoint-dir"
+            )
+        from ..parallel.streaming import learn_streaming
+
+        res = learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(args.seed))
+    else:
+        res = learn(
+            jnp.asarray(b),
+            geom,
+            cfg,
+            key=jax.random.PRNGKey(args.seed),
+            mesh=mesh,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            init_d=init_d,
+            profile_dir=args.profile_dir,
+        )
     save_filters(args.out, res.d, res.trace, layout="2d")
     print(
         f"saved {res.d.shape} filters to {args.out}; total "
