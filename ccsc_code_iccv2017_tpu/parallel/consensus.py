@@ -18,6 +18,7 @@ global kernel prox a purely local computation.
 from __future__ import annotations
 
 import functools
+import math
 import time
 from typing import Optional
 
@@ -232,15 +233,33 @@ def learn(
     from ..utils import profiling
 
     t_total = trace["tim_vals"][-1]
+    prev_state = state
     with profiling.xla_trace(profile_dir):
         for i in range(start_it, cfg.max_it):
             t0 = time.perf_counter()
             with profiling.annotate(f"ccsc_outer_{i}"):
-                state, m = step(state, b_blocks)
+                new_state, m = step(state, b_blocks)
                 # scalar readbacks double as the device fence
                 # (block_until_ready is a no-op on the axon platform)
                 obj_d, obj_z = float(m.obj_d), float(m.obj_z)
                 d_diff, z_diff = float(m.d_diff), float(m.z_diff)
+            # failure detection: a non-finite metric means the iterate
+            # diverged (bad rho for the data scale, or a numeric fault);
+            # keep the last good state instead of propagating NaNs into
+            # the result/checkpoint. The reference's only analogous
+            # mechanism is the objective rollback in admm_learn.m:204-213.
+            if not all(
+                math.isfinite(v) for v in (obj_d, obj_z, d_diff, z_diff)
+            ):
+                print(
+                    f"Iter {i + 1}: non-finite metrics "
+                    f"(obj_d={obj_d}, obj_z={obj_z}, d_diff={d_diff}, "
+                    f"z_diff={z_diff}); keeping last good state"
+                )
+                state = prev_state
+                break
+            prev_state = state
+            state = new_state
             t_total += time.perf_counter() - t0
             trace["obj_vals_d"].append(obj_d)
             trace["obj_vals_z"].append(obj_z)

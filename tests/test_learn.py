@@ -157,3 +157,21 @@ def test_warm_start_init_d():
     )
     with pytest.raises(ValueError):
         learn(b, geom, cfg, init_d=jnp.zeros((3, 5, 5)))
+
+
+def test_nan_guard_keeps_last_good_state():
+    """Failure detection: a diverging run (non-finite metrics) stops and
+    returns the last finite state instead of NaNs."""
+    geom = ProblemGeom((3, 3), 4)
+    b = np.array(
+        jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12)), np.float32
+    )
+    b[0, 0, 0] = np.inf  # poison the data -> metrics go non-finite
+    cfg = LearnConfig(
+        max_it=3, max_it_d=1, max_it_z=1, num_blocks=2,
+        rho_d=50.0, rho_z=2.0, verbose="none", track_objective=True,
+    )
+    res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0))
+    # result is the pre-divergence state: everything finite
+    assert np.isfinite(np.asarray(res.d)).all()
+    assert np.isfinite(np.asarray(res.z)).all()
