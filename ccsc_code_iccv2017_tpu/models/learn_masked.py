@@ -44,10 +44,7 @@ class MaskedLearnState(NamedTuple):
     dual_z2: jnp.ndarray  # [n, k, *spatial] sparsity-side dual
 
 
-@functools.partial(
-    jax.jit, static_argnames=("geom", "cfg", "fg", "gamma_div_d", "gamma_div_z")
-)
-def _outer_step(
+def _outer_step_impl(
     state: MaskedLearnState,
     b_pad: jnp.ndarray,
     M_pad: jnp.ndarray,
@@ -57,11 +54,44 @@ def _outer_step(
     fg: common.FreqGeom,
     gamma_div_d: float,
     gamma_div_z: float,
+    freq_axis_name: Optional[str] = None,
+    num_freq_shards: int = 1,
 ):
     """One outer iteration: d-ADMM (admm_learn.m:102-136) then z-ADMM
-    (:165-200). Returns (state, obj_d, obj_z, d_diff, z_diff)."""
+    (:165-200). Returns (state, obj_d, obj_z, d_diff, z_diff).
+
+    ``freq_axis_name`` shards the per-frequency solves over a mesh axis
+    (frequency-axis tensor parallelism, same scheme as
+    models.learn.outer_step): each device solves an F/num_freq_shards
+    slice of the spectrum; one tiled all_gather per inner iteration
+    reassembles it for the replicated FFT boundary. State and data stay
+    replicated — n is small in the hyperspectral workloads
+    (learn_hyperspectral.m), the spectrum is the big axis.
+    """
     support = geom.spatial_support
     radius = geom.psf_radius
+
+    if fg.num_freq % num_freq_shards:
+        raise ValueError(
+            f"num_freq={fg.num_freq} not divisible by "
+            f"num_freq_shards={num_freq_shards}"
+        )
+    f_local = fg.num_freq // num_freq_shards
+
+    def fslice(x):
+        if freq_axis_name is None:
+            return x
+        idx = jax.lax.axis_index(freq_axis_name)
+        return jax.lax.dynamic_slice_in_dim(
+            x, idx * f_local, f_local, axis=x.ndim - 1
+        )
+
+    def fgather(x):
+        if freq_axis_name is None:
+            return x
+        return jax.lax.all_gather(
+            x, freq_axis_name, axis=x.ndim - 1, tiled=True
+        )
 
     g = 60.0 * cfg.lambda_prior / jnp.maximum(jnp.max(M_pad * b_pad), 1e-30)
     Mtb = (b_pad - smoothinit) * M_pad
@@ -83,9 +113,10 @@ def _outer_step(
         )
 
     zhat = common.codes_to_freq(state.z, fg)
+    zhat_l = fslice(zhat)
 
     # ------------------ d-pass (:102-136) ---------------------------
-    dkern = freq_solvers.precompute_d_kernel(zhat, rho_d)
+    dkern = freq_solvers.precompute_d_kernel(zhat_l, rho_d)
 
     def d_iter(carry, _):
         d_full, du1, du2 = carry
@@ -97,9 +128,11 @@ def _outer_step(
         u2 = prox_kernel(d_full - du2)
         du1 = du1 - (v1 - u1)
         du2 = du2 - (d_full - u2)
-        xi1_hat = common.data_to_freq(u1 + du1, fg)
-        xi2_hat = common.full_filters_to_freq(u2 + du2, fg)
-        dhat_new = freq_solvers.solve_d(dkern, xi1_hat, xi2_hat, rho_d)
+        xi1_hat = fslice(common.data_to_freq(u1 + du1, fg))
+        xi2_hat = fslice(common.full_filters_to_freq(u2 + du2, fg))
+        dhat_new = fgather(
+            freq_solvers.solve_d(dkern, xi1_hat, xi2_hat, rho_d)
+        )
         d_new = fourier.irfftn_spatial(
             dhat_new.reshape(
                 dhat_new.shape[0], *fg.reduce_shape, *fg.freq_shape
@@ -119,7 +152,7 @@ def _outer_step(
     obj_d = objective(state.z, dhat)
 
     # ------------------ z-pass (:165-200) ---------------------------
-    zkern = freq_solvers.precompute_z_kernel(dhat, rho_z)
+    zkern = freq_solvers.precompute_z_kernel(fslice(dhat), rho_z)
 
     def z_iter(carry, _):
         z, du1, du2 = carry
@@ -131,9 +164,13 @@ def _outer_step(
         u2 = proxes.soft_threshold(z - du2, cfg.lambda_prior / g)
         du1 = du1 - (v1 - u1)
         du2 = du2 - (z - u2)
-        xi1_hat = common.data_to_freq(u1 + du1, fg)
-        xi2_hat = common.codes_to_freq(u2 + du2, fg)
-        zhat_new = freq_solvers.solve_z(zkern, xi1_hat, xi2_hat, rho_z)
+        xi1_hat = fslice(common.data_to_freq(u1 + du1, fg))
+        xi2_hat = fslice(common.codes_to_freq(u2 + du2, fg))
+        zhat_new = fgather(
+            freq_solvers.solve_z(
+                zkern, xi1_hat, xi2_hat, rho_z, use_pallas=cfg.use_pallas
+            )
+        )
         z_new = common.codes_from_freq(zhat_new, fg)
         return (z_new, du1, du2), None
 
@@ -155,6 +192,43 @@ def _outer_step(
     )
 
 
+_outer_step = functools.partial(
+    jax.jit,
+    static_argnames=("geom", "cfg", "fg", "gamma_div_d", "gamma_div_z"),
+)(_outer_step_impl)
+
+
+@functools.lru_cache(maxsize=16)
+def _sharded_outer_step(geom, cfg, fg, gamma_div_d, gamma_div_z, mesh):
+    """shard_map'd outer step over a 1-D 'freq' mesh: state and data
+    replicated, per-frequency solves sharded (TP), one tiled all_gather
+    per inner iteration."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map
+
+    nf = mesh.shape["freq"]
+    fn = functools.partial(
+        _outer_step_impl,
+        geom=geom,
+        cfg=cfg,
+        fg=fg,
+        gamma_div_d=gamma_div_d,
+        gamma_div_z=gamma_div_z,
+        freq_axis_name="freq",
+        num_freq_shards=nf,
+    )
+    rep = P()
+    sharded = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(rep, rep, rep, rep),
+        out_specs=(rep, rep, rep, rep, rep),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def learn_masked(
     b: jnp.ndarray,
     geom: ProblemGeom,
@@ -164,9 +238,14 @@ def learn_masked(
     key: Optional[jax.Array] = None,
     gamma_div_d: float = 5000.0,
     gamma_div_z: float = 500.0,
+    mesh=None,
 ) -> LearnResult:
     """b: [n, *reduce, *data_spatial]; smooth_init: same shape;
-    init_d: [k, *reduce, *support] warm start (admm_learn.m:50-58)."""
+    init_d: [k, *reduce, *support] warm start (admm_learn.m:50-58).
+
+    ``mesh``: optional 1-D mesh with axis 'freq' — shards the
+    per-frequency solves (frequency-axis tensor parallelism); the
+    result matches the unsharded run up to float reduction order."""
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
     radius = geom.psf_radius
@@ -217,21 +296,35 @@ def learn_masked(
         "d_diff": [],
         "z_diff": [],
     }
+    if mesh is not None:
+        if mesh.axis_names != ("freq",):
+            raise ValueError(
+                f"learn_masked expects a 1-D ('freq',) mesh, got "
+                f"{mesh.axis_names}"
+            )
+        step = _sharded_outer_step(
+            geom, cfg, fg, gamma_div_d, gamma_div_z, mesh
+        )
+    else:
+        step = functools.partial(
+            _outer_step,
+            geom=geom,
+            cfg=cfg,
+            fg=fg,
+            gamma_div_d=gamma_div_d,
+            gamma_div_z=gamma_div_z,
+        )
+
     obj_best = jnp.inf
     t_total = 0.0
     prev = state
     for i in range(cfg.max_it):
         t0 = time.perf_counter()
-        new_state, obj_d, obj_z, d_diff, z_diff = _outer_step(
+        new_state, obj_d, obj_z, d_diff, z_diff = step(
             state,
             b_pad,
             M_pad,
             smoothinit,
-            geom,
-            cfg,
-            fg,
-            gamma_div_d,
-            gamma_div_z,
         )
         obj_d, obj_z = float(obj_d), float(obj_z)  # also the fence
         d_diff, z_diff = float(d_diff), float(z_diff)

@@ -60,6 +60,17 @@ def block_freq_mesh(num_block: int, num_freq: int, devices=None) -> Mesh:
     )
 
 
+def freq_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the 'freq' (frequency tensor-parallel) axis — for
+    solvers whose batch is small but whose spectrum is large (the
+    masked hyperspectral learner)."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return jax.make_mesh((len(devices),), ("freq",), devices=devices)
+
+
 def block_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (block) axis; replicate the rest."""
     return NamedSharding(mesh, P("block"))

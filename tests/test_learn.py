@@ -175,3 +175,27 @@ def test_nan_guard_keeps_last_good_state():
     # result is the pre-divergence state: everything finite
     assert np.isfinite(np.asarray(res.d)).all()
     assert np.isfinite(np.asarray(res.z)).all()
+
+
+def test_learn_masked_freq_mesh_matches():
+    """Masked hyperspectral learner with frequency-axis TP == local."""
+    from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
+    from ccsc_code_iccv2017_tpu.parallel.mesh import freq_mesh
+
+    geom = ProblemGeom((3, 3), 3, reduce_shape=(2,))
+    cfg = LearnConfig(
+        max_it=2, max_it_d=2, max_it_z=2, verbose="none",
+        lambda_residual=1.0, lambda_prior=1.0,
+    )
+    r = np.random.default_rng(0)
+    # padded 8+2 -> 10x10 rfft = (10, 6) -> F=60, divisible by 4
+    b = r.uniform(0.1, 1.0, (2, 2, 8, 8)).astype(np.float32)
+    kw = dict(gamma_div_d=50.0, gamma_div_z=10.0, key=jax.random.PRNGKey(0))
+    res_l = learn_masked(jnp.asarray(b), geom, cfg, **kw)
+    res_m = learn_masked(jnp.asarray(b), geom, cfg, mesh=freq_mesh(4), **kw)
+    np.testing.assert_allclose(
+        np.asarray(res_l.d), np.asarray(res_m.d), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        res_l.trace["obj_vals_z"], res_m.trace["obj_vals_z"], rtol=1e-4
+    )
