@@ -101,3 +101,95 @@ def test_app_smoke_2d(tmp_path):
         ]
     )
     assert int(res.trace.num_iters) >= 1
+
+
+def test_app_pipeline_hyperspectral(tmp_path):
+    """learn_hyperspectral -> demosaic_hyperspectral, tiny synthetic."""
+    from ccsc_code_iccv2017_tpu.apps import (
+        demosaic_hyperspectral,
+        learn_hyperspectral,
+    )
+
+    out = str(tmp_path / "hs.mat")
+    learn_hyperspectral.main(
+        [
+            "--synthetic", "--bands", "4", "--filters", "4",
+            "--support", "3", "--max-it", "1", "--limit", "2",
+            "--out", out, "--verbose", "none",
+        ]
+    )
+    res = demosaic_hyperspectral.main(
+        ["--synthetic", "--filters", out, "--max-it", "4"]
+    )
+    assert int(res.trace.num_iters) >= 1
+
+
+def test_app_pipeline_3d(tmp_path):
+    """learn_3d -> deblur_video, tiny synthetic clips."""
+    from ccsc_code_iccv2017_tpu.apps import deblur_video, learn_3d
+
+    out = str(tmp_path / "f3d.mat")
+    learn_3d.main(
+        [
+            "--synthetic", "--clips", "2", "--clip-size", "12",
+            "--clip-frames", "6", "--filters", "4", "--support", "3",
+            "--support-t", "3", "--blocks", "2", "--max-it", "1",
+            "--out", out, "--verbose", "none",
+        ]
+    )
+    res = deblur_video.main(
+        [
+            "--synthetic", "--filters", out, "--side", "16",
+            "--frames", "6", "--max-it", "4",
+        ]
+    )
+    assert int(res.trace.num_iters) >= 1
+
+
+def test_app_pipeline_4d(tmp_path):
+    """learn_4d -> view_synthesis, tiny synthetic lightfield."""
+    from ccsc_code_iccv2017_tpu.apps import learn_4d, view_synthesis
+
+    out = str(tmp_path / "f4d.mat")
+    learn_4d.main(
+        [
+            "--synthetic", "--patches", "2", "--patch-size", "12",
+            "--views", "3", "--filters", "4", "--support", "3",
+            "--blocks", "2", "--max-it", "1", "--out", out,
+            "--verbose", "none",
+        ]
+    )
+    res = view_synthesis.main(
+        [
+            "--synthetic", "--filters", out, "--side", "16",
+            "--max-it", "4",
+        ]
+    )
+    assert int(res.trace.num_iters) >= 1
+
+
+def test_app_pipeline_poisson(tmp_path):
+    """learn_2d -> poisson_2d on reference images."""
+    import os
+
+    if not os.path.isdir("/root/reference/2D/Poisson_deconv/dataset_norm"):
+        pytest.skip("reference not mounted")
+    from ccsc_code_iccv2017_tpu.apps import learn_2d, poisson_2d
+
+    out = str(tmp_path / "f.mat")
+    learn_2d.main(
+        [
+            "--data", "/root/reference/2D/Poisson_deconv/dataset_norm",
+            "--filters", "6", "--support", "5", "--blocks", "2",
+            "--max-it", "1", "--size", "24", "--limit", "2",
+            "--out", out, "--verbose", "none",
+        ]
+    )
+    res = poisson_2d.main(
+        [
+            "--data", "/root/reference/2D/Poisson_deconv/dataset_norm",
+            "--filters", out, "--limit", "1", "--size", "24",
+            "--max-it", "4",
+        ]
+    )
+    assert res is not None
