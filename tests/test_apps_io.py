@@ -193,3 +193,29 @@ def test_app_pipeline_poisson(tmp_path):
         ]
     )
     assert res is not None
+
+
+def test_all_reference_artifacts_load():
+    """Every shipped pretrained filter bank loads into the canonical
+    [k, *reduce, *spatial] layout (SURVEY.md section 1, L1 assets)."""
+    import os
+
+    if not os.path.isdir("/root/reference"):
+        pytest.skip("reference not mounted")
+    cases = [
+        ("/root/reference/2D/Filters/Filters_ours_2D_large.mat",
+         io_mat.load_filters_2d, (100, 11, 11)),
+        ("/root/reference/2-3D/Filters/2D-3D-Hyperspectral.mat",
+         io_mat.load_filters_hyperspectral, (100, 31, 11, 11)),
+        ("/root/reference/3D/Filters/3D_video_filters.mat",
+         io_mat.load_filters_3d, (49, 11, 11, 11)),
+        ("/root/reference/4D/Filters/4d_filters_lightfield.mat",
+         io_mat.load_filters_lightfield, (49, 5, 5, 11, 11)),
+    ]
+    for path, loader, shape in cases:
+        d = loader(path)
+        assert d.shape == shape, (path, d.shape)
+        assert np.isfinite(d).all(), path
+        # trained banks are nontrivial: no dead (all-zero) filters
+        flat = d.reshape(shape[0], -1)
+        assert (np.abs(flat).max(axis=1) > 0).all(), path

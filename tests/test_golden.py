@@ -1,0 +1,77 @@
+"""Fixed-seed golden-trajectory regression tests (the golden-value
+strategy SURVEY.md section 4 prescribes for the rebuild).
+
+The NumPy oracle tests prove the iteration math; these pin the exact
+numeric trajectory of a fixed-seed run so any silent behavioral change
+— init order, update order, termination, reduction layout — trips a
+diff even if it remains a "valid" ADMM. Values were produced by this
+code on the CPU backend; tolerances absorb cross-platform float
+reassociation only.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccsc_code_iccv2017_tpu.config import (
+    LearnConfig,
+    ProblemGeom,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.models.learn import learn
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+    reconstruct,
+)
+
+
+def test_golden_learn_2d_trajectory():
+    r = np.random.default_rng(7)
+    b = r.normal(size=(4, 16, 16)).astype(np.float32)
+    geom = ProblemGeom((5, 5), 6)
+    cfg = LearnConfig(
+        max_it=4, max_it_d=3, max_it_z=3, num_blocks=2,
+        rho_d=500.0, rho_z=10.0, lambda_prior=0.5,
+        verbose="none", track_objective=True,
+    )
+    res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(42))
+    np.testing.assert_allclose(
+        res.trace["obj_vals_z"],
+        [7255.2153, 3005.686, 2262.0251, 1775.2529, 1392.6475],
+        rtol=1e-3,
+    )
+    np.testing.assert_allclose(
+        res.trace["obj_vals_d"],
+        [7255.2153, 7065.29, 2975.1284, 2257.9888, 1772.7599],
+        rtol=1e-3,
+    )
+    np.testing.assert_allclose(
+        float(np.abs(np.asarray(res.d)).sum()), 22.9037, rtol=1e-3
+    )
+
+
+def test_golden_inpaint_trajectory():
+    r = np.random.default_rng(11)
+    b = r.uniform(0.1, 1.0, (2, 16, 16)).astype(np.float32)
+    d = r.normal(size=(4, 5, 5)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    mask = (r.uniform(size=b.shape) > 0.5).astype(np.float32)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=2.0, max_it=5, tol=0.0,
+        verbose="none",
+    )
+    res = reconstruct(
+        jnp.asarray(b * mask),
+        jnp.asarray(d),
+        ReconstructionProblem(ProblemGeom((5, 5), 4)),
+        cfg,
+        mask=jnp.asarray(mask),
+    )
+    assert int(res.trace.num_iters) == 5
+    np.testing.assert_allclose(
+        np.asarray(res.trace.obj_vals)[:6],
+        [253.75302, 253.80643, 253.57663, 252.72368, 250.94093, 248.40901],
+        rtol=1e-3,
+    )
+    np.testing.assert_allclose(
+        float(np.abs(np.asarray(res.z)).sum()), 4.11126, rtol=1e-3
+    )
