@@ -28,6 +28,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--out", default="hyperspectral_filters.mat")
     p.add_argument("--init", default=None, help="warm-start filter .mat")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--verbose", default="brief")
     return p
@@ -96,6 +98,8 @@ def main(argv=None):
         smooth_init=jnp.asarray(sm),
         init_d=init_d,
         key=jax.random.PRNGKey(args.seed),
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
     )
     save_filters(args.out, res.d, res.trace, layout="hyperspectral")
     print(f"saved {res.d.shape} filters to {args.out}")

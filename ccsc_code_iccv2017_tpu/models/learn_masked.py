@@ -239,13 +239,19 @@ def learn_masked(
     gamma_div_d: float = 5000.0,
     gamma_div_z: float = 500.0,
     mesh=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 5,
 ) -> LearnResult:
     """b: [n, *reduce, *data_spatial]; smooth_init: same shape;
     init_d: [k, *reduce, *support] warm start (admm_learn.m:50-58).
 
     ``mesh``: optional 1-D mesh with axis 'freq' — shards the
     per-frequency solves (frequency-axis tensor parallelism); the
-    result matches the unsharded run up to float reduction order."""
+    result matches the unsharded run up to float reduction order.
+
+    ``checkpoint_dir``: atomic full-state snapshots every
+    ``checkpoint_every`` outer iterations and resume-on-restart, same
+    protocol as the consensus learner (utils.checkpoint)."""
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
     radius = geom.psf_radius
@@ -315,10 +321,29 @@ def learn_masked(
             gamma_div_z=gamma_div_z,
         )
 
-    obj_best = jnp.inf
-    t_total = 0.0
+    start_it = 0
+    if checkpoint_dir is not None:
+        from ..utils import checkpoint as ckpt
+
+        snap = ckpt.load(checkpoint_dir)
+        if snap is not None:
+            fields, resumed_trace, start_it = snap
+            expect = {f: getattr(state, f).shape for f in state._fields}
+            got = {k: v.shape for k, v in fields.items()}
+            if expect != got:
+                raise ValueError(
+                    f"checkpoint shapes {got} do not match problem {expect}"
+                )
+            state = MaskedLearnState(**fields)
+            if resumed_trace is not None:
+                trace = resumed_trace
+            print(f"resumed from {checkpoint_dir} at iteration {start_it}")
+
+    seen = trace["obj_vals_d"] + trace["obj_vals_z"]
+    obj_best = min(seen) if seen else jnp.inf
+    t_total = trace["tim_vals"][-1]
     prev = state
-    for i in range(cfg.max_it):
+    for i in range(start_it, cfg.max_it):
         t0 = time.perf_counter()
         new_state, obj_d, obj_z, d_diff, z_diff = step(
             state,
@@ -348,8 +373,13 @@ def learn_masked(
                 f"Iter {i + 1}, Obj_d {obj_d:.5g}, Obj_z {obj_z:.5g}, "
                 f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}"
             )
+        if checkpoint_dir is not None and (i + 1) % checkpoint_every == 0:
+            ckpt.save(checkpoint_dir, state, trace, i + 1)
         if d_diff < cfg.tol and z_diff < cfg.tol:
             break
+
+    if checkpoint_dir is not None:
+        ckpt.save(checkpoint_dir, state, trace, cfg.max_it)
 
     dhat = common.full_filters_to_freq(state.d_full, fg)
     d_proj = proxes.kernel_constraint_proj(
