@@ -35,11 +35,14 @@ def _soft_np(u, theta):
     return np.sign(u) * np.maximum(np.abs(u) - theta, 0.0)
 
 
-def oracle_reconstruct(b, d, prob, cfg, mask, n_iters):
+def oracle_reconstruct(b, d, prob, cfg, mask, n_iters, blur_psf=None):
     """Dense NumPy rerun of _reconstruct_jit, returning (z, recon,
-    obj trace) after exactly ``n_iters`` iterations."""
+    obj trace) after exactly ``n_iters`` iterations. Supports W == 1
+    (optionally with dirac/blur) and W > 1 (reduce dims, e.g. the
+    demosaic configuration)."""
     geom = prob.geom
     ndim_s = geom.ndim_spatial
+    W = geom.reduce_size
     data_spatial = b.shape[-ndim_s:]
     radius = geom.psf_radius if prob.pad else (0,) * ndim_s
     spatial = tuple(s + 2 * r for s, r in zip(data_spatial, radius))
@@ -49,18 +52,28 @@ def oracle_reconstruct(b, d, prob, cfg, mask, n_iters):
 
     b = b.astype(np.float64)
     if prob.dirac == "append":
-        dirac = np.zeros((1, *geom.spatial_support))
-        dirac[(0, *[s // 2 for s in geom.spatial_support])] = 1.0
+        dirac = np.zeros((1, *geom.reduce_shape, *geom.spatial_support))
+        dirac[
+            (0, *[0] * geom.ndim_reduce,
+             *[s // 2 for s in geom.spatial_support])
+        ] = 1.0
         d = np.concatenate([d.astype(np.float64), dirac], 0)
     else:
         d = d.astype(np.float64)
     K = d.shape[0]
     dirac_idx = K - 1
 
-    dhat = _psf2otf_np(d, spatial).reshape(K, F)
+    dhat_clean = _psf2otf_np(d, spatial).reshape(K, W, F)
+    if blur_psf is not None:
+        blur_otf = _psf2otf_np(
+            blur_psf.astype(np.float64), spatial
+        ).reshape(F)
+        dhat = dhat_clean * blur_otf[None, None, :]
+    else:
+        dhat = dhat_clean
 
     M = np.ones_like(b) if mask is None else mask.astype(np.float64)
-    pad = [(0, 0)] + [(r, r) for r in radius]
+    pad = [(0, 0)] * (b.ndim - ndim_s) + [(r, r) for r in radius]
     B_pad = np.pad(b, pad)
     M_pad = np.pad(M, pad)
     if prob.data_term == "gaussian":
@@ -71,7 +84,7 @@ def oracle_reconstruct(b, d, prob, cfg, mask, n_iters):
     b_max = np.max(M * b)
     g = cfg.gamma_factor * cfg.lambda_prior / b_max
     gamma1, gamma2 = g / cfg.gamma_ratio, g
-    rho = cfg.gamma_ratio
+    rho = cfg.gamma_ratio * (W if cfg.scale_rho_by_reduce else 1.0)
     theta1 = cfg.lambda_residual / gamma1
     theta2 = cfg.lambda_prior / gamma2
 
@@ -95,26 +108,30 @@ def oracle_reconstruct(b, d, prob, cfg, mask, n_iters):
 
     z = np.zeros((n, K, *spatial))
     zhat = np.zeros((n, K, F), complex)
-    d1 = np.zeros((n, *spatial))
+    d1 = np.zeros_like(B_pad)
     d2 = np.zeros_like(z)
 
-    def Dz_of(zh):
-        s = np.einsum("kf,nkf->nf", dhat, zh).reshape(n, *spatial)
+    def crop(x):
+        lead = x.ndim - ndim_s
+        sl = (slice(None),) * lead + tuple(
+            slice(r_, dim - r_)
+            for r_, dim in zip(radius, x.shape[lead:])
+        )
+        return x[sl]
+
+    def Dz_of(zh, dh):
+        s = np.einsum("kwf,nkf->nwf", dh, zh).reshape(B_pad.shape)
         return np.real(np.fft.ifftn(s, axes=fft_axes))
 
     def objective(zc, zh):
-        r = Dz_of(zh) - B_pad
-        sl = (slice(None),) + tuple(
-            slice(r_, dim - r_) for r_, dim in zip(radius, r.shape[1:])
-        )
-        r = (M_pad * r)[sl]
+        r = crop(M_pad * (Dz_of(zh, dhat) - B_pad))
         return 0.5 * cfg.lambda_residual * np.sum(
             r * r
         ) + cfg.lambda_prior * np.sum(np.abs(zc))
 
     objs = [objective(z, zhat)]
     for _ in range(n_iters):
-        v1 = Dz_of(zhat)
+        v1 = Dz_of(zhat, dhat)
         u1 = data_prox(v1 - d1)
         u2_raw = z - d2
         u2 = _soft_np(u2_raw, theta2)
@@ -122,37 +139,38 @@ def oracle_reconstruct(b, d, prob, cfg, mask, n_iters):
             u2[:, dirac_idx] = u2_raw[:, dirac_idx]
         d1 = d1 - (v1 - u1)
         d2 = d2 - (z - u2)
-        xi1_hat = np.fft.fftn(u1 + d1, axes=fft_axes).reshape(n, F)
+        xi1_hat = np.fft.fftn(u1 + d1, axes=fft_axes).reshape(n, W, F)
         xi2_hat = np.fft.fftn(u2 + d2, axes=fft_axes).reshape(n, K, F)
         zhat = np.empty_like(xi2_hat)
         for ni_ in range(n):
             for f in range(F):
-                dv = dhat[:, f]
-                A = np.diag(gam[:, f]) + np.outer(dv.conj(), dv)
-                rhs = dv.conj() * xi1_hat[ni_, f] + rho * xi2_hat[ni_, :, f]
+                A_f = dhat[:, :, f].T  # [W, K]
+                A = np.diag(gam[:, f]) + A_f.conj().T @ A_f
+                rhs = (
+                    A_f.conj().T @ xi1_hat[ni_, :, f]
+                    + rho * xi2_hat[ni_, :, f]
+                )
                 zhat[ni_, :, f] = np.linalg.solve(A, rhs)
         z = np.real(
             np.fft.ifftn(zhat.reshape(n, K, *spatial), axes=fft_axes)
         )
         objs.append(objective(z, zhat))
 
-    recon = Dz_of(zhat)
-    sl = (slice(None),) + tuple(
-        slice(r_, dim - r_) for r_, dim in zip(radius, recon.shape[1:])
-    )
-    recon = recon[sl]
+    recon = crop(Dz_of(zhat, dhat_clean))
     if prob.clamp_nonneg:
         recon = np.maximum(recon, 0.0)
     return z, recon, np.array(objs)
 
 
-def _run_both(prob, cfg, b, d, mask, n_iters):
+def _run_both(prob, cfg, b, d, mask, n_iters, blur_psf=None):
     res = reconstruct(
-        jnp.asarray(b), jnp.asarray(d), prob, cfg, mask=(
-            jnp.asarray(mask) if mask is not None else None
-        )
+        jnp.asarray(b), jnp.asarray(d), prob, cfg,
+        mask=(jnp.asarray(mask) if mask is not None else None),
+        blur_psf=(jnp.asarray(blur_psf) if blur_psf is not None else None),
     )
-    z_np, recon_np, objs_np = oracle_reconstruct(b, d, prob, cfg, mask, n_iters)
+    z_np, recon_np, objs_np = oracle_reconstruct(
+        b, d, prob, cfg, mask, n_iters, blur_psf=blur_psf
+    )
     assert int(res.trace.num_iters) == n_iters
     np.testing.assert_allclose(
         np.asarray(res.z, np.float64), z_np, atol=2e-4, rtol=2e-4
@@ -215,3 +233,54 @@ def test_poisson_dirac_matches_oracle():
     d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
     mask = np.ones_like(b)
     _run_both(prob, cfg, b, d, mask, n_iters)
+
+
+def test_demosaic_reduce_unpadded_matches_oracle():
+    """W > 1 (wavelength/view reduce dims) with pad=False — the
+    demosaic / view-synthesis configuration
+    (admm_solve_conv23D_weighted_sampling.m:5, SURVEY.md #8/#10)."""
+    r = np.random.default_rng(5)
+    geom = ProblemGeom((3, 3), 3, reduce_shape=(2,))
+    prob = ReconstructionProblem(geom, pad=False)
+    n_iters = 3
+    cfg = SolveConfig(
+        lambda_residual=100.0,
+        lambda_prior=1.0,
+        max_it=n_iters,
+        tol=0.0,
+        gamma_factor=60.0,
+        gamma_ratio=100.0,
+        verbose="none",
+    )
+    b = r.uniform(0.1, 1.0, (2, 2, 8, 8)).astype(np.float32)
+    d = r.normal(size=(3, 2, 3, 3)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(2, 3), keepdims=True))
+    # mosaic-style mask: each pixel observes one of the two channels
+    mask = np.zeros_like(b)
+    mask[:, 0, ::2, :] = 1.0
+    mask[:, 1, 1::2, :] = 1.0
+    _run_both(prob, cfg, b, d, mask, n_iters)
+
+
+def test_blur_composition_matches_oracle():
+    """Blur OTF composed into the solve operator, clean filters for the
+    output — the deblurring mechanism
+    (admm_solve_video_weighted_sampling.m:109,124-132)."""
+    r = np.random.default_rng(6)
+    geom = ProblemGeom((3, 3), 4)
+    prob = ReconstructionProblem(geom)
+    n_iters = 3
+    cfg = SolveConfig(
+        lambda_residual=100.0,
+        lambda_prior=0.5,
+        max_it=n_iters,
+        tol=0.0,
+        gamma_factor=500.0,
+        gamma_ratio=1.0,
+        verbose="none",
+    )
+    b = r.uniform(0.1, 1.0, (2, 8, 8)).astype(np.float32)
+    d = r.normal(size=(4, 3, 3)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    blur = np.ones((3, 3), np.float32) / 9.0
+    _run_both(prob, cfg, b, d, None, n_iters, blur_psf=blur)
