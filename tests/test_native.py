@@ -1,4 +1,6 @@
 """Native (C++) preprocessing runtime vs the numpy reference path."""
+import os
+
 import numpy as np
 import pytest
 
@@ -61,3 +63,25 @@ def test_smooth_fill_matches_numpy():
         np.stack([rconv2(bi, k) for bi in b]),
         atol=2e-5,
     )
+
+
+def test_native_selftest_and_tsan():
+    """C++ self-test harness; TSAN build is the framework's
+    race-detection pass (skipped if the toolchain lacks tsan)."""
+    import subprocess
+
+    root = os.path.join(os.path.dirname(__file__), "..", "native")
+    r = subprocess.run(
+        ["make", "-C", root, "selftest"], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "ccsc_selftest: OK" in r.stdout
+    t = subprocess.run(
+        ["make", "-C", root, "tsan"], capture_output=True, text=True,
+        timeout=600,
+    )
+    if t.returncode != 0 and "fsanitize" in (t.stderr or ""):
+        pytest.skip("toolchain lacks ThreadSanitizer")
+    assert t.returncode == 0, t.stderr
+    assert "WARNING: ThreadSanitizer" not in t.stdout + t.stderr
