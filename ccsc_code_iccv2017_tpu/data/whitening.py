@@ -84,17 +84,20 @@ def zca_whiten_images(stack: np.ndarray, eps: float = 1e-5) -> np.ndarray:
     return Xw.reshape(stack.shape).astype(np.float32)
 
 
-def zca_whiten_patches(
+def zca_conv_filters(
     stack: np.ndarray,
     patch: int = 9,
     eps: float = 1e-2,
     num_patches: int = 20000,
     seed: int = 0,
-) -> np.ndarray:
-    """Patch-based ZCA whitening applied as a convolution
-    (CreateImages.m:476-589 / contrast_normalization/region_zca.m
-    intent): estimate the patch covariance from random patches, build
-    the ZCA transform, and apply its center row as a filter."""
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Derive convolutional whitening AND dewhitening kernels from
+    patch-level ZCA — the intent of
+    contrast_normalization/region_zca.m (a dev scratch upstream with
+    missing helpers, SURVEY.md section 2.3 #18): estimate the patch
+    covariance C from random patches; the center rows of C^{-1/2}
+    (whitening) and C^{+1/2} (dewhitening) are the shift-invariant
+    filter approximations of the two transforms."""
     r = np.random.default_rng(seed)
     n, H, W = stack.shape
     ps = []
@@ -107,10 +110,38 @@ def zca_whiten_patches(
     P -= P.mean(axis=0)
     C = P.T @ P / P.shape[0]
     e, V = np.linalg.eigh(C)
-    Wz = V @ np.diag(1.0 / np.sqrt(np.maximum(e, 0) + eps)) @ V.T
-    # center row of the ZCA matrix is the whitening convolution kernel
-    kern = Wz[(patch * patch) // 2].reshape(patch, patch)[::-1, ::-1]
+    e = np.maximum(e, 0) + eps
+    Wz = V @ np.diag(1.0 / np.sqrt(e)) @ V.T
+    Dz = V @ np.diag(np.sqrt(e)) @ V.T
+    center = (patch * patch) // 2
+    wk = Wz[center].reshape(patch, patch)[::-1, ::-1]
+    dk = Dz[center].reshape(patch, patch)[::-1, ::-1]
+    return wk, dk
+
+
+def zca_whiten_patches(
+    stack: np.ndarray,
+    patch: int = 9,
+    eps: float = 1e-2,
+    num_patches: int = 20000,
+    seed: int = 0,
+) -> np.ndarray:
+    """Patch-based ZCA whitening applied as a convolution
+    (CreateImages.m:476-589 / region_zca.m intent): apply the
+    zca_conv_filters whitening kernel with reflected boundaries."""
+    kern, _ = zca_conv_filters(stack, patch, eps, num_patches, seed)
     out = np.stack([rconv2(im.astype(np.float64), kern) for im in stack])
+    return out.astype(np.float32)
+
+
+def zca_conv_dewhiten(
+    stack: np.ndarray, dewhiten_kernel: np.ndarray
+) -> np.ndarray:
+    """Apply the dewhitening kernel from zca_conv_filters (the inverse
+    conv transform region_zca.m derives)."""
+    out = np.stack(
+        [rconv2(im.astype(np.float64), dewhiten_kernel) for im in stack]
+    )
     return out.astype(np.float32)
 
 

@@ -115,3 +115,42 @@ def test_zca_patch_whitening_runs():
     X = _stack(n=4, seed=7)
     out = whitening.zca_whiten_patches(X, patch=5, num_patches=2000)
     assert out.shape == X.shape and np.isfinite(out).all()
+
+
+def test_zca_conv_filter_pair_inverts():
+    """region_zca.m intent: the whitening and dewhitening conv kernels
+    are approximate inverses — their convolution is close to a delta,
+    and whiten->dewhiten approximately restores smooth images away from
+    the boundary."""
+    from scipy.signal import convolve2d
+
+    from ccsc_code_iccv2017_tpu.data.whitening import (
+        zca_conv_dewhiten,
+        zca_conv_filters,
+        zca_whiten_patches,
+    )
+
+    r = np.random.default_rng(0)
+    # smooth correlated images (what whitening is for)
+    from scipy.ndimage import gaussian_filter
+
+    stack = np.stack(
+        [
+            gaussian_filter(r.normal(size=(48, 48)), 2.0)
+            for _ in range(6)
+        ]
+    ).astype(np.float32)
+    wk, dk = zca_conv_filters(stack, patch=7, num_patches=4000)
+    comp = convolve2d(wk, dk, mode="full")
+    c = comp.shape[0] // 2
+    peak = comp[c, c]
+    off = comp.copy()
+    off[c, c] = 0.0
+    assert abs(peak) > 5 * np.abs(off).max()
+
+    white = zca_whiten_patches(stack, patch=7, num_patches=4000)
+    back = zca_conv_dewhiten(white, dk)
+    m = (slice(None), slice(10, -10), slice(10, -10))
+    denom = np.abs(stack[m]).mean()
+    err = np.abs(back[m] - stack[m]).mean() / denom
+    assert err < 0.35, err
