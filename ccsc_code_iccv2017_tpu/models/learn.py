@@ -321,6 +321,7 @@ def learn(
     checkpoint_every: int = 5,
     init_d: Optional[jnp.ndarray] = None,
     profile_dir: Optional[str] = None,
+    figures_dir: Optional[str] = None,
 ) -> LearnResult:
     """Learn a filter bank from data b [n, *reduce, *data_spatial].
 
@@ -342,4 +343,5 @@ def learn(
         checkpoint_every=checkpoint_every,
         init_d=init_d,
         profile_dir=profile_dir,
+        figures_dir=figures_dir,
     )

@@ -125,6 +125,32 @@ def make_eval_fn(
     )
 
 
+def _write_figures(figdir, it, eval_fn, state, b_blocks):
+    """Per-iteration filter mosaic + original-vs-iterate panels
+    (display_func, dParallel.m:326-369), written headlessly."""
+    import os
+
+    import numpy as np
+
+    from ..utils import display
+
+    os.makedirs(figdir, exist_ok=True)
+    _, d_sup, Dz = eval_fn(state, b_blocks)
+    display.save_filter_mosaic(
+        os.path.join(figdir, f"filters_{it:03d}.png"),
+        np.asarray(d_sup),
+        title=f"iter {it}",
+    )
+    flat_Dz = np.asarray(Dz).reshape(-1, *Dz.shape[2:])
+    flat_b = np.asarray(b_blocks).reshape(-1, *b_blocks.shape[2:])
+    display.save_iterate_panel(
+        os.path.join(figdir, f"iterates_{it:03d}.png"),
+        list(flat_b[:3]),
+        list(flat_Dz[:3]),
+        title=f"iter {it}",
+    )
+
+
 def learn(
     b: jnp.ndarray,
     geom: ProblemGeom,
@@ -135,6 +161,7 @@ def learn(
     checkpoint_every: int = 5,
     init_d: Optional[jnp.ndarray] = None,
     profile_dir: Optional[str] = None,
+    figures_dir: Optional[str] = None,
 ) -> learn_mod.LearnResult:
     """Driver: Python outer loop around the jitted consensus step, with
     the reference's trace protocol (obj_vals_d / obj_vals_z / tim_vals,
@@ -142,6 +169,11 @@ def learn(
 
     ``profile_dir`` captures an XLA profiler trace of the whole solve
     (utils.profiling.xla_trace) for TensorBoard/xprof inspection.
+
+    ``verbose='all'`` additionally writes per-iteration figures (filter
+    mosaic + original-vs-iterate panels — the reference's display_func,
+    dParallel.m:326-369, headless) into ``figures_dir`` (default
+    ``ccsc_figures``).
 
     ``checkpoint_dir`` enables atomic mid-run snapshots every
     ``checkpoint_every`` outer iterations and resume-on-restart (full
@@ -271,6 +303,11 @@ def learn(
                     f"Iter {i + 1}, Obj_d {obj_d:.4g}, Obj_z {obj_z:.4g}, "
                     f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}, "
                     f"t {t_total:.2f}s"
+                )
+            if cfg.verbose == "all":
+                _write_figures(
+                    figures_dir or "ccsc_figures", i + 1, eval_fn,
+                    state, b_blocks,
                 )
             if checkpoint_dir is not None and (i + 1) % checkpoint_every == 0:
                 ckpt.save(checkpoint_dir, state, trace, i + 1)

@@ -55,3 +55,25 @@ def test_learn_with_profile_dir(tmp_path):
         if f.endswith((".xplane.pb", ".trace.json.gz", ".json.gz"))
     ]
     assert found, f"no profiler artifacts under {prof}"
+
+
+def test_verbose_all_writes_figures(tmp_path, capsys):
+    """verbose='all' produces per-iteration figures (the reference's
+    display_func behavior, dParallel.m:326-369, headless)."""
+    figs = str(tmp_path / "figs")
+    b = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12)), np.float32
+    )
+    geom = ProblemGeom((3, 3), 4)
+    cfg = LearnConfig(
+        max_it=2, max_it_d=1, max_it_z=1, num_blocks=2,
+        rho_d=50.0, rho_z=2.0, tol=0.0, verbose="all",
+    )
+    learn_mod.learn(
+        jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0),
+        figures_dir=figs,
+    )
+    capsys.readouterr()
+    files = sorted(os.listdir(figs))
+    assert "filters_001.png" in files and "filters_002.png" in files
+    assert "iterates_001.png" in files and "iterates_002.png" in files
