@@ -30,6 +30,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 
 
@@ -38,14 +39,26 @@ def hermitian_inverse(G: jnp.ndarray) -> jnp.ndarray:
     matrices via the real block embedding (TPU-safe).
 
     G: [..., m, m] complex -> G^{-1} [..., m, m] complex.
+
+    The embedding [[Re,-Im],[Im,Re]] is symmetric PD whenever G is
+    Hermitian PD, so the batched factorization is a Cholesky (one
+    triangular factor + two triangular solves) rather than a general
+    LU — the cheaper and more stable choice for the d-pass, which
+    inverts one such system per frequency per outer iteration
+    (precompute_H_hat_D's pinv in the reference, dParallel.m:235).
     """
     m = G.shape[-1]
     re, im = jnp.real(G), jnp.imag(G)
     top = jnp.concatenate([re, -im], axis=-1)
     bot = jnp.concatenate([im, re], axis=-1)
     R = jnp.concatenate([top, bot], axis=-2)  # [..., 2m, 2m] sym PD
+    L = jnp.linalg.cholesky(R)
     eye = jnp.broadcast_to(jnp.eye(2 * m, dtype=R.dtype), R.shape)
-    Rinv = jnp.linalg.solve(R, eye)
+    # R^{-1} = L^{-T} L^{-1}: two batched triangular solves
+    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    Rinv = jax.scipy.linalg.solve_triangular(
+        L, Linv, lower=True, trans=1
+    )
     return Rinv[..., :m, :m] + 1j * Rinv[..., m:, :m]
 
 
