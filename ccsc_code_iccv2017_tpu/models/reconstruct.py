@@ -151,6 +151,13 @@ def reconstruct(
             f"batch {b.shape[0]} not divisible by mesh axis "
             f"'{axis}' size {ndev}"
         )
+    # optional second axis 'freq': frequency-axis tensor parallelism of
+    # the per-frequency solves (DP x TP, like the learner's
+    # block_freq_mesh)
+    if len(mesh.axis_names) > 1 and mesh.axis_names[1] != "freq":
+        raise ValueError(
+            f"second mesh axis must be 'freq', got {mesh.axis_names}"
+        )
     fn = _sharded_reconstruct_fn(
         prob,
         cfg,
@@ -174,9 +181,14 @@ def _sharded_reconstruct_fn(
 
     from ..parallel.mesh import shard_map
 
+    has_freq = "freq" in mesh.axis_names
+    nf = mesh.shape["freq"] if has_freq else 1
+
     def shard_step(b_l, d, mask_l, sm_l, blur, xo_l):
         return _reconstruct_jit(
-            b_l, d, prob, cfg, mask_l, sm_l, blur, xo_l, axis_name=axis
+            b_l, d, prob, cfg, mask_l, sm_l, blur, xo_l, axis_name=axis,
+            freq_axis_name="freq" if has_freq else None,
+            num_freq_shards=nf,
         )
 
     bs, rep = P(axis), P()
@@ -201,7 +213,11 @@ def _sharded_reconstruct_fn(
     return jax.jit(fn)
 
 
-@functools.partial(jax.jit, static_argnames=("prob", "cfg", "axis_name"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("prob", "cfg", "axis_name", "freq_axis_name",
+                     "num_freq_shards"),
+)
 def _reconstruct_jit(
     b,
     d,
@@ -212,12 +228,19 @@ def _reconstruct_jit(
     blur_psf,
     x_orig,
     axis_name=None,
+    freq_axis_name=None,
+    num_freq_shards=1,
 ):
     """axis_name: when set (called inside shard_map over a batch
     shard), every batch-wide scalar — gamma's max(b), the objective,
     PSNR's mse, the rel-change termination metric — is reduced across
     shards, so all shards take identical trip counts and the result
-    matches the unsharded run."""
+    matches the unsharded run.
+
+    freq_axis_name: optional second mesh axis sharding the
+    per-frequency solves (each device solves F/num_freq_shards bins;
+    one tiled all_gather per iteration reassembles the spectrum for
+    the replicated FFT boundary — the learner's TP scheme)."""
 
     def gsum(x):
         return jax.lax.psum(x, axis_name) if axis_name else x
@@ -276,12 +299,38 @@ def _reconstruct_jit(
     # static; gamma cancels in the ratio so rho is static. Weights of
     # the two prox terms stay dynamic (depend on max(b)).
 
+    if fg.num_freq % num_freq_shards:
+        raise ValueError(
+            f"num_freq={fg.num_freq} not divisible by "
+            f"num_freq_shards={num_freq_shards}"
+        )
+    f_local = fg.num_freq // num_freq_shards
+
+    def fslice(x):
+        if freq_axis_name is None:
+            return x
+        idx = jax.lax.axis_index(freq_axis_name)
+        return jax.lax.dynamic_slice_in_dim(
+            x, idx * f_local, f_local, axis=x.ndim - 1
+        )
+
+    def fgather(x):
+        if freq_axis_name is None:
+            return x
+        return jax.lax.all_gather(
+            x, freq_axis_name, axis=x.ndim - 1, tiled=True
+        )
+
     extra_diag = None
     if prob.grad_reg_dirac:
         tg = _grad_diag(fg, cfg.lambda_smooth)  # [F]
         extra_diag = jnp.zeros((K, fg.num_freq)).at[dirac_idx].set(tg)
 
-    kern = freq_solvers.precompute_z_kernel(dhat_solve, rho, extra_diag)
+    kern = freq_solvers.precompute_z_kernel(
+        fslice(dhat_solve),
+        rho,
+        fslice(extra_diag) if extra_diag is not None else None,
+    )
 
     channel_mask = None
     if not prob.sparsify_dirac and prob.dirac != "none":
@@ -327,10 +376,12 @@ def _reconstruct_jit(
         )
         d1 = d1 - (v1 - u1)
         d2 = d2 - (z - u2)
-        xi1_hat = common.data_to_freq(u1 + d1, fg)
-        xi2_hat = common.codes_to_freq(u2 + d2, fg)
-        zhat_new = freq_solvers.solve_z(
-            kern, xi1_hat, xi2_hat, rho, use_pallas=cfg.use_pallas
+        xi1_hat = fslice(common.data_to_freq(u1 + d1, fg))
+        xi2_hat = fslice(common.codes_to_freq(u2 + d2, fg))
+        zhat_new = fgather(
+            freq_solvers.solve_z(
+                kern, xi1_hat, xi2_hat, rho, use_pallas=cfg.use_pallas
+            )
         )
         z_new = common.codes_from_freq(zhat_new, fg)
         diff = common.rel_change(z_new, z, axis_name)
