@@ -81,47 +81,175 @@ def run_workload():
     # warmup / compile. NB: jax.block_until_ready is a no-op on the
     # axon TPU platform — a scalar readback is the only reliable fence.
     s1, m0 = step(state, b_blocks)
-    float(m0.obj_z)
+    float(m0.d_diff)  # real scalar computed from the chain, not the
+    # constant-0 objective (verbose='none' skips the objective)
 
     t0 = time.perf_counter()
     cur = s1
     for _ in range(iters):
         cur, m = step(cur, b_blocks)
-    float(m.obj_z)  # fences the whole chain
+    float(m.d_diff)  # fences the whole chain
     dt = time.perf_counter() - t0
+    ips = iters / dt
+
+    # ---- utilization: XLA's cost model, analytic fallback ----------
+    from ccsc_code_iccv2017_tpu.utils import perfmodel
+
+    cost = None
+    try:
+        compiled = step.lower(state, b_blocks).compile()
+        cost = perfmodel.compiled_cost(compiled)
+        cost_src = "xla_cost_analysis"
+    except Exception:
+        cost = None
+    if cost is None:
+        cost = perfmodel.analytic_outer_step_cost(
+            num_blocks=blocks,
+            ni=n // blocks,
+            k=k,
+            spatial=fg.spatial_shape,
+            num_freq=fg.num_freq,
+            max_it_d=cfg.max_it_d,
+            max_it_z=cfg.max_it_z,
+        )
+        cost_src = "analytic"
+    util = perfmodel.utilization(cost, ips)
+    util["cost_source"] = cost_src
 
     platform = jax.devices()[0].platform
-    return {
-        "iters_per_sec": iters / dt,
+    out = {
+        "iters_per_sec": ips,
         "n": n,
         "size": size,
         "k": k,
         "blocks": blocks,
         "platform": platform,
+        "util": util,
     }
+    if os.environ.get("CCSC_BENCH_PROFILE") == "1":
+        out["components"] = profile_components(
+            geom, cfg, fg, state, b_blocks
+        )
+    return out
+
+
+def profile_components(geom, cfg, fg, state, b_blocks, reps=5):
+    """Wall-clock split of the outer step's stages (the FFT vs Gram vs
+    solve mix VERDICT asks for): each stage jitted separately, fenced
+    by a real-scalar readback, timed over ``reps`` runs. Overlap/fusion
+    across stages is lost, so the parts can sum to more than the fused
+    step — the table is for MIX, not absolute totals."""
+    import jax
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.models import common
+    from ccsc_code_iccv2017_tpu.ops import fourier, freq_solvers, proxes
+
+    radius = geom.psf_radius
+    b_pad = fourier.pad_spatial(b_blocks, radius)
+    bhat = jax.jit(
+        jax.vmap(lambda bp: common.data_to_freq(bp, fg))
+    )(b_pad)
+
+    f_zhat = jax.jit(
+        lambda z: jax.vmap(lambda zl: common.codes_to_freq(zl, fg))(z)
+    )
+    zhat = f_zhat(state.z)
+    f_kern = jax.jit(
+        jax.vmap(lambda zh: freq_solvers.precompute_d_kernel(zh, cfg.rho_d))
+    )
+    kern = f_kern(zhat)
+    xi_hat = jax.vmap(lambda x: common.full_filters_to_freq(x, fg))(
+        state.d_local
+    )
+    f_solve_d = jax.jit(
+        jax.vmap(
+            lambda kn, bh, xh: freq_solvers.solve_d(kn, bh, xh, cfg.rho_d)
+        )
+    )
+    dhat_z = common.full_filters_to_freq(state.dbar, fg)
+    zkern = freq_solvers.precompute_z_kernel(dhat_z, cfg.rho_z)
+    f_solve_z = jax.jit(
+        jax.vmap(
+            lambda bh, xh: freq_solvers.solve_z(
+                zkern, bh, xh, cfg.rho_z, use_pallas=cfg.use_pallas
+            )
+        )
+    )
+    f_izhat = jax.jit(
+        lambda zh: jax.vmap(lambda z1: common.codes_from_freq(z1, fg))(zh)
+    )
+    f_prox = jax.jit(
+        lambda z: proxes.soft_threshold(z, cfg.lambda_prior / cfg.rho_z)
+    )
+
+    stages = {
+        "codes_rfft": (f_zhat, (state.z,), lambda o: o.real.sum()),
+        "gram_cholesky": (f_kern, (zhat,), lambda o: o.ginv.real.sum()),
+        "solve_d": (
+            f_solve_d,
+            (kern, bhat, xi_hat),
+            lambda o: o.real.sum(),
+        ),
+        "solve_z": (
+            f_solve_z,
+            (bhat, zhat),
+            lambda o: o.real.sum(),
+        ),
+        "codes_irfft": (f_izhat, (zhat,), lambda o: o.sum()),
+        "soft_threshold": (f_prox, (state.z,), lambda o: o.sum()),
+    }
+    table = {}
+    for name, (fn, args, red) in stages.items():
+        # jit fn+scalar-reduction together: no eager complex ops (axon
+        # can't do them), and the full output stays an executable
+        # output so it is still materialized to HBM.
+        def g(*a, _fn=fn, _red=red):
+            o = _fn(*a)
+            return o, jnp.real(jnp.asarray(_red(o))).astype(jnp.float32)
+
+        gj = jax.jit(g)
+        _, s = gj(*args)  # compile
+        float(s)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _, s = gj(*args)
+        float(s)
+        table[name] = (time.perf_counter() - t0) / reps * 1e3  # ms
+    return {k: round(v, 3) for k, v in table.items()}
 
 
 def emit(r, degraded=False):
     target_pace = 20.0 / 300.0  # north-star: 20 outer iters in 5 min
-    suffix = (
-        f", DEGRADED: TPU unreachable, ran on {r['platform']}"
-        if degraded
-        else ", 1 chip"
-    )
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"2D consensus ADMM outer iters/sec "
-                    f"(k={r['k']} 11x11 filters, n={r['n']}x{r['size']}^2, "
-                    f"{r['blocks']} blocks{suffix})"
-                ),
-                "value": round(r["iters_per_sec"], 4),
-                "unit": "outer_iters/sec",
-                "vs_baseline": round(r["iters_per_sec"] / target_pace, 3),
-            }
-        )
-    )
+    if degraded:
+        # only the fallback path after a failed TPU attempt is DEGRADED;
+        # an intentional JAX_PLATFORMS=cpu run is labeled neutrally
+        suffix = f", DEGRADED: TPU unreachable, ran on {r['platform']}"
+    elif r["platform"] in ("tpu", "axon"):
+        suffix = ", 1 chip"
+    else:
+        suffix = f", {r['platform']}"
+    out = {
+        "metric": (
+            f"2D consensus ADMM outer iters/sec "
+            f"(k={r['k']} 11x11 filters, n={r['n']}x{r['size']}^2, "
+            f"{r['blocks']} blocks{suffix})"
+        ),
+        "value": round(r["iters_per_sec"], 4),
+        "unit": "outer_iters/sec",
+        "vs_baseline": round(r["iters_per_sec"] / target_pace, 3),
+    }
+    u = r.get("util")
+    if u:
+        out["mfu"] = round(u["mfu_vs_bf16_peak"], 5)
+        out["hbm_frac"] = round(u["hbm_frac"], 4)
+        out["achieved_tflops"] = round(u["achieved_tflops"], 3)
+        out["achieved_gbps"] = round(u["achieved_gbps"], 2)
+        out["flops_per_step"] = u["flops_per_step"]
+        out["bytes_per_step"] = u["bytes_per_step"]
+        out["chip"] = u["chip"]
+        out["cost_source"] = u["cost_source"]
+    print(json.dumps(out))
 
 
 def attempt(extra_env, timeout):
@@ -155,7 +283,15 @@ def main():
     timeout = float(os.environ.get("CCSC_BENCH_TIMEOUT", 900))
     r = attempt({}, timeout)
     if r is not None:
-        emit(r, degraded=r["platform"] not in ("tpu", "axon"))
+        # A first attempt landing on CPU is DEGRADED unless the caller
+        # explicitly asked for a non-TPU platform (JAX_PLATFORMS set):
+        # with the axon plugin registering zero devices JAX silently
+        # falls back to CPU, and that must not read as a normal run.
+        unexpected_cpu = r["platform"] not in (
+            "tpu",
+            "axon",
+        ) and not os.environ.get("JAX_PLATFORMS")
+        emit(r, degraded=unexpected_cpu)
         return
     # TPU attempt hung or crashed — degrade to CPU so the round still
     # records a number (and says so).

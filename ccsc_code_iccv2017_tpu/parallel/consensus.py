@@ -265,7 +265,6 @@ def learn(
     from ..utils import profiling
 
     t_total = trace["tim_vals"][-1]
-    prev_state = state
     with profiling.xla_trace(profile_dir):
         for i in range(start_it, cfg.max_it):
             t0 = time.perf_counter()
@@ -280,6 +279,9 @@ def learn(
             # keep the last good state instead of propagating NaNs into
             # the result/checkpoint. The reference's only analogous
             # mechanism is the objective rollback in admm_learn.m:204-213.
+            # The metrics are computed on new_state inside step(), so
+            # `state` itself is still the last verified-good iterate —
+            # just stop without adopting new_state.
             if not all(
                 math.isfinite(v) for v in (obj_d, obj_z, d_diff, z_diff)
             ):
@@ -288,9 +290,7 @@ def learn(
                     f"(obj_d={obj_d}, obj_z={obj_z}, d_diff={d_diff}, "
                     f"z_diff={z_diff}); keeping last good state"
                 )
-                state = prev_state
                 break
-            prev_state = state
             state = new_state
             t_total += time.perf_counter() - t0
             trace["obj_vals_d"].append(obj_d)

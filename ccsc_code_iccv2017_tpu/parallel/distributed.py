@@ -22,11 +22,30 @@ unchanged in one process (including under
 """
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_log = logging.getLogger(__name__)
+
+# jax.distributed.initialize() must run BEFORE any XLA backend is
+# touched (jax.devices()/process_count() initialize backends, after
+# which initialize() raises) — so the already-initialized guard below
+# must not call any jax.* query. Tracked with a module flag plus the
+# distributed client object, neither of which spins up a backend.
+_initialized = False
+
+
+def _runtime_already_initialized() -> bool:
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        return _jax_distributed.global_state.client is not None
+    except Exception:  # pragma: no cover - private-API drift fallback
+        return False
 
 
 def initialize(
@@ -38,23 +57,33 @@ def initialize(
 
     On TPU pods all three arguments resolve automatically from the
     environment; pass them explicitly for CPU/GPU clusters. No-op if
-    the runtime is already initialized or single-process with no
-    coordinator configured.
+    the distributed runtime is already initialized. Call this before
+    anything that touches a device (jax.devices(), jit, ...).
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    global _initialized
+    if _initialized or _runtime_already_initialized():
+        return
     if coordinator_address is None and num_processes is None:
-        # TPU pod: env provides everything; bare single host: skip.
+        # TPU pod / managed cluster: env autodetection provides
+        # everything; on a bare single host autodetection fails and we
+        # stay single-process — but say so instead of hiding it.
         try:
             jax.distributed.initialize()
-        except Exception:
-            pass  # single-process — nothing to bootstrap
+        except Exception as e:
+            _log.info(
+                "jax.distributed auto-init unavailable (%s); "
+                "running single-process",
+                e,
+            )
+            return
+        _initialized = True
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+    _initialized = True
 
 
 def multihost_block_mesh(freq_shards: int = 1) -> Mesh:

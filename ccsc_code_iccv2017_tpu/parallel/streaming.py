@@ -182,6 +182,18 @@ def learn_streaming(
         d_proj = f_prox(dbar, udbar)
         dhat_z = f_full_dhat(d_proj)
 
+        # post-d-pass objective (codes not yet updated) — keeps the
+        # trace protocol of the in-memory learner and the reference
+        # (obj_vals_d = objective after the d-pass, dParallel.m:62-71)
+        obj_d = 0.0
+        if cfg.with_objective:
+            for nn in range(N):
+                obj_d += float(
+                    f_obj_block(
+                        jnp.asarray(z[nn]), jnp.asarray(b_blocks[nn]), dhat_z
+                    )
+                )
+
         # ---- z-pass: blocks fully independent ----------------------
         num = 0.0
         den = 0.0
@@ -203,7 +215,7 @@ def learn_streaming(
         z_diff = float(np.sqrt(num) / max(np.sqrt(den), 1e-30))
         t_total += time.perf_counter() - t0
         trace["obj_vals_z"].append(obj_z)
-        trace["obj_vals_d"].append(obj_z)
+        trace["obj_vals_d"].append(obj_d)
         trace["tim_vals"].append(t_total)
         trace["d_diff"].append(d_diff)
         trace["z_diff"].append(z_diff)

@@ -1,0 +1,62 @@
+"""Utilization model sanity: XLA's cost analysis vs the analytic count.
+
+The analytic model is the fallback for backends without cost_analysis
+(the axon tunnel); it must agree with XLA's own count to well within an
+order of magnitude or the reported MFU is meaningless.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+from ccsc_code_iccv2017_tpu.models import common, learn as learn_mod
+from ccsc_code_iccv2017_tpu.parallel import consensus
+from ccsc_code_iccv2017_tpu.utils import perfmodel
+
+
+def test_analytic_vs_xla_cost():
+    blocks, ni, k, size = 2, 4, 8, 24
+    geom = ProblemGeom((5, 5), k)
+    cfg = LearnConfig(
+        max_it=1, max_it_d=3, max_it_z=5, num_blocks=blocks,
+        rho_d=500.0, rho_z=10.0, verbose="none",
+    )
+    fg = common.FreqGeom.create(geom, (size, size))
+    state = learn_mod.init_state(
+        jax.random.PRNGKey(0), geom, fg, blocks, ni
+    )
+    b_blocks = jax.random.normal(
+        jax.random.PRNGKey(1), (blocks, ni, size, size), jnp.float32
+    )
+    step = consensus.make_outer_step(geom, cfg, fg, mesh=None)
+    compiled = step.lower(state, b_blocks).compile()
+    xla = perfmodel.compiled_cost(compiled)
+    if xla is None:
+        pytest.skip("backend has no cost_analysis")
+    ana = perfmodel.analytic_outer_step_cost(
+        num_blocks=blocks, ni=ni, k=k, spatial=fg.spatial_shape,
+        num_freq=fg.num_freq, max_it_d=cfg.max_it_d,
+        max_it_z=cfg.max_it_z,
+    )
+    ratio_f = ana["flops"] / xla["flops"]
+    assert 0.2 < ratio_f < 5.0, (ana, xla)
+    # bytes: analytic is a minimal-traffic lower-bound style estimate;
+    # allow a wider band but the same order of magnitude
+    ratio_b = ana["bytes"] / xla["bytes"]
+    assert 0.1 < ratio_b < 10.0, (ana, xla)
+
+
+def test_utilization_fields():
+    u = perfmodel.utilization(
+        {"flops": 1e12, "bytes": 1e9}, steps_per_sec=2.0, chip="v5e"
+    )
+    assert u["achieved_tflops"] == pytest.approx(2.0)
+    assert u["mfu_vs_bf16_peak"] == pytest.approx(2e12 / 197e12)
+    assert u["achieved_gbps"] == pytest.approx(2.0)
+    assert u["hbm_frac"] == pytest.approx(2e9 / 819e9)
+
+
+def test_detect_chip_cpu():
+    # under the test conftest the backend is CPU; a CPU run must never
+    # be scored against a TPU roofline even with the axon env set
+    assert perfmodel.detect_chip() == "cpu"
