@@ -1,0 +1,32 @@
+"""Shared CLI dispatch: consensus learner vs host-streaming learner.
+
+One place for the --streaming arm the learning drivers share, so the
+guard logic cannot drift between apps."""
+from __future__ import annotations
+
+
+def dispatch_learn(b, geom, cfg, key, mesh, streaming: bool, **kwargs):
+    """Run the consensus learner, or the host-streaming variant when
+    ``streaming`` (single-device, bounded HBM; parallel.streaming).
+    ``kwargs`` pass through to models.learn.learn only."""
+    if streaming:
+        if mesh is not None:
+            raise SystemExit(
+                "--streaming is single-device and does not combine "
+                "with --mesh"
+            )
+        if any(v for v in kwargs.values()):
+            raise SystemExit(
+                "--streaming does not combine with "
+                + "/".join(k for k, v in kwargs.items() if v)
+            )
+        from ..parallel.streaming import learn_streaming
+
+        import numpy as np
+
+        return learn_streaming(np.asarray(b), geom, cfg, key=key)
+    import jax.numpy as jnp
+
+    from ..models.learn import learn
+
+    return learn(jnp.asarray(b), geom, cfg, key=key, mesh=mesh, **kwargs)

@@ -32,6 +32,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rho-d", type=float, default=5000.0)
     p.add_argument("--rho-z", type=float, default=1.0)
     p.add_argument("--mesh", type=int, default=0)
+    p.add_argument(
+        "--streaming",
+        action="store_true",
+        help="host-streaming mode: one consensus block on device at a "
+        "time (bounded HBM; parallel.streaming)",
+    )
     p.add_argument("--out", default="3D_video_filters.mat")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--verbose", default="brief")
@@ -76,9 +82,11 @@ def main(argv=None):
         num_blocks=args.blocks,
         verbose=args.verbose,
     )
+    from ._dispatch import dispatch_learn
+
     mesh = block_mesh(args.mesh) if args.mesh else None
-    res = learn(
-        jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(args.seed), mesh=mesh
+    res = dispatch_learn(
+        b, geom, cfg, jax.random.PRNGKey(args.seed), mesh, args.streaming
     )
     save_filters(args.out, res.d, res.trace, layout="3d")
     print(f"saved {res.d.shape} filters to {args.out}")

@@ -28,6 +28,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--out", default="hyperspectral_filters.mat")
     p.add_argument("--init", default=None, help="warm-start filter .mat")
+    p.add_argument(
+        "--streaming",
+        action="store_true",
+        help="host-streaming mode: bounded HBM via the consensus "
+        "streaming learner on offset-subtracted cubes. DIVERGENCE: "
+        "uses the consensus objective (zero-padded border residual, "
+        "models.learn) rather than the masked-boundary ADMM — the "
+        "masked learner's n x n Woodbury inner system couples all "
+        "images and cannot stream (admm_learn.m:273-300).",
+    )
+    p.add_argument("--streaming-blocks", type=int, default=4)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
@@ -91,6 +102,27 @@ def main(argv=None):
         if args.init
         else None
     )
+    if args.streaming:
+        if args.init or args.checkpoint_dir:
+            raise SystemExit(
+                "--streaming does not combine with --init/"
+                "--checkpoint-dir"
+            )
+        import dataclasses
+
+        from ..parallel.streaming import learn_streaming
+
+        n = b.shape[0]
+        blocks = max(1, min(args.streaming_blocks, n))
+        while n % blocks:
+            blocks -= 1
+        scfg = dataclasses.replace(cfg, num_blocks=blocks)
+        res = learn_streaming(
+            b - sm, geom, scfg, key=jax.random.PRNGKey(args.seed)
+        )
+        save_filters(args.out, res.d, res.trace, layout="hyperspectral")
+        print(f"saved {res.d.shape} filters to {args.out} (streaming)")
+        return res
     res = learn_masked(
         jnp.asarray(b),
         geom,

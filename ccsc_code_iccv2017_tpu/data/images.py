@@ -188,25 +188,138 @@ def _list_image_files(path: str) -> List[str]:
     return [os.path.join(path, f) for f in files]
 
 
+def _mat_image_stack(path: str) -> List[np.ndarray]:
+    """A .mat file holding an image stack -> list of [H, W(, C)] arrays.
+
+    Mirrors the reference's three non-directory input forms
+    (CreateImages.m:182-245 via check_imgs_path.m:19-64): it prefers
+    the variable names the reference looks for (``images``,
+    ``original_images``), else takes the largest array in the file.
+    Layout rule: the MATLAB-convention names (``images``,
+    ``original_images``, ``I``) are image-major-last ([H, W, n] /
+    [H, W, C, n]); the framework-convention name ``b`` is
+    batch-leading ([n, H, W] / [n, H, W, C]); unnamed arrays default
+    to MATLAB layout unless a trailing channel axis marks them as
+    framework-saved."""
+    from ..utils.io_mat import _loadmat
+
+    d = {
+        k: np.asarray(v)
+        for k, v in _loadmat(path).items()
+        if not k.startswith("__") and np.asarray(v).ndim >= 2
+    }
+    if not d:
+        raise ValueError(f"no image array found in {path}")
+    layout = None
+    for name in ("images", "original_images", "I", "b"):
+        if name in d:
+            arr = d[name]
+            layout = "framework" if name == "b" else "matlab"
+            break
+    else:
+        arr = max(d.values(), key=lambda a: a.size)
+    arr = np.asarray(arr)
+    if layout is None:
+        layout = (
+            "framework"
+            if arr.ndim == 4
+            and arr.shape[-1] in (1, 3)
+            and arr.shape[2] not in (1, 3)
+            else "matlab"
+        )
+    return array_image_stack(arr, layout=layout)
+
+
+def array_image_stack(
+    arr: np.ndarray, layout: str = "framework"
+) -> List[np.ndarray]:
+    """Array -> list of [H, W(, C)] images (the reference's
+    array-input branch, CreateImages.m:229-245).
+
+    layout='framework': [n, H, W] or [n, H, W, C] (batch-leading, the
+    canonical layout everywhere in this package);
+    layout='matlab': [H, W, n] or [H, W, C, n] (image-major-last, the
+    reference's .mat convention). A singleton C axis is squeezed.
+    """
+    arr = np.asarray(arr)
+    if arr.ndim == 2:
+        return [arr]
+    if layout == "matlab":
+        if arr.ndim == 3:
+            return [arr[..., i] for i in range(arr.shape[-1])]
+        if arr.ndim == 4:
+            return [
+                np.squeeze(arr[..., i], -1)
+                if arr.shape[2] == 1
+                else arr[..., i]
+                for i in range(arr.shape[-1])
+            ]
+    elif layout == "framework":
+        if arr.ndim == 3:
+            return list(arr)
+        if arr.ndim == 4:
+            return [
+                np.squeeze(a, -1) if arr.shape[-1] == 1 else a
+                for a in arr
+            ]
+    else:
+        raise ValueError(f"unknown array layout {layout!r}")
+    raise ValueError(f"cannot interpret image array of shape {arr.shape}")
+
+
 def load_image_list(
-    path: str,
+    path,
     contrast_normalize: str = "none",
     zero_mean: bool = False,
     color: str = "gray",
     limit: Optional[int] = None,
     frames: Optional[Sequence] = None,
 ) -> List[np.ndarray]:
-    """Load a folder of images as a list of [H, W] (gray) or [H, W, 3]
+    """Load images as a list of [H, W] (gray) or [H, W, 3]
     (rgb/ycbcr/hsv) float32 arrays — the CreateImagesList.m variant,
     for images of differing sizes (used by the Poisson driver,
     reconstruct_poisson_noise.m:15). ``frames`` is the reference's
-    {A,B,C} stride selection over the sorted file list."""
+    {A,B,C} stride selection over the sorted file list.
+
+    ``path`` may be (CreateImages.m:111-245 input forms):
+    a directory of images; a directory holding a single .mat stack;
+    a .mat file; a single image file; or an in-memory array
+    (see array_image_stack for accepted layouts).
+    """
     from PIL import Image
 
-    files = select_frames(_list_image_files(path), frames)
+    if isinstance(path, np.ndarray):
+        raws = select_frames(array_image_stack(path), frames)
+    elif os.path.isfile(path):
+        if path.lower().endswith(".mat"):
+            raws = select_frames(_mat_image_stack(path), frames)
+        else:
+            raws = select_frames(
+                [np.asarray(Image.open(path))], frames
+            )
+    else:
+        listing = _list_image_files(path)
+        if len(listing) == 0:
+            mats = [
+                os.path.join(path, f)
+                for f in sorted(os.listdir(path))
+                if f.lower().endswith(".mat")
+            ]
+            if len(mats) == 1:
+                # single-.mat directory (check_imgs_path.m:48-53)
+                raws = select_frames(_mat_image_stack(mats[0]), frames)
+            else:
+                raise ValueError(
+                    f"no images and no single .mat stack in {path}"
+                )
+        else:
+            files = select_frames(listing, frames)
+            # decode only what the limit keeps
+            files = files[: limit if limit else None]
+            raws = [np.asarray(Image.open(f)) for f in files]
     out = []
-    for f in files[: limit if limit else None]:
-        img = convert_color(np.asarray(Image.open(f)), color)
+    for raw in raws[: limit if limit else None]:
+        img = convert_color(raw, color)
         if contrast_normalize == "local_cn":
             img = _per_channel(local_contrast_normalize, img)
         elif contrast_normalize != "none":
@@ -256,7 +369,7 @@ def channels_to_batch(stack: np.ndarray) -> np.ndarray:
 
 
 def load_images(
-    path: str,
+    path,
     contrast_normalize: str = "none",
     zero_mean: bool = False,
     color: str = "gray",
@@ -266,7 +379,9 @@ def load_images(
     frames: Optional[Sequence] = None,
     layout: str = "channels_last",
 ) -> np.ndarray:
-    """CreateImages.m equivalent: folder -> [n, H, W] float32 (gray)
+    """CreateImages.m equivalent: folder / .mat stack / single image /
+    in-memory array (the reference's four input forms,
+    CreateImages.m:111-245) -> [n, H, W] float32 (gray)
     or, for color modes (rgb/ycbcr/hsv, CreateImages.m:253-281), an
     array whose channel placement is picked by ``layout``:
 

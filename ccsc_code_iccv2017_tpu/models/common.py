@@ -74,10 +74,19 @@ def codes_from_freq(zhat: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
 
 
 def recon_from_freq(
-    dhat: jnp.ndarray, zhat: jnp.ndarray, fg: FreqGeom
+    dhat: jnp.ndarray,
+    zhat: jnp.ndarray,
+    fg: FreqGeom,
+    filter_axis_name=None,
 ) -> jnp.ndarray:
-    """Dz in real space: [n, *reduce, *spatial] (reduce axes restored)."""
+    """Dz in real space: [n, *reduce, *spatial] (reduce axes restored).
+
+    ``filter_axis_name``: dhat/zhat hold only this device's k shard —
+    the filter sum inside apply_dictionary is completed with one psum
+    over that mesh axis before the inverse FFT."""
     Dzh = fourier.apply_dictionary(dhat, zhat)  # [n, W, F]
+    if filter_axis_name is not None:
+        Dzh = jax.lax.psum(Dzh, filter_axis_name)
     Dzh = Dzh.reshape(Dzh.shape[0], *fg.reduce_shape, *fg.freq_shape)
     return fourier.irfftn_spatial(Dzh, fg.spatial_shape)
 

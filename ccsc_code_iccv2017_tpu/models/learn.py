@@ -97,8 +97,11 @@ def init_state(
     )
 
 
-def _psum(x, axis_name: Optional[str]):
-    return x if axis_name is None else jax.lax.psum(x, axis_name)
+def _psum(x, axis_name):
+    """psum over one axis name, a tuple of them, or None (elided)."""
+    if axis_name is None or axis_name == ():
+        return x
+    return jax.lax.psum(x, axis_name)
 
 
 def outer_step(
@@ -111,6 +114,7 @@ def outer_step(
     axis_name: Optional[str] = None,
     freq_axis_name: Optional[str] = None,
     num_freq_shards: int = 1,
+    filter_axis_name: Optional[str] = None,
 ) -> Tuple[LearnState, OuterMetrics]:
     """One outer consensus iteration over this device's L local blocks.
 
@@ -127,16 +131,32 @@ def outer_step(
     per inner iteration reassembles the spectrum for the (replicated)
     FFT boundary. Frequency plays the role sequence plays in all-to-all
     context parallelism.
+
+    ``filter_axis_name`` enables FILTER-BANK (k) PARALLELISM — the
+    third shardable axis of SURVEY.md section 2.5 (the reference's k
+    loops, dParallel.m:278-303). Filters, duals, and codes arrive with
+    only this device's K/nk slice of the k axis; each k-reduction
+    (code Gram, both solves' data-side sums, the Dz reconstruction) is
+    one psum over this axis, everything else is k-local. Mutually
+    exclusive with ``freq_axis_name`` (one inner TP axis at a time).
     """
     support = geom.spatial_support
     radius = geom.psf_radius
 
+    if freq_axis_name is not None and filter_axis_name is not None:
+        raise ValueError(
+            "freq and filter tensor parallelism cannot be combined"
+        )
     if fg.num_freq % num_freq_shards:
         raise ValueError(
             f"num_freq={fg.num_freq} not divisible by "
             f"num_freq_shards={num_freq_shards}"
         )
     f_local = fg.num_freq // num_freq_shards
+    # all axes a GLOBAL scalar reduction must cross (objective, z_diff)
+    global_axes = tuple(
+        a for a in (axis_name, filter_axis_name) if a is not None
+    ) or None
 
     def fslice(x):
         """Take this device's slice of the trailing frequency axis."""
@@ -172,18 +192,26 @@ def outer_step(
 
         def one(zl, bl):
             zhat = common.codes_to_freq(zl, fg)
-            Dz = common.recon_from_freq(dhat, zhat, fg)
-            return common.data_fidelity(
-                Dz, bl, radius, cfg.lambda_residual
-            ) + common.l1_penalty(zl, cfg.lambda_prior)
+            Dz = common.recon_from_freq(
+                dhat, zhat, fg, filter_axis_name=filter_axis_name
+            )
+            fid = common.data_fidelity(Dz, bl, radius, cfg.lambda_residual)
+            return fid, common.l1_penalty(zl, cfg.lambda_prior)
 
-        return _psum(jnp.sum(jax.vmap(one)(z, b_blocks)), axis_name)
+        fids, l1s = jax.vmap(one)(z, b_blocks)
+        # fid is replicated across filter shards after the psum above;
+        # the l1 term is k-local and reduces over block AND filter
+        return _psum(jnp.sum(fids), axis_name) + _psum(
+            jnp.sum(l1s), global_axes
+        )
 
     # ---------------- d-pass (dzParallel.m:95-135) -------------------
     zhat = jax.vmap(lambda zl: common.codes_to_freq(zl, fg))(state.z)
     zhat_l = fslice(zhat)
     dkern = jax.vmap(
-        lambda zh: freq_solvers.precompute_d_kernel(zh, cfg.rho_d)
+        lambda zh: freq_solvers.precompute_d_kernel(
+            zh, cfg.rho_d, axis_name=filter_axis_name
+        )
     )(zhat_l)
 
     def consensus_mean(x_l):
@@ -201,7 +229,8 @@ def outer_step(
         dhat = fgather(
             jax.vmap(
                 lambda kern, bh, xh: freq_solvers.solve_d(
-                    kern, bh, xh, cfg.rho_d
+                    kern, bh, xh, cfg.rho_d,
+                    axis_name=filter_axis_name,
                 )
             )(dkern, bhat_l, xi_hat)
         )
@@ -216,7 +245,7 @@ def outer_step(
         None,
         length=cfg.max_it_d,
     )
-    d_diff = common.rel_change(dbar, state.dbar)
+    d_diff = common.rel_change(dbar, state.dbar, axis_name=filter_axis_name)
 
     # consensus dictionary used for coding (projected -> feasible)
     d_proj = prox_kernel(dbar + udbar)
@@ -224,7 +253,9 @@ def outer_step(
     obj_d = objective(state.z, dhat_z)
 
     # ---------------- z-pass (dzParallel.m:140-172) ------------------
-    zkern = freq_solvers.precompute_z_kernel(fslice(dhat_z), cfg.rho_z)
+    zkern = freq_solvers.precompute_z_kernel(
+        fslice(dhat_z), cfg.rho_z, axis_name=filter_axis_name
+    )
     theta = cfg.lambda_prior / cfg.rho_z
 
     def z_iter(carry, _):
@@ -238,7 +269,8 @@ def outer_step(
         zhat_new = fgather(
             jax.vmap(
                 lambda bh, xh: freq_solvers.solve_z(
-                    zkern, bh, xh, cfg.rho_z, use_pallas=cfg.use_pallas
+                    zkern, bh, xh, cfg.rho_z, use_pallas=cfg.use_pallas,
+                    axis_name=filter_axis_name,
                 )
             )(bhat_l, xi2_hat)
         )
@@ -248,8 +280,8 @@ def outer_step(
     (z, dual_z), _ = jax.lax.scan(
         z_iter, (state.z, state.dual_z), None, length=cfg.max_it_z
     )
-    num = _psum(jnp.sum((z - state.z) ** 2), axis_name)
-    den = _psum(jnp.sum(z * z), axis_name)
+    num = _psum(jnp.sum((z - state.z) ** 2), global_axes)
+    den = _psum(jnp.sum(z * z), global_axes)
     z_diff = jnp.sqrt(num) / jnp.maximum(jnp.sqrt(den), 1e-30)
     obj_z = objective(z, dhat_z)
 
@@ -265,11 +297,15 @@ def eval_block(
     fg: common.FreqGeom,
     axis_name: Optional[str] = None,
     with_outputs: bool = True,
+    filter_axis_name: Optional[str] = None,
 ):
     """(global objective, support filters, cropped per-block Dz).
 
     ``with_outputs=False`` skips materializing the Dz reconstructions
     (the largest tensors) for objective-only evaluations.
+    ``filter_axis_name``: state carries only this device's k shard;
+    the Dz filter sum is psummed and the returned d_sup is the local
+    filter slice (gathered by the caller's out_spec).
     """
     d_proj = proxes.kernel_constraint_proj(
         state.dbar + state.udbar, geom.spatial_support, fg.spatial_shape
@@ -278,16 +314,22 @@ def eval_block(
 
     def one(zl, bl):
         zhat = common.codes_to_freq(zl, fg)
-        Dz = common.recon_from_freq(dhat, zhat, fg)
-        obj = common.data_fidelity(
+        Dz = common.recon_from_freq(
+            dhat, zhat, fg, filter_axis_name=filter_axis_name
+        )
+        fid = common.data_fidelity(
             Dz, bl, geom.psf_radius, cfg.lambda_residual
-        ) + common.l1_penalty(zl, cfg.lambda_prior)
+        )
+        l1 = common.l1_penalty(zl, cfg.lambda_prior)
         if not with_outputs:
-            return obj, jnp.zeros((), Dz.dtype)
-        return obj, fourier.crop_spatial(Dz, geom.psf_radius)
+            return fid, l1, jnp.zeros((), Dz.dtype)
+        return fid, l1, fourier.crop_spatial(Dz, geom.psf_radius)
 
-    objs, Dz = jax.vmap(one)(state.z, b_blocks)
-    obj = _psum(jnp.sum(objs), axis_name)
+    fids, l1s, Dz = jax.vmap(one)(state.z, b_blocks)
+    global_axes = tuple(
+        a for a in (axis_name, filter_axis_name) if a is not None
+    ) or None
+    obj = _psum(jnp.sum(fids), axis_name) + _psum(jnp.sum(l1s), global_axes)
     d_sup = extract_filters(d_proj, geom)
     return obj, d_sup, Dz
 

@@ -83,16 +83,28 @@ class ZSolveKernel(NamedTuple):
     minv_diag: Optional[jnp.ndarray]
 
 
+def _ksum(x, axis_name: Optional[str]):
+    """Sum a k-reduced partial across filter-axis shards (SURVEY.md
+    section 2.5: the filter bank is the third shardable axis; the
+    z-step's sum over k needs exactly one psum)."""
+    return x if axis_name is None else jax.lax.psum(x, axis_name)
+
+
 def precompute_z_kernel(
     dhat: jnp.ndarray,
     rho: float,
     extra_diag: Optional[jnp.ndarray] = None,
+    axis_name: Optional[str] = None,
 ) -> ZSolveKernel:
     """Build the per-frequency inverse factors for the z-solve.
 
     dhat: [K, W, F]; extra_diag: optional [K, F] real, added to rho on
     the diagonal (gradient regularization of the dirac channel in the
     Poisson solver, admm_solve_conv_poisson.m:165-176).
+
+    ``axis_name``: dhat holds only this device's K/nk filter shard;
+    the k-reductions are psummed over that mesh axis, so the inner
+    inverse factors come out replicated.
     """
     K, W, F = dhat.shape
     gamma = rho + (extra_diag if extra_diag is not None else 0.0)
@@ -100,12 +112,16 @@ def precompute_z_kernel(
     dinv = 1.0 / gamma
     if W == 1:
         # scalar inner system: 1 + sum_k |d_k|^2 / Gamma_k
-        m = 1.0 + jnp.sum(
-            (jnp.abs(dhat[:, 0, :]) ** 2) * dinv, axis=0
+        m = 1.0 + _ksum(
+            jnp.sum((jnp.abs(dhat[:, 0, :]) ** 2) * dinv, axis=0),
+            axis_name,
         )
         return ZSolveKernel(dhat, dinv, None, 1.0 / m)
     # M_f = I_W + A Gamma^{-1} A^H, A = dhat[:, :, f].T (W x K)
-    M = jnp.einsum("kvf,kf,kwf->fvw", dhat, dinv, jnp.conj(dhat))
+    M = _ksum(
+        jnp.einsum("kvf,kf,kwf->fvw", dhat, dinv, jnp.conj(dhat)),
+        axis_name,
+    )
     M = M + jnp.eye(W, dtype=M.dtype)
     return ZSolveKernel(dhat, dinv, hermitian_inverse(M), None)
 
@@ -123,6 +139,7 @@ def solve_z(
     xi2_hat: jnp.ndarray,
     rho: float,
     use_pallas: bool = False,
+    axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
     """Solve (Gamma + A^H A) x = A^H xi1 + rho * xi2 per frequency.
 
@@ -136,7 +153,13 @@ def solve_z(
     ``use_pallas`` routes the W == 1 case through the fused Pallas
     kernel (ops.pallas_kernels; interpret mode off-TPU); W > 1 always
     takes the einsum path.
+
+    ``axis_name``: filter-axis sharding — K here is the local shard;
+    the data-side reduction t = A Ginv rhs is the one k-sum, psummed
+    (the seam at dParallel.m:278-303); everything else is k-local.
     """
+    if axis_name is not None and use_pallas:
+        use_pallas = False  # fused kernel is single-shard only
     if use_pallas and kernel.minv is None:
         from . import pallas_kernels
 
@@ -151,7 +174,9 @@ def solve_z(
     dhat, dinv = kernel.dhat, kernel.dinv
     rhs = jnp.einsum("kwf,nwf->nkf", jnp.conj(dhat), xi1_hat) + rho * xi2_hat
     g = dinv[None] * rhs  # Gamma^{-1} rhs, [N, K, F]
-    t = jnp.einsum("kwf,nkf->nwf", dhat, g)  # A Ginv rhs
+    t = _ksum(
+        jnp.einsum("kwf,nkf->nwf", dhat, g), axis_name
+    )  # A Ginv rhs
     if kernel.minv is None:
         s = kernel.minv_diag[None, None, :] * t
     else:
@@ -174,10 +199,16 @@ class DSolveKernel(NamedTuple):
     ginv: jnp.ndarray
 
 
-def precompute_d_kernel(zhat: jnp.ndarray, rho: float) -> DSolveKernel:
-    """zhat: [Ni, K, F]."""
+def precompute_d_kernel(
+    zhat: jnp.ndarray, rho: float, axis_name: Optional[str] = None
+) -> DSolveKernel:
+    """zhat: [Ni, K, F]. ``axis_name``: K is this device's filter
+    shard; the code Gram's k-sum is psummed so the Ni x Ni inverse is
+    replicated across filter shards."""
     Ni = zhat.shape[0]
-    G = jnp.einsum("nkf,mkf->fnm", zhat, jnp.conj(zhat))
+    G = _ksum(
+        jnp.einsum("nkf,mkf->fnm", zhat, jnp.conj(zhat)), axis_name
+    )
     G = G + rho * jnp.eye(Ni, dtype=G.dtype)
     return DSolveKernel(zhat, hermitian_inverse(G))
 
@@ -187,6 +218,7 @@ def solve_d(
     b_hat: jnp.ndarray,
     xi_hat: jnp.ndarray,
     rho: float,
+    axis_name: Optional[str] = None,
 ) -> jnp.ndarray:
     """Solve (rho I_K + Z^H Z) x = Z^H b + rho * xi per frequency.
 
@@ -201,6 +233,6 @@ def solve_d(
     """
     zhat, ginv = kernel.zhat, kernel.ginv
     r = jnp.einsum("nkf,nwf->kwf", jnp.conj(zhat), b_hat) + rho * xi_hat
-    t = jnp.einsum("nkf,kwf->nwf", zhat, r)
+    t = _ksum(jnp.einsum("nkf,kwf->nwf", zhat, r), axis_name)
     s = jnp.einsum("fnm,mwf->nwf", ginv, t)
     return (r - jnp.einsum("nkf,nwf->kwf", jnp.conj(zhat), s)) / rho

@@ -33,10 +33,24 @@ from . import mesh as mesh_lib
 from .mesh import shard_map
 
 
-def _state_specs(batched: bool = True):
-    blk = P("block") if batched else P()
+def _state_specs(batched: bool = True, filter_sharded: bool = False):
+    """PartitionSpecs of LearnState: block-local fields on 'block';
+    with filter sharding the k axis (axis 1 of d fields, axis 2 of z
+    fields) additionally splits over 'filter'."""
+    if filter_sharded:
+        blk_d = P("block", "filter")
+        blk_z = P("block", None, "filter")
+        rep_d = P("filter")
+    else:
+        blk_d = blk_z = P("block") if batched else P()
+        rep_d = P()
     return learn_mod.LearnState(
-        d_local=blk, dual_d=blk, dbar=P(), udbar=P(), z=blk, dual_z=blk
+        d_local=blk_d,
+        dual_d=blk_d,
+        dbar=rep_d,
+        udbar=rep_d,
+        z=blk_z,
+        dual_z=blk_z,
     )
 
 
@@ -51,7 +65,10 @@ def make_outer_step(
 
     With a 2-D ('block', 'freq') mesh the step additionally shards the
     per-frequency solves over the 'freq' axis (models.learn.outer_step
-    freq_axis_name) — DP x TP."""
+    freq_axis_name) — DP x TP. With a ('block', 'filter') mesh the
+    filter bank's k axis shards instead (filter_axis_name) — the
+    third parallelism axis of SURVEY.md section 2.5, for very large
+    banks."""
     if mesh is None:
         step = functools.partial(
             learn_mod.outer_step,
@@ -64,7 +81,15 @@ def make_outer_step(
         return jax.jit(step)
 
     has_freq = "freq" in mesh.axis_names
+    has_filter = "filter" in mesh.axis_names
     nf = mesh.shape["freq"] if has_freq else 1
+    if has_filter:
+        nk = mesh.shape["filter"]
+        if geom.num_filters % nk:
+            raise ValueError(
+                f"num_filters={geom.num_filters} not divisible by "
+                f"mesh 'filter' axis {nk}"
+            )
     step = functools.partial(
         learn_mod.outer_step,
         geom=geom,
@@ -74,14 +99,16 @@ def make_outer_step(
         axis_name="block",
         freq_axis_name="freq" if has_freq else None,
         num_freq_shards=nf,
+        filter_axis_name="filter" if has_filter else None,
     )
     metrics_specs = learn_mod.OuterMetrics(P(), P(), P(), P())
+    specs = _state_specs(filter_sharded=has_filter)
     sharded = shard_map(
         step,
         mesh=mesh,
-        in_specs=(_state_specs(), P("block")),
-        out_specs=(_state_specs(), metrics_specs),
-        check_vma=not has_freq,
+        in_specs=(specs, P("block")),
+        out_specs=(specs, metrics_specs),
+        check_vma=not (has_freq or has_filter),
     )
     return jax.jit(sharded)
 
@@ -107,6 +134,7 @@ def make_eval_fn(
             with_outputs=with_outputs,
         )
         return jax.jit(f)
+    has_filter = "filter" in mesh.axis_names
     f = functools.partial(
         learn_mod.eval_block,
         geom=geom,
@@ -114,13 +142,21 @@ def make_eval_fn(
         fg=fg,
         axis_name="block",
         with_outputs=with_outputs,
+        filter_axis_name="filter" if has_filter else None,
     )
     return jax.jit(
         shard_map(
             f,
             mesh=mesh,
-            in_specs=(_state_specs(), P("block")),
-            out_specs=(P(), P(), P("block")),
+            in_specs=(_state_specs(filter_sharded=has_filter), P("block")),
+            # d_sup is the local k slice under filter sharding; the
+            # out_spec gathers the full bank
+            out_specs=(
+                P(),
+                P("filter") if has_filter else P(),
+                P("block"),
+            ),
+            check_vma=not has_filter,
         )
     )
 
@@ -233,7 +269,9 @@ def learn(
             print(f"resumed from {checkpoint_dir} at iteration {start_it}")
 
     if mesh is not None:
-        specs = _state_specs()
+        specs = _state_specs(
+            filter_sharded="filter" in mesh.axis_names
+        )
         state = jax.tree.map(
             lambda x, s: jax.device_put(
                 x, jax.sharding.NamedSharding(mesh, s)

@@ -46,6 +46,21 @@ def block_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     return jax.make_mesh((len(devices),), ("block",), devices=devices)
 
 
+def block_filter_mesh(num_block: int, num_filter: int, devices=None) -> Mesh:
+    """2-D mesh ('block', 'filter'): consensus data parallelism x
+    filter-bank (k) tensor parallelism — the third shardable axis of
+    SURVEY.md section 2.5 (the reference's per-filter loops,
+    dParallel.m:278-303), for banks too large for one device. 'filter'
+    is innermost: its per-solve psum of the k-reduced data side rides
+    the fastest ICI links."""
+    if devices is None:
+        devices = jax.devices()
+    devices = devices[: num_block * num_filter]
+    return jax.make_mesh(
+        (num_block, num_filter), ("block", "filter"), devices=devices
+    )
+
+
 def block_freq_mesh(num_block: int, num_freq: int, devices=None) -> Mesh:
     """2-D mesh ('block', 'freq'): consensus data parallelism x
     frequency-axis tensor parallelism. 'freq' is innermost so the

@@ -53,7 +53,7 @@ def detect_chip() -> str:
         if dev.platform == "cpu":
             return "cpu"
         env = os.environ.get("PALLAS_AXON_TPU_GEN")
-        if env:
+        if env in CHIP_PEAKS:  # ignore hints we have no roofline for
             return env
         kind = dev.device_kind.lower()
         for gen in ("v6e", "v5p", "v5e", "v4"):
@@ -161,7 +161,13 @@ def utilization(
 ) -> Dict[str, float]:
     """Achieved FLOP/s / GB/s and their fractions of chip peak."""
     chip = chip or detect_chip()
-    peaks = CHIP_PEAKS.get(chip, CHIP_PEAKS["v5e"])
+    if chip not in CHIP_PEAKS:
+        # make the fallback roofline visible instead of silently
+        # scoring an unknown chip against v5e peaks
+        chip = f"{chip}->v5e"
+        peaks = CHIP_PEAKS["v5e"]
+    else:
+        peaks = CHIP_PEAKS[chip]
     fps = cost["flops"] * steps_per_sec
     bps = cost["bytes"] * steps_per_sec
     return {

@@ -1,0 +1,86 @@
+"""load_images input-form parity: directory / .mat stack / single-.mat
+directory / single image / in-memory array (the reference's
+CreateImages.m:111-245 forms via check_imgs_path.m:19-64)."""
+import numpy as np
+import pytest
+from scipy.io import savemat
+
+from ccsc_code_iccv2017_tpu.data import images as I
+
+REF_TEST_DIR = "/root/reference/2D/Inpainting/Test"
+
+
+@pytest.fixture(scope="module")
+def dir_stack():
+    return I.load_images(REF_TEST_DIR, size=(32, 32), limit=4)
+
+
+def test_mat_file_input_matlab_layout(tmp_path, dir_stack):
+    # MATLAB layout [H, W, n] with the reference's variable name
+    mat = tmp_path / "stack.mat"
+    savemat(mat, {"images": np.moveaxis(dir_stack, 0, -1)})
+    got = I.load_images(str(mat))
+    np.testing.assert_allclose(got, dir_stack, rtol=1e-6)
+
+
+def test_mat_file_input_framework_layout(tmp_path, dir_stack):
+    mat = tmp_path / "stack.mat"
+    savemat(mat, {"b": dir_stack[..., None]})  # [n, H, W, 1]
+    got = I.load_images(str(mat))
+    np.testing.assert_allclose(got, dir_stack, rtol=1e-6)
+
+
+def test_single_mat_directory(tmp_path, dir_stack):
+    # a directory whose only file is a .mat stack
+    # (check_imgs_path.m:48-53)
+    d = tmp_path / "matdir"
+    d.mkdir()
+    savemat(d / "all.mat", {"images": np.moveaxis(dir_stack, 0, -1)})
+    got = I.load_images(str(d))
+    np.testing.assert_allclose(got, dir_stack, rtol=1e-6)
+
+
+def test_array_input_and_frames():
+    rng = np.random.default_rng(0)
+    arr = rng.uniform(size=(6, 16, 16)).astype(np.float32)
+    got = I.load_images(arr)
+    np.testing.assert_allclose(got, arr, rtol=1e-6)
+    # frames {1,2,end}: images 1,3,5 (MATLAB 1-based stride)
+    sel = I.load_images(arr, frames=(1, 2, "end"))
+    np.testing.assert_allclose(sel, arr[[0, 2, 4]], rtol=1e-6)
+
+
+def test_array_input_color():
+    rng = np.random.default_rng(1)
+    # in-memory arrays use the framework batch-leading layout
+    arr = rng.uniform(size=(5, 16, 16, 3)).astype(np.float32)
+    got = I.load_images(arr, color="rgb")
+    assert got.shape == (5, 16, 16, 3)
+    np.testing.assert_allclose(got[2], arr[2], rtol=1e-6)
+    # MATLAB-layout arrays go through array_image_stack explicitly
+    hwcn = np.moveaxis(arr, 0, -1)
+    imgs = I.array_image_stack(hwcn, layout="matlab")
+    assert len(imgs) == 5
+    np.testing.assert_allclose(imgs[3], arr[3], rtol=1e-6)
+
+
+def test_single_image_file():
+    import os
+
+    f = sorted(
+        os.path.join(REF_TEST_DIR, x)
+        for x in os.listdir(REF_TEST_DIR)
+        if x.endswith(".jpg")
+    )[0]
+    got = I.load_images(f)
+    assert got.ndim == 3 and got.shape[0] == 1
+
+
+def test_mat_input_contrast_normalize(tmp_path, dir_stack):
+    mat = tmp_path / "stack.mat"
+    savemat(mat, {"images": np.moveaxis(dir_stack, 0, -1)})
+    a = I.load_images(str(mat), contrast_normalize="local_cn")
+    b = np.stack(
+        [I.local_contrast_normalize(x) for x in dir_stack]
+    )
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)

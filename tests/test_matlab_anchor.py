@@ -1,0 +1,158 @@
+"""MATLAB-anchored golden trajectory (VERDICT r1 missing #8).
+
+Everything else in tests/ checks the implementation against oracles
+written from the same reading of the math. This file breaks that loop:
+it is a LITERAL, line-ordered float64 transcription of the reference
+inpainting solver 2D/Inpainting/admm_solve_conv2D_weighted_sampling.m
+— full complex fft2, psf2otf, the exact MATLAB update order and
+gamma heuristic, transcribed statement by statement (citations inline)
+rather than re-derived. If the framework and this transcription agree
+on a trajectory, a shared systematic misreading would have to survive
+two independent renderings of the MATLAB text.
+
+The transcription exists only as a test fixture; the framework's
+solver (models.reconstruct) shares no code or structure with it
+(rfft + einsum Woodbury vs flattened repmat Sherman-Morrison).
+"""
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from ccsc_code_iccv2017_tpu.config import ProblemGeom, SolveConfig
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+    reconstruct,
+)
+
+
+def psf2otf(k, shape):
+    """MATLAB psf2otf: zero-pad to shape, circshift the center to the
+    origin, fft2 (used at admm_solve_conv2D_weighted_sampling.m:161)."""
+    p = np.zeros(shape, np.float64)
+    p[: k.shape[0], : k.shape[1]] = k
+    p = np.roll(p, (-(k.shape[0] // 2), -(k.shape[1] // 2)), (0, 1))
+    return np.fft.fft2(p)
+
+
+def matlab_inpainting_solver(b, kmat, mask, lam_res, lam_pri, max_it):
+    """Statement-for-statement transcription of
+    admm_solve_conv2D_weighted_sampling.m (lines cited per step).
+    smooth_init = 0, verbose trajectory returned instead of printed.
+    Returns (obj_vals[0..max_it], res)."""
+    # :10-11 psf_radius, padded size
+    r = (kmat.shape[0] // 2, kmat.shape[1] // 2)
+    size_x = (b.shape[0] + 2 * r[0], b.shape[1] + 2 * r[1])
+    K = kmat.shape[2]
+    # :12 precompute_H_hat (:155-168)
+    dhat = np.stack(
+        [psf2otf(kmat[:, :, i], size_x) for i in range(K)], axis=2
+    )
+    dhatTdhat = np.sum(np.conj(dhat) * dhat, axis=2)  # :166
+    # :28 precompute_MProx (:146-153), smoothinit = 0
+    M = np.zeros(size_x)
+    M[r[0] : r[0] + b.shape[0], r[1] : r[1] + b.shape[1]] = mask
+    MtM = M * M  # :151
+    Mtb = np.zeros(size_x)
+    Mtb[r[0] : r[0] + b.shape[0], r[1] : r[1] + b.shape[1]] = b * mask  # :152
+    # :35-37 lambdas and gammas
+    lam = (lam_res, lam_pri)
+    gamma_h = 60.0 * lam_pri / np.max(b)
+    gamma = (gamma_h / 100.0, gamma_h)
+    rho = gamma[1] / gamma[0]  # solve_conv_term :178
+
+    def prox_data_masked(u, theta):  # :29
+        return (Mtb + u / theta) / (MtM + 1.0 / theta)
+
+    def prox_sparse(u, theta):  # :32
+        with np.errstate(divide="ignore", invalid="ignore"):
+            f = np.where(np.abs(u) > 0, 1.0 - theta / np.abs(u), 0.0)
+        return np.maximum(0.0, f) * u
+
+    def objective(z):  # :192-202
+        Dz = np.real(
+            np.fft.ifft2(
+                np.sum(dhat * np.fft.fft2(z, axes=(0, 1)), axis=2)
+            )
+        )
+        crop = Dz[r[0] : size_x[0] - r[0], r[1] : size_x[1] - r[1]]
+        f_z = lam_res * 0.5 * np.sum((mask * crop - mask * b) ** 2)
+        return f_z + lam_pri * np.sum(np.abs(z))
+
+    def solve_conv_term(xi1h, xi2h):  # :170-190
+        # b_k = conj(dhat_k) xi1 + rho xi2_k   (:181)
+        bvec = np.conj(dhat) * xi1h[:, :, None] + rho * xi2h
+        # scalar Sherman-Morrison inverse (:184-185)
+        sc = 1.0 / (rho + dhatTdhat)
+        corr = np.sum(dhat * bvec, axis=2)  # sum_j conj(dhatT)*b (:185)
+        return bvec / rho - (sc * corr)[:, :, None] * np.conj(dhat) / rho
+
+    # :42-51 zero init
+    size_z = (size_x[0], size_x[1], K)
+    d1 = np.zeros(size_x)
+    d2 = np.zeros(size_z)
+    z = np.zeros(size_z)
+    z_hat = np.zeros(size_z, complex)
+    obj_vals = [objective(z)]  # :68 (iter 0 print)
+
+    for _ in range(max_it):  # :81
+        v1 = np.real(np.fft.ifft2(np.sum(dhat * z_hat, axis=2)))  # :84
+        v2 = z  # :85
+        u1 = prox_data_masked(v1 - d1, lam[0] / gamma[0])  # :88
+        u2 = prox_sparse(v2 - d2, lam[1] / gamma[1])  # :89
+        d1 = d1 - (v1 - u1)  # :93
+        d2 = d2 - (v2 - u2)
+        xi1_hat = np.fft.fft2(u1 + d1)  # :96-97
+        xi2_hat = np.fft.fft2(u2 + d2, axes=(0, 1))
+        z_hat = solve_conv_term(xi1_hat, xi2_hat)  # :103
+        z = np.real(np.fft.ifft2(z_hat, axes=(0, 1)))  # :104
+        obj_vals.append(objective(z))  # :123
+    Dz = np.real(np.fft.ifft2(np.sum(dhat * z_hat, axis=2)))  # :141
+    res = Dz[r[0] : size_x[0] - r[0], r[1] : size_x[1] - r[1]]  # :142
+    return np.array(obj_vals), res
+
+
+def test_framework_matches_matlab_transcription():
+    rng = np.random.default_rng(42)
+    b = rng.uniform(0.1, 1.0, (12, 12))
+    mask = (rng.uniform(size=(12, 12)) > 0.5).astype(np.float64)
+    kmat = rng.normal(size=(3, 3, 4))
+    kmat /= np.sqrt(np.sum(kmat**2, axis=(0, 1), keepdims=True))
+
+    max_it = 4
+    obj_ml, res_ml = matlab_inpainting_solver(
+        b, kmat, mask, lam_res=5.0, lam_pri=2.0, max_it=max_it
+    )
+
+    geom = ProblemGeom((3, 3), 4)
+    prob = ReconstructionProblem(geom)
+    cfg = SolveConfig(
+        lambda_residual=5.0,
+        lambda_prior=2.0,
+        max_it=max_it,
+        tol=0.0,
+        gamma_factor=60.0,
+        gamma_ratio=100.0,
+        verbose="none",
+    )
+    d = np.moveaxis(kmat, -1, 0)  # [k, s, s] framework layout
+    res = reconstruct(
+        jnp.asarray(b[None].astype(np.float32)),
+        jnp.asarray(d.astype(np.float32)),
+        prob,
+        cfg,
+        mask=jnp.asarray(mask[None].astype(np.float32)),
+    )
+    obj_fw = np.asarray(res.trace.obj_vals)[: max_it + 1]
+    assert obj_ml[0] == pytest.approx(obj_fw[0], rel=1e-4)
+    np.testing.assert_allclose(obj_fw, obj_ml, rtol=5e-4)
+    np.testing.assert_allclose(
+        np.asarray(res.recon)[0], res_ml, atol=5e-4
+    )
+    # pin the anchored trajectory as literals so drift in EITHER
+    # rendering (transcription or framework) trips the test
+    expected = np.array(
+        [56.18919067, 54.14431462, 55.35870044, 54.59166188, 53.21755806]
+    )
+    np.testing.assert_allclose(obj_ml, expected, rtol=1e-7)
+    assert float(np.sum(res_ml)) == pytest.approx(2.2126866250765, rel=1e-9)
