@@ -1,32 +1,85 @@
-"""Shared CLI dispatch: consensus learner vs host-streaming learner.
+"""Shared CLI dispatch: device-resident learner vs host-streaming learner.
 
-One place for the --streaming arm the learning drivers share, so the
-guard logic cannot drift between apps."""
+One place for the --streaming arm ALL learning drivers share (2D, 3D,
+4D, hyperspectral), so the guard logic cannot drift between apps."""
 from __future__ import annotations
 
+from typing import Dict, Optional
 
-def dispatch_learn(b, geom, cfg, key, mesh, streaming: bool, **kwargs):
-    """Run the consensus learner, or the host-streaming variant when
-    ``streaming`` (single-device, bounded HBM; parallel.streaming).
-    ``kwargs`` pass through to models.learn.learn only."""
+
+def add_mat_layout_arg(parser) -> None:
+    """The shared --mat-layout flag for apps that accept .mat image
+    stacks (one definition so the vocabulary cannot drift)."""
+    parser.add_argument(
+        "--mat-layout",
+        choices=["matlab", "framework"],
+        default=None,
+        help="layout of an unnamed .mat image stack: matlab "
+        "[H,W(,C),n] or framework [n,H,W(,C)] (required when "
+        "the shape is ambiguous)",
+    )
+
+
+def dispatch_learn(
+    b,
+    geom,
+    cfg,
+    key,
+    mesh,
+    streaming: bool,
+    solver=None,
+    streaming_blocks: Optional[int] = None,
+    streaming_offset=None,
+    forbidden: Optional[Dict[str, object]] = None,
+    **kwargs,
+):
+    """Run the device-resident learner, or the host-streaming variant
+    when ``streaming`` (single-device, bounded HBM; parallel.streaming).
+
+    ``solver`` is the non-streaming callable (default models.learn.learn;
+    the hyperspectral app passes models.learn_masked.learn_masked) and
+    receives ``kwargs``. The streaming arm supports none of those
+    options: callers pass ``forbidden`` — a {"--cli-flag": value} map —
+    and any truthy entry is rejected BY ITS CLI NAME (an explicit error
+    beats silently ignoring a requested option). The hyperspectral
+    adjustments live here too: ``streaming_offset`` is subtracted from
+    the data (the smooth_init the masked objective would model,
+    learn_hyperspectral.m:16-17) and ``streaming_blocks`` shrinks to
+    the nearest divisor of n before replacing cfg.num_blocks."""
     if streaming:
         if mesh is not None:
             raise SystemExit(
                 "--streaming is single-device and does not combine "
                 "with --mesh"
             )
-        if any(v for v in kwargs.values()):
+        set_flags = [k for k, v in (forbidden or {}).items() if v]
+        if set_flags:
+            raise SystemExit(
+                "--streaming does not combine with " + "/".join(set_flags)
+            )
+        if kwargs:
             raise SystemExit(
                 "--streaming does not combine with "
-                + "/".join(k for k, v in kwargs.items() if v)
+                + "/".join(sorted(kwargs))
             )
-        from ..parallel.streaming import learn_streaming
-
         import numpy as np
 
-        return learn_streaming(np.asarray(b), geom, cfg, key=key)
+        from ..parallel.streaming import learn_streaming
+
+        b = np.asarray(b)
+        if streaming_offset is not None:
+            b = b - np.asarray(streaming_offset)
+        if streaming_blocks is not None:
+            import dataclasses
+
+            n = b.shape[0]
+            blocks = max(1, min(streaming_blocks, n))
+            while n % blocks:
+                blocks -= 1
+            cfg = dataclasses.replace(cfg, num_blocks=blocks)
+        return learn_streaming(b, geom, cfg, key=key)
     import jax.numpy as jnp
 
-    from ..models.learn import learn
-
-    return learn(jnp.asarray(b), geom, cfg, key=key, mesh=mesh, **kwargs)
+    if solver is None:
+        from ..models.learn import learn as solver
+    return solver(jnp.asarray(b), geom, cfg, key=key, mesh=mesh, **kwargs)

@@ -23,6 +23,8 @@ import numpy as np
 
 
 def build_parser() -> argparse.ArgumentParser:
+    from ._dispatch import add_mat_layout_arg
+
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="test image folder")
     p.add_argument("--filters", required=True, help=".mat or .npz filter bank")
@@ -35,6 +37,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--size", type=int, default=None)
     p.add_argument("--out-dir", default=None, help="write 16-bit PNGs here")
     p.add_argument("--seed", type=int, default=0)
+    add_mat_layout_arg(p)
     return p
 
 
@@ -57,7 +60,7 @@ def main(argv=None):
 
     d = load_filters_2d(args.filters)
     size = (args.size, args.size) if args.size else None
-    b = load_images(args.data, limit=args.limit, size=size)
+    b = load_images(args.data, limit=args.limit, size=size, mat_layout=args.mat_layout)
     rng = np.random.default_rng(args.seed)
     mask = (rng.random(b.shape) < args.keep).astype(np.float32)
     sm = smooth_fill(b, mask)

@@ -17,6 +17,8 @@ import time
 
 
 def build_parser() -> argparse.ArgumentParser:
+    from ._dispatch import add_mat_layout_arg
+
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="image folder")
     p.add_argument("--filters", type=int, default=100)
@@ -31,6 +33,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rho-d", type=float, default=5000.0)
     p.add_argument("--rho-z", type=float, default=1.0)
     p.add_argument("--contrast", default="local_cn")
+    add_mat_layout_arg(p)
     p.add_argument("--size", type=int, default=None, help="resize side")
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--mesh", type=int, default=0, help="devices (0=off)")
@@ -65,7 +68,6 @@ def main(argv=None):
 
     from .. import ProblemGeom, LearnConfig
     from ..data.images import load_images
-    from ..models.learn import learn
     from ..parallel.mesh import block_mesh
     from ..utils.io_mat import load_filters_2d, save_filters
 
@@ -78,6 +80,7 @@ def main(argv=None):
         square=args.size is None,
         size=size,
         limit=args.limit,
+        mat_layout=args.mat_layout,
     )
     print(f"loaded {b.shape[0]} images {b.shape[1:]} in {time.time()-t0:.1f}s")
 
@@ -98,22 +101,30 @@ def main(argv=None):
     init_d = (
         load_filters_2d(args.init_filters) if args.init_filters else None
     )
-    if args.streaming:
-        if mesh is not None or init_d is not None or args.checkpoint_dir:
-            raise SystemExit(
-                "--streaming is single-device and does not combine with "
-                "--mesh/--init-filters/--checkpoint-dir"
-            )
-        from ..parallel.streaming import learn_streaming
+    from ._dispatch import dispatch_learn
 
-        res = learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(args.seed))
-    else:
-        res = learn(
-            jnp.asarray(b),
+    if args.streaming:
+        res = dispatch_learn(
+            b,
             geom,
             cfg,
-            key=jax.random.PRNGKey(args.seed),
-            mesh=mesh,
+            jax.random.PRNGKey(args.seed),
+            mesh,
+            streaming=True,
+            forbidden={
+                "--init-filters": args.init_filters,
+                "--checkpoint-dir": args.checkpoint_dir,
+                "--profile-dir": args.profile_dir,
+            },
+        )
+    else:
+        res = dispatch_learn(
+            b,
+            geom,
+            cfg,
+            jax.random.PRNGKey(args.seed),
+            mesh,
+            streaming=False,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             init_d=init_d,

@@ -102,34 +102,36 @@ def main(argv=None):
         if args.init
         else None
     )
+    from ._dispatch import dispatch_learn
+
     if args.streaming:
-        if args.init or args.checkpoint_dir:
-            raise SystemExit(
-                "--streaming does not combine with --init/"
-                "--checkpoint-dir"
-            )
-        import dataclasses
-
-        from ..parallel.streaming import learn_streaming
-
-        n = b.shape[0]
-        blocks = max(1, min(args.streaming_blocks, n))
-        while n % blocks:
-            blocks -= 1
-        scfg = dataclasses.replace(cfg, num_blocks=blocks)
-        res = learn_streaming(
-            b - sm, geom, scfg, key=jax.random.PRNGKey(args.seed)
+        res = dispatch_learn(
+            b,
+            geom,
+            cfg,
+            jax.random.PRNGKey(args.seed),
+            mesh=None,
+            streaming=True,
+            streaming_blocks=args.streaming_blocks,
+            streaming_offset=sm,
+            forbidden={
+                "--init": args.init,
+                "--checkpoint-dir": args.checkpoint_dir,
+            },
         )
         save_filters(args.out, res.d, res.trace, layout="hyperspectral")
         print(f"saved {res.d.shape} filters to {args.out} (streaming)")
         return res
-    res = learn_masked(
-        jnp.asarray(b),
+    res = dispatch_learn(
+        b,
         geom,
         cfg,
+        jax.random.PRNGKey(args.seed),
+        mesh=None,
+        streaming=False,
+        solver=learn_masked,
         smooth_init=jnp.asarray(sm),
         init_d=init_d,
-        key=jax.random.PRNGKey(args.seed),
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )

@@ -21,6 +21,8 @@ import numpy as np
 
 
 def build_parser() -> argparse.ArgumentParser:
+    from ._dispatch import add_mat_layout_arg
+
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="image folder")
     p.add_argument("--filters", required=True)
@@ -33,6 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--size", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    add_mat_layout_arg(p)
     return p
 
 
@@ -46,7 +49,7 @@ def main(argv=None):
     from ..utils.io_mat import load_filters_2d
 
     d = load_filters_2d(args.filters)
-    imgs = load_image_list(args.data, limit=args.limit)
+    imgs = load_image_list(args.data, limit=args.limit, mat_layout=args.mat_layout)
     rng = np.random.default_rng(args.seed)
 
     geom = ProblemGeom(d.shape[1:], d.shape[0])

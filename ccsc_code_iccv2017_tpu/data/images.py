@@ -188,19 +188,25 @@ def _list_image_files(path: str) -> List[str]:
     return [os.path.join(path, f) for f in files]
 
 
-def _mat_image_stack(path: str) -> List[np.ndarray]:
+def _mat_image_stack(
+    path: str, layout: Optional[str] = None
+) -> List[np.ndarray]:
     """A .mat file holding an image stack -> list of [H, W(, C)] arrays.
 
     Mirrors the reference's three non-directory input forms
     (CreateImages.m:182-245 via check_imgs_path.m:19-64): it prefers
     the variable names the reference looks for (``images``,
     ``original_images``), else takes the largest array in the file.
-    Layout rule: the MATLAB-convention names (``images``,
-    ``original_images``, ``I``) are image-major-last ([H, W, n] /
-    [H, W, C, n]); the framework-convention name ``b`` is
-    batch-leading ([n, H, W] / [n, H, W, C]); unnamed arrays default
-    to MATLAB layout unless a trailing channel axis marks them as
-    framework-saved."""
+    Layout rule: an explicit ``layout`` argument wins; else the
+    MATLAB-convention names (``images``, ``original_images``, ``I``)
+    are image-major-last ([H, W, n] / [H, W, C, n]) and the
+    framework-convention name ``b`` is batch-leading ([n, H, W] /
+    [n, H, W, C]). Unnamed arrays default to MATLAB layout; an unnamed
+    4-D array whose shape is ambiguous between the two conventions
+    ([?, ?, C, n] with a (1,3)-sized trailing axis but a non-(1,3)
+    third axis could be a framework [n, H, W, C] stack OR a MATLAB
+    [H, W, C, n] stack with n in (1,3) images) raises rather than
+    guesses — pass ``mat_layout`` or name the variable."""
     from ..utils.io_mat import _loadmat
 
     d = {
@@ -210,23 +216,31 @@ def _mat_image_stack(path: str) -> List[np.ndarray]:
     }
     if not d:
         raise ValueError(f"no image array found in {path}")
-    layout = None
+    named = None
     for name in ("images", "original_images", "I", "b"):
         if name in d:
             arr = d[name]
-            layout = "framework" if name == "b" else "matlab"
+            named = "framework" if name == "b" else "matlab"
             break
     else:
         arr = max(d.values(), key=lambda a: a.size)
     arr = np.asarray(arr)
     if layout is None:
-        layout = (
-            "framework"
-            if arr.ndim == 4
+        layout = named
+    if layout is None:
+        if (
+            arr.ndim == 4
             and arr.shape[-1] in (1, 3)
             and arr.shape[2] not in (1, 3)
-            else "matlab"
-        )
+        ):
+            raise ValueError(
+                f"ambiguous unnamed 4-D stack of shape {arr.shape} in "
+                f"{path}: could be framework [n, H, W, C] or MATLAB "
+                f"[H, W, C, n] with {arr.shape[-1]} images. Pass "
+                "mat_layout='framework'/'matlab' or name the variable "
+                "'images' (MATLAB) / 'b' (framework)."
+            )
+        layout = "matlab"
     return array_image_stack(arr, layout=layout)
 
 
@@ -274,6 +288,7 @@ def load_image_list(
     color: str = "gray",
     limit: Optional[int] = None,
     frames: Optional[Sequence] = None,
+    mat_layout: Optional[str] = None,
 ) -> List[np.ndarray]:
     """Load images as a list of [H, W] (gray) or [H, W, 3]
     (rgb/ycbcr/hsv) float32 arrays — the CreateImagesList.m variant,
@@ -292,7 +307,9 @@ def load_image_list(
         raws = select_frames(array_image_stack(path), frames)
     elif os.path.isfile(path):
         if path.lower().endswith(".mat"):
-            raws = select_frames(_mat_image_stack(path), frames)
+            raws = select_frames(
+                _mat_image_stack(path, layout=mat_layout), frames
+            )
         else:
             raws = select_frames(
                 [np.asarray(Image.open(path))], frames
@@ -307,7 +324,9 @@ def load_image_list(
             ]
             if len(mats) == 1:
                 # single-.mat directory (check_imgs_path.m:48-53)
-                raws = select_frames(_mat_image_stack(mats[0]), frames)
+                raws = select_frames(
+                    _mat_image_stack(mats[0], layout=mat_layout), frames
+                )
             else:
                 raise ValueError(
                     f"no images and no single .mat stack in {path}"
@@ -378,6 +397,7 @@ def load_images(
     size: Optional[Sequence[int]] = None,
     frames: Optional[Sequence] = None,
     layout: str = "channels_last",
+    mat_layout: Optional[str] = None,
 ) -> np.ndarray:
     """CreateImages.m equivalent: folder / .mat stack / single image /
     in-memory array (the reference's four input forms,
@@ -397,7 +417,8 @@ def load_images(
     (CreateImages.m:100-107).
     """
     imgs = load_image_list(
-        path, contrast_normalize, zero_mean, color, limit, frames
+        path, contrast_normalize, zero_mean, color, limit, frames,
+        mat_layout=mat_layout,
     )
     if size is not None:
         imgs = [_resize(i, size) for i in imgs]

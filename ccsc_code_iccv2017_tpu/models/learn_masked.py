@@ -229,6 +229,88 @@ def _sharded_outer_step(geom, cfg, fg, gamma_div_d, gamma_div_z, mesh):
     return jax.jit(sharded)
 
 
+def hbm_estimate(
+    geom: ProblemGeom,
+    data_spatial_shape: Tuple[int, ...],
+    n: int,
+    dtype_bytes: int = 4,
+    num_freq_shards: int = 1,
+    fg: Optional[common.FreqGeom] = None,
+) -> dict:
+    """Analytic peak-HBM estimate (bytes) for one learn_masked step.
+
+    The masked learner cannot stream over images: its d-pass Woodbury
+    inner system couples ALL n images per frequency (the [F, n, n]
+    Gram inverse from precompute_d_kernel; admm_learn.m:273-300), so
+    the whole state must be device-resident. This estimator plus the
+    pre-flight in learn_masked is the memory story the HS --streaming
+    flag's algorithm switch cannot provide.
+
+    Counts the resident state, the padded data triple, and the live
+    frequency-domain temporaries of the bigger (z) pass; the XLA
+    working set is approximated by the 3 largest simultaneous
+    spectra. Frequency sharding divides only the per-shard solve
+    temporaries, not the replicated state.
+    """
+    if fg is None:
+        fg = common.FreqGeom.create(geom, data_spatial_shape)
+    S = 1
+    for s in fg.spatial_shape:
+        S *= s
+    F = fg.num_freq
+    W = 1
+    for w in geom.reduce_shape:
+        W *= w
+    k = geom.num_filters
+    cplx = 2 * dtype_bytes
+    Fl = F // max(1, num_freq_shards)
+
+    state = (
+        2 * k * W * S  # d_full + kernel-side dual
+        + 2 * n * k * S  # z + sparsity-side dual
+        + 2 * n * W * S  # two data-side duals
+    ) * dtype_bytes
+    data = 5 * n * W * S * dtype_bytes  # b_pad, M_pad, smoothinit, Mtb, MtM
+    # z-pass live spectra: zhat-new, xi1, xi2 (+ the z-kernel)
+    spectra = (2 * n * k * Fl + n * W * Fl + k * W * Fl) * cplx
+    # d-pass Woodbury: code spectra + [F, n, n] Gram inverse
+    woodbury = (n * k * Fl + Fl * n * n) * cplx
+    total = state + data + max(spectra, woodbury)
+    return {
+        "state_bytes": state,
+        "data_bytes": data,
+        "spectra_bytes": spectra,
+        "woodbury_bytes": woodbury,
+        "total_bytes": total,
+    }
+
+
+def _preflight_hbm(geom, data_spatial_shape, n, num_freq_shards=1, fg=None):
+    """Warn before compiling a step that cannot fit device memory."""
+    est = hbm_estimate(
+        geom, data_spatial_shape, n, num_freq_shards=num_freq_shards, fg=fg
+    )
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+    except Exception:
+        limit = None
+    if limit and est["total_bytes"] > 0.9 * limit:
+        import warnings
+
+        warnings.warn(
+            f"learn_masked estimated peak HBM "
+            f"{est['total_bytes'] / 1e9:.2f} GB vs device limit "
+            f"{limit / 1e9:.2f} GB — likely OOM. The masked learner's "
+            "d-pass couples all n images per frequency and cannot "
+            "stream; shrink n, shard the frequency axis (mesh), or "
+            "switch to the consensus learner (--streaming accepts a "
+            "different objective).",
+            stacklevel=3,
+        )
+    return est
+
+
 def learn_masked(
     b: jnp.ndarray,
     geom: ProblemGeom,
@@ -256,6 +338,13 @@ def learn_masked(
     n = b.shape[0]
     radius = geom.psf_radius
     fg = common.FreqGeom.create(geom, b.shape[-ndim_s:])
+    _preflight_hbm(
+        geom,
+        b.shape[-ndim_s:],
+        n,
+        num_freq_shards=mesh.shape.get("freq", 1) if mesh is not None else 1,
+        fg=fg,
+    )
 
     b_pad = fourier.pad_spatial(b, radius)
     M_pad = fourier.pad_spatial(jnp.ones_like(b), radius)
@@ -296,6 +385,10 @@ def learn_masked(
     )
 
     trace = {
+        # producer identity, machine-readable in saved .mat traces:
+        # distinguishes the masked-boundary objective from the
+        # consensus objective a --streaming run substitutes
+        "algorithm": "masked_admm",
         "obj_vals_d": [],
         "obj_vals_z": [],
         "tim_vals": [0.0],
@@ -337,6 +430,8 @@ def learn_masked(
             state = MaskedLearnState(**fields)
             if resumed_trace is not None:
                 trace = resumed_trace
+                # checkpoints written before the identity key existed
+                trace.setdefault("algorithm", "masked_admm")
             print(f"resumed from {checkpoint_dir} at iteration {start_it}")
 
     seen = trace["obj_vals_d"] + trace["obj_vals_z"]

@@ -33,6 +33,8 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+_PALLAS_SHARD_WARNED = False
+
 
 def hermitian_inverse(G: jnp.ndarray) -> jnp.ndarray:
     """Inverse of a batch of Hermitian positive-definite complex
@@ -159,7 +161,21 @@ def solve_z(
     (the seam at dParallel.m:278-303); everything else is k-local.
     """
     if axis_name is not None and use_pallas:
-        use_pallas = False  # fused kernel is single-shard only
+        # fused kernel is single-shard only; say so once rather than
+        # silently taking the einsum path (the perf difference must be
+        # attributable to a visible downgrade)
+        global _PALLAS_SHARD_WARNED
+        if not _PALLAS_SHARD_WARNED:
+            _PALLAS_SHARD_WARNED = True
+            import warnings
+
+            warnings.warn(
+                "use_pallas=True ignored under filter-axis sharding: "
+                "the fused z-solve kernel is single-shard only; using "
+                "the einsum path",
+                stacklevel=2,
+            )
+        use_pallas = False
     if use_pallas and kernel.minv is None:
         from . import pallas_kernels
 

@@ -287,6 +287,8 @@ def learn(
 
     if resumed_trace is not None:
         trace = resumed_trace
+        # checkpoints written before the identity key existed
+        trace.setdefault("algorithm", "consensus")
     else:
         obj0 = (
             float(obj_fn(state, b_blocks)[0])
@@ -294,6 +296,7 @@ def learn(
             else 0.0
         )
         trace = {
+            "algorithm": "consensus",  # producer identity (see streaming)
             "obj_vals_d": [obj0],
             "obj_vals_z": [obj0],
             "tim_vals": [0.0],

@@ -143,6 +143,10 @@ def learn_streaming(
     ) = _jit_pieces(geom, cfg, fg)
 
     trace = {
+        # machine-readable producer identity: a .mat saved from a
+        # --streaming run records WHICH objective produced it (the HS
+        # CLI's streaming arm switches algorithms, not just memory)
+        "algorithm": "consensus_streaming",
         "obj_vals_d": [0.0],
         "obj_vals_z": [0.0],
         "tim_vals": [0.0],

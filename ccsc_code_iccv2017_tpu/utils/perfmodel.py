@@ -59,9 +59,12 @@ def detect_chip() -> str:
         for gen in ("v6e", "v5p", "v5e", "v4"):
             if gen in kind:
                 return gen
+        # Unknown TPU generation: return the raw device kind so
+        # utilization() applies its labeled '{kind}->v5e' fallback
+        # instead of silently scoring against the v5e roofline.
+        return kind or "unknown-tpu"
     except Exception:
-        pass
-    return "v5e"
+        return "unknown-tpu"
 
 
 def compiled_cost(compiled) -> Optional[Dict[str, float]]:

@@ -219,3 +219,19 @@ def test_all_reference_artifacts_load():
         # trained banks are nontrivial: no dead (all-zero) filters
         flat = d.reshape(shape[0], -1)
         assert (np.abs(flat).max(axis=1) > 0).all(), path
+
+
+def test_streaming_guard_names_cli_flags(tmp_path):
+    # the shared dispatch guard must name the CLI flag as typed
+    # (--init-filters), not the Python kwarg (init_filters)
+    from ccsc_code_iccv2017_tpu.apps import learn_2d
+
+    with pytest.raises(SystemExit, match="--checkpoint-dir"):
+        learn_2d.main(
+            [
+                "--data", "/root/reference/2D/Inpainting/Test",
+                "--streaming", "--checkpoint-dir", str(tmp_path),
+                "--filters", "4", "--support", "5",
+                "--limit", "2", "--size", "16",
+            ]
+        )

@@ -30,6 +30,29 @@ def test_mat_file_input_framework_layout(tmp_path, dir_stack):
     np.testing.assert_allclose(got, dir_stack, rtol=1e-6)
 
 
+def test_mat_unnamed_ambiguous_raises(tmp_path):
+    # an unnamed [H, W, 31, 3] array is ambiguous between a framework
+    # [n, H, W, C] stack and a MATLAB [H, W, C, n] hyperspectral stack
+    # with 3 cubes — the loader must refuse to guess (ADVICE r2)
+    rng = np.random.default_rng(2)
+    arr = rng.uniform(size=(16, 16, 31, 3)).astype(np.float32)
+    mat = tmp_path / "amb.mat"
+    savemat(mat, {"mystery": arr})
+    with pytest.raises(ValueError, match="ambiguous"):
+        I.load_images(str(mat))
+    # explicit mat_layout resolves it — matlab: 3 cubes of [16,16,31]
+    imgs = I._mat_image_stack(str(mat), layout="matlab")
+    assert len(imgs) == 3 and imgs[0].shape == (16, 16, 31)
+    # framework through the public API: [n=16, H=16, W=31, C=3]
+    got = I.load_images(str(mat), mat_layout="framework", color="rgb")
+    assert got.shape == (16, 16, 31, 3)
+    # an unnamed 3-D stack is unambiguous and still defaults to
+    # MATLAB [H, W, n]
+    savemat(mat, {"mystery": arr[..., 0]})  # [16, 16, 31]
+    got = I.load_images(str(mat))
+    assert got.shape == (31, 16, 16)
+
+
 def test_single_mat_directory(tmp_path, dir_stack):
     # a directory whose only file is a .mat stack
     # (check_imgs_path.m:48-53)

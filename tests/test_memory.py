@@ -1,0 +1,78 @@
+"""Masked-learner memory story: analytic HBM estimator, pre-flight
+warning, and machine-readable algorithm identity in traces/.mat files
+(VERDICT r2 weak #6)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+from ccsc_code_iccv2017_tpu.models import learn_masked as lm
+
+
+def test_hbm_estimate_scales():
+    geom = ProblemGeom((11, 11), 100, (31,))
+    small = lm.hbm_estimate(geom, (64, 64), n=4)
+    big = lm.hbm_estimate(geom, (64, 64), n=16)
+    assert big["total_bytes"] > small["total_bytes"]
+    # the d-pass Woodbury term grows quadratically in n
+    assert big["woodbury_bytes"] > 4 * small["woodbury_bytes"]
+    # frequency sharding shrinks solve temporaries, not state
+    sharded = lm.hbm_estimate(geom, (64, 64), n=4, num_freq_shards=4)
+    assert sharded["state_bytes"] == small["state_bytes"]
+    assert sharded["woodbury_bytes"] < small["woodbury_bytes"]
+
+
+def test_hbm_estimate_order_of_magnitude():
+    # the reference HS operating point (learn_hyperspectral.m:3): kernel
+    # [11,11,31,100]; a handful of 128^2 cubes must estimate in the
+    # tens-of-GB range that motivated the memory story
+    geom = ProblemGeom((11, 11), 100, (31,))
+    est = lm.hbm_estimate(geom, (128, 128), n=10)
+    assert 1e9 < est["total_bytes"] < 1e12
+
+
+def test_algorithm_identity_in_traces(tmp_path):
+    from ccsc_code_iccv2017_tpu.models.learn import learn
+    from ccsc_code_iccv2017_tpu.parallel.streaming import learn_streaming
+    from ccsc_code_iccv2017_tpu.utils.io_mat import _loadmat, save_filters
+
+    b = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16), jnp.float32)
+    )
+    geom = ProblemGeom((5, 5), 4)
+    cfg = LearnConfig(
+        max_it=1, max_it_d=2, max_it_z=2, num_blocks=2, verbose="none"
+    )
+    r_mem = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(1))
+    assert r_mem.trace["algorithm"] == "consensus"
+    r_str = learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(1))
+    assert r_str.trace["algorithm"] == "consensus_streaming"
+
+    r_msk = lm.learn_masked(
+        jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(1)
+    )
+    assert r_msk.trace["algorithm"] == "masked_admm"
+
+    # identity survives the .mat round-trip
+    out = tmp_path / "f.mat"
+    save_filters(str(out), r_msk.d, r_msk.trace, layout="2d")
+    loaded = _loadmat(str(out))["iterations"]
+    names = (
+        loaded.dtype.names
+        if loaded.dtype.names
+        else loaded[0, 0].dtype.names
+    )
+    assert "algorithm" in names
+
+
+def test_preflight_warns_when_over_limit(monkeypatch):
+    # force a tiny fake device limit and check the warning fires
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_limit": 1_000_000}
+
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
+    geom = ProblemGeom((11, 11), 100, (31,))
+    with pytest.warns(UserWarning, match="likely OOM"):
+        lm._preflight_hbm(geom, (128, 128), n=10)
