@@ -77,7 +77,14 @@ def dispatch_learn(
             while n % blocks:
                 blocks -= 1
             cfg = dataclasses.replace(cfg, num_blocks=blocks)
-        return learn_streaming(b, geom, cfg, key=key)
+        res = learn_streaming(b, geom, cfg, key=key)
+        if streaming_offset is not None:
+            # learn_streaming codes the offset-subtracted data; restore
+            # the offset so Dz means "full reconstruction" exactly like
+            # the masked learner's Dz (learn_masked returns
+            # recon + smoothinit, matching admm_learn.m:236)
+            res = res._replace(Dz=res.Dz + np.asarray(streaming_offset))
+        return res
     import jax.numpy as jnp
 
     if solver is None:

@@ -130,7 +130,7 @@ def main(argv=None):
             init_d=init_d,
             profile_dir=args.profile_dir,
         )
-    save_filters(args.out, res.d, res.trace, layout="2d")
+    save_filters(args.out, res.d, res.trace, layout="2d", Dz=res.Dz)
     print(
         f"saved {res.d.shape} filters to {args.out}; total "
         f"{time.time()-t0:.1f}s, solver {res.trace['tim_vals'][-1]:.1f}s"

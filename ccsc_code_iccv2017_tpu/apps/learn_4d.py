@@ -95,7 +95,7 @@ def main(argv=None):
     res = dispatch_learn(
         b, geom, cfg, jax.random.PRNGKey(args.seed), mesh, args.streaming
     )
-    save_filters(args.out, res.d, res.trace, layout="lightfield")
+    save_filters(args.out, res.d, res.trace, layout="lightfield", Dz=res.Dz)
     print(f"saved {res.d.shape} filters to {args.out}")
     return res
 

@@ -119,7 +119,7 @@ def main(argv=None):
                 "--checkpoint-dir": args.checkpoint_dir,
             },
         )
-        save_filters(args.out, res.d, res.trace, layout="hyperspectral")
+        save_filters(args.out, res.d, res.trace, layout="hyperspectral", Dz=res.Dz)
         print(f"saved {res.d.shape} filters to {args.out} (streaming)")
         return res
     res = dispatch_learn(
@@ -135,7 +135,7 @@ def main(argv=None):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
-    save_filters(args.out, res.d, res.trace, layout="hyperspectral")
+    save_filters(args.out, res.d, res.trace, layout="hyperspectral", Dz=res.Dz)
     print(f"saved {res.d.shape} filters to {args.out}")
     return res
 

@@ -117,6 +117,14 @@ class LearnConfig:
     # reconstruction). None = only when verbose != 'none', matching the
     # reference (dParallel.m:126-129,161-167).
     track_objective: Optional[bool] = None
+    # Which dictionary the z-pass codes (and the objectives evaluate)
+    # against. 'consensus' (default): the projected consensus average
+    # Proj(Dbar + Udbar) — feasible by construction. 'block1': block
+    # 1's unprojected local iterate, the reference's exact semantic
+    # (dzParallel.m:143 codes against dup{1}; dParallel.m:143 against
+    # fft2(D{1}); objectives at :128,:166 likewise) — used by the
+    # MATLAB-anchored trajectory tests.
+    compat_coding: str = "consensus"
     # Route the W == 1 z-solve through the fused Pallas TPU kernel
     # (ops.pallas_kernels; interpret mode off-TPU). Bit-compatible with
     # the einsum path up to float reassociation.
@@ -158,5 +166,25 @@ class SolveConfig:
     lambda_smooth: float = 0.5
     dtype: str = "float32"
     verbose: str = "brief"
+    # Per-iteration objective / PSNR traces each cost an extra Dz
+    # reconstruction (two FFT passes) per iteration — the reference
+    # computes both unconditionally inside its solve loop
+    # (admm_solve_conv2D_weighted_sampling.m:109-134); here they follow
+    # the learners' with_objective pattern. None = only when
+    # verbose != 'none'. PSNR additionally requires x_orig.
+    track_objective: Optional[bool] = None
+    track_psnr: Optional[bool] = None
     # Route the W == 1 z-solve through the fused Pallas TPU kernel.
     use_pallas: bool = False
+
+    @property
+    def with_objective(self) -> bool:
+        if self.track_objective is None:
+            return self.verbose != "none"
+        return self.track_objective
+
+    @property
+    def with_psnr(self) -> bool:
+        if self.track_psnr is None:
+            return self.verbose != "none"
+        return self.track_psnr

@@ -398,6 +398,7 @@ def load_images(
     frames: Optional[Sequence] = None,
     layout: str = "channels_last",
     mat_layout: Optional[str] = None,
+    return_info: bool = False,
 ) -> np.ndarray:
     """CreateImages.m equivalent: folder / .mat stack / single image /
     in-memory array (the reference's four input forms,
@@ -415,6 +416,11 @@ def load_images(
     pads, CreateImages.m:665-699; cropping avoids fabricating pixels);
     ``size`` resizes after load; ``frames`` strides the file list
     (CreateImages.m:100-107).
+
+    ``return_info`` returns ``(stack, info)`` where ``info`` carries
+    preprocessing state needed to undo the transform — currently
+    ``info['mean_image']`` for the ``sep_mean`` mode (the dataset mean
+    the reference keeps for re-addition, CreateImages.m:640-646).
     """
     imgs = load_image_list(
         path, contrast_normalize, zero_mean, color, limit, frames,
@@ -439,15 +445,42 @@ def load_images(
     stack = np.stack(imgs).astype(np.float32)
     from . import whitening
 
+    info = {}
     if contrast_normalize in whitening.STACK_MODES:
         mode = whitening.STACK_MODES[contrast_normalize]
         if stack.ndim == 4:  # color: whiten each channel's stack
-            stack = np.stack(
-                [mode(stack[..., c]) for c in range(stack.shape[-1])], -1
-            )
+            outs = [mode(stack[..., c]) for c in range(stack.shape[-1])]
+            if isinstance(outs[0], tuple):  # (stack, aux) modes
+                stack = np.stack([o[0] for o in outs], -1)
+                info["mean_image"] = np.stack([o[1] for o in outs], -1)
+            else:
+                stack = np.stack(outs, -1)
         else:
-            stack = mode(stack)
-    return _apply_layout(stack, layout)
+            out = mode(stack)
+            if isinstance(out, tuple):
+                stack, info["mean_image"] = out
+            else:
+                stack = out
+    out = _apply_layout(stack, layout)
+    if "mean_image" in info:
+        info["mean_image"] = _mean_to_layout(
+            info["mean_image"], layout, stack.shape[0]
+        )
+    return (out, info) if return_info else out
+
+
+def _mean_to_layout(mu: np.ndarray, layout: str, n: int) -> np.ndarray:
+    """Orient the sep_mean mean image to match _apply_layout's stack so
+    ``stack + mean_image`` undoes the centering in every layout."""
+    if mu.ndim == 2:  # gray [H, W] broadcasts against every layout
+        return mu
+    if layout == "reduce":
+        return np.moveaxis(mu, -1, 0)  # [C, H, W] vs stack [n, C, H, W]
+    if layout == "batch":
+        # stack is [n*C, H, W] with channel fastest (channels_to_batch):
+        # repeat the per-channel means n times in the same order
+        return np.tile(np.moveaxis(mu, -1, 0), (n, 1, 1))
+    return mu  # channels_last [H, W, C]
 
 
 def _apply_layout(stack: np.ndarray, layout: str) -> np.ndarray:
@@ -483,6 +516,8 @@ def load_images_native(
     layout = kwargs.pop("layout", "channels_last")
     size = kwargs.pop("size", None)
     square = kwargs.pop("square", False)
+    # none/local_cn produce no undo state: info is always empty here
+    return_info = kwargs.pop("return_info", False)
     stack = load_images(path, "none", False, **kwargs)
     is_color = stack.ndim == 4
     # the kernel consumes [*, H, W] planes: fold color into the batch
@@ -516,4 +551,5 @@ def load_images_native(
         y0 = (stack.shape[1] - s) // 2
         x0 = (stack.shape[2] - s) // 2
         stack = stack[:, y0 : y0 + s, x0 : x0 + s]
-    return _apply_layout(stack.astype(np.float32), layout)
+    out = _apply_layout(stack.astype(np.float32), layout)
+    return (out, {}) if return_info else out

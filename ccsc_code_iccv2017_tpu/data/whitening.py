@@ -186,5 +186,8 @@ STACK_MODES = {
     "PCA_whitening": pca_whiten_images,
     "ZCA_image_whitening": zca_whiten_images,
     "ZCA_patch_whitening": zca_whiten_patches,
-    "sep_mean": lambda s: sep_mean(s)[0],
+    # sep_mean returns (centered stack, mean image); the mean is kept
+    # for later re-addition (CreateImages.m:640-646) and surfaced via
+    # load_images(return_info=True).
+    "sep_mean": sep_mean,
 }

@@ -247,9 +247,25 @@ def outer_step(
     )
     d_diff = common.rel_change(dbar, state.dbar, axis_name=filter_axis_name)
 
-    # consensus dictionary used for coding (projected -> feasible)
-    d_proj = prox_kernel(dbar + udbar)
-    dhat_z = common.full_filters_to_freq(d_proj, fg)
+    # dictionary used for coding: the projected consensus average
+    # (feasible by construction; default), or block 1's unprojected
+    # local iterate — the reference's exact semantic
+    # (dzParallel.m:143 / dParallel.m:143), kept as a compat mode for
+    # the MATLAB-anchored trajectory tests.
+    if cfg.compat_coding == "block1":
+        d_code = d_local[0]
+        if axis_name is not None:
+            # global block 1 lives on device 0 of the block axis
+            idx = jax.lax.axis_index(axis_name)
+            d_code = _psum(
+                jnp.where(idx == 0, d_code, jnp.zeros_like(d_code)),
+                axis_name,
+            )
+    elif cfg.compat_coding == "consensus":
+        d_code = prox_kernel(dbar + udbar)
+    else:
+        raise ValueError(f"unknown compat_coding {cfg.compat_coding!r}")
+    dhat_z = common.full_filters_to_freq(d_code, fg)
     obj_d = objective(state.z, dhat_z)
 
     # ---------------- z-pass (dzParallel.m:140-172) ------------------

@@ -337,6 +337,14 @@ def learn_masked(
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
     radius = geom.psf_radius
+    if cfg.compat_coding != "consensus":
+        # an explicit error beats silently ignoring a requested option:
+        # block-1 compat is a consensus-learner semantic (there are no
+        # consensus blocks here — admm_learn.m has a single dictionary)
+        raise ValueError(
+            "compat_coding is only supported by the consensus learner "
+            "(models.learn)"
+        )
     fg = common.FreqGeom.create(geom, b.shape[-ndim_s:])
     _preflight_hbm(
         geom,

@@ -348,6 +348,11 @@ def _reconstruct_jit(
         return common.recon_from_freq(dhat, zhat, fg)
 
     def objective(z, zhat):
+        # gated like the learners' with_objective: each evaluation costs
+        # an extra Dz (two FFT passes) — material at the max_it=200
+        # demosaic/view-synth operating points
+        if not cfg.with_objective:
+            return jnp.float32(0.0)
         Dz = Dz_real(zhat, dhat_solve)
         r = fourier.crop_spatial(Dz + smoothinit, radius) - b
         r = fourier.crop_spatial(M_pad, radius) * r
@@ -357,7 +362,7 @@ def _reconstruct_jit(
         )
 
     def psnr_of(zhat):
-        if x_orig is None:
+        if x_orig is None or not cfg.with_psnr:
             return jnp.float32(0.0)
         Dz = Dz_real(zhat, dhat_clean) + smoothinit
         rec = fourier.crop_spatial(Dz, radius)

@@ -118,6 +118,12 @@ def learn_streaming(
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
     N = cfg.num_blocks
+    if cfg.compat_coding != "consensus":
+        # an explicit error beats silently ignoring a requested option
+        raise ValueError(
+            "compat_coding is only supported by the in-memory consensus "
+            "learner (models.learn)"
+        )
     if n % N:
         raise ValueError(f"n={n} not divisible by num_blocks={N}")
     ni = n // N

@@ -91,19 +91,36 @@ def save_filters(
     d: np.ndarray,
     trace: dict | None = None,
     layout: str | None = None,
+    Dz: np.ndarray | None = None,
 ) -> None:
-    """Save learned filters (+ optional trace) in the REFERENCE's .mat
-    layout (spatial-first, filter-index last), mirroring the terminal
-    save at 2D/learn_kernels_2D_large.m:45 — so files round-trip
-    through load_filters_* and are interchangeable with the MATLAB
-    artifacts."""
+    """Save learned filters (+ optional trace and Dz reconstructions)
+    in the REFERENCE's .mat layout (spatial-first, index last),
+    mirroring the terminal ``save('...','d','Dz','iterations')`` at
+    2D/learn_kernels_2D_large.m:45 — so files round-trip through
+    load_filters_* / load_dz and are interchangeable with the MATLAB
+    artifacts.
+
+    ``Dz``: [n, *reduce, *spatial] reconstructions (LearnResult.Dz);
+    stored with the batch axis last like the reference's data layout
+    (e.g. 2D [n, x, y] -> [x, y, n])."""
     import scipy.io
 
     d = np.asarray(d)
     layout = layout or infer_layout(d)
     payload = {"d": np.transpose(d, _TO_MATLAB[layout])}
+    if Dz is not None:
+        # same family permutation as the filters, with n in the k role
+        payload["Dz"] = np.transpose(np.asarray(Dz), _TO_MATLAB[layout])
     if trace is not None:
         payload["iterations"] = {
             k: np.asarray(v) for k, v in trace.items()
         }
     scipy.io.savemat(path, payload)
+
+
+def load_dz(path: str, layout: str = "2d") -> np.ndarray:
+    """Load the Dz reconstructions back into [n, *reduce, *spatial]."""
+    Dz = _loadmat(path)["Dz"]
+    perm = _TO_MATLAB[layout]
+    inv = np.argsort(perm)
+    return np.ascontiguousarray(np.transpose(Dz, inv)).astype(np.float32)

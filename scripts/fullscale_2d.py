@@ -127,7 +127,9 @@ def main():
     print(f"learned in {t_learn:.1f}s", flush=True)
 
     bank = os.path.join(args.out, "learned_bank.mat")
-    save_filters(bank, res.d, res.trace, layout="2d")
+    # keep a handful of Dz examples like the shipped artifact (its Dz
+    # holds 5 reconstructions, SURVEY.md section 6)
+    save_filters(bank, res.d, res.trace, layout="2d", Dz=res.Dz[:8])
     display.save_filter_mosaic(
         os.path.join(args.out, "filters_mosaic.png"),
         np.asarray(res.d),

@@ -26,6 +26,33 @@ def test_filter_mat_roundtrip(tmp_path, shape, layout, loader):
     np.testing.assert_allclose(back, d, rtol=1e-6)
 
 
+@pytest.mark.parametrize(
+    "dz_shape,layout",
+    [
+        ((3, 12, 12), "2d"),
+        ((3, 4, 12, 12), "hyperspectral"),
+        ((3, 12, 12, 6), "3d"),
+        ((3, 2, 2, 12, 12), "lightfield"),
+    ],
+)
+def test_dz_mat_roundtrip(tmp_path, dz_shape, layout):
+    """The terminal save keeps Dz alongside d/iterations
+    (learn_kernels_2D_large.m:45); the stored layout is the reference's
+    data layout (spatial-first, n last) and round-trips exactly."""
+    r = np.random.default_rng(3)
+    nd = {"2d": (6, 5, 5), "hyperspectral": (6, 4, 5, 5),
+          "3d": (6, 5, 5, 5), "lightfield": (6, 2, 2, 5, 5)}[layout]
+    d = r.normal(size=nd).astype(np.float32)
+    Dz = r.normal(size=dz_shape).astype(np.float32)
+    p = str(tmp_path / "f.mat")
+    io_mat.save_filters(p, d, {"obj_vals_d": [1.0]}, layout=layout, Dz=Dz)
+    raw = io_mat._loadmat(p)
+    assert "Dz" in raw and "d" in raw and "iterations" in raw
+    # stored with n last, like the reference's b/Dz arrays
+    assert raw["Dz"].shape[-1] == dz_shape[0]
+    np.testing.assert_allclose(io_mat.load_dz(p, layout), Dz, rtol=1e-6)
+
+
 def test_reference_layout_compat():
     """load_filters_2d on a MATLAB-layout array equals manual transpose."""
     import scipy.io, tempfile, os

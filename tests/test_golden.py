@@ -58,6 +58,7 @@ def test_golden_inpaint_trajectory():
     cfg = SolveConfig(
         lambda_residual=5.0, lambda_prior=2.0, max_it=5, tol=0.0,
         verbose="none",
+        track_objective=True,
     )
     res = reconstruct(
         jnp.asarray(b * mask),

@@ -134,6 +134,7 @@ def test_framework_matches_matlab_transcription():
         gamma_factor=60.0,
         gamma_ratio=100.0,
         verbose="none",
+        track_objective=True,
     )
     d = np.moveaxis(kmat, -1, 0)  # [k, s, s] framework layout
     res = reconstruct(

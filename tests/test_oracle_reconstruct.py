@@ -198,6 +198,7 @@ def test_masked_gaussian_matches_oracle():
         gamma_factor=60.0,
         gamma_ratio=100.0,
         verbose="none",
+        track_objective=True,
     )
     b = r.uniform(0.1, 1.0, (2, 8, 8)).astype(np.float32)
     d = r.normal(size=(4, 3, 3)).astype(np.float32)
@@ -227,6 +228,7 @@ def test_poisson_dirac_matches_oracle():
         gamma_ratio=5.0,
         lambda_smooth=0.5,
         verbose="none",
+        track_objective=True,
     )
     b = r.poisson(50.0, (2, 8, 8)).astype(np.float32)
     d = r.normal(size=(3, 3, 3)).astype(np.float32)
@@ -251,6 +253,7 @@ def test_demosaic_reduce_unpadded_matches_oracle():
         gamma_factor=60.0,
         gamma_ratio=100.0,
         verbose="none",
+        track_objective=True,
     )
     b = r.uniform(0.1, 1.0, (2, 2, 8, 8)).astype(np.float32)
     d = r.normal(size=(3, 2, 3, 3)).astype(np.float32)
@@ -278,6 +281,7 @@ def test_blur_composition_matches_oracle():
         gamma_factor=500.0,
         gamma_ratio=1.0,
         verbose="none",
+        track_objective=True,
     )
     b = r.uniform(0.1, 1.0, (2, 8, 8)).astype(np.float32)
     d = r.normal(size=(4, 3, 3)).astype(np.float32)

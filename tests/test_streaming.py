@@ -112,3 +112,46 @@ def test_streaming_flag_apps(tmp_path):
         ]
     )
     assert rh.d.shape == (3, 3, 3, 3)
+
+
+def test_streaming_dispatch_restores_offset_in_dz():
+    """dispatch_learn(streaming=True, streaming_offset=sm) must return
+    Dz WITH the offset added back, matching the masked learner's
+    Dz-includes-smoothinit meaning (admm_learn.m:236) — both arms of
+    the hyperspectral app save interchangeable artifacts."""
+    from ccsc_code_iccv2017_tpu.apps._dispatch import dispatch_learn
+    from ccsc_code_iccv2017_tpu.data import volumes
+
+    b = volumes.synthetic_hyperspectral(n=2, bands=3, side=12)
+    sm = np.full_like(b, 0.25)
+    geom = ProblemGeom((3, 3), 4, (3,))
+    cfg = LearnConfig(
+        max_it=1, max_it_d=2, max_it_z=2, num_blocks=2, verbose="none"
+    )
+    key = jax.random.PRNGKey(0)
+    res = dispatch_learn(
+        b, geom, cfg, key, mesh=None, streaming=True,
+        streaming_blocks=2, streaming_offset=sm,
+    )
+    raw = streaming.learn_streaming(b - sm, geom, cfg, key=key)
+    np.testing.assert_allclose(
+        np.asarray(res.Dz), np.asarray(raw.Dz) + sm, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_compat_coding_rejected_outside_consensus_learner():
+    """compat_coding='block1' is a consensus-learner semantic; the
+    streaming and masked learners must reject it, not ignore it."""
+    import pytest
+
+    from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
+
+    b = np.zeros((2, 8, 8), np.float32)
+    geom = ProblemGeom((3, 3), 2)
+    cfg = LearnConfig(
+        max_it=1, num_blocks=2, verbose="none", compat_coding="block1"
+    )
+    with pytest.raises(ValueError, match="compat_coding"):
+        streaming.learn_streaming(b, geom, cfg)
+    with pytest.raises(ValueError, match="compat_coding"):
+        learn_masked(jnp.asarray(b), geom, cfg)

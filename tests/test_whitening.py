@@ -103,6 +103,45 @@ def test_sep_mean():
     np.testing.assert_allclose(C + mu, X, rtol=1e-4, atol=1e-6)
 
 
+def test_sep_mean_mean_image_plumbed_through_loader():
+    """load_images(return_info=True) surfaces the dataset mean the
+    reference keeps for re-addition (CreateImages.m:640-646) instead of
+    dropping it; centered + mean reconstructs the input."""
+    from ccsc_code_iccv2017_tpu.data.images import load_images
+
+    X = _stack(n=5, seed=8)
+    C, info = load_images(
+        X, contrast_normalize="sep_mean", return_info=True
+    )
+    assert "mean_image" in info
+    np.testing.assert_allclose(
+        C + info["mean_image"], X, rtol=1e-4, atol=1e-5
+    )
+    # modes without undo state return an empty info dict
+    _, info2 = load_images(X, return_info=True)
+    assert info2 == {}
+    # the default single-return signature is unchanged
+    C2 = load_images(X, contrast_normalize="sep_mean")
+    np.testing.assert_allclose(C2, C)
+
+
+def test_sep_mean_mean_image_follows_layout():
+    """For color stacks the mean image is re-oriented with the layout
+    so `stack + mean_image` undoes the centering in every layout."""
+    from ccsc_code_iccv2017_tpu.data.images import load_images
+
+    rng = np.random.default_rng(9)
+    X = rng.uniform(0.1, 1.0, (4, 8, 8, 3)).astype(np.float32)
+    for layout in ("channels_last", "reduce", "batch"):
+        C, info = load_images(
+            X, contrast_normalize="sep_mean", color="rgb",
+            layout=layout, return_info=True,
+        )
+        undone = C + info["mean_image"]
+        ref = load_images(X, color="rgb", layout=layout)
+        np.testing.assert_allclose(undone, ref, rtol=1e-4, atol=1e-5)
+
+
 def test_laplacian_and_box_modes_run():
     img = _stack(n=1, seed=6)[0]
     lap = whitening.laplacian_cn(img)
