@@ -55,6 +55,8 @@ def run_workload():
     iters = int(os.environ.get("CCSC_BENCH_ITERS", 3))
 
     use_pallas = os.environ.get("CCSC_BENCH_PALLAS") == "1"
+    fft_pad = os.environ.get("CCSC_BENCH_FFTPAD", "none")
+    storage = os.environ.get("CCSC_BENCH_STORAGE", "float32")
     geom = ProblemGeom((11, 11), k)
     cfg = LearnConfig(
         max_it=iters,
@@ -65,8 +67,10 @@ def run_workload():
         rho_z=1.0,
         verbose="none",
         use_pallas=use_pallas,
+        fft_pad=fft_pad,
+        storage_dtype=storage,
     )
-    fg = common.FreqGeom.create(geom, (size, size))
+    fg = common.FreqGeom.create(geom, (size, size), fft_pad=fft_pad)
 
     key = jax.random.PRNGKey(0)
     ni = n // blocks
@@ -74,7 +78,9 @@ def run_workload():
     b_blocks = jax.random.normal(
         jax.random.PRNGKey(1), (blocks, ni, size, size), jnp.float32
     )
-    state = learn_mod.init_state(key, geom, fg, blocks, ni)
+    state = learn_mod.init_state(
+        key, geom, fg, blocks, ni, z_dtype=jnp.dtype(storage)
+    )
 
     step = consensus.make_outer_step(geom, cfg, fg, mesh=None)
 
@@ -153,7 +159,7 @@ def profile_components(geom, cfg, fg, state, b_blocks, reps=5):
     from ccsc_code_iccv2017_tpu.ops import fourier, freq_solvers, proxes
 
     radius = geom.psf_radius
-    b_pad = fourier.pad_spatial(b_blocks, radius)
+    b_pad = fourier.pad_spatial(b_blocks, radius, target=fg.spatial_shape)
     # ALL stage inputs are produced inside jit — eager complex ops
     # fail on the axon platform
     bhat = jax.jit(

@@ -129,6 +129,19 @@ class LearnConfig:
     # (ops.pallas_kernels; interpret mode off-TPU). Bit-compatible with
     # the einsum path up to float reassociation.
     use_pallas: bool = False
+    # Round the FFT domain up to a TPU-friendly size ('pow2' | 'fast',
+    # fourier.next_fast_size). 'none' keeps the reference's exact
+    # s + 2*psf_radius padding (dParallel.m:16). A fast domain solves
+    # the same CCSC problem with a slightly larger code canvas (data
+    # still sits at offset psf_radius; objectives are evaluated on the
+    # data region only) but avoids awkward FFT lengths like 110.
+    fft_pad: str = "none"
+    # Storage dtype of the CODE state (z and its dual — by far the
+    # largest tensors, [n, k, *spatial]). 'bfloat16' halves their HBM
+    # footprint and traffic; every computation still runs in float32
+    # (cast-up at the scan boundary), so only the stored iterate is
+    # rounded. The dictionary-side state stays float32 (it is tiny).
+    storage_dtype: str = "float32"
 
     @property
     def with_objective(self) -> bool:
@@ -176,6 +189,10 @@ class SolveConfig:
     track_psnr: Optional[bool] = None
     # Route the W == 1 z-solve through the fused Pallas TPU kernel.
     use_pallas: bool = False
+    # Round the FFT domain up to a TPU-friendly size ('pow2' | 'fast');
+    # requires a padded problem (ReconstructionProblem.pad=True) — see
+    # LearnConfig.fft_pad.
+    fft_pad: str = "none"
 
     @property
     def with_objective(self) -> bool:

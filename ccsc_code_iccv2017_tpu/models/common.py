@@ -26,13 +26,26 @@ class FreqGeom(NamedTuple):
 
     @classmethod
     def create(
-        cls, geom: ProblemGeom, data_spatial: Sequence[int], pad: bool = True
+        cls,
+        geom: ProblemGeom,
+        data_spatial: Sequence[int],
+        pad: bool = True,
+        fft_pad: str = "none",
     ) -> "FreqGeom":
+        """``fft_pad`` ('none' | 'pow2' | 'fast') rounds the padded FFT
+        domain up to a TPU-friendly length (fourier.next_fast_size);
+        the data always sits at offset psf_radius, extra zeros trail.
+        Requires ``pad`` — an unpadded (pure circular) problem's domain
+        IS the data, so growing it would change the problem
+        (demosaic/view-synth, admm_solve_conv23D:5)."""
+        if fft_pad != "none" and not pad:
+            raise ValueError("fft_pad requires a padded problem domain")
         sp = (
             geom.padded_shape(tuple(data_spatial))
             if pad
             else tuple(data_spatial)
         )
+        sp = tuple(fourier.next_fast_size(s, fft_pad) for s in sp)
         fs = fourier.rfreq_shape(sp)
         import math
 
@@ -101,7 +114,7 @@ def data_fidelity(
     """lambda_res/2 * || mask .* (crop(Dz) - b) ||^2
     (objectiveFunction, 2D/admm_learn_conv2D_large_dParallel.m:305-324).
     """
-    r = fourier.crop_spatial(Dz, radius) - b
+    r = fourier.crop_spatial(Dz, radius, b.shape[-len(radius):]) - b
     if mask is not None:
         r = mask * r
     return 0.5 * lambda_residual * jnp.sum(r * r)

@@ -74,11 +74,17 @@ def init_state(
     num_blocks: int,
     ni: int,
     dtype=jnp.float32,
+    z_dtype=None,
 ) -> LearnState:
     """Random init matching the reference's shapes: randn filters
     embedded at the origin (dzParallel.m:38-42), randn codes (:44-47),
     zero duals (:79-86). Returns global state with the FULL block axis
     [N, ...]; the driver reshapes to [ndev, L, ...] sharding as needed.
+
+    ``z_dtype``: storage dtype of the code state z/dual_z (the largest
+    tensors — LearnConfig.storage_dtype); defaults to ``dtype``. The
+    randn init is drawn in f32 then rounded, so bf16 storage starts
+    from the same trajectory as f32.
     """
     kd, kz = jax.random.split(key)
     d0 = jax.random.normal(kd, geom.filter_shape, dtype)
@@ -86,7 +92,7 @@ def init_state(
     d_locals = jnp.broadcast_to(d_full, (num_blocks, *d_full.shape))
     z0 = jax.random.normal(
         kz, (num_blocks, ni, geom.num_filters, *fg.spatial_shape), dtype
-    )
+    ).astype(z_dtype or dtype)
     return LearnState(
         d_locals,
         jnp.zeros_like(d_locals),
@@ -175,9 +181,14 @@ def outer_step(
             x, freq_axis_name, axis=x.ndim - 1, tiled=True
         )
 
-    b_pad = fourier.pad_spatial(b_blocks, radius)
+    b_pad = fourier.pad_spatial(b_blocks, radius, target=fg.spatial_shape)
     bhat = jax.vmap(lambda bp: common.data_to_freq(bp, fg))(b_pad)  # [L,ni,W,F]
     bhat_l = fslice(bhat)
+
+    # code state may be stored bf16 (LearnConfig.storage_dtype); all
+    # arithmetic runs in f32 — only the stored iterate is rounded
+    sd = state.z.dtype
+    f32 = lambda x: x.astype(jnp.float32)
 
     prox_kernel = lambda u: proxes.kernel_constraint_proj(
         u, support, fg.spatial_shape
@@ -191,6 +202,7 @@ def outer_step(
             return jnp.float32(0.0)
 
         def one(zl, bl):
+            zl = f32(zl)
             zhat = common.codes_to_freq(zl, fg)
             Dz = common.recon_from_freq(
                 dhat, zhat, fg, filter_axis_name=filter_axis_name
@@ -206,7 +218,7 @@ def outer_step(
         )
 
     # ---------------- d-pass (dzParallel.m:95-135) -------------------
-    zhat = jax.vmap(lambda zl: common.codes_to_freq(zl, fg))(state.z)
+    zhat = jax.vmap(lambda zl: common.codes_to_freq(f32(zl), fg))(state.z)
     zhat_l = fslice(zhat)
     dkern = jax.vmap(
         lambda zh: freq_solvers.precompute_d_kernel(
@@ -275,7 +287,7 @@ def outer_step(
     theta = cfg.lambda_prior / cfg.rho_z
 
     def z_iter(carry, _):
-        z, dual_z = carry
+        z, dual_z = f32(carry[0]), f32(carry[1])
         u2 = proxes.soft_threshold(z + dual_z, theta)
         dual_z = dual_z + (z - u2)
         xi2 = u2 - dual_z
@@ -291,13 +303,13 @@ def outer_step(
             )(bhat_l, xi2_hat)
         )
         z_new = jax.vmap(lambda zh: common.codes_from_freq(zh, fg))(zhat_new)
-        return (z_new, dual_z), None
+        return (z_new.astype(sd), dual_z.astype(sd)), None
 
     (z, dual_z), _ = jax.lax.scan(
         z_iter, (state.z, state.dual_z), None, length=cfg.max_it_z
     )
-    num = _psum(jnp.sum((z - state.z) ** 2), global_axes)
-    den = _psum(jnp.sum(z * z), global_axes)
+    num = _psum(jnp.sum((f32(z) - f32(state.z)) ** 2), global_axes)
+    den = _psum(jnp.sum(f32(z) ** 2), global_axes)
     z_diff = jnp.sqrt(num) / jnp.maximum(jnp.sqrt(den), 1e-30)
     obj_z = objective(z, dhat_z)
 
@@ -329,6 +341,7 @@ def eval_block(
     dhat = common.full_filters_to_freq(d_proj, fg)
 
     def one(zl, bl):
+        zl = zl.astype(jnp.float32)  # z may be stored bf16
         zhat = common.codes_to_freq(zl, fg)
         Dz = common.recon_from_freq(
             dhat, zhat, fg, filter_axis_name=filter_axis_name
@@ -339,7 +352,9 @@ def eval_block(
         l1 = common.l1_penalty(zl, cfg.lambda_prior)
         if not with_outputs:
             return fid, l1, jnp.zeros((), Dz.dtype)
-        return fid, l1, fourier.crop_spatial(Dz, geom.psf_radius)
+        return fid, l1, fourier.crop_spatial(
+            Dz, geom.psf_radius, bl.shape[-geom.ndim_spatial:]
+        )
 
     fids, l1s, Dz = jax.vmap(one)(state.z, b_blocks)
     global_axes = tuple(

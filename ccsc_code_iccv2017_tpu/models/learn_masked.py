@@ -345,6 +345,14 @@ def learn_masked(
             "compat_coding is only supported by the consensus learner "
             "(models.learn)"
         )
+    if cfg.fft_pad != "none":
+        raise ValueError(
+            "fft_pad is not yet supported by the masked learner"
+        )
+    if cfg.storage_dtype != "float32":
+        raise ValueError(
+            "storage_dtype is not yet supported by the masked learner"
+        )
     fg = common.FreqGeom.create(geom, b.shape[-ndim_s:])
     _preflight_hbm(
         geom,

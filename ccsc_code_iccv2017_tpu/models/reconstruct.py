@@ -252,7 +252,9 @@ def _reconstruct_jit(
     ndim_s = geom.ndim_spatial
     data_spatial = b.shape[-ndim_s:]
     radius = geom.psf_radius if prob.pad else (0,) * ndim_s
-    fg = common.FreqGeom.create(geom, data_spatial, pad=prob.pad)
+    fg = common.FreqGeom.create(
+        geom, data_spatial, pad=prob.pad, fft_pad=cfg.fft_pad
+    )
     n = b.shape[0]
 
     if prob.dirac != "none":
@@ -274,10 +276,12 @@ def _reconstruct_jit(
         if mask is None
         else mask.astype(b.dtype)
     )
-    B_pad = fourier.pad_spatial(b, radius)
-    M_pad = fourier.pad_spatial(M, radius)
+    B_pad = fourier.pad_spatial(b, radius, target=fg.spatial_shape)
+    M_pad = fourier.pad_spatial(M, radius, target=fg.spatial_shape)
     smoothinit = (
-        fourier.pad_spatial(smooth_init, radius, mode="symmetric")
+        fourier.pad_spatial(
+            smooth_init, radius, mode="symmetric", target=fg.spatial_shape
+        )
         if smooth_init is not None
         else jnp.zeros_like(B_pad)
     )
@@ -354,8 +358,8 @@ def _reconstruct_jit(
         if not cfg.with_objective:
             return jnp.float32(0.0)
         Dz = Dz_real(zhat, dhat_solve)
-        r = fourier.crop_spatial(Dz + smoothinit, radius) - b
-        r = fourier.crop_spatial(M_pad, radius) * r
+        r = fourier.crop_spatial(Dz + smoothinit, radius, data_spatial) - b
+        r = fourier.crop_spatial(M_pad, radius, data_spatial) * r
         return (
             0.5 * cfg.lambda_residual * gsum(jnp.sum(r * r))
             + cfg.lambda_prior * gsum(jnp.sum(jnp.abs(z)))
@@ -365,7 +369,7 @@ def _reconstruct_jit(
         if x_orig is None or not cfg.with_psnr:
             return jnp.float32(0.0)
         Dz = Dz_real(zhat, dhat_clean) + smoothinit
-        rec = fourier.crop_spatial(Dz, radius)
+        rec = fourier.crop_spatial(Dz, radius, data_spatial)
         return common.psnr(rec, x_orig, geom.psf_radius, axis_name)
 
     z_shape = (n, K, *fg.spatial_shape)
@@ -420,7 +424,7 @@ def _reconstruct_jit(
     )
 
     Dz = Dz_real(zhat, dhat_clean) + smoothinit
-    recon = fourier.crop_spatial(Dz, radius)
+    recon = fourier.crop_spatial(Dz, radius, data_spatial)
     if prob.clamp_nonneg:
         recon = jnp.maximum(recon, 0.0)
     return ReconResult(z, recon, ReconTrace(obj_t, psnr_t, diff_t, i))

@@ -45,10 +45,40 @@ def irfftn_spatial(
     )
 
 
+def next_fast_size(n: int, mode: str = "none") -> int:
+    """Round an FFT length up to a TPU-friendly size.
+
+    'none' keeps the reference's exact padding (s + 2r, dParallel.m:16);
+    'pow2' -> next power of two (best MXU/lane alignment and avoids
+    Bluestein codegen for awkward lengths like 110 = 2*5*11);
+    'fast' -> smallest 5-smooth (2^a 3^b 5^c) size >= n.
+    """
+    if mode == "none":
+        return n
+    pow2 = 1 << max(n - 1, 1).bit_length()
+    if mode == "pow2":
+        return pow2
+    if mode == "fast":
+        best = pow2
+        p5 = 1
+        while p5 <= best:
+            p35 = p5
+            while p35 <= best:
+                x = p35
+                while x < n:
+                    x *= 2
+                best = min(best, x)
+                p35 *= 3
+            p5 *= 5
+        return best
+    raise ValueError(f"unknown fft pad mode {mode!r}")
+
+
 def pad_spatial(
     x: jnp.ndarray,
     radius: Sequence[int],
     mode: str = "zero",
+    target: Optional[Sequence[int]] = None,
 ) -> jnp.ndarray:
     """Pad the trailing len(radius) spatial axes by radius on both sides.
 
@@ -56,9 +86,29 @@ def pad_spatial(
     (2D/admm_learn_conv2D_large_dParallel.m:23); ``symmetric`` matches
     padarray(smooth_init, psf_radius, 'symmetric', 'both')
     (admm_solve_conv2D_weighted_sampling.m:25).
+
+    ``target`` (the FreqGeom spatial shape) places any EXTRA padding
+    beyond radius after the trailing edge: [radius | data | radius |
+    extra] — used when the FFT domain is rounded up to a fast size
+    (next_fast_size). The data always sits at offset ``radius``.
     """
     ndim_s = len(radius)
-    pad = [(0, 0)] * (x.ndim - ndim_s) + [(r, r) for r in radius]
+    if target is None:
+        pad = [(0, 0)] * (x.ndim - ndim_s) + [(r, r) for r in radius]
+    else:
+        for r, d, t in zip(radius, x.shape[-ndim_s:], target):
+            if t - d - r < r:
+                # a trailing pad narrower than radius would wrap filter
+                # tails into the data under circular convolution —
+                # corrupting silently; fail instead
+                raise ValueError(
+                    f"target {t} leaves <radius trailing pad for data "
+                    f"size {d}, radius {r}"
+                )
+        pad = [(0, 0)] * (x.ndim - ndim_s) + [
+            (r, t - d - r)
+            for r, d, t in zip(radius, x.shape[-ndim_s:], target)
+        ]
     if mode == "zero":
         return jnp.pad(x, pad)
     if mode == "symmetric":
@@ -66,11 +116,26 @@ def pad_spatial(
     raise ValueError(f"unknown pad mode {mode!r}")
 
 
-def crop_spatial(x: jnp.ndarray, radius: Sequence[int]) -> jnp.ndarray:
-    """Undo pad_spatial: crop radius from both sides of trailing axes."""
-    sl = [slice(None)] * (x.ndim - len(radius)) + [
-        slice(r, d - r) for r, d in zip(radius, x.shape[-len(radius):])
-    ]
+def crop_spatial(
+    x: jnp.ndarray,
+    radius: Sequence[int],
+    out_spatial: Optional[Sequence[int]] = None,
+) -> jnp.ndarray:
+    """Undo pad_spatial: the data region starts at ``radius``.
+
+    ``out_spatial`` gives the data's spatial shape explicitly — needed
+    when the domain carries extra fast-size padding past the trailing
+    radius; without it both sides are assumed to be exactly radius.
+    """
+    ndim_s = len(radius)
+    if out_spatial is None:
+        sl = [slice(None)] * (x.ndim - ndim_s) + [
+            slice(r, d - r) for r, d in zip(radius, x.shape[-ndim_s:])
+        ]
+    else:
+        sl = [slice(None)] * (x.ndim - ndim_s) + [
+            slice(r, r + o) for r, o in zip(radius, out_spatial)
+        ]
     return x[tuple(sl)]
 
 

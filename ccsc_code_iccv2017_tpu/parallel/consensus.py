@@ -235,12 +235,15 @@ def learn(
             raise ValueError(
                 f"num_blocks={N} not divisible by mesh 'block' axis {nb}"
             )
-    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:])
+    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:], fft_pad=cfg.fft_pad)
     b_blocks = b.reshape(N, ni, *b.shape[1:])
 
     if key is None:
         key = jax.random.PRNGKey(0)
-    state = learn_mod.init_state(key, geom, fg, N, ni, b.dtype)
+    state = learn_mod.init_state(
+        key, geom, fg, N, ni, b.dtype,
+        z_dtype=jnp.dtype(cfg.storage_dtype),
+    )
     if init_d is not None:
         if tuple(init_d.shape) != tuple(geom.filter_shape):
             raise ValueError(

@@ -124,6 +124,14 @@ def learn_streaming(
             "compat_coding is only supported by the in-memory consensus "
             "learner (models.learn)"
         )
+    if cfg.fft_pad != "none":
+        raise ValueError(
+            "fft_pad is not yet supported by the streaming learner"
+        )
+    if cfg.storage_dtype != "float32":
+        raise ValueError(
+            "storage_dtype is not yet supported by the streaming learner"
+        )
     if n % N:
         raise ValueError(f"n={n} not divisible by num_blocks={N}")
     ni = n // N

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Per-family throughput benchmarks (VERDICT r3 next-round #6).
+
+The north-star bench (bench.py) covers only the 2D consensus learner.
+This script measures one operating point for each remaining family:
+
+  hs         2-3D hyperspectral masked learner (admm_learn.m shape)
+  3d         3D video consensus learner (admm_learn_conv3D_large.m)
+  demosaic   2-3D demosaic reconstruction, pad=False, W=31
+             (admm_solve_conv23D_weighted_sampling.m, max_it=200 protocol)
+  viewsynth  4D view-synth reconstruction, W=25 angular views
+             (admm_solve_conv_weighted_sampling_lf.m)
+
+Prints one JSON line per family: {"family", "metric", "iters_per_sec",
+"platform", ...}. Families: CCSC_FAMILIES env (comma list, default all).
+Sizes are chosen to exercise the real geometry at single-chip scale;
+each timed region is fenced by a scalar readback (axon
+block_until_ready is a no-op).
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import jax
+import jax.numpy as jnp
+
+
+def out(d):
+    d["platform"] = jax.devices()[0].platform
+    print(json.dumps(d), flush=True)
+
+
+def bench_hs():
+    """Masked hyperspectral learner: k=100 filters 11x11x31, n=2 cubes
+    96^2 x 31 (learn_hyperspectral.m protocol: max_it_d=max_it_z=10)."""
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+    from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
+
+    n, side, bands, k = 2, 96, 31, 100
+    iters = int(os.environ.get("CCSC_FAMILY_ITERS", 3))
+    b = jax.random.uniform(
+        jax.random.PRNGKey(0), (n, bands, side, side), jnp.float32
+    )
+    geom = ProblemGeom((11, 11), k, (bands,))
+    cfg = LearnConfig(
+        max_it=iters, max_it_d=10, max_it_z=10, tol=0.0, verbose="none"
+    )
+    t0 = time.perf_counter()
+    res = learn_masked(b, geom, cfg)
+    dt = time.perf_counter() - t0
+    solver_t = res.trace["tim_vals"][-1]
+    ips = iters / solver_t if solver_t > 0 else iters / dt
+    out(
+        {
+            "family": "hs_masked_learner",
+            "metric": f"outer iters/sec (k={k} 11x11x{bands}, n={n}x{side}^2)",
+            "iters_per_sec": round(ips, 4),
+            "wall_s": round(dt, 1),
+        }
+    )
+
+
+def bench_3d():
+    """3D video consensus learner: k=49 11^3 filters, n=8 volumes 50^3,
+    4 blocks (learn_kernels_3D.m geometry at single-chip scale)."""
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+    from ccsc_code_iccv2017_tpu.models import common, learn as learn_mod
+    from ccsc_code_iccv2017_tpu.parallel import consensus
+    from ccsc_code_iccv2017_tpu.utils import perfmodel
+
+    blocks, ni, side, k = 4, 2, 50, 49
+    iters = int(os.environ.get("CCSC_FAMILY_ITERS", 3))
+    geom = ProblemGeom((11, 11, 11), k)
+    cfg = LearnConfig(
+        max_it=iters, max_it_d=5, max_it_z=10, num_blocks=blocks,
+        rho_d=5000.0, rho_z=1.0, verbose="none",
+    )
+    fg = common.FreqGeom.create(geom, (side, side, side))
+    state = learn_mod.init_state(jax.random.PRNGKey(0), geom, fg, blocks, ni)
+    b_blocks = jax.random.normal(
+        jax.random.PRNGKey(1), (blocks, ni, side, side, side), jnp.float32
+    )
+    step = consensus.make_outer_step(geom, cfg, fg, mesh=None)
+    try:
+        compiled = step.lower(state, b_blocks).compile()
+    except Exception:
+        compiled = step
+    s1, m0 = compiled(state, b_blocks)
+    float(m0.d_diff)
+    t0 = time.perf_counter()
+    cur = s1
+    for _ in range(iters):
+        cur, m = compiled(cur, b_blocks)
+    float(m.d_diff)
+    dt = time.perf_counter() - t0
+    rec = {
+        "family": "3d_consensus_learner",
+        "metric": f"outer iters/sec (k={k} 11^3, n={blocks * ni}x{side}^3, "
+        f"{blocks} blocks)",
+        "iters_per_sec": round(iters / dt, 4),
+    }
+    cost = (
+        perfmodel.compiled_cost(compiled) if compiled is not step else None
+    )
+    if cost:
+        u = perfmodel.utilization(cost, iters / dt)
+        rec.update(
+            mfu=round(u["mfu_vs_bf16_peak"], 5),
+            hbm_frac=round(u["hbm_frac"], 4),
+        )
+    out(rec)
+
+
+def _bench_recon(family, geom, k_shape, side, reduce_shape, lam_res):
+    """Shared reconstruction timing: fixed trip count (tol=0), one
+    warm call for compile, then timed calls."""
+    from ccsc_code_iccv2017_tpu.config import SolveConfig
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+        reconstruct,
+    )
+
+    max_it = int(os.environ.get("CCSC_FAMILY_RECON_ITERS", 40))
+    d = jax.random.normal(jax.random.PRNGKey(2), k_shape, jnp.float32)
+    d = d / jnp.sqrt(
+        jnp.sum(d * d, axis=tuple(range(1, d.ndim)), keepdims=True)
+    )
+    b = jax.random.uniform(
+        jax.random.PRNGKey(3), (1, *reduce_shape, side, side), jnp.float32
+    )
+    mask = (
+        jax.random.uniform(jax.random.PRNGKey(4), b.shape) > 0.7
+    ).astype(jnp.float32)
+    prob = ReconstructionProblem(geom, pad=False)
+    cfg = SolveConfig(
+        lambda_residual=lam_res, lambda_prior=1.0, max_it=max_it,
+        tol=0.0, verbose="none",
+    )
+    r = reconstruct(b * mask, d, prob, cfg, mask=mask)  # compile + run
+    float(jnp.sum(r.recon))
+    t0 = time.perf_counter()
+    r = reconstruct(b * mask, d, prob, cfg, mask=mask)
+    float(jnp.sum(r.recon))
+    dt = time.perf_counter() - t0
+    out(
+        {
+            "family": family,
+            "metric": f"ADMM iters/sec (k={k_shape[0]}, {side}^2, "
+            f"W={int(jnp.prod(jnp.array(reduce_shape)) if reduce_shape else 1)}, "
+            f"max_it={max_it})",
+            "iters_per_sec": round(max_it / dt, 4),
+        }
+    )
+
+
+def bench_demosaic():
+    from ccsc_code_iccv2017_tpu.config import ProblemGeom
+
+    bands = 31
+    _bench_recon(
+        "demosaic_recon",
+        ProblemGeom((11, 11), 100, (bands,)),
+        (100, bands, 11, 11),
+        96,
+        (bands,),
+        100000.0,
+    )
+
+
+def bench_viewsynth():
+    from ccsc_code_iccv2017_tpu.config import ProblemGeom
+
+    _bench_recon(
+        "viewsynth_recon",
+        ProblemGeom((11, 11), 49, (5, 5)),
+        (49, 5, 5, 11, 11),
+        96,
+        (5, 5),
+        10000.0,
+    )
+
+
+FAMILIES = {
+    "hs": bench_hs,
+    "3d": bench_3d,
+    "demosaic": bench_demosaic,
+    "viewsynth": bench_viewsynth,
+}
+
+
+def main():
+    names = os.environ.get("CCSC_FAMILIES", ",".join(FAMILIES)).split(",")
+    for name in names:
+        name = name.strip()
+        if name:
+            FAMILIES[name]()
+
+
+if __name__ == "__main__":
+    main()

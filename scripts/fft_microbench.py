@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""On-chip FFT strategy microbenchmark (round-4 utilization work).
+
+The north-star bench's hot loop moves the [8, 16, 100, 110, 110] code
+tensor through rfft2/irfft2 every inner z-iteration. 110 = 2*5*11 is
+not a friendly FFT size on TPU; this script times, at bench shapes:
+
+  a) rfft2/irfft2 at the reference padding (110^2),
+  b) the same at the next power of two (128^2),
+  c) a DFT-as-matmul pair (two complex matmuls per axis) at 110^2 —
+     the MXU route that avoids FFT codegen entirely,
+  d) the elementwise soft-threshold pass for a bandwidth roofline
+     reference point.
+
+Each timed op is jitted with a scalar readback fence (axon
+block_until_ready is a no-op). Prints one JSON dict per variant.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(name, fn, *args, reps=5):
+    gj = jax.jit(fn)
+    out = gj(*args)
+    float(out[1] if isinstance(out, tuple) else out)  # compile+fence
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = gj(*args)
+    float(out[1] if isinstance(out, tuple) else out)
+    dt = (time.perf_counter() - t0) / reps
+    print(json.dumps({"op": name, "ms": round(dt * 1e3, 3)}), flush=True)
+    return dt
+
+
+def dft_mats(n, dtype=jnp.complex64):
+    w = np.exp(-2j * np.pi * np.outer(np.arange(n), np.arange(n)) / n)
+    return jnp.asarray(w, dtype), jnp.asarray(np.conj(w) / n, dtype)
+
+
+def main():
+    L = int(os.environ.get("MB_BLOCKS", 8))
+    NI = int(os.environ.get("MB_NI", 16))
+    K = int(os.environ.get("MB_K", 100))
+    S = int(os.environ.get("MB_SIZE", 110))
+    S2 = int(os.environ.get("MB_SIZE_FAST", 128))
+    reps = int(os.environ.get("MB_REPS", 5))
+    print(
+        json.dumps(
+            {
+                "shape": [L, NI, K, S, S],
+                "fast": S2,
+                "platform": jax.devices()[0].platform,
+            }
+        ),
+        flush=True,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (L, NI, K, S, S), jnp.float32)
+    x2 = jax.random.normal(
+        jax.random.PRNGKey(0), (L, NI, K, S2, S2), jnp.float32
+    )
+
+    # a) rfft2 + irfft2 roundtrip at 110
+    def rt(a):
+        h = jnp.fft.rfftn(a, axes=(-2, -1))
+        b = jnp.fft.irfftn(h, s=a.shape[-2:], axes=(-2, -1))
+        return b, b.ravel()[0]
+
+    timed(f"rfft2+irfft2 {S}", rt, x, reps=reps)
+    # b) same at 128
+    timed(f"rfft2+irfft2 {S2}", rt, x2, reps=reps)
+
+    # forward only
+    def fwd(a):
+        h = jnp.fft.rfftn(a, axes=(-2, -1))
+        return h, jnp.real(h).ravel()[0]
+
+    timed(f"rfft2 {S}", fwd, x, reps=reps)
+    timed(f"rfft2 {S2}", fwd, x2, reps=reps)
+
+    # c) DFT-as-matmul roundtrip at 110 (full complex, both axes)
+    W, Winv = dft_mats(S)
+
+    def mm_rt(a):
+        ac = a.astype(jnp.complex64)
+        h = jnp.einsum("...xy,xu,yv->...uv", ac, W, W)
+        b = jnp.real(jnp.einsum("...uv,ux,vy->...xy", h, Winv, Winv))
+        return b, b.ravel()[0]
+
+    timed(f"dft-matmul fwd+inv {S}", mm_rt, x, reps=reps)
+
+    # d) bandwidth reference: soft threshold (2 reads + 1 write-ish)
+    def st(a):
+        o = jnp.sign(a) * jnp.maximum(jnp.abs(a) - 0.1, 0.0)
+        return o, o.ravel()[0]
+
+    timed("soft_threshold", st, x, reps=reps)
+
+    # batched einsum reference at bench shape: the z-solve's k-reduction
+    dh = jax.random.normal(
+        jax.random.PRNGKey(1), (K, S * (S // 2 + 1)), jnp.complex64
+    )
+    zh = jax.random.normal(
+        jax.random.PRNGKey(2), (L, NI, K, S * (S // 2 + 1)), jnp.complex64
+    )
+
+    def ks(d, z):
+        o = jnp.einsum("kf,lnkf->lnf", d, z)
+        return o, jnp.real(o).ravel()[0]
+
+    timed("z-solve k-einsum", ks, dh, zh, reps=reps)
+
+
+if __name__ == "__main__":
+    main()

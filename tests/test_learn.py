@@ -250,3 +250,75 @@ def test_filter_mesh_reduce_geometry():
         res_mesh.trace["obj_vals_z"],
         rtol=1e-4,
     )
+
+
+def test_fft_pad_fast_domain():
+    """fft_pad rounds the FFT domain up (110-style sizes -> pow2) while
+    keeping the data at offset radius. At a size where padding is
+    already a power of two the result is bit-identical to 'none'; at an
+    awkward size the learner still converges and produces filters close
+    to the exact-domain run."""
+    r = np.random.default_rng(3)
+    geom = ProblemGeom((5, 5), 6)
+    cfg_kw = dict(
+        max_it=3, max_it_d=3, max_it_z=3, num_blocks=2,
+        rho_d=500.0, rho_z=10.0, lambda_prior=0.5,
+        verbose="none", track_objective=True,
+    )
+    # 12 + 2*2 = 16 = 2^4: fast domain == exact domain, identical run
+    b16 = r.normal(size=(4, 12, 12)).astype(np.float32)
+    r_none = learn(
+        jnp.asarray(b16), geom, LearnConfig(**cfg_kw),
+        key=jax.random.PRNGKey(0),
+    )
+    r_pow2 = learn(
+        jnp.asarray(b16), geom, LearnConfig(**cfg_kw, fft_pad="pow2"),
+        key=jax.random.PRNGKey(0),
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_none.d), np.asarray(r_pow2.d), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        r_none.trace["obj_vals_z"], r_pow2.trace["obj_vals_z"], rtol=1e-6
+    )
+    # 13 + 4 = 17 -> pow2 32: converges on the padded canvas
+    b17 = r.normal(size=(4, 13, 13)).astype(np.float32)
+    r_fast = learn(
+        jnp.asarray(b17), geom, LearnConfig(**cfg_kw, fft_pad="pow2"),
+        key=jax.random.PRNGKey(0),
+    )
+    assert r_fast.Dz.shape == (4, 13, 13)
+    assert r_fast.d.shape == (6, 5, 5)
+    obj = r_fast.trace["obj_vals_z"]
+    assert obj[-1] < obj[0]
+
+
+def test_bf16_storage_trajectory_close_to_f32():
+    """storage_dtype='bfloat16' keeps z/dual_z in bf16 (half the HBM
+    bytes of the dominant tensors) with all math in f32. The golden-2D
+    trajectory must track the f32 run closely — the stored iterate is
+    the only thing rounded."""
+    r = np.random.default_rng(7)
+    b = r.normal(size=(4, 16, 16)).astype(np.float32)
+    geom = ProblemGeom((5, 5), 6)
+    kw = dict(
+        max_it=4, max_it_d=3, max_it_z=3, num_blocks=2,
+        rho_d=500.0, rho_z=10.0, lambda_prior=0.5,
+        verbose="none", track_objective=True,
+    )
+    r32 = learn(
+        jnp.asarray(b), geom, LearnConfig(**kw),
+        key=jax.random.PRNGKey(42),
+    )
+    r16 = learn(
+        jnp.asarray(b), geom,
+        LearnConfig(**kw, storage_dtype="bfloat16"),
+        key=jax.random.PRNGKey(42),
+    )
+    assert r16.z.dtype == jnp.bfloat16
+    o32 = np.asarray(r32.trace["obj_vals_z"], np.float64)
+    o16 = np.asarray(r16.trace["obj_vals_z"], np.float64)
+    dev = np.max(np.abs(o32 - o16) / np.abs(o32))
+    assert dev < 0.02, f"bf16 trajectory deviates {dev:.3%}"
+    d_err = np.max(np.abs(np.asarray(r32.d) - np.asarray(r16.d, np.float32)))
+    assert d_err < 0.05 * np.abs(np.asarray(r32.d)).max()

@@ -215,3 +215,38 @@ def test_solve_d_exact_vs_dense(W):
             rhs = np.conj(Z.T) @ bhat[:, w, f] + rho * xi[:, w, f]
             ref = np.linalg.solve(lhs, rhs)
             np.testing.assert_allclose(x[:, w, f], ref, rtol=2e-3, atol=2e-3)
+
+
+def test_next_fast_size():
+    from ccsc_code_iccv2017_tpu.ops.fourier import next_fast_size
+
+    assert next_fast_size(110, "none") == 110
+    assert next_fast_size(110, "pow2") == 128
+    assert next_fast_size(110, "fast") == 120  # 2^3 * 3 * 5
+    assert next_fast_size(128, "pow2") == 128
+    assert next_fast_size(128, "fast") == 128
+    assert next_fast_size(17, "fast") == 18
+    for n in range(2, 200):
+        f = next_fast_size(n, "fast")
+        assert f >= n
+        m = f
+        for p in (2, 3, 5):
+            while m % p == 0:
+                m //= p
+        assert m == 1, (n, f)
+        assert next_fast_size(n, "pow2") >= n
+
+
+def test_pad_crop_with_fast_target():
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.ops import fourier
+
+    x = np.arange(2 * 13 * 13, dtype=np.float32).reshape(2, 13, 13)
+    p = fourier.pad_spatial(jnp.asarray(x), (2, 2), target=(32, 32))
+    assert p.shape == (2, 32, 32)
+    # data sits at offset radius; everything else zero
+    np.testing.assert_array_equal(np.asarray(p[:, 2:15, 2:15]), x)
+    assert float(jnp.abs(p).sum()) == float(jnp.abs(jnp.asarray(x)).sum())
+    back = fourier.crop_spatial(p, (2, 2), out_spatial=(13, 13))
+    np.testing.assert_array_equal(np.asarray(back), x)

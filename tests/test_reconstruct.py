@@ -418,3 +418,39 @@ def test_trace_gating_matches_tracked_run():
     assert float(np.asarray(on.trace.obj_vals)[1]) > 0.0
     assert float(np.asarray(on.trace.psnr_vals)[1]) > 0.0
     np.testing.assert_allclose(np.asarray(off2.z), np.asarray(off.z))
+
+
+def test_fft_pad_fast_reconstruction():
+    """fft_pad on the coding solver: identical when the padded size is
+    already fast; close (boundary-only differences) when the canvas
+    grows."""
+    x = _toy_image(size=28, seed=21)  # 28 + 8 = 36 -> pow2 64 grows
+    r = np.random.default_rng(22)
+    mask = (r.random(x.shape) < 0.5).astype(np.float32)
+    d = _toy_dictionary()
+    geom = ProblemGeom((5, 5), 8)
+    base = dict(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=25, tol=0.0,
+        verbose="none",
+    )
+    run = lambda cfg: reconstruct(
+        jnp.asarray((x * mask)[None]), d, ReconstructionProblem(geom),
+        cfg, mask=jnp.asarray(mask[None]),
+    )
+    r_none = run(SolveConfig(**base))
+    r_pow2 = run(SolveConfig(**base, fft_pad="pow2"))
+    assert r_pow2.recon.shape == r_none.recon.shape
+    # same solve on a larger circular canvas: interior agrees closely
+    err = np.abs(np.asarray(r_pow2.recon) - np.asarray(r_none.recon))
+    scale = np.abs(np.asarray(r_none.recon)).max()
+    assert err.max() / scale < 0.05, err.max() / scale
+    # unpadded problems (pure circular boundary) must refuse to grow
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="fft_pad"):
+        run_np = reconstruct(
+            jnp.asarray((x * mask)[None]), d,
+            ReconstructionProblem(geom, pad=False),
+            SolveConfig(**base, fft_pad="pow2"),
+            mask=jnp.asarray(mask[None]),
+        )
