@@ -15,3 +15,13 @@ learn_4d                  4D/learn_kernels_4D.m
 view_synthesis            4D/ViewSynthesis/reconstruct_subsampling_lightfield.m
 ========================  =========================================
 """
+
+# Re-assert JAX_PLATFORMS before any app initializes a backend: the
+# TPU image's sitecustomize overrides the env var for every process,
+# so without this a `JAX_PLATFORMS=cpu python -m ...apps.learn_2d`
+# would still dial the TPU tunnel (utils.platform docstring). Importing
+# any app module imports this package first, so the hook runs early.
+from ..utils.platform import honor_jax_platforms_env as _honor
+
+_honor()
+del _honor

@@ -32,6 +32,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lambda-residual", type=float, default=5.0)
     p.add_argument("--lambda-prior", type=float, default=2.0)
     p.add_argument("--max-it", type=int, default=100)
+    p.add_argument(
+        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
+        help="round the FFT domain up to a TPU-friendly size",
+    )
     p.add_argument("--tol", type=float, default=1e-3)
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--size", type=int, default=None)
@@ -71,6 +75,7 @@ def main(argv=None):
         lambda_prior=args.lambda_prior,
         max_it=args.max_it,
         tol=args.tol,
+        fft_pad=args.fft_pad,
     )
     res = reconstruct(
         jnp.asarray(b * mask),

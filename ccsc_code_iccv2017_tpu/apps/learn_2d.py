@@ -56,6 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="host-streaming mode: one consensus block on device at a "
         "time (bounded HBM; parallel.streaming)",
     )
+    p.add_argument(
+        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
+        help="round the FFT domain up to a TPU-friendly size",
+    )
+    p.add_argument(
+        "--storage-dtype", default="float32",
+        choices=["float32", "bfloat16"],
+        help="storage dtype of the code state (bf16 halves HBM)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--verbose", default="brief")
     return p
@@ -96,6 +105,8 @@ def main(argv=None):
         rho_z=args.rho_z,
         num_blocks=args.blocks,
         verbose=args.verbose,
+        fft_pad=args.fft_pad,
+        storage_dtype=args.storage_dtype,
     )
     mesh = block_mesh(args.mesh) if args.mesh else None
     init_d = (

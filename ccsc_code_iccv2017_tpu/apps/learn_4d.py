@@ -39,6 +39,15 @@ def build_parser() -> argparse.ArgumentParser:
         "time (bounded HBM; parallel.streaming)",
     )
     p.add_argument("--out", default="4d_filters_lightfield.mat")
+    p.add_argument(
+        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
+        help="round the FFT domain up to a TPU-friendly size",
+    )
+    p.add_argument(
+        "--storage-dtype", default="float32",
+        choices=["float32", "bfloat16"],
+        help="storage dtype of the code state (bf16 halves HBM)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--verbose", default="brief")
     return p
@@ -88,6 +97,8 @@ def main(argv=None):
         rho_z=args.rho_z,
         num_blocks=args.blocks,
         verbose=args.verbose,
+        fft_pad=args.fft_pad,
+        storage_dtype=args.storage_dtype,
     )
     from ._dispatch import dispatch_learn
 

@@ -41,6 +41,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--streaming-blocks", type=int, default=4)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=5)
+    p.add_argument(
+        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
+        help="round the FFT domain up to a TPU-friendly size",
+    )
+    p.add_argument(
+        "--storage-dtype", default="float32",
+        choices=["float32", "bfloat16"],
+        help="storage dtype of the code state (bf16 halves HBM)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--verbose", default="brief")
     return p
@@ -96,6 +105,8 @@ def main(argv=None):
         max_it_z=10,
         tol=args.tol,
         verbose=args.verbose,
+        fft_pad=args.fft_pad,
+        storage_dtype=args.storage_dtype,
     )
     init_d = (
         jnp.asarray(load_filters_hyperspectral(args.init))

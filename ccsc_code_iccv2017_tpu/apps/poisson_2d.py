@@ -31,6 +31,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lambda-prior", type=float, default=1.0)
     p.add_argument("--lambda-smooth", type=float, default=0.5)
     p.add_argument("--max-it", type=int, default=50)
+    p.add_argument(
+        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
+        help="round the FFT domain up to a TPU-friendly size",
+    )
     p.add_argument("--tol", type=float, default=1e-4)
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--size", type=int, default=None)
@@ -67,6 +71,7 @@ def main(argv=None):
         lambda_smooth=args.lambda_smooth,
         max_it=args.max_it,
         tol=args.tol,
+        fft_pad=args.fft_pad,
         gamma_factor=20.0,
         gamma_ratio=5.0,
     )

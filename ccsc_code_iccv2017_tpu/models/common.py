@@ -134,6 +134,8 @@ def rel_change(
     the norms are reduced across that mesh axis so every shard sees
     the GLOBAL metric (identical termination decisions).
     """
+    new = new.astype(jnp.float32)  # bf16-stored iterates: accumulate f32
+    old = old.astype(jnp.float32)
     num = jnp.sum((new - old) ** 2)
     den = jnp.sum(new**2)
     if axis_name is not None:

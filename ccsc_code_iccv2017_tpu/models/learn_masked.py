@@ -104,7 +104,13 @@ def _outer_step_impl(
         u, support, fg.spatial_shape
     )
 
+    # z/dual_z2 may be stored bf16 (LearnConfig.storage_dtype); all
+    # math runs f32 — only the stored iterate is rounded
+    sd = state.z.dtype
+    f32 = lambda x: x.astype(jnp.float32)
+
     def objective(z, dhat):
+        z = f32(z)
         zhat = common.codes_to_freq(z, fg)
         Dz = common.recon_from_freq(dhat, zhat, fg)
         r = M_pad * (Dz + smoothinit - b_pad)
@@ -112,7 +118,7 @@ def _outer_step_impl(
             z, cfg.lambda_prior
         )
 
-    zhat = common.codes_to_freq(state.z, fg)
+    zhat = common.codes_to_freq(f32(state.z), fg)
     zhat_l = fslice(zhat)
 
     # ------------------ d-pass (:102-136) ---------------------------
@@ -155,7 +161,7 @@ def _outer_step_impl(
     zkern = freq_solvers.precompute_z_kernel(fslice(dhat), rho_z)
 
     def z_iter(carry, _):
-        z, du1, du2 = carry
+        z, du1, du2 = f32(carry[0]), carry[1], f32(carry[2])
         zh = common.codes_to_freq(z, fg)
         v1 = common.recon_from_freq(dhat, zh, fg)
         u1 = proxes.masked_quadratic_prox(
@@ -172,7 +178,7 @@ def _outer_step_impl(
             )
         )
         z_new = common.codes_from_freq(zhat_new, fg)
-        return (z_new, du1, du2), None
+        return (z_new.astype(sd), du1, du2.astype(sd)), None
 
     (z, dual_z1, dual_z2), _ = jax.lax.scan(
         z_iter,
@@ -236,6 +242,7 @@ def hbm_estimate(
     dtype_bytes: int = 4,
     num_freq_shards: int = 1,
     fg: Optional[common.FreqGeom] = None,
+    z_dtype_bytes: Optional[int] = None,
 ) -> dict:
     """Analytic peak-HBM estimate (bytes) for one learn_masked step.
 
@@ -264,12 +271,13 @@ def hbm_estimate(
     k = geom.num_filters
     cplx = 2 * dtype_bytes
     Fl = F // max(1, num_freq_shards)
+    # z/dual_z2 may be stored bf16 (LearnConfig.storage_dtype)
+    zb = z_dtype_bytes if z_dtype_bytes is not None else dtype_bytes
 
     state = (
         2 * k * W * S  # d_full + kernel-side dual
-        + 2 * n * k * S  # z + sparsity-side dual
         + 2 * n * W * S  # two data-side duals
-    ) * dtype_bytes
+    ) * dtype_bytes + 2 * n * k * S * zb  # z + sparsity-side dual
     data = 5 * n * W * S * dtype_bytes  # b_pad, M_pad, smoothinit, Mtb, MtM
     # z-pass live spectra: zhat-new, xi1, xi2 (+ the z-kernel)
     spectra = (2 * n * k * Fl + n * W * Fl + k * W * Fl) * cplx
@@ -285,10 +293,14 @@ def hbm_estimate(
     }
 
 
-def _preflight_hbm(geom, data_spatial_shape, n, num_freq_shards=1, fg=None):
+def _preflight_hbm(
+    geom, data_spatial_shape, n, num_freq_shards=1, fg=None,
+    z_dtype_bytes=None,
+):
     """Warn before compiling a step that cannot fit device memory."""
     est = hbm_estimate(
-        geom, data_spatial_shape, n, num_freq_shards=num_freq_shards, fg=fg
+        geom, data_spatial_shape, n, num_freq_shards=num_freq_shards, fg=fg,
+        z_dtype_bytes=z_dtype_bytes,
     )
     try:
         stats = jax.devices()[0].memory_stats() or {}
@@ -345,27 +357,26 @@ def learn_masked(
             "compat_coding is only supported by the consensus learner "
             "(models.learn)"
         )
-    if cfg.fft_pad != "none":
-        raise ValueError(
-            "fft_pad is not yet supported by the masked learner"
-        )
-    if cfg.storage_dtype != "float32":
-        raise ValueError(
-            "storage_dtype is not yet supported by the masked learner"
-        )
-    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:])
+    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:], fft_pad=cfg.fft_pad)
     _preflight_hbm(
         geom,
         b.shape[-ndim_s:],
         n,
         num_freq_shards=mesh.shape.get("freq", 1) if mesh is not None else 1,
         fg=fg,
+        z_dtype_bytes=jnp.dtype(cfg.storage_dtype).itemsize,
     )
 
-    b_pad = fourier.pad_spatial(b, radius)
-    M_pad = fourier.pad_spatial(jnp.ones_like(b), radius)
+    b_pad = fourier.pad_spatial(b, radius, target=fg.spatial_shape)
+    # the mask is zero over ALL padding (incl. any fast-FFT extra), so
+    # the masked data prox automatically excludes it (admm_learn.m:255)
+    M_pad = fourier.pad_spatial(
+        jnp.ones_like(b), radius, target=fg.spatial_shape
+    )
     smoothinit = (
-        fourier.pad_spatial(smooth_init, radius, mode="symmetric")
+        fourier.pad_spatial(
+            smooth_init, radius, mode="symmetric", target=fg.spatial_shape
+        )
         if smooth_init is not None
         else jnp.zeros_like(b_pad)
     )
@@ -387,9 +398,13 @@ def learn_masked(
         )
         d_full = fourier.circ_embed(d0, fg.spatial_shape)
 
+    # code state (z + sparsity dual, the biggest tensors) may be stored
+    # bf16 (LearnConfig.storage_dtype); drawn f32 then rounded so the
+    # bf16 run starts from the same init
+    sd = jnp.dtype(cfg.storage_dtype)
     z0 = jax.random.normal(
         kz, (n, geom.num_filters, *fg.spatial_shape), b.dtype
-    )
+    ).astype(sd)
     x_shape = (n, *geom.reduce_shape, *fg.spatial_shape)
     state = MaskedLearnState(
         d_full,
@@ -496,9 +511,9 @@ def learn_masked(
     d_proj = proxes.kernel_constraint_proj(
         state.d_full, geom.spatial_support, fg.spatial_shape
     )
-    zhat = common.codes_to_freq(state.z, fg)
+    zhat = common.codes_to_freq(state.z.astype(jnp.float32), fg)
     Dz = common.recon_from_freq(dhat, zhat, fg) + smoothinit
-    Dz = fourier.crop_spatial(Dz, radius)
+    Dz = fourier.crop_spatial(Dz, radius, b.shape[-ndim_s:])
     return LearnResult(
         extract_filters(d_proj, geom), state.z[None], Dz, trace
     )

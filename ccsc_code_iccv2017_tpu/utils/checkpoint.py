@@ -20,10 +20,25 @@ import numpy as np
 
 def save(path_dir: str, state, trace: dict, it: int) -> str:
     """Atomically snapshot ``state`` (a models.learn.LearnState) at
-    outer iteration ``it``."""
+    outer iteration ``it``.
+
+    bfloat16 fields (LearnConfig.storage_dtype) are stored as their
+    uint16 bit pattern with a dtype sidecar — np.savez accepts an
+    ml_dtypes bfloat16 array but np.load hands it back as a void
+    '|V2' dtype, which would crash the resumed run."""
     os.makedirs(path_dir, exist_ok=True)
-    payload = {f: np.asarray(getattr(state, f)) for f in state._fields}
+    payload = {}
+    dtypes = {}
+    for f in state._fields:
+        a = np.asarray(getattr(state, f))
+        if a.dtype.name == "bfloat16":
+            dtypes[f] = "bfloat16"
+            a = a.view(np.uint16)
+        payload[f] = a
     payload["__iteration__"] = np.asarray(it)
+    payload["__bf16_fields__"] = np.asarray(
+        json.dumps(sorted(dtypes)).encode()
+    )
     fd, tmp = tempfile.mkstemp(dir=path_dir, suffix=".npz.tmp")
     os.close(fd)
     with open(tmp, "wb") as f:
@@ -41,8 +56,19 @@ def load(path_dir: str):
     if not os.path.exists(final):
         return None
     with np.load(final) as z:
-        fields = {k: z[k] for k in z.files if k != "__iteration__"}
+        meta = {"__iteration__", "__bf16_fields__"}
+        fields = {k: z[k] for k in z.files if k not in meta}
         it = int(z["__iteration__"])
+        bf16 = (
+            json.loads(bytes(z["__bf16_fields__"]).decode())
+            if "__bf16_fields__" in z.files
+            else []
+        )
+    if bf16:
+        import ml_dtypes
+
+        for k in bf16:
+            fields[k] = fields[k].view(ml_dtypes.bfloat16)
     trace_path = os.path.join(path_dir, "trace.json")
     trace = None
     if os.path.exists(trace_path):

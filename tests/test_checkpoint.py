@@ -75,3 +75,34 @@ def test_masked_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(
         resumed.trace["obj_vals_z"], full.trace["obj_vals_z"], rtol=1e-4
     )
+
+
+def test_checkpoint_roundtrip_bf16_state():
+    """bf16-stored code state survives save/load: np.load returns raw
+    '|V2' for ml_dtypes arrays, so the checkpoint stores the uint16 bit
+    pattern with a dtype sidecar and restores bfloat16 exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+    from ccsc_code_iccv2017_tpu.models.learn import learn
+
+    import tempfile
+
+    r = np.random.default_rng(3)
+    b = r.normal(size=(4, 12, 12)).astype(np.float32)
+    geom = ProblemGeom((3, 3), 4)
+    kw = dict(max_it=2, max_it_d=2, max_it_z=2, num_blocks=2,
+              verbose="none", storage_dtype="bfloat16")
+    with tempfile.TemporaryDirectory() as td:
+        r1 = learn(jnp.asarray(b), geom, LearnConfig(**kw),
+                   key=jax.random.PRNGKey(0), checkpoint_dir=td,
+                   checkpoint_every=1)
+        # resume from the mid-run snapshot: must restore bf16 and run
+        r2 = learn(jnp.asarray(b), geom,
+                   LearnConfig(**{**kw, "max_it": 3}),
+                   key=jax.random.PRNGKey(0), checkpoint_dir=td,
+                   checkpoint_every=1)
+    assert r2.z.dtype == jnp.bfloat16
+    assert len(r2.trace["obj_vals_z"]) >= len(r1.trace["obj_vals_z"])
