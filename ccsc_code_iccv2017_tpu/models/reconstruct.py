@@ -351,13 +351,12 @@ def _reconstruct_jit(
     def Dz_real(zhat, dhat):
         return common.recon_from_freq(dhat, zhat, fg)
 
-    def objective(z, zhat):
-        # gated like the learners' with_objective: each evaluation costs
-        # an extra Dz (two FFT passes) — material at the max_it=200
-        # demosaic/view-synth operating points
+    def objective(z, Dz):
+        # gated like the learners' with_objective; Dz is the ALREADY
+        # computed solve-side reconstruction of the iterate (it is also
+        # next iteration's v1), so tracking adds no extra Dz pass
         if not cfg.with_objective:
             return jnp.float32(0.0)
-        Dz = Dz_real(zhat, dhat_solve)
         r = fourier.crop_spatial(Dz + smoothinit, radius, data_spatial) - b
         r = fourier.crop_spatial(M_pad, radius, data_spatial) * r
         return (
@@ -365,19 +364,23 @@ def _reconstruct_jit(
             + cfg.lambda_prior * gsum(jnp.sum(jnp.abs(z)))
         )
 
-    def psnr_of(zhat):
+    def psnr_of(zhat, Dz_solve):
         if x_orig is None or not cfg.with_psnr:
             return jnp.float32(0.0)
-        Dz = Dz_real(zhat, dhat_clean) + smoothinit
-        rec = fourier.crop_spatial(Dz, radius, data_spatial)
+        # without a blur operator the clean and solve spectra coincide:
+        # reuse the carried reconstruction instead of a second Dz pass
+        Dz = (
+            Dz_solve
+            if blur_psf is None
+            else Dz_real(zhat, dhat_clean)
+        )
+        rec = fourier.crop_spatial(Dz + smoothinit, radius, data_spatial)
         return common.psnr(rec, x_orig, geom.psf_radius, axis_name)
 
     z_shape = (n, K, *fg.spatial_shape)
-    x_shape = (n, *geom.reduce_shape, *fg.spatial_shape)
 
     def body(state):
-        i, z, zhat, d1, d2, obj_t, psnr_t, diff_t, _ = state
-        v1 = Dz_real(zhat, dhat_solve)
+        i, z, zhat, v1, d1, d2, obj_t, psnr_t, diff_t, _ = state
         u1 = data_prox(v1 - d1)
         u2_raw = z - d2
         u2 = proxes.skip_channels(
@@ -393,11 +396,17 @@ def _reconstruct_jit(
             )
         )
         z_new = common.codes_from_freq(zhat_new, fg)
+        # the iterate's reconstruction: next iteration's v1 AND this
+        # iteration's objective/PSNR input — computed exactly once
+        v1_new = Dz_real(zhat_new, dhat_solve)
         diff = common.rel_change(z_new, z, axis_name)
-        obj_t = obj_t.at[i + 1].set(objective(z_new, zhat_new))
-        psnr_t = psnr_t.at[i + 1].set(psnr_of(zhat_new))
+        obj_t = obj_t.at[i + 1].set(objective(z_new, v1_new))
+        psnr_t = psnr_t.at[i + 1].set(psnr_of(zhat_new, v1_new))
         diff_t = diff_t.at[i + 1].set(diff)
-        return (i + 1, z_new, zhat_new, d1, d2, obj_t, psnr_t, diff_t, diff)
+        return (
+            i + 1, z_new, zhat_new, v1_new, d1, d2, obj_t, psnr_t,
+            diff_t, diff,
+        )
 
     def cond(state):
         i, *_, diff = state
@@ -405,14 +414,16 @@ def _reconstruct_jit(
 
     z0 = jnp.zeros(z_shape, b.dtype)
     zhat0 = common.codes_to_freq(z0, fg)
-    obj_t = jnp.zeros(cfg.max_it + 1).at[0].set(objective(z0, zhat0))
-    psnr_t = jnp.zeros(cfg.max_it + 1).at[0].set(psnr_of(zhat0))
+    v10 = Dz_real(zhat0, dhat_solve)
+    obj_t = jnp.zeros(cfg.max_it + 1).at[0].set(objective(z0, v10))
+    psnr_t = jnp.zeros(cfg.max_it + 1).at[0].set(psnr_of(zhat0, v10))
     diff_t = jnp.zeros(cfg.max_it + 1)
     state = (
         jnp.int32(0),
         z0,
         zhat0,
-        jnp.zeros(x_shape, b.dtype),
+        v10,
+        jnp.zeros_like(v10),
         jnp.zeros(z_shape, b.dtype),
         obj_t,
         psnr_t,

@@ -42,16 +42,23 @@ from ..ops import fourier, freq_solvers, proxes
 
 def _jit_pieces(geom: ProblemGeom, cfg: LearnConfig, fg: common.FreqGeom):
     support = geom.spatial_support
+    # code state may be stored bf16 (LearnConfig.storage_dtype): halves
+    # both host RAM and the PCIe streaming traffic that dominates this
+    # path's cost model; all math runs f32
+    f32 = lambda x: x.astype(jnp.float32)
 
     @jax.jit
     def f_bhat(b_nn):
         return common.data_to_freq(
-            fourier.pad_spatial(b_nn, geom.psf_radius), fg
+            fourier.pad_spatial(
+                b_nn, geom.psf_radius, target=fg.spatial_shape
+            ),
+            fg,
         )
 
     @jax.jit
     def f_dkern(z_nn):
-        zhat = common.codes_to_freq(z_nn, fg)
+        zhat = common.codes_to_freq(f32(z_nn), fg)
         return freq_solvers.precompute_d_kernel(zhat, cfg.rho_d)
 
     @jax.jit
@@ -70,11 +77,12 @@ def _jit_pieces(geom: ProblemGeom, cfg: LearnConfig, fg: common.FreqGeom):
 
     @jax.jit
     def f_z_block(z, dual_z, bhat_nn, dhat_z):
+        sd = z.dtype
         zkern = freq_solvers.precompute_z_kernel(dhat_z, cfg.rho_z)
         theta = cfg.lambda_prior / cfg.rho_z
 
         def z_iter(carry, _):
-            zc, du = carry
+            zc, du = f32(carry[0]), f32(carry[1])
             u2 = proxes.soft_threshold(zc + du, theta)
             du = du + (zc - u2)
             xi2_hat = common.codes_to_freq(u2 - du, fg)
@@ -82,7 +90,8 @@ def _jit_pieces(geom: ProblemGeom, cfg: LearnConfig, fg: common.FreqGeom):
                 zkern, bhat_nn, xi2_hat, cfg.rho_z,
                 use_pallas=cfg.use_pallas,
             )
-            return (common.codes_from_freq(zhat_new, fg), du), None
+            z_new = common.codes_from_freq(zhat_new, fg)
+            return (z_new.astype(sd), du.astype(sd)), None
 
         (z_new, dual_new), _ = jax.lax.scan(
             z_iter, (z, dual_z), None, length=cfg.max_it_z
@@ -95,6 +104,7 @@ def _jit_pieces(geom: ProblemGeom, cfg: LearnConfig, fg: common.FreqGeom):
 
     @jax.jit
     def f_obj_block(z_nn, b_nn, dhat):
+        z_nn = f32(z_nn)
         zhat = common.codes_to_freq(z_nn, fg)
         Dz = common.recon_from_freq(dhat, zhat, fg)
         return common.data_fidelity(
@@ -124,25 +134,21 @@ def learn_streaming(
             "compat_coding is only supported by the in-memory consensus "
             "learner (models.learn)"
         )
-    if cfg.fft_pad != "none":
-        raise ValueError(
-            "fft_pad is not yet supported by the streaming learner"
-        )
-    if cfg.storage_dtype != "float32":
-        raise ValueError(
-            "storage_dtype is not yet supported by the streaming learner"
-        )
     if n % N:
         raise ValueError(f"n={n} not divisible by num_blocks={N}")
     ni = n // N
-    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:])
+    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:], fft_pad=cfg.fft_pad)
     b_blocks = np.asarray(b, np.float32).reshape(N, ni, *b.shape[1:])
 
     if key is None:
         key = jax.random.PRNGKey(0)
     # identical init to models.learn.init_state (shared across blocks /
-    # independent z per block), pulled to host
-    state0 = learn_mod.init_state(key, geom, fg, N, ni, jnp.float32)
+    # independent z per block), pulled to host; bf16 storage halves
+    # both the host-resident z/dual buffers and their PCIe streaming
+    state0 = learn_mod.init_state(
+        key, geom, fg, N, ni, jnp.float32,
+        z_dtype=jnp.dtype(cfg.storage_dtype),
+    )
     # np.array (copy): host buffers are mutated block-by-block below
     d_local = np.array(state0.d_local)
     dual_d = np.array(state0.dual_d)
@@ -222,8 +228,11 @@ def learn_streaming(
                 jnp.asarray(z[nn]), jnp.asarray(dual_z[nn]), bhat_nn, dhat_z
             )
             z_new_h = np.asarray(z_new)
-            num += float(np.sum((z_new_h - z[nn]) ** 2))
-            den += float(np.sum(z_new_h * z_new_h))
+            # bf16-safe accumulation; copy=False keeps f32 copy-free
+            zf_new = z_new_h.astype(np.float32, copy=False)
+            zf_old = z[nn].astype(np.float32, copy=False)
+            num += float(np.sum((zf_new - zf_old) ** 2))
+            den += float(np.sum(zf_new * zf_new))
             z[nn] = z_new_h
             dual_z[nn] = np.asarray(du_new)
             if cfg.with_objective:
@@ -253,9 +262,11 @@ def learn_streaming(
 
     @jax.jit
     def f_dz_block(z_nn):
-        zhat = common.codes_to_freq(z_nn, fg)
+        zhat = common.codes_to_freq(z_nn.astype(jnp.float32), fg)
         full = common.recon_from_freq(dhat_z, zhat, fg)
-        return fourier.crop_spatial(full, geom.psf_radius)
+        return fourier.crop_spatial(
+            full, geom.psf_radius, b.shape[-ndim_s:]
+        )
 
     for nn in range(N):
         Dz[nn] = np.asarray(f_dz_block(jnp.asarray(z[nn])))

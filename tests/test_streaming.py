@@ -155,3 +155,32 @@ def test_compat_coding_rejected_outside_consensus_learner():
         streaming.learn_streaming(b, geom, cfg)
     with pytest.raises(ValueError, match="compat_coding"):
         learn_masked(jnp.asarray(b), geom, cfg)
+
+
+def test_streaming_matches_in_memory_with_fft_pad_and_bf16():
+    """fft_pad + bf16 storage in the streaming learner: still matches
+    the in-memory learner configured the same way (same fast domain,
+    same rounded storage) — streaming stays an exact rearrangement."""
+    import dataclasses
+
+    geom, cfg, b = _problem()
+    cfg = dataclasses.replace(
+        cfg, fft_pad="pow2", storage_dtype="bfloat16"
+    )
+    res_s = streaming.learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(0))
+    res_m = learn_mod.learn(
+        jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0)
+    )
+    assert res_s.z.dtype == jnp.dtype(jnp.bfloat16)
+    assert np.asarray(res_m.z).dtype == res_s.z.dtype
+    np.testing.assert_allclose(
+        np.asarray(res_s.d), np.asarray(res_m.d), atol=5e-4
+    )
+    np.testing.assert_allclose(
+        res_s.trace["obj_vals_z"][1:],
+        res_m.trace["obj_vals_z"][1:],
+        rtol=2e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_s.Dz), np.asarray(res_m.Dz), atol=5e-3
+    )
