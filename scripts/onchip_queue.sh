@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-4 on-chip measurement queue (PERF.md "On-chip queue").
+#
+# Probes the axon TPU tunnel; the moment it answers, runs every queued
+# benchmark SERIALLY (the tunnel is single-client — see PERF.md's
+# tunnel-wedge protocol) and appends JSON lines to onchip_r4.jsonl.
+# Each step runs under `timeout`; bench.py additionally self-watchdogs
+# (CCSC_BENCH_TIMEOUT) with a CPU fallback we label and keep.
+set -u
+cd "$(dirname "$0")/.."
+OUT=onchip_r4.jsonl
+LOG=/tmp/onchip_queue.log
+
+probe() {
+  timeout 60 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform in ('tpu', 'axon')
+x = jnp.ones((128, 128)); float((x @ x).sum())
+" > /dev/null 2>&1
+}
+
+note() { echo "{\"note\": \"$1\", \"at\": \"$(date +%H:%M:%S)\"}" >> "$OUT"; }
+
+run_bench() { # label, env pairs...
+  local label=$1; shift
+  echo "=== $label $(date +%H:%M:%S)" >> "$LOG"
+  local line
+  line=$(env "$@" CCSC_BENCH_TIMEOUT=2400 timeout 5400 python bench.py 2>> "$LOG" | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"run\": \"$label\", \"result\": $line}" >> "$OUT"
+  else
+    note "$label FAILED/empty"
+  fi
+}
+
+while true; do
+  if probe; then
+    note "tunnel UP - starting queue"
+    run_bench baseline
+    run_bench pallas CCSC_BENCH_PALLAS=1
+    run_bench fftpad_pow2 CCSC_BENCH_FFTPAD=pow2
+    run_bench fftpad_fast CCSC_BENCH_FFTPAD=fast
+    run_bench bf16 CCSC_BENCH_STORAGE=bfloat16
+    run_bench fftpad_pow2_bf16 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=bfloat16
+    echo "=== microbench $(date +%H:%M:%S)" >> "$LOG"
+    timeout 3600 python scripts/fft_microbench.py >> "$OUT" 2>> "$LOG" \
+      || note "fft_microbench FAILED"
+    echo "=== families $(date +%H:%M:%S)" >> "$LOG"
+    timeout 5400 python scripts/family_bench.py >> "$OUT" 2>> "$LOG" \
+      || note "family_bench FAILED"
+    run_bench profile CCSC_BENCH_PROFILE=1
+    note "queue complete"
+    break
+  fi
+  echo "$(date +%H:%M:%S) tunnel down" >> "$LOG"
+  sleep 240
+done
