@@ -35,13 +35,57 @@ run_bench() { # label, env pairs...
 
 while true; do
   if probe; then
+    # rotate any previous generation's records: the arm picker below
+    # must only see THIS invocation's measurements
+    [ -f "$OUT" ] && mv "$OUT" "$OUT.$(date +%s).old"
     note "tunnel UP - starting queue"
-    run_bench baseline
-    run_bench pallas CCSC_BENCH_PALLAS=1
-    run_bench fftpad_pow2 CCSC_BENCH_FFTPAD=pow2
-    run_bench fftpad_fast CCSC_BENCH_FFTPAD=fast
-    run_bench bf16 CCSC_BENCH_STORAGE=bfloat16
-    run_bench fftpad_pow2_bf16 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=bfloat16
+    # pin the defaults during the A/Bs so a pre-existing
+    # bench_tuned.json can't contaminate the baseline arm
+    run_bench baseline CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32
+    run_bench pallas CCSC_BENCH_PALLAS=1 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32
+    run_bench fftpad_pow2 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=float32
+    run_bench fftpad_fast CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=fast CCSC_BENCH_STORAGE=float32
+    run_bench bf16 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=bfloat16
+    run_bench fftpad_pow2_bf16 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=bfloat16
+    # pick the fastest real-TPU arm and persist its knobs (read back
+    # from each record's own "knobs" field — single source of truth)
+    # as bench_tuned.json for future `python bench.py` runs; env still
+    # overrides. Requires a SUCCESSFUL baseline to compare against;
+    # otherwise (and when baseline wins) any stale tuned file is
+    # removed so defaults really are the defaults.
+    OUT="$OUT" python - <<'PYEOF' >> "$LOG" 2>&1
+import json
+import os
+
+DEFAULTS = {"fft_pad": "none", "storage_dtype": "float32",
+            "use_pallas": False}
+best, best_v, best_k, base_v = None, -1.0, {}, None
+for line in open(os.environ["OUT"]):
+    try:
+        rec = json.loads(line)
+    except Exception:
+        continue
+    res = rec.get("result") or {}
+    metric = res.get("metric", "")
+    v = float(res.get("value", 0.0))
+    if not rec.get("run") or "DEGRADED" in metric or "FAILED" in metric:
+        continue
+    if v <= 0:
+        continue
+    if rec["run"] == "baseline":
+        base_v = v if base_v is None else max(base_v, v)
+    if v > best_v:
+        best, best_v, best_k = rec["run"], v, res.get("knobs") or {}
+tuned = {k: v for k, v in best_k.items() if v != DEFAULTS.get(k)}
+if base_v is None or best in (None, "baseline") or best_v <= base_v or not tuned:
+    if os.path.exists("bench_tuned.json"):
+        os.remove("bench_tuned.json")
+    print(f"tuned: defaults (baseline={base_v}, best={best}@{best_v})")
+else:
+    with open("bench_tuned.json", "w") as f:
+        json.dump(tuned, f)
+    print(f"tuned: {best}@{best_v} it/s knobs={tuned}")
+PYEOF
     echo "=== microbench $(date +%H:%M:%S)" >> "$LOG"
     timeout 3600 python scripts/fft_microbench.py >> "$OUT" 2>> "$LOG" \
       || note "fft_microbench FAILED"
