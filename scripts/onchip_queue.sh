@@ -33,27 +33,14 @@ run_bench() { # label, env pairs...
   fi
 }
 
-while true; do
-  if probe; then
-    # rotate any previous generation's records: the arm picker below
-    # must only see THIS invocation's measurements
-    [ -f "$OUT" ] && mv "$OUT" "$OUT.$(date +%s).old"
-    note "tunnel UP - starting queue"
-    # pin the defaults during the A/Bs so a pre-existing
-    # bench_tuned.json can't contaminate the baseline arm
-    run_bench baseline CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32
-    run_bench pallas CCSC_BENCH_PALLAS=1 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32
-    run_bench fftpad_pow2 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=float32
-    run_bench fftpad_fast CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=fast CCSC_BENCH_STORAGE=float32
-    run_bench bf16 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=bfloat16
-    run_bench fftpad_pow2_bf16 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=bfloat16
-    # pick the fastest real-TPU arm and persist its knobs (read back
-    # from each record's own "knobs" field — single source of truth)
-    # as bench_tuned.json for future `python bench.py` runs; env still
-    # overrides. Requires a SUCCESSFUL baseline to compare against;
-    # otherwise (and when baseline wins) any stale tuned file is
-    # removed so defaults really are the defaults.
-    OUT="$OUT" python - <<'PYEOF' >> "$LOG" 2>&1
+# pick the fastest real-TPU arm measured SO FAR and persist its knobs
+# (read back from each record's own "knobs" field — single source of
+# truth) as bench_tuned.json for future `python bench.py` runs; env
+# still overrides. Requires a SUCCESSFUL baseline to compare against;
+# otherwise (and when baseline wins) any stale tuned file is removed
+# so defaults really are the defaults.
+pick() {
+  OUT="$OUT" python - <<'PYEOF' >> "$LOG" 2>&1
 import json
 import os
 
@@ -86,6 +73,30 @@ else:
         json.dump(tuned, f)
     print(f"tuned: {best}@{best_v} it/s knobs={tuned}")
 PYEOF
+}
+
+while true; do
+  if probe; then
+    # rotate any previous generation's records: the arm picker must
+    # only see THIS invocation's measurements
+    [ -f "$OUT" ] && mv "$OUT" "$OUT.$(date +%s).old"
+    note "tunnel UP - starting queue"
+    # pin the defaults during the A/Bs so a pre-existing
+    # bench_tuned.json can't contaminate the baseline arm. Arms run in
+    # expected-win order and the picker runs AFTER EVERY arm, so even
+    # a short tunnel window leaves a valid (partial) tuned config.
+    run_bench baseline CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32
+    pick
+    run_bench fftpad_pow2 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=float32
+    pick
+    run_bench fftpad_pow2_bf16 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=bfloat16
+    pick
+    run_bench bf16 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=bfloat16
+    pick
+    run_bench fftpad_fast CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=fast CCSC_BENCH_STORAGE=float32
+    pick
+    run_bench pallas CCSC_BENCH_PALLAS=1 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32
+    pick
     echo "=== microbench $(date +%H:%M:%S)" >> "$LOG"
     timeout 3600 python scripts/fft_microbench.py >> "$OUT" 2>> "$LOG" \
       || note "fft_microbench FAILED"
