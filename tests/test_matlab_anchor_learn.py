@@ -379,3 +379,74 @@ def test_learner_matches_matlab_transcription_dparallel_point():
     fw_d, fw_z = _run_framework(b, d0_full, z0, N, cfg)
     np.testing.assert_allclose(fw_d, ml_d[1:], rtol=2e-3)
     np.testing.assert_allclose(fw_z, ml_z[1:], rtol=2e-3)
+
+
+def test_block1_compat_sharded_matches_unsharded():
+    """compat_coding='block1' under a block mesh: block 1 lives on
+    device 0, so the coding dictionary is psum-broadcast from there
+    (models/learn.py outer_step); trajectories must equal the
+    unsharded run."""
+    b, d0_full, z0, r = _problem(seed=44)
+    N = 2
+    cfg = LearnConfig(
+        lambda_residual=1.0,
+        lambda_prior=1.0,
+        max_it=2,
+        tol=0.0,
+        max_it_d=3,
+        max_it_z=3,
+        rho_d=5000.0,
+        rho_z=1.0,
+        num_blocks=N,
+        verbose="none",
+        track_objective=True,
+        compat_coding="block1",
+    )
+    lo_d, lo_z = _run_framework(b, d0_full, z0, N, cfg)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ccsc_code_iccv2017_tpu.parallel import mesh as mesh_lib
+
+    H, _, n = b.shape
+    ni = n // N
+    k = d0_full.shape[2]
+    geom = ProblemGeom((2 * r + 1,) * 2, k)
+    fg = common.FreqGeom.create(geom, (H, H))
+    mesh = mesh_lib.block_mesh(N)
+    d_fw = jnp.asarray(np.moveaxis(d0_full, -1, 0), jnp.float32)
+    z_fw = jnp.asarray(
+        np.broadcast_to(
+            np.transpose(z0, (3, 2, 0, 1))[None],
+            (N, ni, k, *fg.spatial_shape),
+        ),
+        jnp.float32,
+    )
+    state = learn_mod.LearnState(
+        d_local=jnp.broadcast_to(d_fw, (N, *d_fw.shape)),
+        dual_d=jnp.zeros((N, *d_fw.shape), jnp.float32),
+        dbar=jnp.zeros_like(d_fw),
+        udbar=jnp.zeros_like(d_fw),
+        z=z_fw,
+        dual_z=jnp.zeros_like(z_fw),
+    )
+    specs = consensus._state_specs()
+    state = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state,
+        specs,
+    )
+    b_blocks = jax.device_put(
+        jnp.asarray(
+            np.transpose(b, (2, 0, 1)).reshape(N, ni, H, H), jnp.float32
+        ),
+        NamedSharding(mesh, P("block")),
+    )
+    step = consensus.make_outer_step(geom, cfg, fg, mesh)
+    sh_d, sh_z = [], []
+    for _ in range(cfg.max_it):
+        state, m = step(state, b_blocks)
+        sh_d.append(float(m.obj_d))
+        sh_z.append(float(m.obj_z))
+    np.testing.assert_allclose(sh_d, lo_d, rtol=2e-4)
+    np.testing.assert_allclose(sh_z, lo_z, rtol=2e-4)
