@@ -1,0 +1,310 @@
+"""MATLAB-anchored golden trajectory for the VIDEO DEBLUR SOLVER.
+
+Sixth anchor in the series: a LITERAL, line-ordered float64 NumPy
+transcription of 3D/Deblurring/admm_solve_video_weighted_sampling.m —
+the reconstruction solver whose distinguishing mechanism is OPERATOR
+COMPOSITION: the blur OTF multiplies every filter spectrum inside the
+solve (:124-132) while the final reconstruction uses the clean filter
+OTFs (:109), so coding "through" the blur deconvolves. Also anchored:
+the prepended dirac (:5-7, still sparsified — unlike the Poisson
+solver there is NO channel exemption), the symmetric-padded
+smooth_init offset subtracted in the data prox (:16, :117) and added
+back at the end (:109), the quadratic masked prox (:29), and the
+gamma heuristic 500*lambda/max(b) at ratio 1 (:36-37).
+
+The text contains TWO local deviations from its own intent, both
+parameterized so each can be anchored AND quantified:
+
+1. DIAGONAL SOLVE (``exact_solve``): solve_conv_term :155-156
+   computes x_k = b_k / (rho + sum_j |d_j|^2) — it drops the
+   Sherman-Morrison projection entirely (the correct rank-1 update
+   term is conj(d_k) * sum_j d_j b_j / (rho + sum|d|^2); compare the
+   inpainting solver's exact :170-190). The framework solves the
+   rank-1 system exactly; ``exact_solve=True`` swaps in the exact
+   closed form.
+
+2. RHO SCALE (``rho_literal``): :146,:149 set
+   rho = sw * gammas(2)/gammas(1) with sw = size(xi_hat{1},3) — the
+   PADDED TEMPORAL FFT LENGTH. The same line in the demosaic solver
+   (admm_solve_conv23D_weighted_sampling.m:126) scales by the
+   wavelength count to compensate its W-fold data-term sum; here the
+   temporal axis is an FFT dim (there is no reduce sum), so the
+   scaling is a copy-paste artifact that just rescales the ADMM
+   penalty by the clip length. The framework uses rho =
+   gamma_ratio (models/reconstruct.py DOCUMENTED DIVERGENCES (c));
+   ``rho_literal=False`` does the same.
+
+test_deblur_matches_matlab_exact_variant anchors the framework
+against the transcription with both deviations resolved to intent;
+the quantification test pins that the literal diagonal solve is a
+REAL divergence without anchoring to it.
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from ccsc_code_iccv2017_tpu.config import ProblemGeom, SolveConfig
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+    reconstruct,
+)
+
+AXES3 = (0, 1, 2)
+
+
+def fftn3(x):
+    return np.fft.fftn(x, axes=AXES3)
+
+
+def ifftn3(x):
+    return np.fft.ifftn(x, axes=AXES3)
+
+
+def psf2otf3(psf, size_x):
+    """MATLAB psf2otf in 3D: zero-pad, circshift the center to (1,1,1),
+    fftn (:124, :130-131)."""
+    full = np.zeros(size_x)
+    full[: psf.shape[0], : psf.shape[1], : psf.shape[2]] = psf
+    full = np.roll(
+        full,
+        tuple(-(s // 2) for s in psf.shape),
+        AXES3,
+    )
+    return fftn3(full)
+
+
+def prox_sparse(u, theta):
+    """ProxSparse = max(0, 1 - theta/|u|) .* u (:32)."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        f = np.where(np.abs(u) > 0, 1.0 - theta / np.abs(u), 0.0)
+    return np.maximum(0.0, f) * u
+
+
+def sympad3(x, r):
+    """padarray(x, psf_radius, 'symmetric', 'both') (:16); r is the
+    per-axis radius tuple."""
+    return np.pad(x, [(ri, ri) for ri in r], mode="symmetric")
+
+
+def matlab_deblur_solver(
+    b,
+    kmat,
+    mask,
+    psf,
+    smooth_init,
+    lam_res,
+    lam_pri,
+    max_it,
+    exact_solve=False,
+    rho_literal=True,
+):
+    """Transcription of admm_solve_video_weighted_sampling.m.
+    b, mask, smooth_init: [H, W, T] (one clip); kmat: [s, s, st, K];
+    psf: [3, 3, 3] blur. Returns (obj_vals [max_it + 1], final res)."""
+    s = kmat.shape[0]
+    st = kmat.shape[2]
+    # :5-7 — dirac PREPENDED
+    k_dirac = np.zeros((s, s, st))
+    k_dirac[s // 2, s // 2, st // 2] = 1.0
+    kmat = np.concatenate([k_dirac[..., None], kmat], axis=3)
+    K = kmat.shape[3]
+
+    r = (s // 2, s // 2, st // 2)  # :10
+    size_x = tuple(b.shape[i] + 2 * r[i] for i in range(3))  # :11
+    ss = int(np.prod(size_x))
+
+    # precompute_H_hat (:121-138): blur OTF times each filter OTF;
+    # clean OTFs kept for the final reconstruction
+    psf_hat = psf2otf3(psf, size_x)  # :124
+    dhat_k = np.stack(
+        [psf2otf3(kmat[..., i], size_x) for i in range(K)], axis=3
+    )  # :130
+    dhat = psf_hat[..., None] * dhat_k  # :131
+    dhat_flat = np.reshape(dhat, (ss, K), order="F")  # :135
+    dhatTdhat = np.sum(np.conj(dhat_flat) * dhat_flat, axis=1)  # :136
+    dhatT = np.conj(dhat_flat.T)  # [K, ss] (:13)
+
+    smoothinit = sympad3(smooth_init, r)  # :16
+
+    # precompute_MProx (:114-119)
+    M = np.zeros(size_x)
+    M[r[0] : r[0] + b.shape[0], r[1] : r[1] + b.shape[1],
+      r[2] : r[2] + b.shape[2]] = mask
+    B_pad = np.zeros(size_x)
+    B_pad[r[0] : r[0] + b.shape[0], r[1] : r[1] + b.shape[1],
+          r[2] : r[2] + b.shape[2]] = b
+    Mtb = B_pad * M - smoothinit * M  # :117
+
+    lam = (lam_res, lam_pri)  # :35
+    gamma_heuristic = 500.0 * lam_pri / np.max(b)  # :36
+    gamma = (gamma_heuristic, gamma_heuristic)  # :37
+
+    sw = size_x[2]  # :146 sw = size(xi_hat{1}, 3)
+    rho = (sw if rho_literal else 1.0) * gamma[1] / gamma[0]  # :149
+
+    def solve_conv_term(xi1_hat, xi2_hat):
+        """solve_conv_term (:140-161) in its [K, ss] layout; or the
+        exact Sherman-Morrison solve of the same rank-1 system."""
+        bb = dhatT * np.reshape(xi1_hat, (1, ss), order="F") + (
+            rho * np.reshape(xi2_hat, (ss, K), order="F").T
+        )  # :152
+        if exact_solve:
+            corr = np.sum(dhat_flat.T * bb, axis=0, keepdims=True)
+            x = bb / rho - (
+                dhatT * corr / (rho + dhatTdhat)[None, :] / rho
+            )
+        else:
+            scInverse = 1.0 / (rho + dhatTdhat)  # :155
+            x = bb / rho - (
+                (scInverse * dhatTdhat)[None, :] * bb / rho
+            )  # :156
+        return np.reshape(x.T, (*size_x, K), order="F")  # :159
+
+    def objective(zc):
+        """objectiveFunction (:163-178): BLURRED operator + smoothinit."""
+        zh = np.stack([fftn3(zc[..., i]) for i in range(K)], axis=3)
+        Dz = np.real(ifftn3(np.sum(dhat * zh, axis=3))) + smoothinit  # :171
+        crop = Dz[r[0] : size_x[0] - r[0], r[1] : size_x[1] - r[1],
+                  r[2] : size_x[2] - r[2]]
+        f_z = lam_res * 0.5 * np.sum((mask * crop - mask * b) ** 2)  # :172
+        g_z = lam_pri * np.sum(np.abs(zc))  # :173
+        return f_z + g_z
+
+    # init (:39-50): everything zero
+    size_z = (*size_x, K)
+    d1 = np.zeros(size_x)
+    d2 = np.zeros(size_z)
+    z = np.zeros(size_z)
+    z_hat = np.zeros(size_z, complex)
+
+    obj_vals = [objective(z)]  # :53
+    for _ in range(max_it):  # :58
+        v1 = np.real(ifftn3(np.sum(dhat * z_hat, axis=3)))  # :61
+        v2 = z  # :62
+        theta1 = lam[0] / gamma[0]
+        u1 = (Mtb + (v1 - d1) / theta1) / (M + 1.0 / theta1)  # :29,:65
+        u2 = prox_sparse(v2 - d2, lam[1] / gamma[1])  # :66 (NO exemption)
+        d1 = d1 - (v1 - u1)  # :70
+        xi1_hat = fftn3(u1 + d1)  # :73-74
+        d2 = d2 - (z - u2)  # :78
+        xi2 = u2 + d2  # :81
+        xi2_hat = np.stack(
+            [fftn3(xi2[..., q]) for q in range(K)], axis=3
+        )  # :83-85
+        z_hat = solve_conv_term(xi1_hat, xi2_hat)  # :92
+        z = np.stack(
+            [np.real(ifftn3(z_hat[..., q])) for q in range(K)], axis=3
+        )  # :93-95
+        obj_vals.append(objective(z))  # :101
+
+    # final: CLEAN filter OTFs + smoothinit, crop (:109-110); no clamp
+    Dz = np.real(ifftn3(np.sum(dhat_k * z_hat, axis=3))) + smoothinit
+    res = Dz[r[0] : size_x[0] - r[0], r[1] : size_x[1] - r[1],
+             r[2] : size_x[2] - r[2]]
+    return np.array(obj_vals), res
+
+
+def _problem(seed=88, H=6, s=3, K=2):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0.1, 1.0, (H, H, H))
+    mask = (rng.uniform(size=x.shape) > 0.3).astype(np.float64)
+    b = mask * x  # the driver feeds the masked observation
+    b[b == b.max()] += 0.05  # pin a unique max for the gamma heuristic
+    d = rng.normal(size=(s, s, s, K))
+    d /= np.sqrt(np.sum(d**2, axis=(0, 1, 2), keepdims=True))
+    psf = rng.uniform(0.1, 1.0, (3, 3, 3))
+    psf /= psf.sum()
+    smooth_init = rng.uniform(0.2, 0.4, (H, H, H))
+    return b, d, mask, psf, smooth_init
+
+
+def test_deblur_matches_matlab_exact_variant():
+    """Framework vs the transcription with both text deviations
+    resolved to intent (exact rank-1 solve, rho = gamma ratio):
+    objective trajectory and final reconstruction must match to float
+    tolerance — anchoring the blur-OTF composition, clean-OTF output,
+    prepended (sparsified) dirac, symmetric smooth_init plumbing, and
+    the 500x gamma heuristic against the MATLAB text."""
+    b, d, mask, psf, smooth_init = _problem()
+    n_iters = 4
+    ml_objs, ml_res = matlab_deblur_solver(
+        b, d, mask, psf, smooth_init, 100.0, 0.5, n_iters,
+        exact_solve=True, rho_literal=False,
+    )
+    geom = ProblemGeom((3, 3, 3), 2)
+    prob = ReconstructionProblem(geom, dirac="prepend")
+    cfg = SolveConfig(
+        lambda_residual=100.0,
+        lambda_prior=0.5,
+        max_it=n_iters,
+        tol=0.0,
+        gamma_factor=500.0,
+        gamma_ratio=1.0,
+        verbose="none",
+        track_objective=True,
+    )
+    res = reconstruct(
+        jnp.asarray(b[None], jnp.float32),
+        jnp.asarray(np.transpose(d, (3, 0, 1, 2)), jnp.float32),
+        prob,
+        cfg,
+        mask=jnp.asarray(mask[None], jnp.float32),
+        smooth_init=jnp.asarray(smooth_init[None], jnp.float32),
+        blur_psf=jnp.asarray(psf, jnp.float32),
+    )
+    assert int(res.trace.num_iters) == n_iters
+    np.testing.assert_allclose(
+        np.asarray(res.trace.obj_vals[: n_iters + 1], np.float64),
+        ml_objs,
+        rtol=5e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.recon[0], np.float64), ml_res, atol=2e-3, rtol=2e-3
+    )
+    # trajectory must actually move (no trivial agreement)
+    assert ml_objs[-1] < 0.75 * ml_objs[0]
+
+
+def test_deblur_literal_diag_solve_quantified():
+    """Deviation 1 is real: the literal :155-156 formula (which drops
+    the Sherman-Morrison projection term entirely) measurably departs
+    from the exact solve of the same system, while both still
+    converge at the reference operating point."""
+    b, d, mask, psf, smooth_init = _problem(seed=89)
+    n_iters = 4
+    lit, _ = matlab_deblur_solver(
+        b, d, mask, psf, smooth_init, 100.0, 0.5, n_iters,
+        exact_solve=False, rho_literal=True,
+    )
+    exact, _ = matlab_deblur_solver(
+        b, d, mask, psf, smooth_init, 100.0, 0.5, n_iters,
+        exact_solve=True, rho_literal=True,
+    )
+    assert np.all(np.isfinite(lit)) and np.all(np.isfinite(exact))
+    assert lit[-1] < 0.95 * lit[0] and exact[-1] < 0.95 * exact[0]
+    rel = np.abs(lit[1:] - exact[1:]) / np.abs(exact[1:])
+    assert rel.max() > 1e-6
+
+
+def test_deblur_literal_rho_scale_quantified():
+    """Deviation 2 is real: rho = sw * gamma_ratio (the literal
+    :146/:149 temporal-length scaling) measurably changes the
+    trajectory versus rho = gamma_ratio, and both converge — pinning
+    that the framework's unscaled rho is a deliberate divergence, not
+    a transcription accident."""
+    b, d, mask, psf, smooth_init = _problem(seed=90)
+    n_iters = 4
+    lit, _ = matlab_deblur_solver(
+        b, d, mask, psf, smooth_init, 100.0, 0.5, n_iters,
+        exact_solve=True, rho_literal=True,
+    )
+    intent, _ = matlab_deblur_solver(
+        b, d, mask, psf, smooth_init, 100.0, 0.5, n_iters,
+        exact_solve=True, rho_literal=False,
+    )
+    assert np.all(np.isfinite(lit)) and np.all(np.isfinite(intent))
+    assert lit[-1] < 0.95 * lit[0] and intent[-1] < 0.95 * intent[0]
+    rel = np.abs(lit[1:] - intent[1:]) / np.abs(intent[1:])
+    assert rel.max() > 1e-6
