@@ -81,6 +81,9 @@ def run_workload():
     storage = os.environ.get(
         "CCSC_BENCH_STORAGE", tuned.get("storage_dtype", "float32")
     )
+    fft_impl = os.environ.get(
+        "CCSC_BENCH_FFTIMPL", tuned.get("fft_impl", "xla")
+    )
     geom = ProblemGeom((11, 11), k)
     cfg = LearnConfig(
         max_it=iters,
@@ -93,8 +96,11 @@ def run_workload():
         use_pallas=use_pallas,
         fft_pad=fft_pad,
         storage_dtype=storage,
+        fft_impl=fft_impl,
     )
-    fg = common.FreqGeom.create(geom, (size, size), fft_pad=fft_pad)
+    fg = common.FreqGeom.create(
+        geom, (size, size), fft_pad=fft_pad, fft_impl=fft_impl
+    )
 
     key = jax.random.PRNGKey(0)
     ni = n // blocks
@@ -148,6 +154,7 @@ def run_workload():
             num_freq=fg.num_freq,
             max_it_d=cfg.max_it_d,
             max_it_z=cfg.max_it_z,
+            fft_impl=fft_impl,
         )
         cost_src = "analytic"
     util = perfmodel.utilization(cost, ips)
@@ -166,6 +173,7 @@ def run_workload():
             "fft_pad": fft_pad,
             "storage_dtype": storage,
             "use_pallas": use_pallas,
+            "fft_impl": fft_impl,
         },
     }
     if os.environ.get("CCSC_BENCH_PROFILE") == "1":

@@ -142,6 +142,12 @@ class LearnConfig:
     # (cast-up at the scan boundary), so only the stored iterate is
     # rounded. The dictionary-side state stays float32 (it is tiny).
     storage_dtype: str = "float32"
+    # FFT implementation: 'xla' (jnp.fft) or 'matmul' (explicit DFT
+    # matrices — batched matmuls on the MXU; identical bytes moved,
+    # O(side) extra flops per element on otherwise-idle MXU capacity,
+    # same math to float tolerance). Worthwhile when XLA's FFT kernels
+    # leave the chip bandwidth-idle (PERF.md r4 utilization data).
+    fft_impl: str = "xla"
 
     @property
     def with_objective(self) -> bool:
@@ -193,6 +199,8 @@ class SolveConfig:
     # requires a padded problem (ReconstructionProblem.pad=True) — see
     # LearnConfig.fft_pad.
     fft_pad: str = "none"
+    # FFT implementation ('xla' | 'matmul') — see LearnConfig.fft_impl.
+    fft_impl: str = "xla"
 
     @property
     def with_objective(self) -> bool:

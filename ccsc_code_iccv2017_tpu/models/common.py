@@ -23,6 +23,9 @@ class FreqGeom(NamedTuple):
     num_freq: int  # F = prod(freq_shape)
     reduce_shape: Tuple[int, ...]
     reduce_size: int  # W
+    # 'xla' (jnp.fft) or 'matmul' (DFT matrices on the MXU — same
+    # bytes, same math to float tolerance; see fourier._matmul_rfftn)
+    fft_impl: str = "xla"
 
     @classmethod
     def create(
@@ -31,6 +34,7 @@ class FreqGeom(NamedTuple):
         data_spatial: Sequence[int],
         pad: bool = True,
         fft_pad: str = "none",
+        fft_impl: str = "xla",
     ) -> "FreqGeom":
         """``fft_pad`` ('none' | 'pow2' | 'fast') rounds the padded FFT
         domain up to a TPU-friendly length (fourier.next_fast_size);
@@ -49,12 +53,15 @@ class FreqGeom(NamedTuple):
         fs = fourier.rfreq_shape(sp)
         import math
 
-        return cls(sp, fs, math.prod(fs), geom.reduce_shape, geom.reduce_size)
+        return cls(
+            sp, fs, math.prod(fs), geom.reduce_shape, geom.reduce_size,
+            fft_impl,
+        )
 
 
 def filters_to_freq(d: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
     """Support-domain filters [k, *reduce, *support] -> dhat [k, W, F]."""
-    dh = fourier.psf2otf(d, fg.spatial_shape)
+    dh = fourier.psf2otf(d, fg.spatial_shape, impl=fg.fft_impl)
     ndim_s = len(fg.spatial_shape)
     k = d.shape[0]
     return dh.reshape(k, fg.reduce_size, fg.num_freq)
@@ -64,26 +71,26 @@ def full_filters_to_freq(d_full: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
     """Full-domain (origin-centered) filters [k, *reduce, *spatial] ->
     dhat [k, W, F]."""
     ndim_s = len(fg.spatial_shape)
-    dh = fourier.rfftn_spatial(d_full, ndim_s)
+    dh = fourier.rfftn_spatial(d_full, ndim_s, impl=fg.fft_impl)
     return dh.reshape(d_full.shape[0], fg.reduce_size, fg.num_freq)
 
 
 def data_to_freq(b_pad: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
     """Padded data [n, *reduce, *spatial] -> bhat [n, W, F]."""
     ndim_s = len(fg.spatial_shape)
-    bh = fourier.rfftn_spatial(b_pad, ndim_s)
+    bh = fourier.rfftn_spatial(b_pad, ndim_s, impl=fg.fft_impl)
     return bh.reshape(b_pad.shape[0], fg.reduce_size, fg.num_freq)
 
 
 def codes_to_freq(z: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
     """Codes [n, k, *spatial] -> zhat [n, k, F]."""
-    zh = fourier.rfftn_spatial(z, len(fg.spatial_shape))
+    zh = fourier.rfftn_spatial(z, len(fg.spatial_shape), impl=fg.fft_impl)
     return zh.reshape(z.shape[0], z.shape[1], fg.num_freq)
 
 
 def codes_from_freq(zhat: jnp.ndarray, fg: FreqGeom) -> jnp.ndarray:
     zh = zhat.reshape(*zhat.shape[:-1], *fg.freq_shape)
-    return fourier.irfftn_spatial(zh, fg.spatial_shape)
+    return fourier.irfftn_spatial(zh, fg.spatial_shape, impl=fg.fft_impl)
 
 
 def recon_from_freq(
@@ -101,7 +108,7 @@ def recon_from_freq(
     if filter_axis_name is not None:
         Dzh = jax.lax.psum(Dzh, filter_axis_name)
     Dzh = Dzh.reshape(Dzh.shape[0], *fg.reduce_shape, *fg.freq_shape)
-    return fourier.irfftn_spatial(Dzh, fg.spatial_shape)
+    return fourier.irfftn_spatial(Dzh, fg.spatial_shape, impl=fg.fft_impl)
 
 
 def data_fidelity(

@@ -368,7 +368,7 @@ def eval_block(
 def _filters_from_freq(dhat: jnp.ndarray, fg: common.FreqGeom) -> jnp.ndarray:
     """dhat [K, W, F] -> full-domain real filters [k, *reduce, *spatial]."""
     dh = dhat.reshape(dhat.shape[0], *fg.reduce_shape, *fg.freq_shape)
-    return fourier.irfftn_spatial(dh, fg.spatial_shape)
+    return fourier.irfftn_spatial(dh, fg.spatial_shape, impl=fg.fft_impl)
 
 
 def extract_filters(dbar_proj: jnp.ndarray, geom: ProblemGeom) -> jnp.ndarray:

@@ -144,6 +144,7 @@ def _outer_step_impl(
                 dhat_new.shape[0], *fg.reduce_shape, *fg.freq_shape
             ),
             fg.spatial_shape,
+            impl=fg.fft_impl,
         )
         return (d_new, du1, du2), None
 
@@ -357,7 +358,9 @@ def learn_masked(
             "compat_coding is only supported by the consensus learner "
             "(models.learn)"
         )
-    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:], fft_pad=cfg.fft_pad)
+    fg = common.FreqGeom.create(
+        geom, b.shape[-ndim_s:], fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl
+    )
     _preflight_hbm(
         geom,
         b.shape[-ndim_s:],

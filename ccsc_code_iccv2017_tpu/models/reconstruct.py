@@ -103,7 +103,7 @@ def _grad_diag(fg: common.FreqGeom, lambda_smooth: float) -> jnp.ndarray:
         shape = [1] * ndim_s
         shape[ax] = 2
         diff = jnp.array([1.0, -1.0]).reshape(shape)
-        otf = fourier.psf2otf(diff, fg.spatial_shape)
+        otf = fourier.psf2otf(diff, fg.spatial_shape, impl=fg.fft_impl)
         tg = tg + jnp.abs(otf) ** 2
     return lambda_smooth * tg.reshape(-1)
 
@@ -253,7 +253,8 @@ def _reconstruct_jit(
     data_spatial = b.shape[-ndim_s:]
     radius = geom.psf_radius if prob.pad else (0,) * ndim_s
     fg = common.FreqGeom.create(
-        geom, data_spatial, pad=prob.pad, fft_pad=cfg.fft_pad
+        geom, data_spatial, pad=prob.pad, fft_pad=cfg.fft_pad,
+        fft_impl=cfg.fft_impl,
     )
     n = b.shape[0]
 
@@ -265,7 +266,9 @@ def _reconstruct_jit(
     # --- spectra ----------------------------------------------------
     dhat_clean = common.filters_to_freq(d, fg)  # [K, W, F]
     if blur_psf is not None:
-        blur_otf = fourier.psf2otf(blur_psf, fg.spatial_shape).reshape(-1)
+        blur_otf = fourier.psf2otf(
+            blur_psf, fg.spatial_shape, impl=fg.fft_impl
+        ).reshape(-1)
         dhat_solve = dhat_clean * blur_otf[None, None, :]
     else:
         dhat_solve = dhat_clean

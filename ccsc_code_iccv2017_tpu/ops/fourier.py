@@ -15,9 +15,11 @@ with W = prod(reduce_shape) (1 if none).
 """
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -32,16 +34,118 @@ def rfft_len(spatial_shape: Sequence[int]) -> int:
     return math.prod(s[:-1]) * (s[-1] // 2 + 1)
 
 
-def rfftn_spatial(x: jnp.ndarray, ndim_s: int) -> jnp.ndarray:
+def rfftn_spatial(
+    x: jnp.ndarray, ndim_s: int, impl: str = "xla"
+) -> jnp.ndarray:
+    if impl == "matmul":
+        return _matmul_rfftn(x, ndim_s)
+    if impl != "xla":
+        raise ValueError(f"unknown fft impl {impl!r}")
     return jnp.fft.rfftn(x, axes=spatial_axes(x, ndim_s))
 
 
 def irfftn_spatial(
-    xh: jnp.ndarray, spatial_shape: Sequence[int]
+    xh: jnp.ndarray, spatial_shape: Sequence[int], impl: str = "xla"
 ) -> jnp.ndarray:
     ndim_s = len(spatial_shape)
+    if impl == "matmul":
+        return _matmul_irfftn(xh, tuple(spatial_shape))
+    if impl != "xla":
+        raise ValueError(f"unknown fft impl {impl!r}")
     return jnp.fft.irfftn(
         xh, s=tuple(spatial_shape), axes=tuple(range(xh.ndim - ndim_s, xh.ndim))
+    )
+
+
+# --------------------------- matmul DFT ------------------------------
+#
+# DFT-as-matmul: for the short transform lengths of this problem
+# (padded spatial sides, e.g. 110 = data 100 + 2*radius), an explicit
+# multiply by the DFT matrix maps onto the TPU MXU (a [*, N] x [N, M]
+# batched matmul per axis) instead of XLA's multi-pass FFT kernels.
+# Bytes moved are identical to the FFT path; the extra O(N) flops per
+# element ride otherwise-idle MXU capacity. Matrices are numpy
+# constants (<=100 KB), folded into the jitted program; matmuls run at
+# HIGHEST precision so f32 inputs are not truncated to bf16.
+
+_PREC = jax.lax.Precision.HIGHEST
+
+
+@functools.lru_cache(maxsize=None)
+def _rdft_mat(n: int) -> np.ndarray:
+    """[n, n//2+1] forward half-spectrum DFT matrix (rfft)."""
+    k = np.arange(n // 2 + 1)
+    t = np.arange(n)[:, None] * k[None, :]
+    return np.exp(-2j * np.pi * t / n).astype(np.complex64)
+
+
+@functools.lru_cache(maxsize=None)
+def _irdft_mat(n: int) -> np.ndarray:
+    """[n//2+1, n] inverse matrix: real signal from its half spectrum.
+
+    x = Re(H @ W) with W[k, t] = c_k/n * exp(2j pi k t / n); c_k = 2
+    for interior bins (their conjugate halves are implicit), 1 for the
+    DC and (even n) Nyquist bins.
+    """
+    m = n // 2 + 1
+    k = np.arange(m)
+    c = np.full(m, 2.0)
+    c[0] = 1.0
+    if n % 2 == 0:
+        c[-1] = 1.0
+    t = k[:, None] * np.arange(n)[None, :]
+    return (c[:, None] / n * np.exp(2j * np.pi * t / n)).astype(np.complex64)
+
+
+@functools.lru_cache(maxsize=None)
+def _dft_mat(n: int, inverse: bool) -> np.ndarray:
+    """[n, n] full complex DFT (or 1/n-scaled inverse) matrix."""
+    t = np.arange(n)[:, None] * np.arange(n)[None, :]
+    if inverse:
+        return (np.exp(2j * np.pi * t / n) / n).astype(np.complex64)
+    return np.exp(-2j * np.pi * t / n).astype(np.complex64)
+
+
+def _apply_last(x: jnp.ndarray, mat: np.ndarray) -> jnp.ndarray:
+    return jnp.einsum("...n,nk->...k", x, mat, precision=_PREC)
+
+
+def _apply_axis(x: jnp.ndarray, mat: np.ndarray, axis: int) -> jnp.ndarray:
+    out = jnp.einsum("...n,nk->...k", jnp.moveaxis(x, axis, -1), mat,
+                     precision=_PREC)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def _matmul_rfftn(x: jnp.ndarray, ndim_s: int) -> jnp.ndarray:
+    """rfftn over the trailing ndim_s axes, one matmul per axis.
+
+    The half-spectrum transform runs first (on the last axis, while the
+    input is still real — 2 real matmuls); the remaining axes get full
+    complex DFTs on the narrowed spectrum.
+    """
+    f = _rdft_mat(x.shape[-1])
+    x = x.astype(jnp.float32)
+    # real input x complex matrix as two real matmuls
+    xh = jax.lax.complex(
+        _apply_last(x, np.ascontiguousarray(f.real)),
+        _apply_last(x, np.ascontiguousarray(f.imag)),
+    )
+    for ax in range(x.ndim - ndim_s, x.ndim - 1):
+        xh = _apply_axis(xh, _dft_mat(x.shape[ax], inverse=False), ax)
+    return xh
+
+
+def _matmul_irfftn(
+    xh: jnp.ndarray, spatial_shape: Tuple[int, ...]
+) -> jnp.ndarray:
+    ndim_s = len(spatial_shape)
+    for i, ax in enumerate(range(xh.ndim - ndim_s, xh.ndim - 1)):
+        xh = _apply_axis(xh, _dft_mat(spatial_shape[i], inverse=True), ax)
+    w = _irdft_mat(spatial_shape[-1])
+    # only the real part survives; two real matmuls instead of four
+    return (
+        _apply_last(jnp.real(xh), np.ascontiguousarray(w.real))
+        - _apply_last(jnp.imag(xh), np.ascontiguousarray(w.imag))
     )
 
 
@@ -174,14 +278,16 @@ def circ_extract(
 
 
 def psf2otf(
-    psf: jnp.ndarray, spatial_shape: Sequence[int]
+    psf: jnp.ndarray, spatial_shape: Sequence[int], impl: str = "xla"
 ) -> jnp.ndarray:
     """rfftn of the origin-centered embedding of ``psf``.
 
     Matches MATLAB psf2otf up to the half-spectrum (reference:
     admm_solve_conv2D_weighted_sampling.m:155-162).
     """
-    return rfftn_spatial(circ_embed(psf, spatial_shape), len(spatial_shape))
+    return rfftn_spatial(
+        circ_embed(psf, spatial_shape), len(spatial_shape), impl=impl
+    )
 
 
 def freq_flatten(xh: jnp.ndarray, ndim_s: int) -> jnp.ndarray:

@@ -235,7 +235,9 @@ def learn(
             raise ValueError(
                 f"num_blocks={N} not divisible by mesh 'block' axis {nb}"
             )
-    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:], fft_pad=cfg.fft_pad)
+    fg = common.FreqGeom.create(
+        geom, b.shape[-ndim_s:], fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl
+    )
     b_blocks = b.reshape(N, ni, *b.shape[1:])
 
     if key is None:

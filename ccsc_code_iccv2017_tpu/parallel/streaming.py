@@ -137,7 +137,9 @@ def learn_streaming(
     if n % N:
         raise ValueError(f"n={n} not divisible by num_blocks={N}")
     ni = n // N
-    fg = common.FreqGeom.create(geom, b.shape[-ndim_s:], fft_pad=cfg.fft_pad)
+    fg = common.FreqGeom.create(
+        geom, b.shape[-ndim_s:], fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl
+    )
     b_blocks = np.asarray(b, np.float32).reshape(N, ni, *b.shape[1:])
 
     if key is None:

@@ -85,11 +85,16 @@ def compiled_cost(compiled) -> Optional[Dict[str, float]]:
         return None
 
 
-def _fft_flops(spatial: tuple, batch: int) -> float:
+def _fft_flops(spatial: tuple, batch: int, fft_impl: str = "xla") -> float:
     """Real-FFT cost over the trailing spatial dims for ``batch``
-    independent transforms: 2.5 * S * log2(S) real flops each (the
-    standard split-radix estimate, halved for rfft)."""
+    independent transforms. 'xla': 2.5 * S * log2(S) real flops each
+    (the standard split-radix estimate, halved for rfft). 'matmul'
+    (fourier._matmul_rfftn): one [*, side] x [side, ~side/2] complex
+    matmul per axis — ~4 * S * sum(sides) real flops (half-spectrum
+    narrowing on the last axis roughly offsets complex-MAC overhead)."""
     S = math.prod(spatial)
+    if fft_impl == "matmul":
+        return 4.0 * S * sum(spatial) * batch
     return 2.5 * S * max(math.log2(S), 1.0) * batch
 
 
@@ -104,6 +109,7 @@ def analytic_outer_step_cost(
     max_it_z: int,
     reduce_size: int = 1,
     dtype_bytes: int = 4,
+    fft_impl: str = "xla",
 ) -> Dict[str, float]:
     """Closed-form FLOP / HBM-byte count of ONE consensus outer step
     (models.learn.outer_step): the d-pass code-Gram + Cholesky +
@@ -123,7 +129,7 @@ def analytic_outer_step_cost(
 
     flops = 0.0
     # initial code spectra zhat: rfft over all codes
-    flops += _fft_flops(spatial, n_imgs * k)
+    flops += _fft_flops(spatial, n_imgs * k, fft_impl)
     # code Gram G_f = Z_f Z_f^H per block: F * ni^2 * k complex MACs
     flops += 8.0 * N * F * ni * ni * k
     # Cholesky of [F, 2ni, 2ni] + 2 triangular solves per block
@@ -131,14 +137,14 @@ def analytic_outer_step_cost(
     flops += N * F * (m2**3 / 3.0 + m2**3)
     for _ in range(max_it_d):
         # filter FFT fwd+inv: N*k transforms each way
-        flops += 2 * _fft_flops(spatial, N * k * W)
+        flops += 2 * _fft_flops(spatial, N * k * W, fft_impl)
         # solve_d einsums: r, t, s-apply, final — 8F(3 k ni W + ni^2)/blk
         flops += 8.0 * N * F * (3 * k * ni * W + ni * ni)
     # z-pass filter spectra + per-iteration solves
-    flops += _fft_flops(spatial, k * W)
+    flops += _fft_flops(spatial, k * W, fft_impl)
     for _ in range(max_it_z):
         # codes FFT fwd+inv
-        flops += 2 * _fft_flops(spatial, n_imgs * k)
+        flops += 2 * _fft_flops(spatial, n_imgs * k, fft_impl)
         # scalar-path Sherman-Morrison: 3 einsums of k MACs per (n, f)
         flops += 8.0 * 3 * n_imgs * k * F * W
         # soft-threshold + dual updates: ~6 elementwise ops

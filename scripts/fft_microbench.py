@@ -45,9 +45,14 @@ def timed(name, fn, *args, reps=5):
     return dt
 
 
-def dft_mats(n, dtype=jnp.complex64):
-    w = np.exp(-2j * np.pi * np.outer(np.arange(n), np.arange(n)) / n)
-    return jnp.asarray(w, dtype), jnp.asarray(np.conj(w) / n, dtype)
+def dft_mats(n):
+    # NUMPY constants: a jnp array closed over by a jitted fn must be
+    # read back to host to embed as an MLIR constant, and the axon
+    # platform cannot (UNIMPLEMENTED); host arrays embed directly.
+    w = np.exp(
+        -2j * np.pi * np.outer(np.arange(n), np.arange(n)) / n
+    ).astype(np.complex64)
+    return w, (np.conj(w) / n).astype(np.complex64)
 
 
 def main():
@@ -100,6 +105,23 @@ def main():
         return b, b.ravel()[0]
 
     timed(f"dft-matmul fwd+inv {S}", mm_rt, x, reps=reps)
+
+    # c2) the production matmul-DFT path (ops.fourier, half-spectrum
+    # rfft matrices, HIGHEST-precision real matmuls — fft_impl='matmul')
+    from ccsc_code_iccv2017_tpu.ops import fourier
+
+    def prod_rt(a):
+        h = fourier.rfftn_spatial(a, 2, impl="matmul")
+        b = fourier.irfftn_spatial(h, a.shape[-2:], impl="matmul")
+        return b, b.ravel()[0]
+
+    timed(f"fourier-matmul fwd+inv {S}", prod_rt, x, reps=reps)
+
+    def prod_fwd(a):
+        h = fourier.rfftn_spatial(a, 2, impl="matmul")
+        return h, jnp.real(h).ravel()[0]
+
+    timed(f"fourier-matmul fwd {S}", prod_fwd, x, reps=reps)
 
     # d) bandwidth reference: soft threshold (2 reads + 1 write-ish)
     def st(a):

@@ -322,3 +322,25 @@ def test_bf16_storage_trajectory_close_to_f32():
     assert dev < 0.02, f"bf16 trajectory deviates {dev:.3%}"
     d_err = np.max(np.abs(np.asarray(r32.d) - np.asarray(r16.d, np.float32)))
     assert d_err < 0.05 * np.abs(np.asarray(r32.d)).max()
+
+
+def test_fft_impl_matmul_matches_xla():
+    """The matmul-DFT execution strategy (fft_impl='matmul') reproduces
+    the jnp.fft learner trajectory to float tolerance — same problem,
+    same math, different kernels (PERF.md r4: the MXU-side FFT lever)."""
+    b = _toy_data(n=8, size=20, seed=5)
+    geom = ProblemGeom((5, 5), 6)
+    kw = dict(CFG, num_blocks=2)
+    r_xla = learn(
+        b, geom, LearnConfig(**kw), key=jax.random.PRNGKey(2)
+    )
+    r_mm = learn(
+        b, geom, LearnConfig(**kw, fft_impl="matmul"),
+        key=jax.random.PRNGKey(2),
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_xla.d), np.asarray(r_mm.d), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        r_xla.trace["obj_vals_z"], r_mm.trace["obj_vals_z"], rtol=2e-4
+    )

@@ -250,3 +250,32 @@ def test_pad_crop_with_fast_target():
     assert float(jnp.abs(p).sum()) == float(jnp.abs(jnp.asarray(x)).sum())
     back = fourier.crop_spatial(p, (2, 2), out_spatial=(13, 13))
     np.testing.assert_array_equal(np.asarray(back), x)
+
+
+@pytest.mark.parametrize(
+    "shape,nd",
+    [((3, 4, 18, 18), 2), ((2, 10, 10, 10), 3), ((5, 7, 9), 2),
+     ((2, 3, 8, 11), 2)],
+)
+def test_matmul_dft_matches_fft(shape, nd):
+    """fft_impl='matmul' (DFT matrices on the MXU) reproduces jnp.fft
+    to float tolerance, forward and inverse, even/odd lengths, 2D/3D."""
+    x = _rng(7).standard_normal(shape).astype(np.float32)
+    sp = shape[-nd:]
+    ref = np.fft.rfftn(x, axes=tuple(range(len(shape) - nd, len(shape))))
+    got = np.asarray(
+        fourier.rfftn_spatial(jnp.asarray(x), nd, impl="matmul")
+    )
+    np.testing.assert_allclose(got, ref, atol=2e-5 * np.abs(ref).max())
+    back = np.asarray(
+        fourier.irfftn_spatial(
+            jnp.asarray(ref.astype(np.complex64)), sp, impl="matmul"
+        )
+    )
+    np.testing.assert_allclose(back, x, atol=1e-5)
+
+
+def test_matmul_dft_unknown_impl_rejected():
+    x = jnp.zeros((4, 4))
+    with pytest.raises(ValueError):
+        fourier.rfftn_spatial(x, 2, impl="fftw")
