@@ -142,11 +142,13 @@ class LearnConfig:
     # (cast-up at the scan boundary), so only the stored iterate is
     # rounded. The dictionary-side state stays float32 (it is tiny).
     storage_dtype: str = "float32"
-    # FFT implementation: 'xla' (jnp.fft) or 'matmul' (explicit DFT
+    # FFT implementation: 'xla' (jnp.fft), 'matmul' (explicit DFT
     # matrices — batched matmuls on the MXU; identical bytes moved,
     # O(side) extra flops per element on otherwise-idle MXU capacity,
-    # same math to float tolerance). Worthwhile when XLA's FFT kernels
-    # leave the chip bandwidth-idle (PERF.md r4 utilization data).
+    # same math to float tolerance; +36% on the v5e north-star,
+    # PERF.md r4), or 'matmul_bf16' (same matmuls at DEFAULT precision
+    # — one bf16 MXU pass each, ~3 decimal digits per transform;
+    # validate trajectories before relying on it).
     fft_impl: str = "xla"
 
     @property

@@ -37,8 +37,8 @@ def rfft_len(spatial_shape: Sequence[int]) -> int:
 def rfftn_spatial(
     x: jnp.ndarray, ndim_s: int, impl: str = "xla"
 ) -> jnp.ndarray:
-    if impl == "matmul":
-        return _matmul_rfftn(x, ndim_s)
+    if impl in ("matmul", "matmul_bf16"):
+        return _matmul_rfftn(x, ndim_s, _matmul_prec(impl))
     if impl != "xla":
         raise ValueError(f"unknown fft impl {impl!r}")
     return jnp.fft.rfftn(x, axes=spatial_axes(x, ndim_s))
@@ -48,8 +48,8 @@ def irfftn_spatial(
     xh: jnp.ndarray, spatial_shape: Sequence[int], impl: str = "xla"
 ) -> jnp.ndarray:
     ndim_s = len(spatial_shape)
-    if impl == "matmul":
-        return _matmul_irfftn(xh, tuple(spatial_shape))
+    if impl in ("matmul", "matmul_bf16"):
+        return _matmul_irfftn(xh, tuple(spatial_shape), _matmul_prec(impl))
     if impl != "xla":
         raise ValueError(f"unknown fft impl {impl!r}")
     return jnp.fft.irfftn(
@@ -65,10 +65,23 @@ def irfftn_spatial(
 # batched matmul per axis) instead of XLA's multi-pass FFT kernels.
 # Bytes moved are identical to the FFT path; the extra O(N) flops per
 # element ride otherwise-idle MXU capacity. Matrices are numpy
-# constants (<=100 KB), folded into the jitted program; matmuls run at
-# HIGHEST precision so f32 inputs are not truncated to bf16.
+# constants (<=100 KB), folded into the jitted program.
+#
+# Two precision variants: 'matmul' runs HIGHEST precision (f32-exact
+# via multi-pass bf16 — parity with jnp.fft to float tolerance);
+# 'matmul_bf16' runs DEFAULT precision (single bf16 MXU pass per
+# matmul, f32 accumulation — ~3 decimal digits per transform, an
+# accuracy/speed trade quantified by the golden-trajectory tests).
 
 _PREC = jax.lax.Precision.HIGHEST
+
+
+def _matmul_prec(impl: str):
+    return (
+        jax.lax.Precision.DEFAULT
+        if impl == "matmul_bf16"
+        else jax.lax.Precision.HIGHEST
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -106,17 +119,21 @@ def _dft_mat(n: int, inverse: bool) -> np.ndarray:
     return np.exp(-2j * np.pi * t / n).astype(np.complex64)
 
 
-def _apply_last(x: jnp.ndarray, mat: np.ndarray) -> jnp.ndarray:
-    return jnp.einsum("...n,nk->...k", x, mat, precision=_PREC)
+def _apply_last(x: jnp.ndarray, mat: np.ndarray, prec=_PREC) -> jnp.ndarray:
+    return jnp.einsum("...n,nk->...k", x, mat, precision=prec)
 
 
-def _apply_axis(x: jnp.ndarray, mat: np.ndarray, axis: int) -> jnp.ndarray:
+def _apply_axis(
+    x: jnp.ndarray, mat: np.ndarray, axis: int, prec=_PREC
+) -> jnp.ndarray:
     out = jnp.einsum("...n,nk->...k", jnp.moveaxis(x, axis, -1), mat,
-                     precision=_PREC)
+                     precision=prec)
     return jnp.moveaxis(out, -1, axis)
 
 
-def _matmul_rfftn(x: jnp.ndarray, ndim_s: int) -> jnp.ndarray:
+def _matmul_rfftn(
+    x: jnp.ndarray, ndim_s: int, prec=_PREC
+) -> jnp.ndarray:
     """rfftn over the trailing ndim_s axes, one matmul per axis.
 
     The half-spectrum transform runs first (on the last axis, while the
@@ -127,25 +144,26 @@ def _matmul_rfftn(x: jnp.ndarray, ndim_s: int) -> jnp.ndarray:
     x = x.astype(jnp.float32)
     # real input x complex matrix as two real matmuls
     xh = jax.lax.complex(
-        _apply_last(x, np.ascontiguousarray(f.real)),
-        _apply_last(x, np.ascontiguousarray(f.imag)),
+        _apply_last(x, np.ascontiguousarray(f.real), prec),
+        _apply_last(x, np.ascontiguousarray(f.imag), prec),
     )
     for ax in range(x.ndim - ndim_s, x.ndim - 1):
-        xh = _apply_axis(xh, _dft_mat(x.shape[ax], inverse=False), ax)
+        xh = _apply_axis(xh, _dft_mat(x.shape[ax], inverse=False), ax, prec)
     return xh
 
 
 def _matmul_irfftn(
-    xh: jnp.ndarray, spatial_shape: Tuple[int, ...]
+    xh: jnp.ndarray, spatial_shape: Tuple[int, ...], prec=_PREC
 ) -> jnp.ndarray:
     ndim_s = len(spatial_shape)
     for i, ax in enumerate(range(xh.ndim - ndim_s, xh.ndim - 1)):
-        xh = _apply_axis(xh, _dft_mat(spatial_shape[i], inverse=True), ax)
+        xh = _apply_axis(xh, _dft_mat(spatial_shape[i], inverse=True), ax,
+                         prec)
     w = _irdft_mat(spatial_shape[-1])
     # only the real part survives; two real matmuls instead of four
     return (
-        _apply_last(jnp.real(xh), np.ascontiguousarray(w.real))
-        - _apply_last(jnp.imag(xh), np.ascontiguousarray(w.imag))
+        _apply_last(jnp.real(xh), np.ascontiguousarray(w.real), prec)
+        - _apply_last(jnp.imag(xh), np.ascontiguousarray(w.imag), prec)
     )
 
 

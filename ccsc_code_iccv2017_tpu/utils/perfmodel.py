@@ -93,7 +93,7 @@ def _fft_flops(spatial: tuple, batch: int, fft_impl: str = "xla") -> float:
     matmul per axis — ~4 * S * sum(sides) real flops (half-spectrum
     narrowing on the last axis roughly offsets complex-MAC overhead)."""
     S = math.prod(spatial)
-    if fft_impl == "matmul":
+    if fft_impl.startswith("matmul"):  # 'matmul' and 'matmul_bf16'
         return 4.0 * S * sum(spatial) * batch
     return 2.5 * S * max(math.log2(S), 1.0) * batch
 

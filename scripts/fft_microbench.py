@@ -46,13 +46,12 @@ def timed(name, fn, *args, reps=5):
 
 
 def dft_mats(n):
-    # NUMPY constants: a jnp array closed over by a jitted fn must be
+    # NUMPY constants (a jnp array closed over by a jitted fn must be
     # read back to host to embed as an MLIR constant, and the axon
-    # platform cannot (UNIMPLEMENTED); host arrays embed directly.
-    w = np.exp(
-        -2j * np.pi * np.outer(np.arange(n), np.arange(n)) / n
-    ).astype(np.complex64)
-    return w, (np.conj(w) / n).astype(np.complex64)
+    # platform cannot); reuse the production matrices.
+    from ccsc_code_iccv2017_tpu.ops.fourier import _dft_mat
+
+    return _dft_mat(n, inverse=False), _dft_mat(n, inverse=True)
 
 
 def main():

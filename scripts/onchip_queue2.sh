@@ -25,7 +25,11 @@ run_bench() { # label, env pairs...
   echo "=== $label $(date +%H:%M:%S)" >> "$LOG"
   local line
   line=$(env "$@" CCSC_BENCH_TIMEOUT=2400 timeout 5400 python bench.py 2>> "$LOG" | tail -1)
-  if [ -n "$line" ]; then
+  # only record stdout tails that actually parse as a JSON object —
+  # a crashed bench can leave a partial line that would corrupt the
+  # record and silently drop the arm from tuning
+  if [ -n "$line" ] && echo "$line" | python -c \
+      'import json,sys; json.load(sys.stdin)' > /dev/null 2>&1; then
     echo "{\"run\": \"$label\", \"result\": $line}" >> "$OUT"
   else
     note "$label FAILED/empty"

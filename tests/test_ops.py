@@ -279,3 +279,34 @@ def test_matmul_dft_unknown_impl_rejected():
     x = jnp.zeros((4, 4))
     with pytest.raises(ValueError):
         fourier.rfftn_spatial(x, 2, impl="fftw")
+
+
+def test_matmul_bf16_dft_error_bound():
+    """Emulated accuracy bound for fft_impl='matmul_bf16': DEFAULT
+    precision on TPU truncates each matmul's inputs to bf16 (f32
+    accumulation). Emulating that truncation explicitly bounds the
+    per-transform relative error at a few 1e-3 — the basis for the
+    config.py guidance to validate trajectories before relying on it.
+    (On CPU, DEFAULT precision is exact f32, so the knob itself is
+    exercised for parity, not accuracy, off-TPU.)"""
+    import jax.numpy as jnp2
+
+    x = _rng(3).standard_normal((4, 16, 16)).astype(np.float32)
+    ref = np.fft.rfftn(x, axes=(-2, -1))
+    # emulate one bf16 pass per matmul on the forward path
+    f = fourier._rdft_mat(16)
+    bf = lambda a: np.asarray(
+        jnp2.asarray(a).astype(jnp2.bfloat16).astype(jnp2.float32)
+    )
+    xh = bf(x) @ (bf(f.real) + 1j * bf(f.imag))
+    d = fourier._dft_mat(16, inverse=False)
+    got = np.einsum(
+        "byk,yu->buk", xh, (bf(d.real) + 1j * bf(d.imag))
+    )
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 2e-2, rel
+    # the exact-precision path stays at float tolerance
+    exact = np.asarray(
+        fourier.rfftn_spatial(jnp2.asarray(x), 2, impl="matmul_bf16")
+    )
+    np.testing.assert_allclose(exact, ref, atol=2e-5 * np.abs(ref).max())
