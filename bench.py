@@ -84,6 +84,9 @@ def run_workload():
     fft_impl = os.environ.get(
         "CCSC_BENCH_FFTIMPL", tuned.get("fft_impl", "xla")
     )
+    fused_z = os.environ.get(
+        "CCSC_BENCH_FUSEDZ", "1" if tuned.get("fused_z") else "0"
+    ) == "1"
     geom = ProblemGeom((11, 11), k)
     cfg = LearnConfig(
         max_it=iters,
@@ -97,6 +100,7 @@ def run_workload():
         fft_pad=fft_pad,
         storage_dtype=storage,
         fft_impl=fft_impl,
+        fused_z=fused_z,
     )
     fg = common.FreqGeom.create(
         geom, (size, size), fft_pad=fft_pad, fft_impl=fft_impl
@@ -174,6 +178,7 @@ def run_workload():
             "storage_dtype": storage,
             "use_pallas": use_pallas,
             "fft_impl": fft_impl,
+            "fused_z": fused_z,
         },
     }
     if os.environ.get("CCSC_BENCH_PROFILE") == "1":
@@ -183,12 +188,16 @@ def run_workload():
     return out
 
 
-def profile_components(geom, cfg, fg, state, b_blocks, reps=5):
+def profile_components(geom, cfg, fg, state, b_blocks, reps=None):
     """Wall-clock split of the outer step's stages (the FFT vs Gram vs
     solve mix VERDICT asks for): each stage jitted separately, fenced
     by a real-scalar readback, timed over ``reps`` runs. Overlap/fusion
     across stages is lost, so the parts can sum to more than the fused
     step — the table is for MIX, not absolute totals."""
+    if reps is None:
+        # fewer reps = fewer tunnel round-trips = fewer chances for the
+        # axon client to wedge mid-profile (it did, twice, in r4)
+        reps = int(os.environ.get("CCSC_BENCH_PROFILE_REPS", 3))
     import jax
     import jax.numpy as jnp
 
@@ -225,12 +234,15 @@ def profile_components(geom, cfg, fg, state, b_blocks, reps=5):
     zkern = jax.jit(
         lambda dh: freq_solvers.precompute_z_kernel(dh, cfg.rho_z)
     )(dhat_z)
+    # zkern must be an ARGUMENT, not a closure: a device array closed
+    # over by a jitted fn is embedded as a constant, which requires a
+    # host readback the axon platform cannot do (UNIMPLEMENTED)
     f_solve_z = jax.jit(
-        jax.vmap(
-            lambda bh, xh: freq_solvers.solve_z(
-                zkern, bh, xh, cfg.rho_z, use_pallas=cfg.use_pallas
+        lambda zk, bh, xh: jax.vmap(
+            lambda bh1, xh1: freq_solvers.solve_z(
+                zk, bh1, xh1, cfg.rho_z, use_pallas=cfg.use_pallas
             )
-        )
+        )(bh, xh)
     )
     f_izhat = jax.jit(
         lambda zh: jax.vmap(lambda z1: common.codes_from_freq(z1, fg))(zh)
@@ -249,7 +261,7 @@ def profile_components(geom, cfg, fg, state, b_blocks, reps=5):
         ),
         "solve_z": (
             f_solve_z,
-            (bhat, zhat),
+            (zkern, bhat, zhat),
             lambda o: o.real.sum(),
         ),
         "codes_irfft": (f_izhat, (zhat,), lambda o: o.sum()),

@@ -129,6 +129,13 @@ class LearnConfig:
     # (ops.pallas_kernels; interpret mode off-TPU). Bit-compatible with
     # the einsum path up to float reassociation.
     use_pallas: bool = False
+    # Fuse the ENTIRE z inner iteration (prox + dual + DFT + rank-1
+    # solve + inverse DFT) into the two-pass Pallas kernel of
+    # ops.pallas_fused_z — state in/out is the only HBM traffic of the
+    # z-pass (~4x less than the XLA composition at the north-star
+    # shape). 2D, W == 1, unsharded inner axes only; the learner falls
+    # back to the composition elsewhere. Matches it to float tolerance.
+    fused_z: bool = False
     # Round the FFT domain up to a TPU-friendly size ('pow2' | 'fast',
     # fourier.next_fast_size). 'none' keeps the reference's exact
     # s + 2*psf_radius padding (dParallel.m:16). A fast domain solves

@@ -286,6 +286,14 @@ def outer_step(
     )
     theta = cfg.lambda_prior / cfg.rho_z
 
+    fused_ok = (
+        cfg.fused_z
+        and fg.reduce_size == 1
+        and len(fg.spatial_shape) == 2
+        and freq_axis_name is None
+        and filter_axis_name is None
+    )
+
     def z_iter(carry, _):
         z, dual_z = f32(carry[0]), f32(carry[1])
         u2 = proxes.soft_threshold(z + dual_z, theta)
@@ -305,8 +313,33 @@ def outer_step(
         z_new = jax.vmap(lambda zh: common.codes_from_freq(zh, fg))(zhat_new)
         return (z_new.astype(sd), dual_z.astype(sd)), None
 
+    def z_iter_fused(carry, _):
+        # the whole iteration as the two-pass Pallas kernel — only the
+        # z/dual state touches HBM (ops.pallas_fused_z)
+        from ..ops import pallas_fused_z
+
+        z0, du0 = carry
+        L, ni = z0.shape[0], z0.shape[1]
+        K = z0.shape[2]
+        Sy, Sx = fg.spatial_shape
+        Fx = Sx // 2 + 1
+        zn, dn = pallas_fused_z.fused_z_iter(
+            z0.reshape(L * ni, K, Sy, Sx),
+            du0.reshape(L * ni, K, Sy, Sx),
+            bhat.reshape(L * ni, Sy, Fx),
+            dhat_z.reshape(K, Sy, Fx),
+            zkern.minv_diag.reshape(Sy, Fx),
+            cfg.rho_z,
+            theta,
+            interpret=freq_solvers._pallas_interpret(),
+        )
+        return (zn.reshape(z0.shape), dn.reshape(z0.shape)), None
+
     (z, dual_z), _ = jax.lax.scan(
-        z_iter, (state.z, state.dual_z), None, length=cfg.max_it_z
+        z_iter_fused if fused_ok else z_iter,
+        (state.z, state.dual_z),
+        None,
+        length=cfg.max_it_z,
     )
     num = _psum(jnp.sum((f32(z) - f32(state.z)) ** 2), global_axes)
     den = _psum(jnp.sum(f32(z) ** 2), global_axes)

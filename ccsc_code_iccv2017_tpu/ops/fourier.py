@@ -126,9 +126,15 @@ def _apply_last(x: jnp.ndarray, mat: np.ndarray, prec=_PREC) -> jnp.ndarray:
 def _apply_axis(
     x: jnp.ndarray, mat: np.ndarray, axis: int, prec=_PREC
 ) -> jnp.ndarray:
-    out = jnp.einsum("...n,nk->...k", jnp.moveaxis(x, axis, -1), mat,
-                     precision=prec)
-    return jnp.moveaxis(out, -1, axis)
+    """Contract ``mat`` against one axis of x, in place in the axis
+    order. A single einsum (dot_general contracting the given axis)
+    rather than moveaxis+matmul+moveaxis — explicit transposes of the
+    code-sized tensors would each cost a full HBM pass."""
+    letters = "abcdefghijklmnopqrstuvwxy"
+    sub = letters[: x.ndim]
+    ax = sub[axis]
+    out = sub.replace(ax, "z")
+    return jnp.einsum(f"{sub},{ax}z->{out}", x, mat, precision=prec)
 
 
 def _matmul_rfftn(

@@ -1,0 +1,307 @@
+"""Fused z-ADMM-iteration Pallas kernel (2D, W == 1, single shard).
+
+One z-pass inner iteration of the consensus learner
+(dzParallel.m:150-158; models/learn.py::outer_step z_iter) is, per
+image: soft-threshold prox + dual update (elementwise), forward rfft2,
+the rank-1 Sherman-Morrison solve (solve_conv_term_Z,
+2D/admm_learn_conv2D_large_dParallel.m:278-303), and inverse rfft2.
+The XLA composition materializes ~5 code-sized complex spectra in HBM
+per iteration (~6-7 GB at the north-star shape); this kernel keeps the
+entire chain VMEM-resident per (image, k-tile) block, touching HBM
+only for the bf16/f32 state in and out (~1.9 GB) — the r4 roofline
+work (PERF.md) showed the z-pass is bandwidth-bound, so traffic IS the
+step time.
+
+Structure (the k-reduction forces two passes):
+
+  pass A  grid (N, K/kt): prox -> dual' out -> DFT(xi) via the
+          matmul-DFT matrices (ops.fourier) -> accumulate the
+          k-reduction t_f = sum_k d_k g_k into a per-image [Sy, Fx]
+          buffer over consecutive k-tile grid steps.
+  (jnp)   s_f = minv_diag_f * t_f   (tiny elementwise)
+  pass B  same grid: recompute xi spectra (cheaper than a spectra
+          HBM round-trip; the MXU is idle), apply the rank-1
+          correction z_hat = g - (1/rho) conj(d) s, inverse DFT,
+          write z'.
+
+Complex arithmetic is split into re/im planes (no complex buffers at
+kernel boundaries — axon). The filter spectra and DFT matrices ride in
+VMEM with constant block indices, so they are fetched once, not per
+grid step. All math is f32; state loads/stores honor the storage
+dtype (LearnConfig.storage_dtype).
+
+Gated by LearnConfig.fused_z; models/learn.py falls back to the XLA
+composition for W > 1, non-2D geometries, or sharded inner axes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from . import fourier, proxes
+
+
+def _ktile(K: int, cap: int = None) -> int:
+    """Largest divisor of K that is <= cap (VMEM sizing).
+
+    The default cap (25) keeps the worst-case per-step VMEM footprint
+    (state blocks + resident filter spectra + f32 DFT temporaries)
+    within the ~16 MB/core budget at the north-star shape; override
+    with CCSC_FUSEDZ_KT_CAP if a geometry compiles out of memory.
+    """
+    if cap is None:
+        import os
+
+        cap = int(os.environ.get("CCSC_FUSEDZ_KT_CAP", 25))
+    for kt in range(min(cap, K), 0, -1):
+        if K % kt == 0:
+            return kt
+    return 1
+
+
+def _mats(Sy: int, Sx: int):
+    """f32 re/im DFT matrix constants for a [Sy, Sx] plane."""
+    f = fourier._rdft_mat(Sx)  # [Sx, Fx] forward, last axis
+    d = fourier._dft_mat(Sy, inverse=False)  # [Sy, Sy] forward, y axis
+    di = fourier._dft_mat(Sy, inverse=True)
+    w = fourier._irdft_mat(Sx)  # [Fx, Sx] inverse, last axis
+    c = np.ascontiguousarray
+    return dict(
+        fre=c(f.real), fim=c(f.imag),
+        dre=c(d.real), dim=c(d.imag),
+        ire=c(di.real), iim=c(di.imag),
+        wre=c(w.real), wim=c(w.imag),
+    )
+
+
+def _xi_spectra(z, du, theta, fre, fim, dre, dim):
+    """prox + dual + forward DFT of the coding target, f32 in VMEM.
+
+    z, du: [kt, Sy, Sx] f32. Returns (xr, xi) [kt, Sy, Fx] spectra of
+    xi = 2*soft_threshold(z + du, theta) - (z + du), plus dual' =
+    (z + du) - soft_threshold(z + du, theta).
+    """
+    s = z + du
+    u2 = proxes.soft_threshold(s, theta)
+    dual_new = s - u2
+    xi = 2.0 * u2 - s
+    # last-axis rfft: real @ complex as two real matmuls. HIGHEST
+    # precision throughout: the kernel's contract is float-tolerance
+    # parity with the einsum path (default precision would silently be
+    # single-pass bf16 on the MXU — the matmul_bf16 accuracy class).
+    ein = functools.partial(
+        jnp.einsum,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    ar = ein("kyx,xv->kyv", xi, fre)
+    ai = ein("kyx,xv->kyv", xi, fim)
+    # y-axis full complex DFT
+    xr = ein("kyv,yu->kuv", ar, dre) - ein("kyv,yu->kuv", ai, dim)
+    xi_ = ein("kyv,yu->kuv", ar, dim) + ein("kyv,yu->kuv", ai, dre)
+    return xr, xi_, dual_new
+
+
+def _g(xr, xi_, dr, di, br, bi, inv_rho):
+    """g = conj(d) * bhat / rho + xihat, per (k, y, v)."""
+    gr = (dr * br[None] + di * bi[None]) * inv_rho + xr
+    gi = (dr * bi[None] - di * br[None]) * inv_rho + xi_
+    return gr, gi
+
+
+def fused_z_iter(
+    z: jnp.ndarray,
+    dual: jnp.ndarray,
+    bhat: jnp.ndarray,
+    dhat: jnp.ndarray,
+    minv_diag: jnp.ndarray,
+    rho: float,
+    theta: float,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One fused z iteration.
+
+    z, dual: [N, K, Sy, Sx] real state (f32 or bf16 — returned as is).
+    bhat:    [N, Sy, Fx] complex64 data spectra (constant across iters).
+    dhat:    [K, Sy, Fx] complex64 filter spectra.
+    minv_diag: [Sy, Fx] f32, 1 / (1 + sum_k |d_k|^2 / rho).
+    Matches the einsum z_iter (models/learn.py) to float tolerance.
+    """
+    N, K, Sy, Sx = z.shape
+    Fx = Sx // 2 + 1
+    kt = _ktile(K)
+    nk = K // kt
+    m = _mats(Sy, Sx)
+    inv_rho = 1.0 / float(rho)
+    sd = z.dtype
+
+    try:
+        vma_z = tuple(jax.typeof(z).vma)
+    except (AttributeError, TypeError):
+        vma_z = ()
+
+    if interpret and vma_z:
+        # pallas interpret mode's HLO interpreter does not propagate
+        # varying-manual-axes through its block-fetch loop (fails under
+        # shard_map + check_vma). Off-TPU the kernel is a correctness
+        # stand-in anyway — use the identical-math jnp reference; the
+        # real mosaic lowering handles shard_map fine.
+        return fused_z_iter_reference(
+            z, dual, bhat, dhat, minv_diag, rho, theta
+        )
+
+    def lift(x):
+        """Match every kernel input's varying-manual-axes to the
+        state's (under shard_map the z state varies over 'block' while
+        the filter spectra / DFT matrices are replicated — one
+        pallas_call needs them to agree)."""
+        x = jnp.asarray(x)
+        if vma_z:
+            have = tuple(jax.typeof(x).vma)
+            missing = tuple(a for a in vma_z if a not in have)
+            if missing:
+                x = jax.lax.pvary(x, missing)
+        return x
+
+    dr = lift(jnp.real(dhat).astype(jnp.float32))
+    di = lift(jnp.imag(dhat).astype(jnp.float32))
+    br = lift(jnp.real(bhat).astype(jnp.float32))
+    bi = lift(jnp.imag(bhat).astype(jnp.float32))
+
+    state_spec = pl.BlockSpec((1, kt, Sy, Sx), lambda i, j: (i, j, 0, 0))
+    img_spec = pl.BlockSpec((1, Sy, Fx), lambda i, j: (i, 0, 0))
+    d_spec = pl.BlockSpec((K, Sy, Fx), lambda i, j: (0, 0, 0))
+
+    def sds(shape, dtype):
+        """Out aval; under shard_map the outputs vary across the same
+        mesh axes as the state (vma is mandatory there)."""
+        if vma_z:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma_z))
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    def full_spec(a):
+        """Whole array as one VMEM block with a constant index — the
+        pipeline fetches it once, not per grid step."""
+        nd = a.ndim
+        return pl.BlockSpec(a.shape, lambda i, j, _nd=nd: (0,) * _nd)
+
+    fwd_mats = tuple(
+        lift(a) for a in (m["fre"], m["fim"], m["dre"], m["dim"])
+    )
+    inv_mats = tuple(
+        lift(a) for a in (m["ire"], m["iim"], m["wre"], m["wim"])
+    )
+    fwd_specs = [full_spec(a) for a in fwd_mats]
+    inv_specs = [full_spec(a) for a in inv_mats]
+
+    def kernel_a(z_ref, du_ref, dr_ref, di_ref, br_ref, bi_ref,
+                 fre_ref, fim_ref, cre_ref, cim_ref,
+                 dual_ref, tr_ref, ti_ref):
+        j = pl.program_id(1)
+        zt = z_ref[0].astype(jnp.float32)
+        dt = du_ref[0].astype(jnp.float32)
+        xr, xi_, dual_new = _xi_spectra(
+            zt, dt, theta, fre_ref[:], fim_ref[:], cre_ref[:], cim_ref[:]
+        )
+        dual_ref[0] = dual_new.astype(sd)
+        drt = dr_ref[pl.ds(j * kt, kt)]
+        dit = di_ref[pl.ds(j * kt, kt)]
+        gr, gi = _g(xr, xi_, drt, dit, br_ref[0], bi_ref[0], inv_rho)
+        # t += sum_k d_k * g_k (complex)
+        pr = jnp.sum(drt * gr - dit * gi, axis=0)
+        pi = jnp.sum(drt * gi + dit * gr, axis=0)
+
+        @pl.when(j == 0)
+        def _():
+            tr_ref[0] = jnp.zeros((Sy, Fx), jnp.float32)
+            ti_ref[0] = jnp.zeros((Sy, Fx), jnp.float32)
+
+        tr_ref[0] = tr_ref[0] + pr
+        ti_ref[0] = ti_ref[0] + pi
+
+    dual_new, t_re, t_im = pl.pallas_call(
+        kernel_a,
+        grid=(N, nk),
+        in_specs=[state_spec, state_spec, d_spec, d_spec, img_spec,
+                  img_spec, *fwd_specs],
+        out_specs=[state_spec, img_spec, img_spec],
+        out_shape=[
+            sds(z.shape, sd),
+            sds((N, Sy, Fx), jnp.float32),
+            sds((N, Sy, Fx), jnp.float32),
+        ],
+        interpret=interpret,
+    )(z, dual, dr, di, br, bi, *fwd_mats)
+
+    # rank-1 inner solve: s = minv_diag * t (tiny, plain XLA)
+    s_re = minv_diag[None] * t_re
+    s_im = minv_diag[None] * t_im
+
+    def kernel_b(z_ref, du_ref, dr_ref, di_ref, br_ref, bi_ref,
+                 sr_ref, si_ref,
+                 fre_ref, fim_ref, cre_ref, cim_ref,
+                 ire_ref, iim_ref, wre_ref, wim_ref,
+                 zout_ref):
+        j = pl.program_id(1)
+        zt = z_ref[0].astype(jnp.float32)
+        dt = du_ref[0].astype(jnp.float32)
+        xr, xi_, _ = _xi_spectra(
+            zt, dt, theta, fre_ref[:], fim_ref[:], cre_ref[:], cim_ref[:]
+        )
+        drt = dr_ref[pl.ds(j * kt, kt)]
+        dit = di_ref[pl.ds(j * kt, kt)]
+        gr, gi = _g(xr, xi_, drt, dit, br_ref[0], bi_ref[0], inv_rho)
+        # z_hat = g - (1/rho) conj(d) s
+        sr = sr_ref[0]
+        si = si_ref[0]
+        zr = gr - inv_rho * (drt * sr[None] + dit * si[None])
+        zi = gi - inv_rho * (drt * si[None] - dit * sr[None])
+        # inverse y-axis DFT (HIGHEST precision — see _xi_spectra)
+        ein = functools.partial(
+            jnp.einsum,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        ire, iim = ire_ref[:], iim_ref[:]
+        yr = ein("kuv,uy->kyv", zr, ire) - ein("kuv,uy->kyv", zi, iim)
+        yi = ein("kuv,uy->kyv", zr, iim) + ein("kuv,uy->kyv", zi, ire)
+        # inverse last-axis half-spectrum transform (real output)
+        out = (
+            ein("kyv,vx->kyx", yr, wre_ref[:])
+            - ein("kyv,vx->kyx", yi, wim_ref[:])
+        )
+        zout_ref[0] = out.astype(sd)
+
+    z_new = pl.pallas_call(
+        kernel_b,
+        grid=(N, nk),
+        in_specs=[state_spec, state_spec, d_spec, d_spec, img_spec,
+                  img_spec, img_spec, img_spec, *fwd_specs, *inv_specs],
+        out_specs=state_spec,
+        out_shape=sds(z.shape, sd),
+        interpret=interpret,
+    )(z, dual, dr, di, br, bi, s_re, s_im, *fwd_mats, *inv_mats)
+
+    return z_new, dual_new
+
+
+def fused_z_iter_reference(z, dual, bhat, dhat, minv_diag, rho, theta):
+    """Dense jnp re-statement of the fused iteration, for parity tests:
+    exactly the prox/DFT/solve/iDFT composition the kernel fuses."""
+    f32 = lambda x: x.astype(jnp.float32)
+    s = f32(z) + f32(dual)
+    u2 = proxes.soft_threshold(s, theta)
+    dual_new = s - u2
+    xi = 2.0 * u2 - s
+    xihat = fourier.rfftn_spatial(xi, 2, impl="matmul")
+    g = jnp.conj(dhat)[None] * bhat[:, None] / rho + xihat
+    t = jnp.sum(dhat[None] * g, axis=1)
+    s_f = minv_diag[None] * t
+    zhat = g - jnp.conj(dhat)[None] * s_f[:, None] / rho
+    z_new = fourier.irfftn_spatial(zhat, z.shape[-2:], impl="matmul")
+    return z_new.astype(z.dtype), dual_new.astype(z.dtype)

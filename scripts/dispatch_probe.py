@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Per-dispatch overhead probe for the axon TPU tunnel.
+
+The r4 microbenchmark showed even a pure elementwise op moving bytes
+at ~10% of datasheet HBM bandwidth. Two hypotheses: (a) the kernels
+are bandwidth-inefficient, (b) a fixed per-call overhead (tunnel
+round-trip + dispatch) dominates at these sizes. This probe times one
+jitted elementwise op across sizes spanning 4 decades; the y-intercept
+of time-vs-bytes is the fixed overhead, the slope is the real
+streaming bandwidth. Prints one JSON line per size plus a fit line.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    reps = int(os.environ.get("DP_REPS", 10))
+    sizes_mb = [0.004, 0.04, 0.4, 4, 40, 400]
+    rows = []
+    for mb in sizes_mb:
+        n = max(int(mb * 1e6 / 4), 256)
+        x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+
+        def st(a):
+            o = jnp.sign(a) * jnp.maximum(jnp.abs(a) - 0.1, 0.0)
+            # reduce over the WHOLE result: a [0]-element fence would
+            # let XLA sink the slice and never stream the array
+            return jnp.sum(o)
+
+        f = jax.jit(st)
+        float(f(x))  # compile + fence
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(x)
+        float(out)
+        dt = (time.perf_counter() - t0) / reps
+        rows.append((2 * n * 4, dt))  # read + write bytes
+        print(
+            json.dumps(
+                {"bytes": 2 * n * 4, "ms": round(dt * 1e3, 4)}
+            ),
+            flush=True,
+        )
+    b = np.array([r[0] for r in rows], float)
+    t = np.array([r[1] for r in rows], float)
+    slope, intercept = np.polyfit(b, t, 1)
+    print(
+        json.dumps(
+            {
+                "fit": "t = overhead + bytes/bw",
+                "overhead_ms": round(intercept * 1e3, 3),
+                "streaming_gbps": round(1e-9 / slope, 1)
+                if slope > 0
+                else None,
+                "platform": jax.devices()[0].platform,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+#!/bin/bash
+# Round-4 on-chip queue, phase 3: items stranded by the tunnel wedge
+# during the phase-1 profile arm (the profile itself — now fixed to
+# pass zkern as an argument instead of a jit-captured constant — plus
+# the dispatch-overhead probe and the matmul_bf16 precision arm).
+#
+# Arms are read from scripts/onchip_arms.txt (one "label env..." per
+# line) so later work can append arms without touching a running
+# script. Waits for any other queue phase to exit first (single-client
+# tunnel).
+set -u
+cd "$(dirname "$0")/.."
+OUT=onchip_r4.jsonl
+LOG=/tmp/onchip_queue3.log
+ARMS=scripts/onchip_arms.txt
+
+probe() {
+  timeout 60 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform in ('tpu', 'axon')
+x = jnp.ones((128, 128)); float((x @ x).sum())
+" > /dev/null 2>&1
+}
+
+note() { echo "{\"note\": \"$1\", \"at\": \"$(date +%H:%M:%S)\"}" >> "$OUT"; }
+
+run_bench() { # label, env pairs...
+  local label=$1; shift
+  echo "=== $label $(date +%H:%M:%S)" >> "$LOG"
+  local line
+  line=$(env "$@" CCSC_BENCH_TIMEOUT=2000 timeout 4000 python bench.py 2>> "$LOG" | tail -1)
+  if [ -n "$line" ] && echo "$line" | python -c \
+      'import json,sys; json.load(sys.stdin)' > /dev/null 2>&1; then
+    echo "{\"run\": \"$label\", \"result\": $line}" >> "$OUT"
+  else
+    note "$label FAILED/empty"
+  fi
+}
+
+while pgrep -f "scripts/onchip_queue.sh|scripts/onchip_queue2.sh" \
+    | grep -qv $$ 2>/dev/null; do
+  echo "$(date +%H:%M:%S) earlier phase still running" >> "$LOG"
+  sleep 120
+done
+
+while true; do
+  if probe; then
+    note "phase 3 start"
+    if [ -f "$ARMS" ]; then
+      while read -r label envs; do
+        [ -z "$label" ] && continue
+        case "$label" in \#*) continue ;; esac
+        # shellcheck disable=SC2086
+        run_bench "$label" $envs
+      done < "$ARMS"
+    fi
+    echo "=== dispatch_probe $(date +%H:%M:%S)" >> "$LOG"
+    timeout 1200 python scripts/dispatch_probe.py >> "$OUT" 2>> "$LOG" \
+      || note "dispatch_probe FAILED"
+    note "phase 3 complete"
+    break
+  fi
+  echo "$(date +%H:%M:%S) tunnel down" >> "$LOG"
+  sleep 240
+done

@@ -1,0 +1,148 @@
+"""Parity tests for the fused z-iteration Pallas kernel
+(ops.pallas_fused_z; interpret mode on CPU — SURVEY.md section 4's
+fake-backend strategy). The kernel fuses the entire z ADMM inner
+iteration of the consensus learner (dzParallel.m:150-158)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+from ccsc_code_iccv2017_tpu.models.learn import learn
+from ccsc_code_iccv2017_tpu.ops import freq_solvers, pallas_fused_z, proxes
+
+
+def _problem(N=3, K=6, Sy=12, Sx=10, seed=0):
+    rng = np.random.default_rng(seed)
+    z = rng.standard_normal((N, K, Sy, Sx)).astype(np.float32)
+    du = rng.standard_normal((N, K, Sy, Sx)).astype(np.float32)
+    d = rng.standard_normal((K, Sy, Sx)).astype(np.float32)
+    dhat = np.fft.rfftn(d, axes=(-2, -1)).astype(np.complex64)
+    b = rng.standard_normal((N, Sy, Sx)).astype(np.float32)
+    bhat = np.fft.rfftn(b, axes=(-2, -1)).astype(np.complex64)
+    rho = 1.0
+    minv = (1.0 / (1.0 + np.sum(np.abs(dhat) ** 2, 0) / rho)).astype(
+        np.float32
+    )
+    return z, du, bhat, dhat, minv, rho
+
+
+@pytest.mark.parametrize("Sy,Sx", [(12, 10), (9, 9)])
+def test_fused_z_iter_matches_einsum_composition(Sy, Sx):
+    """The kernel equals the exact prox/FFT/solve_z/iFFT composition it
+    fuses — including odd transform lengths."""
+    z, du, bhat, dhat, minv, rho = _problem(Sy=Sy, Sx=Sx)
+    theta = 0.35
+    N, K = z.shape[:2]
+    Fx = Sx // 2 + 1
+    zk, dk = pallas_fused_z.fused_z_iter(
+        jnp.asarray(z), jnp.asarray(du), jnp.asarray(bhat),
+        jnp.asarray(dhat), jnp.asarray(minv), rho, theta, interpret=True,
+    )
+    # composition via the production ops
+    s = z + du
+    u2 = np.asarray(proxes.soft_threshold(jnp.asarray(s), theta))
+    dual_new = s - u2
+    xi = 2 * u2 - s
+    xihat = np.fft.rfftn(xi, axes=(-2, -1)).astype(np.complex64)
+    zkern = freq_solvers.precompute_z_kernel(
+        jnp.asarray(dhat.reshape(K, 1, -1)), rho
+    )
+    zhat = freq_solvers.solve_z(
+        zkern,
+        jnp.asarray(bhat.reshape(N, 1, -1)),
+        jnp.asarray(xihat.reshape(N, K, -1)),
+        rho,
+    )
+    z_ref = np.fft.irfftn(
+        np.asarray(zhat).reshape(N, K, Sy, Fx), s=(Sy, Sx), axes=(-2, -1)
+    )
+    np.testing.assert_allclose(np.asarray(zk), z_ref, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(dk), dual_new, atol=1e-6)
+
+
+def test_fused_z_iter_bf16_state():
+    """bf16 state round-trips with only storage rounding (math in f32)."""
+    z, du, bhat, dhat, minv, rho = _problem()
+    zk, dk = pallas_fused_z.fused_z_iter(
+        jnp.asarray(z).astype(jnp.bfloat16),
+        jnp.asarray(du).astype(jnp.bfloat16),
+        jnp.asarray(bhat), jnp.asarray(dhat), jnp.asarray(minv),
+        rho, 0.35, interpret=True,
+    )
+    assert zk.dtype == jnp.bfloat16 and dk.dtype == jnp.bfloat16
+    zf, _ = pallas_fused_z.fused_z_iter_reference(
+        jnp.asarray(z), jnp.asarray(du), jnp.asarray(bhat),
+        jnp.asarray(dhat), jnp.asarray(minv), rho, 0.35,
+    )
+    err = float(jnp.abs(zk.astype(jnp.float32) - zf).max())
+    scale = float(jnp.abs(zf).max())
+    assert err < 0.02 * scale, (err, scale)
+
+
+def test_learner_fused_z_matches_composition():
+    """LearnConfig(fused_z=True) reproduces the default learner
+    trajectory to float tolerance (interpret mode on CPU)."""
+    rng = np.random.default_rng(4)
+    b = jnp.asarray(rng.standard_normal((4, 12, 12)).astype(np.float32))
+    geom = ProblemGeom((5, 5), 6)
+    kw = dict(
+        max_it=2, max_it_d=2, max_it_z=2, num_blocks=2,
+        rho_d=500.0, rho_z=10.0, lambda_prior=0.5,
+        verbose="none", track_objective=True,
+    )
+    r_ref = learn(b, geom, LearnConfig(**kw), key=jax.random.PRNGKey(1))
+    r_fus = learn(
+        b, geom, LearnConfig(**kw, fused_z=True), key=jax.random.PRNGKey(1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_ref.d), np.asarray(r_fus.d), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        r_ref.trace["obj_vals_z"], r_fus.trace["obj_vals_z"], rtol=1e-5
+    )
+
+
+def test_fused_z_falls_back_when_unsupported():
+    """W > 1 geometry silently takes the composition path (identical
+    results, no error)."""
+    rng = np.random.default_rng(5)
+    b = jnp.asarray(rng.standard_normal((2, 3, 12, 12)).astype(np.float32))
+    geom = ProblemGeom((5, 5), 4, (3,))
+    kw = dict(
+        max_it=1, max_it_d=1, max_it_z=2, num_blocks=1,
+        verbose="none", track_objective=True,
+    )
+    r_ref = learn(b, geom, LearnConfig(**kw), key=jax.random.PRNGKey(0))
+    r_fus = learn(
+        b, geom, LearnConfig(**kw, fused_z=True), key=jax.random.PRNGKey(0)
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_ref.d), np.asarray(r_fus.d), atol=1e-7
+    )
+
+
+def test_learner_fused_z_mesh_matches_local():
+    """fused_z under a 4-device block mesh equals the unsharded run
+    (off-TPU the sharded fused path routes through the identical-math
+    jnp reference — pallas interpret mode cannot run under
+    shard_map's vma checks; the mosaic lowering on real TPU can)."""
+    from ccsc_code_iccv2017_tpu.parallel.mesh import block_mesh
+
+    rng = np.random.default_rng(6)
+    b = jnp.asarray(rng.standard_normal((8, 12, 12)).astype(np.float32))
+    geom = ProblemGeom((5, 5), 6)
+    kw = dict(
+        max_it=2, max_it_d=2, max_it_z=2, num_blocks=4,
+        verbose="none", track_objective=True,
+    )
+    r_local = learn(
+        b, geom, LearnConfig(**kw, fused_z=True), key=jax.random.PRNGKey(0)
+    )
+    r_mesh = learn(
+        b, geom, LearnConfig(**kw, fused_z=True), key=jax.random.PRNGKey(0),
+        mesh=block_mesh(4),
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_local.d), np.asarray(r_mesh.d), atol=1e-5
+    )

@@ -184,3 +184,25 @@ def test_streaming_matches_in_memory_with_fft_pad_and_bf16():
     np.testing.assert_allclose(
         np.asarray(res_s.Dz), np.asarray(res_m.Dz), atol=5e-3
     )
+
+
+def test_streaming_matches_in_memory_with_matmul_fft():
+    """fft_impl='matmul' in the streaming learner matches the in-memory
+    learner configured the same way — the execution strategy composes
+    with host-streaming like the other knobs."""
+    import dataclasses
+
+    geom, cfg, b = _problem()
+    cfg = dataclasses.replace(cfg, fft_impl="matmul")
+    res_s = streaming.learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(0))
+    res_m = learn_mod.learn(
+        jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0)
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_s.d), np.asarray(res_m.d), atol=5e-4
+    )
+    np.testing.assert_allclose(
+        res_s.trace["obj_vals_z"][1:],
+        res_m.trace["obj_vals_z"][1:],
+        rtol=2e-3,
+    )
