@@ -110,6 +110,7 @@ def analytic_outer_step_cost(
     reduce_size: int = 1,
     dtype_bytes: int = 4,
     fft_impl: str = "xla",
+    fused_z: bool = False,
 ) -> Dict[str, float]:
     """Closed-form FLOP / HBM-byte count of ONE consensus outer step
     (models.learn.outer_step): the d-pass code-Gram + Cholesky +
@@ -143,12 +144,20 @@ def analytic_outer_step_cost(
     # z-pass filter spectra + per-iteration solves
     flops += _fft_flops(spatial, k * W, fft_impl)
     for _ in range(max_it_z):
-        # codes FFT fwd+inv
-        flops += 2 * _fft_flops(spatial, n_imgs * k, fft_impl)
-        # scalar-path Sherman-Morrison: 3 einsums of k MACs per (n, f)
-        flops += 8.0 * 3 * n_imgs * k * F * W
-        # soft-threshold + dual updates: ~6 elementwise ops
-        flops += 6.0 * n_imgs * k * S
+        if fused_z:
+            # fused kernel (ops.pallas_fused_z): pass B recomputes the
+            # forward spectra, so 3 transform-equivalents at matmul
+            # cost; prox runs twice
+            flops += 3 * _fft_flops(spatial, n_imgs * k, "matmul")
+            flops += 8.0 * 3 * n_imgs * k * F * W
+            flops += 12.0 * n_imgs * k * S
+        else:
+            # codes FFT fwd+inv
+            flops += 2 * _fft_flops(spatial, n_imgs * k, fft_impl)
+            # scalar-path Sherman-Morrison: 3 einsums of k MACs per (n, f)
+            flops += 8.0 * 3 * n_imgs * k * F * W
+            # soft-threshold + dual updates: ~6 elementwise ops
+            flops += 6.0 * n_imgs * k * S
 
     z_bytes = n_imgs * k * S * dtype_bytes  # codes, spatial domain
     zh_bytes = n_imgs * k * F * cplx  # code spectra
@@ -160,8 +169,15 @@ def analytic_outer_step_cost(
         bytes_ += 2 * N * k * W * F * cplx  # filter spectra r/w
         bytes_ += N * F * ni * ni * cplx  # ginv read
     for _ in range(max_it_z):
-        bytes_ += 4 * z_bytes  # z, dual, u2, xi2
-        bytes_ += 3 * zh_bytes  # spectra through the solve
+        if fused_z:
+            # fused kernel HBM traffic: pass A reads z+dual and writes
+            # dual'+t; pass B re-reads z+dual (+s) and writes z' — the
+            # spectra never leave VMEM
+            bytes_ += 5 * z_bytes
+            bytes_ += 2 * n_imgs * F * 8  # t/s re+im f32 buffers
+        else:
+            bytes_ += 4 * z_bytes  # z, dual, u2, xi2
+            bytes_ += 3 * zh_bytes  # spectra through the solve
     return {"flops": flops, "bytes": bytes_}
 
 

@@ -222,3 +222,28 @@ def test_masked_learner_fft_pad_and_bf16():
     assert m >= 2
     dev = np.max(np.abs(o32[:m] - o16[:m]) / np.abs(o32[:m]))
     assert dev < 0.02, dev
+
+
+def test_masked_learner_fft_impl_matmul():
+    """fft_impl='matmul' reproduces the masked learner's trajectory to
+    float tolerance (W>1 geometry — the spatial FFT axes go through
+    the DFT-matmul path, the wavelength axis stays a reduce axis)."""
+    lm = learn_masked.learn_masked
+    r = np.random.default_rng(23)
+    b = jnp.asarray(r.uniform(0.1, 1.0, (2, 3, 20, 20)), jnp.float32)
+    geom = ProblemGeom((5, 5), 4, (3,))
+    kw = dict(max_it=2, max_it_d=3, max_it_z=3, tol=0.0, verbose="none",
+              track_objective=True)
+    r_xla = lm(b, geom, LearnConfig(**kw), key=jax.random.PRNGKey(2))
+    r_mm = lm(
+        b, geom, LearnConfig(**kw, fft_impl="matmul"),
+        key=jax.random.PRNGKey(2),
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_xla.d), np.asarray(r_mm.d), atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_xla.trace["obj_vals_z"]),
+        np.asarray(r_mm.trace["obj_vals_z"]),
+        rtol=2e-4,
+    )

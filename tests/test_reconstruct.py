@@ -454,3 +454,26 @@ def test_fft_pad_fast_reconstruction():
             SolveConfig(**base, fft_pad="pow2"),
             mask=jnp.asarray(mask[None]),
         )
+
+
+def test_unpadded_reconstruction_fft_impl_matmul():
+    """fft_impl='matmul' on the unpadded W>1 (demosaic-style) solver
+    matches the jnp.fft path to float tolerance."""
+    r = np.random.default_rng(31)
+    d = _toy_dictionary(k=6, seed=11, reduce_shape=(4,))
+    geom = ProblemGeom((5, 5), 6, reduce_shape=(4,))
+    x = np.stack([_toy_image(24, seed=s) for s in range(4)])
+    mask = (r.random((4, 24, 24)) < 0.4).astype(np.float32)
+    prob = ReconstructionProblem(geom, pad=False)
+    outs = {}
+    for impl in ("xla", "matmul"):
+        cfg = SolveConfig(
+            lambda_residual=100.0, lambda_prior=0.3, max_it=15,
+            tol=1e-5, verbose="none", fft_impl=impl,
+        )
+        res = reconstruct(
+            jnp.asarray((x * mask)[None]), d, prob, cfg,
+            mask=jnp.asarray(mask[None]),
+        )
+        outs[impl] = np.asarray(res.recon)
+    np.testing.assert_allclose(outs["xla"], outs["matmul"], atol=2e-4)
