@@ -161,6 +161,7 @@ def run_workload():
             num_freq=fg.num_freq,
             max_it_d=cfg.max_it_d,
             max_it_z=cfg.max_it_z,
+            state_dtype_bytes=2 if storage == "bfloat16" else 4,
             fft_impl=fft_impl,
             fused_z=fused_z,
         )

@@ -7,6 +7,34 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 
+def add_perf_args(parser, fft_pad: bool = True, fused: bool = False) -> None:
+    """The shared execution-strategy flags (one definition so the
+    vocabulary and help text cannot drift across the 9 apps).
+
+    ``fft_pad=False`` for unpadded (pure-circular) problems, where a
+    fast FFT domain would change the problem (demosaic/view-synth);
+    ``fused=True`` only where the fused z kernel can engage (2D W=1
+    learners)."""
+    if fft_pad:
+        parser.add_argument(
+            "--fft-pad", default="none", choices=["none", "pow2", "fast"],
+            help="round the FFT domain up to a TPU-friendly size",
+        )
+    parser.add_argument(
+        "--fft-impl", default="xla",
+        choices=["xla", "matmul", "matmul_bf16"],
+        help="FFT execution strategy (matmul = DFT matrices on the "
+        "MXU; measured on-chip wins in PERF.md)",
+    )
+    if fused:
+        parser.add_argument(
+            "--fused-z",
+            action="store_true",
+            help="fused z-iteration Pallas kernel (2D W=1 learners; "
+            "ops.pallas_fused_z)",
+        )
+
+
 def add_mat_layout_arg(parser) -> None:
     """The shared --mat-layout flag for apps that accept .mat image
     stacks (one definition so the vocabulary cannot drift)."""

@@ -27,10 +27,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lambda-residual", type=float, default=10000.0)
     p.add_argument("--lambda-prior", type=float, default=0.125)
     p.add_argument("--max-it", type=int, default=120)
-    p.add_argument(
-        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
-        help="round the FFT domain up to a TPU-friendly size",
-    )
+    from ._dispatch import add_perf_args
+
+    add_perf_args(p)
     p.add_argument("--tol", type=float, default=1e-6)
     p.add_argument("--seed", type=int, default=0)
     return p
@@ -102,6 +101,7 @@ def main(argv=None):
         max_it=args.max_it,
         tol=args.tol,
         fft_pad=args.fft_pad,
+        fft_impl=args.fft_impl,
         gamma_factor=500.0,
         gamma_ratio=1.0,
     )

@@ -23,7 +23,7 @@ import numpy as np
 
 
 def build_parser() -> argparse.ArgumentParser:
-    from ._dispatch import add_mat_layout_arg
+    from ._dispatch import add_mat_layout_arg, add_perf_args
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="test image folder")
@@ -32,10 +32,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lambda-residual", type=float, default=5.0)
     p.add_argument("--lambda-prior", type=float, default=2.0)
     p.add_argument("--max-it", type=int, default=100)
-    p.add_argument(
-        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
-        help="round the FFT domain up to a TPU-friendly size",
-    )
+    add_perf_args(p)
     p.add_argument("--tol", type=float, default=1e-3)
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--size", type=int, default=None)
@@ -76,6 +73,7 @@ def main(argv=None):
         max_it=args.max_it,
         tol=args.tol,
         fft_pad=args.fft_pad,
+        fft_impl=args.fft_impl,
     )
     res = reconstruct(
         jnp.asarray(b * mask),

@@ -17,7 +17,7 @@ import time
 
 
 def build_parser() -> argparse.ArgumentParser:
-    from ._dispatch import add_mat_layout_arg
+    from ._dispatch import add_mat_layout_arg, add_perf_args
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="image folder")
@@ -56,10 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="host-streaming mode: one consensus block on device at a "
         "time (bounded HBM; parallel.streaming)",
     )
-    p.add_argument(
-        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
-        help="round the FFT domain up to a TPU-friendly size",
-    )
+    add_perf_args(p, fused=True)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -106,6 +103,8 @@ def main(argv=None):
         num_blocks=args.blocks,
         verbose=args.verbose,
         fft_pad=args.fft_pad,
+        fft_impl=args.fft_impl,
+        fused_z=args.fused_z,
         storage_dtype=args.storage_dtype,
     )
     mesh = block_mesh(args.mesh) if args.mesh else None

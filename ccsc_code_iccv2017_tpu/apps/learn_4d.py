@@ -39,10 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
         "time (bounded HBM; parallel.streaming)",
     )
     p.add_argument("--out", default="4d_filters_lightfield.mat")
-    p.add_argument(
-        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
-        help="round the FFT domain up to a TPU-friendly size",
-    )
+    from ._dispatch import add_perf_args
+
+    add_perf_args(p)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -98,6 +97,7 @@ def main(argv=None):
         num_blocks=args.blocks,
         verbose=args.verbose,
         fft_pad=args.fft_pad,
+        fft_impl=args.fft_impl,
         storage_dtype=args.storage_dtype,
     )
     from ._dispatch import dispatch_learn

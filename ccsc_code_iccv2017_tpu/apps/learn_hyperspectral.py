@@ -41,10 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--streaming-blocks", type=int, default=4)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=5)
-    p.add_argument(
-        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
-        help="round the FFT domain up to a TPU-friendly size",
-    )
+    from ._dispatch import add_perf_args
+
+    add_perf_args(p)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -106,6 +105,7 @@ def main(argv=None):
         tol=args.tol,
         verbose=args.verbose,
         fft_pad=args.fft_pad,
+        fft_impl=args.fft_impl,
         storage_dtype=args.storage_dtype,
     )
     init_d = (

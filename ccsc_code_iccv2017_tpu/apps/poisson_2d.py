@@ -21,7 +21,7 @@ import numpy as np
 
 
 def build_parser() -> argparse.ArgumentParser:
-    from ._dispatch import add_mat_layout_arg
+    from ._dispatch import add_mat_layout_arg, add_perf_args
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="image folder")
@@ -31,10 +31,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lambda-prior", type=float, default=1.0)
     p.add_argument("--lambda-smooth", type=float, default=0.5)
     p.add_argument("--max-it", type=int, default=50)
-    p.add_argument(
-        "--fft-pad", default="none", choices=["none", "pow2", "fast"],
-        help="round the FFT domain up to a TPU-friendly size",
-    )
+    add_perf_args(p)
     p.add_argument("--tol", type=float, default=1e-4)
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--size", type=int, default=None)
@@ -72,6 +69,7 @@ def main(argv=None):
         max_it=args.max_it,
         tol=args.tol,
         fft_pad=args.fft_pad,
+        fft_impl=args.fft_impl,
         gamma_factor=20.0,
         gamma_ratio=5.0,
     )

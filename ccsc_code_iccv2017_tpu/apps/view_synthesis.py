@@ -28,6 +28,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-it", type=int, default=200)
     p.add_argument("--tol", type=float, default=1e-4)
     p.add_argument("--seed", type=int, default=0)
+    from ._dispatch import add_perf_args
+
+    add_perf_args(p, fft_pad=False)
     return p
 
 
@@ -97,6 +100,7 @@ def main(argv=None):
     geom = ProblemGeom(d.shape[3:], k, (a1, a2))
     prob = ReconstructionProblem(geom, pad=False)
     cfg = SolveConfig(
+        fft_impl=args.fft_impl,
         lambda_residual=args.lambda_residual,
         lambda_prior=args.lambda_prior,
         max_it=args.max_it,

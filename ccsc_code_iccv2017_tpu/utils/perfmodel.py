@@ -111,6 +111,7 @@ def analytic_outer_step_cost(
     dtype_bytes: int = 4,
     fft_impl: str = "xla",
     fused_z: bool = False,
+    state_dtype_bytes: Optional[int] = None,
 ) -> Dict[str, float]:
     """Closed-form FLOP / HBM-byte count of ONE consensus outer step
     (models.learn.outer_step): the d-pass code-Gram + Cholesky +
@@ -159,7 +160,10 @@ def analytic_outer_step_cost(
             # soft-threshold + dual updates: ~6 elementwise ops
             flops += 6.0 * n_imgs * k * S
 
-    z_bytes = n_imgs * k * S * dtype_bytes  # codes, spatial domain
+    # codes in the spatial domain carry the STORAGE dtype
+    # (LearnConfig.storage_dtype — bf16 halves exactly this term);
+    # spectra and dictionary fields are always f32/complex64
+    z_bytes = n_imgs * k * S * (state_dtype_bytes or dtype_bytes)
     zh_bytes = n_imgs * k * F * cplx  # code spectra
     bytes_ = 0.0
     bytes_ += z_bytes + zh_bytes  # initial zhat
@@ -171,9 +175,9 @@ def analytic_outer_step_cost(
     for _ in range(max_it_z):
         if fused_z:
             # fused kernel HBM traffic: pass A reads z+dual and writes
-            # dual'+t; pass B re-reads z+dual (+s) and writes z' — the
-            # spectra never leave VMEM
-            bytes_ += 5 * z_bytes
+            # dual'+t; pass B re-reads z+dual (+s) and writes z' — six
+            # z-sized transfers; the spectra never leave VMEM
+            bytes_ += 6 * z_bytes
             bytes_ += 2 * n_imgs * F * 8  # t/s re+im f32 buffers
         else:
             bytes_ += 4 * z_bytes  # z, dual, u2, xi2

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""On-chip trajectory-accuracy probe for the execution-strategy knobs.
+
+CPU tests can bound fft_impl='matmul' (exact-precision matmuls) but
+NOT 'matmul_bf16' (DEFAULT precision truncates to bf16 only on the
+MXU) or the real-mosaic fused_z kernel (interpret mode runs f32).
+This probe runs one small-but-representative consensus learn per
+config ON THE CHIP with a fixed seed and reports each config's
+objective-trajectory deviation from the f32 jnp.fft reference —
+the accuracy half of the PERF.md knob table.
+
+Prints one JSON line per config plus the reference.
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+from ccsc_code_iccv2017_tpu.models.learn import learn
+
+
+def main():
+    n = int(os.environ.get("AP_N", 16))
+    size = int(os.environ.get("AP_SIZE", 48))
+    k = int(os.environ.get("AP_K", 16))
+    outers = int(os.environ.get("AP_ITERS", 5))
+    rng = np.random.default_rng(0)
+    b = jnp.asarray(rng.standard_normal((n, size, size)).astype(np.float32))
+    geom = ProblemGeom((11, 11), k)
+    base = dict(
+        max_it=outers, max_it_d=5, max_it_z=10, num_blocks=2,
+        rho_d=5000.0, rho_z=1.0, verbose="none", track_objective=True,
+    )
+    configs = {
+        "reference_xla_f32": {},
+        "matmul": {"fft_impl": "matmul"},
+        "matmul_bf16prec": {"fft_impl": "matmul_bf16"},
+        "bf16_storage": {"storage_dtype": "bfloat16"},
+        "fused_z": {"fused_z": True},
+        "fused_z_bf16": {"fused_z": True, "storage_dtype": "bfloat16"},
+    }
+    ref = None
+    for name, kw in configs.items():
+        res = learn(
+            b, geom, LearnConfig(**base, **kw), key=jax.random.PRNGKey(0)
+        )
+        obj = np.asarray(res.trace["obj_vals_z"], np.float64)
+        row = {"config": name, "obj_final": float(obj[-1]),
+               "platform": jax.devices()[0].platform}
+        if ref is None:
+            ref = obj
+        else:
+            m = min(len(ref), len(obj))
+            row["max_rel_obj_dev_vs_ref"] = float(
+                np.max(np.abs(obj[:m] - ref[:m]) / np.abs(ref[:m]))
+            )
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

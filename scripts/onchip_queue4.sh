@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-4 on-chip queue, phase 4: trajectory-accuracy probe for the
+# execution-strategy knobs (scripts/accuracy_probe.py) — the evidence
+# CPU tests cannot produce (bf16 MXU truncation, real mosaic fused_z).
+# Waits for earlier phases (single-client tunnel), then runs once.
+set -u
+cd "$(dirname "$0")/.."
+OUT=onchip_r4.jsonl
+LOG=/tmp/onchip_queue4.log
+
+probe() {
+  timeout 60 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform in ('tpu', 'axon')
+x = jnp.ones((128, 128)); float((x @ x).sum())
+" > /dev/null 2>&1
+}
+
+note() { echo "{\"note\": \"$1\", \"at\": \"$(date +%H:%M:%S)\"}" >> "$OUT"; }
+
+while pgrep -f "scripts/onchip_queue.sh|scripts/onchip_queue2.sh|scripts/onchip_queue3.sh" \
+    | grep -qv $$ 2>/dev/null; do
+  echo "$(date +%H:%M:%S) earlier phase still running" >> "$LOG"
+  sleep 180
+done
+
+while true; do
+  if probe; then
+    note "phase 4 start (accuracy probe)"
+    timeout 2400 python scripts/accuracy_probe.py >> "$OUT" 2>> "$LOG" \
+      || note "accuracy_probe FAILED"
+    note "phase 4 complete"
+    break
+  fi
+  echo "$(date +%H:%M:%S) tunnel down" >> "$LOG"
+  sleep 240
+done
