@@ -221,10 +221,10 @@ def outer_step(
     zhat = jax.vmap(lambda zl: common.codes_to_freq(f32(zl), fg))(state.z)
     zhat_l = fslice(zhat)
     dkern = jax.vmap(
-        lambda zh: freq_solvers.precompute_d_kernel(
-            zh, cfg.rho_d, axis_name=filter_axis_name
+        lambda zh, bh: freq_solvers.precompute_d_kernel(
+            zh, cfg.rho_d, axis_name=filter_axis_name, b_hat=bh
         )
-    )(zhat_l)
+    )(zhat_l, bhat_l)
 
     def consensus_mean(x_l):
         """mean over ALL N blocks: local sum over L + psum over mesh."""
@@ -240,11 +240,11 @@ def outer_step(
         )
         dhat = fgather(
             jax.vmap(
-                lambda kern, bh, xh: freq_solvers.solve_d(
-                    kern, bh, xh, cfg.rho_d,
+                lambda kern, xh: freq_solvers.solve_d(
+                    kern, None, xh, cfg.rho_d,
                     axis_name=filter_axis_name,
                 )
-            )(dkern, bhat_l, xi_hat)
+            )(dkern, xi_hat)
         )
         d_new = jax.vmap(lambda dh: _filters_from_freq(dh, fg))(dhat)
         dbar_new = consensus_mean(d_new)  # the all-reduce (:115-121)

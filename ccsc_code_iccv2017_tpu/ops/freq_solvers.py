@@ -209,24 +209,37 @@ class DSolveKernel(NamedTuple):
           K x K inverse per frequency, dParallel.m:235; keeping the
           Ni x Ni factor and applying Z/Z^H as einsums is both smaller
           for K > Ni and MXU-batched).
+    zb:   optional [K, W, F] — Z^H b, hoisted when the data-side
+          target is constant across the inner d-iterations (the
+          consensus learner; it saves one full zhat read per
+          iteration). None when the target varies (masked learner).
     """
 
     zhat: jnp.ndarray
     ginv: jnp.ndarray
+    zb: Optional[jnp.ndarray] = None
 
 
 def precompute_d_kernel(
-    zhat: jnp.ndarray, rho: float, axis_name: Optional[str] = None
+    zhat: jnp.ndarray,
+    rho: float,
+    axis_name: Optional[str] = None,
+    b_hat: Optional[jnp.ndarray] = None,
 ) -> DSolveKernel:
     """zhat: [Ni, K, F]. ``axis_name``: K is this device's filter
     shard; the code Gram's k-sum is psummed so the Ni x Ni inverse is
-    replicated across filter shards."""
+    replicated across filter shards. ``b_hat`` [Ni, W, F]: pass the
+    data spectra to hoist the constant Z^H b out of the d-iterations
+    (k-local — no collective needed)."""
     Ni = zhat.shape[0]
     G = _ksum(
         jnp.einsum("nkf,mkf->fnm", zhat, jnp.conj(zhat)), axis_name
     )
     G = G + rho * jnp.eye(Ni, dtype=G.dtype)
-    return DSolveKernel(zhat, hermitian_inverse(G))
+    zb = None
+    if b_hat is not None:
+        zb = jnp.einsum("nkf,nwf->kwf", jnp.conj(zhat), b_hat)
+    return DSolveKernel(zhat, hermitian_inverse(G), zb)
 
 
 def solve_d(
@@ -248,7 +261,19 @@ def solve_d(
     r = Z^H b + rho * xi  (solve_conv_term_D, dParallel.m:252-276).
     """
     zhat, ginv = kernel.zhat, kernel.ginv
-    r = jnp.einsum("nkf,nwf->kwf", jnp.conj(zhat), b_hat) + rho * xi_hat
+    if kernel.zb is not None:
+        if b_hat is not None:
+            # a hoisted kernel bakes in its own data target; accepting
+            # a second one here would silently solve against the stale
+            # baked-in spectra (the masked learner's varying-target
+            # pattern must NOT use a hoisted kernel)
+            raise ValueError(
+                "kernel was built with a hoisted b_hat; pass b_hat=None"
+            )
+        zb = kernel.zb
+    else:
+        zb = jnp.einsum("nkf,nwf->kwf", jnp.conj(zhat), b_hat)
+    r = zb + rho * xi_hat
     t = _ksum(jnp.einsum("nkf,kwf->nwf", zhat, r), axis_name)
     s = jnp.einsum("fnm,mwf->nwf", ginv, t)
     return (r - jnp.einsum("nkf,nwf->kwf", jnp.conj(zhat), s)) / rho

@@ -137,11 +137,13 @@ def analytic_outer_step_cost(
     # Cholesky of [F, 2ni, 2ni] + 2 triangular solves per block
     m2 = 2 * ni
     flops += N * F * (m2**3 / 3.0 + m2**3)
+    # Z^H b hoisted out of the d-iterations (freq_solvers.DSolveKernel.zb)
+    flops += 8.0 * N * F * k * ni * W
     for _ in range(max_it_d):
         # filter FFT fwd+inv: N*k transforms each way
         flops += 2 * _fft_flops(spatial, N * k * W, fft_impl)
-        # solve_d einsums: r, t, s-apply, final — 8F(3 k ni W + ni^2)/blk
-        flops += 8.0 * N * F * (3 * k * ni * W + ni * ni)
+        # solve_d einsums: t, s-apply, final — 8F(2 k ni W + ni^2)/blk
+        flops += 8.0 * N * F * (2 * k * ni * W + ni * ni)
     # z-pass filter spectra + per-iteration solves
     flops += _fft_flops(spatial, k * W, fft_impl)
     for _ in range(max_it_z):

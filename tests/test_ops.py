@@ -195,17 +195,25 @@ def test_solve_z_with_extra_diag_vs_dense():
 
 
 @pytest.mark.parametrize("W", [1, 2])
-def test_solve_d_exact_vs_dense(W):
-    """(rho I_K + Z^H Z) x = Z^H b + rho xi vs numpy dense solve."""
+@pytest.mark.parametrize("hoisted", [False, True])
+def test_solve_d_exact_vs_dense(W, hoisted):
+    """(rho I_K + Z^H Z) x = Z^H b + rho xi vs numpy dense solve —
+    both the per-call Z^H b path and the hoisted-zb kernel (the
+    consensus learner's production path)."""
     r = _rng(11)
     K, F, Ni, rho = 5, 4, 3, 0.9
     zhat = r.normal(size=(Ni, K, F)) + 1j * r.normal(size=(Ni, K, F))
     bhat = r.normal(size=(Ni, W, F)) + 1j * r.normal(size=(Ni, W, F))
     xi = r.normal(size=(K, W, F)) + 1j * r.normal(size=(K, W, F))
-    kern = freq_solvers.precompute_d_kernel(jnp.asarray(zhat, jnp.complex64), rho)
+    kern = freq_solvers.precompute_d_kernel(
+        jnp.asarray(zhat, jnp.complex64), rho,
+        b_hat=jnp.asarray(bhat, jnp.complex64) if hoisted else None,
+    )
     x = np.asarray(
         freq_solvers.solve_d(
-            kern, jnp.asarray(bhat, jnp.complex64), jnp.asarray(xi, jnp.complex64), rho
+            kern,
+            None if hoisted else jnp.asarray(bhat, jnp.complex64),
+            jnp.asarray(xi, jnp.complex64), rho
         )
     )
     for f in range(F):
