@@ -87,6 +87,9 @@ def run_workload():
     fused_z = os.environ.get(
         "CCSC_BENCH_FUSEDZ", "1" if tuned.get("fused_z") else "0"
     ) == "1"
+    d_storage = os.environ.get(
+        "CCSC_BENCH_DSTORAGE", tuned.get("d_storage_dtype", "float32")
+    )
     geom = ProblemGeom((11, 11), k)
     cfg = LearnConfig(
         max_it=iters,
@@ -99,6 +102,7 @@ def run_workload():
         use_pallas=use_pallas,
         fft_pad=fft_pad,
         storage_dtype=storage,
+        d_storage_dtype=d_storage,
         fft_impl=fft_impl,
         fused_z=fused_z,
     )
@@ -113,7 +117,8 @@ def run_workload():
         jax.random.PRNGKey(1), (blocks, ni, size, size), jnp.float32
     )
     state = learn_mod.init_state(
-        key, geom, fg, blocks, ni, z_dtype=jnp.dtype(storage)
+        key, geom, fg, blocks, ni, z_dtype=jnp.dtype(storage),
+        d_dtype=jnp.dtype(d_storage),
     )
 
     step = consensus.make_outer_step(geom, cfg, fg, mesh=None)
@@ -162,6 +167,7 @@ def run_workload():
             max_it_d=cfg.max_it_d,
             max_it_z=cfg.max_it_z,
             state_dtype_bytes=2 if storage == "bfloat16" else 4,
+            d_state_dtype_bytes=2 if d_storage == "bfloat16" else 4,
             fft_impl=fft_impl,
             fused_z=fused_z,
         )
@@ -181,6 +187,7 @@ def run_workload():
         "knobs": {
             "fft_pad": fft_pad,
             "storage_dtype": storage,
+            "d_storage_dtype": d_storage,
             "use_pallas": use_pallas,
             "fft_impl": fft_impl,
             "fused_z": fused_z,

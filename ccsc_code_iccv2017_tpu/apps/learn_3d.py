@@ -47,6 +47,11 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["float32", "bfloat16"],
         help="storage dtype of the code state (bf16 halves HBM)",
     )
+    p.add_argument(
+        "--d-storage-dtype", default="float32",
+        choices=["float32", "bfloat16"],
+        help="storage dtype of the per-block dictionary state",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--verbose", default="brief")
     return p
@@ -92,6 +97,7 @@ def main(argv=None):
         fft_pad=args.fft_pad,
         fft_impl=args.fft_impl,
         storage_dtype=args.storage_dtype,
+        d_storage_dtype=args.d_storage_dtype,
     )
     from ._dispatch import dispatch_learn
 

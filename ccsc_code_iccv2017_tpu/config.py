@@ -147,8 +147,14 @@ class LearnConfig:
     # largest tensors, [n, k, *spatial]). 'bfloat16' halves their HBM
     # footprint and traffic; every computation still runs in float32
     # (cast-up at the scan boundary), so only the stored iterate is
-    # rounded. The dictionary-side state stays float32 (it is tiny).
+    # rounded.
     storage_dtype: str = "float32"
+    # Storage dtype of the per-block DICTIONARY state (d_local and its
+    # dual, [N, k, *spatial] — at n/k parity these are the same
+    # magnitude as one block's codes). Same f32-math/rounded-store
+    # contract as storage_dtype; the consensus average dbar/udbar
+    # stays f32 (it is tiny and feeds the global prox).
+    d_storage_dtype: str = "float32"
     # FFT implementation: 'xla' (jnp.fft), 'matmul' (explicit DFT
     # matrices — batched matmuls on the MXU; identical bytes moved,
     # O(side) extra flops per element on otherwise-idle MXU capacity,

@@ -75,21 +75,26 @@ def init_state(
     ni: int,
     dtype=jnp.float32,
     z_dtype=None,
+    d_dtype=None,
 ) -> LearnState:
     """Random init matching the reference's shapes: randn filters
     embedded at the origin (dzParallel.m:38-42), randn codes (:44-47),
     zero duals (:79-86). Returns global state with the FULL block axis
     [N, ...]; the driver reshapes to [ndev, L, ...] sharding as needed.
 
-    ``z_dtype``: storage dtype of the code state z/dual_z (the largest
-    tensors — LearnConfig.storage_dtype); defaults to ``dtype``. The
-    randn init is drawn in f32 then rounded, so bf16 storage starts
-    from the same trajectory as f32.
+    ``z_dtype`` / ``d_dtype``: storage dtypes of the code state
+    (z/dual_z) and the per-block dictionary state (d_local/dual_d) —
+    LearnConfig.storage_dtype / d_storage_dtype; both default to
+    ``dtype``. Inits are drawn in f32 then rounded, so bf16 storage
+    starts from the same trajectory as f32. The consensus averages
+    (dbar/udbar) always stay ``dtype``.
     """
     kd, kz = jax.random.split(key)
     d0 = jax.random.normal(kd, geom.filter_shape, dtype)
     d_full = fourier.circ_embed(d0, fg.spatial_shape)
-    d_locals = jnp.broadcast_to(d_full, (num_blocks, *d_full.shape))
+    d_locals = jnp.broadcast_to(d_full, (num_blocks, *d_full.shape)).astype(
+        d_dtype or dtype
+    )
     z0 = jax.random.normal(
         kz, (num_blocks, ni, geom.num_filters, *fg.spatial_shape), dtype
     ).astype(z_dtype or dtype)
@@ -230,8 +235,11 @@ def outer_step(
         """mean over ALL N blocks: local sum over L + psum over mesh."""
         return _psum(jnp.sum(x_l, 0), axis_name) / num_blocks
 
+    dsd = state.d_local.dtype  # d-state storage (d_storage_dtype)
+
     def d_iter(carry, _):
         d_local, dual_d, dbar, udbar = carry
+        d_local, dual_d = f32(d_local), f32(dual_d)
         u = prox_kernel(dbar + udbar)  # global prox (dzParallel.m:107)
         dual_d = dual_d + (d_local - u[None])
         xi_full = u[None] - dual_d  # [L, k, *red, *sp]
@@ -249,7 +257,10 @@ def outer_step(
         d_new = jax.vmap(lambda dh: _filters_from_freq(dh, fg))(dhat)
         dbar_new = consensus_mean(d_new)  # the all-reduce (:115-121)
         udbar_new = consensus_mean(dual_d)
-        return (d_new, dual_d, dbar_new, udbar_new), None
+        return (
+            (d_new.astype(dsd), dual_d.astype(dsd), dbar_new, udbar_new),
+            None,
+        )
 
     (d_local, dual_d, dbar, udbar), _ = jax.lax.scan(
         d_iter,
@@ -265,7 +276,7 @@ def outer_step(
     # (dzParallel.m:143 / dParallel.m:143), kept as a compat mode for
     # the MATLAB-anchored trajectory tests.
     if cfg.compat_coding == "block1":
-        d_code = d_local[0]
+        d_code = f32(d_local[0])
         if axis_name is not None:
             # global block 1 lives on device 0 of the block axis
             idx = jax.lax.axis_index(axis_name)

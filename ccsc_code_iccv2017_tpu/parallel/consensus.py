@@ -245,6 +245,7 @@ def learn(
     state = learn_mod.init_state(
         key, geom, fg, N, ni, b.dtype,
         z_dtype=jnp.dtype(cfg.storage_dtype),
+        d_dtype=jnp.dtype(cfg.d_storage_dtype),
     )
     if init_d is not None:
         if tuple(init_d.shape) != tuple(geom.filter_shape):
@@ -255,7 +256,11 @@ def learn(
 
         d_full = fourier.circ_embed(jnp.asarray(init_d, b.dtype), fg.spatial_shape)
         state = state._replace(
-            d_local=jnp.broadcast_to(d_full, state.d_local.shape),
+            # keep the d-state storage dtype — a f32 d_local next to a
+            # bf16 dual_d would make the d-pass scan carry mismatch
+            d_local=jnp.broadcast_to(d_full, state.d_local.shape).astype(
+                state.d_local.dtype
+            ),
             dbar=d_full,
         )
     start_it = 0

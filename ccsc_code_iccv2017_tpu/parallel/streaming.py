@@ -69,11 +69,15 @@ def _jit_pieces(geom: ProblemGeom, cfg: LearnConfig, fg: common.FreqGeom):
 
     @jax.jit
     def f_d_block(kern, bhat_nn, d_local, dual_d, u):
-        dual_d = dual_d + (d_local - u)
+        dsd = d_local.dtype  # d-state storage (d_storage_dtype)
+        dual_d = f32(dual_d) + (f32(d_local) - u)
         xi_hat = common.full_filters_to_freq(u - dual_d, fg)
         dhat = freq_solvers.solve_d(kern, bhat_nn, xi_hat, cfg.rho_d)
         d_new = learn_mod._filters_from_freq(dhat, fg)
-        return d_new, dual_d
+        # round to storage dtype ON DEVICE: the device->host transfer
+        # of the dictionary state rides the storage width (the z-pass
+        # already does this)
+        return d_new.astype(dsd), dual_d.astype(dsd)
 
     @jax.jit
     def f_z_block(z, dual_z, bhat_nn, dhat_z):
@@ -150,6 +154,7 @@ def learn_streaming(
     state0 = learn_mod.init_state(
         key, geom, fg, N, ni, jnp.float32,
         z_dtype=jnp.dtype(cfg.storage_dtype),
+        d_dtype=jnp.dtype(cfg.d_storage_dtype),
     )
     # np.array (copy): host buffers are mutated block-by-block below
     d_local = np.array(state0.d_local)

@@ -112,6 +112,7 @@ def analytic_outer_step_cost(
     fft_impl: str = "xla",
     fused_z: bool = False,
     state_dtype_bytes: Optional[int] = None,
+    d_state_dtype_bytes: Optional[int] = None,
 ) -> Dict[str, float]:
     """Closed-form FLOP / HBM-byte count of ONE consensus outer step
     (models.learn.outer_step): the d-pass code-Gram + Cholesky +
@@ -162,16 +163,17 @@ def analytic_outer_step_cost(
             # soft-threshold + dual updates: ~6 elementwise ops
             flops += 6.0 * n_imgs * k * S
 
-    # codes in the spatial domain carry the STORAGE dtype
-    # (LearnConfig.storage_dtype — bf16 halves exactly this term);
-    # spectra and dictionary fields are always f32/complex64
+    # spectra are always complex64; the spatial-domain z and d states
+    # carry their LearnConfig storage dtypes (state_dtype_bytes /
+    # d_state_dtype_bytes — bf16 halves exactly those terms)
     z_bytes = n_imgs * k * S * (state_dtype_bytes or dtype_bytes)
     zh_bytes = n_imgs * k * F * cplx  # code spectra
     bytes_ = 0.0
     bytes_ += z_bytes + zh_bytes  # initial zhat
     bytes_ += N * F * (2 * ni) ** 2 * dtype_bytes * 2  # Gram + inverse
     for _ in range(max_it_d):
-        bytes_ += 4 * N * k * W * S * dtype_bytes  # d fields r/w
+        # d_local/dual_d carry LearnConfig.d_storage_dtype
+        bytes_ += 4 * N * k * W * S * (d_state_dtype_bytes or dtype_bytes)
         bytes_ += 2 * N * k * W * F * cplx  # filter spectra r/w
         bytes_ += N * F * ni * ni * cplx  # ginv read
     for _ in range(max_it_z):

@@ -47,8 +47,14 @@ def main():
         "matmul": {"fft_impl": "matmul"},
         "matmul_bf16prec": {"fft_impl": "matmul_bf16"},
         "bf16_storage": {"storage_dtype": "bfloat16"},
+        "d_bf16_storage": {"d_storage_dtype": "bfloat16"},
         "fused_z": {"fused_z": True},
         "fused_z_bf16": {"fused_z": True, "storage_dtype": "bfloat16"},
+        "fused_z_bf16_all": {
+            "fused_z": True,
+            "storage_dtype": "bfloat16",
+            "d_storage_dtype": "bfloat16",
+        },
     }
     ref = None
     for name, kw in configs.items():

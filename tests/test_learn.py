@@ -344,3 +344,23 @@ def test_fft_impl_matmul_matches_xla():
     np.testing.assert_allclose(
         r_xla.trace["obj_vals_z"], r_mm.trace["obj_vals_z"], rtol=2e-4
     )
+
+
+def test_d_bf16_storage_trajectory_close_to_f32():
+    """bf16 storage of the per-block dictionary state (d_storage_dtype)
+    tracks the f32 trajectory closely — same contract as the code-state
+    knob (f32 math, only the stored iterate rounded)."""
+    b = _toy_data(n=8, size=20, seed=9)
+    geom = ProblemGeom((5, 5), 6)
+    kw = dict(CFG, num_blocks=2, max_it=8)
+    r32 = learn(b, geom, LearnConfig(**kw), key=jax.random.PRNGKey(3))
+    r16 = learn(
+        b, geom, LearnConfig(**kw, d_storage_dtype="bfloat16"),
+        key=jax.random.PRNGKey(3),
+    )
+    o32 = np.asarray(r32.trace["obj_vals_z"], np.float64)
+    o16 = np.asarray(r16.trace["obj_vals_z"], np.float64)
+    dev = np.max(np.abs(o32 - o16) / np.abs(o32))
+    assert dev < 0.02, f"d-state bf16 trajectory deviates {dev:.3%}"
+    d_err = np.max(np.abs(np.asarray(r32.d) - np.asarray(r16.d, np.float32)))
+    assert d_err < 0.05 * np.abs(np.asarray(r32.d)).max()
