@@ -1,0 +1,424 @@
+#!/usr/bin/env python
+"""Train THIS framework's own 3D / 4D / hyperspectral filter banks.
+
+The reference ships pretrained banks for all four families (SURVEY.md
+section 1 L1) but its 3D/4D/HS TRAINING data blobs are absent from the
+repo (.MISSING_LARGE_BLOBS, SURVEY.md section 5 defect list), so a
+full-data reproduction is impossible for anyone. What IS possible — and
+what this script does — is to synthesize training sets with the real
+structure each filter family exists to model, from the only images the
+reference ships (2D/Inpainting/Test/*.jpg):
+
+  3D  video clips = a window translating across a contrast-normalized
+      image (true spatiotemporal structure: motion parallax of edges),
+      protocol of learn_kernels_3D.m (k=49 11^3, 64 clips of 50^3,
+      ni=8, rho 5000/1, max_it=20, tol=1e-2).
+  4D  lightfield patches = per-view disparity shifts of a window
+      (true parallax: depth-dependent view correlation), protocol of
+      learn_kernels_4D.m (k=49 11x11x5x5, 64 patches 50x50 x 5x5
+      views, ni=8, rho 500/50 — conv4D :105,119,159,162).
+  HS  hyperspectral cubes = two-material mixtures with smooth spectral
+      envelopes (low-rank spectra + spatial detail), protocol of
+      learn_hyperspectral.m (k=100 11x11x31, masked learner,
+      max_it=40, Gaussian smooth_init).
+
+Each bank is evaluated against the SHIPPED reference bank of the same
+family on a held-out reconstruction task (masked subsampling for 3D,
+view synthesis for 4D, spectral demosaicing for HS) with identical
+masks. Artifacts: bank .mat + central-slice mosaic + ARTIFACTS_<fam>.md
+per family under --out.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+TEST_DIR = "/root/reference/2D/Inpainting/Test"
+SHIPPED = {
+    "3d": "/root/reference/3D/Filters/3D_video_filters.mat",
+    "4d": "/root/reference/4D/Filters/4d_filters_lightfield.mat",
+    "hs": "/root/reference/2-3D/Filters/2D-3D-Hyperspectral.mat",
+}
+
+
+def _imgs(contrast="local_cn"):
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.data.images import load_images
+
+    return np.asarray(
+        load_images(TEST_DIR, contrast_normalize=contrast,
+                    zero_mean=(contrast != "none")),
+        np.float32,
+    )
+
+
+def synth_video(n, side, frames, seed=0):
+    """[n, side, side, frames] clips (TIME LAST — the repo's canonical
+    3D layout, io_mat._TO_MATLAB['3d']): window translating across an
+    image along a random direction (wrapping at borders)."""
+    import numpy as np
+
+    imgs = _imgs()
+    rng = np.random.default_rng(seed)
+    H, W = imgs.shape[1:]
+    out = np.empty((n, side, side, frames), np.float32)
+    for i in range(n):
+        im = imgs[rng.integers(len(imgs))]
+        vy, vx = rng.uniform(-2.0, 2.0, 2)
+        y0 = rng.integers(0, H - side)
+        x0 = rng.integers(0, W - side)
+        for t in range(frames):
+            y = int(round(y0 + vy * t)) % (H - side)
+            x = int(round(x0 + vx * t)) % (W - side)
+            out[i, :, :, t] = im[y : y + side, x : x + side]
+    return out
+
+
+def synth_lightfield(n, side, views, seed=0):
+    """[n, views, views, side, side] patches: view (u, v) is the
+    window shifted by disparity * (u - c, v - c) — planar-scene
+    parallax."""
+    import numpy as np
+
+    imgs = _imgs()
+    rng = np.random.default_rng(seed)
+    H, W = imgs.shape[1:]
+    c = views // 2
+    pad = 3 * c + 2
+    out = np.empty((n, views, views, side, side), np.float32)
+    for i in range(n):
+        im = imgs[rng.integers(len(imgs))]
+        disp = rng.uniform(-1.5, 1.5)
+        y0 = rng.integers(pad, H - side - pad)
+        x0 = rng.integers(pad, W - side - pad)
+        for u in range(views):
+            for v in range(views):
+                dy = int(round(disp * (u - c)))
+                dx = int(round(disp * (v - c)))
+                out[i, u, v] = im[
+                    y0 + dy : y0 + dy + side, x0 + dx : x0 + dx + side
+                ]
+    return out
+
+
+def synth_hyperspectral(n, side, bands, seed=0):
+    """[n, bands, side, side] cubes: two-material mixture with smooth
+    per-material spectral envelopes (the low-rank-spectra structure
+    hyperspectral filters model) plus band-correlated detail."""
+    import numpy as np
+
+    imgs = _imgs(contrast="none")
+    rng = np.random.default_rng(seed)
+    H, W = imgs.shape[1:]
+    lam = np.linspace(0.0, 1.0, bands)
+    out = np.empty((n, bands, side, side), np.float32)
+    for i in range(n):
+        im = imgs[rng.integers(len(imgs))]
+        y0 = rng.integers(0, H - side)
+        x0 = rng.integers(0, W - side)
+        patch = im[y0 : y0 + side, x0 : x0 + side]
+        m1 = patch
+        m2 = 1.0 - patch
+        # smooth random spectral envelopes per material
+        def env():
+            c = rng.uniform(0.2, 0.8)
+            w = rng.uniform(0.15, 0.5)
+            a = rng.uniform(0.4, 1.0)
+            return a * np.exp(-((lam - c) ** 2) / (2 * w * w))
+
+        s1, s2 = env(), env()
+        out[i] = (
+            m1[None] * s1[:, None, None] + m2[None] * s2[:, None, None]
+        ).astype(np.float32)
+    return out
+
+
+def central_slice(d, fam):
+    """[k, ...] -> [k, s, s] 2D view for the mosaic."""
+    if fam == "3d":
+        return d[:, :, :, d.shape[-1] // 2]
+    if fam == "4d":
+        return d[:, d.shape[1] // 2, d.shape[2] // 2]
+    return d[:, d.shape[1] // 2]  # hs: central band
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--families", default="3d,4d,hs",
+        help="comma list of 3d, 4d, hs",
+    )
+    ap.add_argument("--n", type=int, default=64, help="3d/4d samples")
+    ap.add_argument("--hs-n", type=int, default=16)
+    ap.add_argument("--side", type=int, default=50)
+    ap.add_argument("--hs-side", type=int, default=96)
+    ap.add_argument("--max-it", type=int, default=20)
+    ap.add_argument("--hs-max-it", type=int, default=40)
+    ap.add_argument("--eval-max-it", type=int, default=80)
+    ap.add_argument("--out", default="artifacts_family")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes (CI/CPU check)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.config import (
+        LearnConfig, ProblemGeom, SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.learn import learn
+    from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem, reconstruct,
+    )
+    from ccsc_code_iccv2017_tpu.utils import display
+    from ccsc_code_iccv2017_tpu.utils.io_mat import save_filters
+
+    os.makedirs(args.out, exist_ok=True)
+    plat = jax.devices()[0].platform
+    print("platform:", plat, flush=True)
+
+    if args.smoke:
+        args.n, args.hs_n = 16, 4
+        args.side, args.hs_side = 20, 24
+        args.max_it = args.hs_max_it = 2
+        args.eval_max_it = 5
+
+    fams = [f.strip() for f in args.families.split(",") if f.strip()]
+    results = {}
+
+    def load_shipped(fam, key):
+        from ccsc_code_iccv2017_tpu.utils import io_mat
+
+        loaders = {
+            "3d": io_mat.load_filters_3d,
+            "4d": io_mat.load_filters_lightfield,
+            "hs": io_mat.load_filters_hyperspectral,
+        }
+        try:
+            return loaders[fam](SHIPPED[fam])
+        except Exception as e:  # pragma: no cover
+            print(f"shipped {fam} bank unavailable: {e}")
+            return None
+
+    # ---------------- 3D video --------------------------------------
+    if "3d" in fams:
+        fam = "3d"
+        support = 11 if not args.smoke else 5
+        k = 49 if not args.smoke else 6
+        b = synth_video(args.n, args.side, args.side)
+        geom = ProblemGeom((support,) * 3, k)
+        cfg = LearnConfig(
+            max_it=args.max_it, tol=1e-2, rho_d=5000.0, rho_z=1.0,
+            num_blocks=8 if not args.smoke else 2,
+            verbose="brief", track_objective=True,
+        )
+        t0 = time.time()
+        res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0))
+        t = time.time() - t0
+        save_filters(
+            os.path.join(args.out, "bank_3d.mat"), res.d, res.trace,
+            layout="3d",
+        )
+        display.save_filter_mosaic(
+            os.path.join(args.out, "mosaic_3d.png"),
+            central_slice(np.asarray(res.d), fam),
+            title=f"3D bank, central temporal slice ({args.max_it} it)",
+        )
+        # eval: 50% masked subsampling on held-out clips
+        test = synth_video(4, args.side, args.side, seed=99)
+        rng = np.random.default_rng(5)
+        mask = (rng.uniform(size=test.shape) > 0.5).astype(np.float32)
+        prob = ReconstructionProblem(geom)
+        scfg = SolveConfig(
+            lambda_residual=100.0, lambda_prior=0.5,
+            max_it=args.eval_max_it, tol=1e-5, verbose="none",
+        )
+
+        def psnr3(d):
+            r = reconstruct(
+                jnp.asarray(test * mask), jnp.asarray(d), prob, scfg,
+                mask=jnp.asarray(mask),
+            )
+            rec = np.asarray(r.recon)
+            mse = np.mean((rec - test) ** 2)
+            span = float(test.max() - test.min()) or 1.0
+            return 10 * np.log10(span**2 / mse)
+
+        own = psnr3(np.asarray(res.d))
+        shipped_d = None if args.smoke else load_shipped(fam, "d")
+        ship = psnr3(shipped_d) if shipped_d is not None else float("nan")
+        results[fam] = dict(t_learn_s=round(float(t), 1),
+                            own_psnr=round(float(own), 2),
+                            shipped_psnr=round(float(ship), 2),
+                            obj=float(res.trace["obj_vals_z"][-1]))
+        print(json.dumps({"family": fam, **results[fam]}), flush=True)
+
+    # ---------------- 4D lightfield ---------------------------------
+    if "4d" in fams:
+        fam = "4d"
+        support = 11 if not args.smoke else 5
+        k = 49 if not args.smoke else 6
+        views = 5 if not args.smoke else 3
+        b = synth_lightfield(args.n, args.side, views)
+        geom = ProblemGeom((support, support), k, (views, views))
+        cfg = LearnConfig(
+            max_it=args.max_it, tol=1e-3, rho_d=500.0, rho_z=50.0,
+            num_blocks=8 if not args.smoke else 2,
+            verbose="brief", track_objective=True,
+        )
+        t0 = time.time()
+        res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0))
+        t = time.time() - t0
+        save_filters(
+            os.path.join(args.out, "bank_4d.mat"), res.d, res.trace,
+            layout="lightfield",
+        )
+        display.save_filter_mosaic(
+            os.path.join(args.out, "mosaic_4d.png"),
+            central_slice(np.asarray(res.d), fam),
+            title=f"4D bank, central view ({args.max_it} it)",
+        )
+        # eval: view synthesis — mask out everything except the border
+        # views (reconstruct_subsampling_lightfield.m:29-34 intent)
+        test = synth_lightfield(4, args.side, views, seed=77)
+        mask = np.zeros_like(test)
+        mask[:, 0, :], mask[:, -1, :] = 1.0, 1.0
+        mask[:, :, 0], mask[:, :, -1] = 1.0, 1.0
+        prob = ReconstructionProblem(geom, pad=False)
+        scfg = SolveConfig(
+            lambda_residual=10000.0, lambda_prior=1.0,
+            max_it=args.eval_max_it, tol=1e-5, verbose="none",
+        )
+
+        def psnr4(d):
+            r = reconstruct(
+                jnp.asarray(test * mask), jnp.asarray(d), prob, scfg,
+                mask=jnp.asarray(mask),
+            )
+            rec = np.asarray(r.recon)
+            hidden = mask == 0.0
+            mse = np.mean((rec[hidden] - test[hidden]) ** 2)
+            span = float(test.max() - test.min()) or 1.0
+            return 10 * np.log10(span**2 / mse)
+
+        own = psnr4(np.asarray(res.d))
+        shipped_d = None if args.smoke else load_shipped(fam, "d")
+        ship = psnr4(shipped_d) if shipped_d is not None else float("nan")
+        results[fam] = dict(t_learn_s=round(float(t), 1),
+                            own_psnr=round(float(own), 2),
+                            shipped_psnr=round(float(ship), 2),
+                            obj=float(res.trace["obj_vals_z"][-1]))
+        print(json.dumps({"family": fam, **results[fam]}), flush=True)
+
+    # ---------------- hyperspectral ---------------------------------
+    if "hs" in fams:
+        fam = "hs"
+        support = 11 if not args.smoke else 5
+        k = 100 if not args.smoke else 6
+        bands = 31 if not args.smoke else 5
+        b = synth_hyperspectral(args.hs_n, args.hs_side, bands)
+        geom = ProblemGeom((support, support), k, (bands,))
+        # Gaussian smooth_init (learn_hyperspectral.m:16-17)
+        from scipy.ndimage import gaussian_filter
+
+        sm = gaussian_filter(b, sigma=(0, 0, 4.0, 4.0)).astype(np.float32)
+        cfg = LearnConfig(
+            max_it=args.hs_max_it, tol=1e-3, verbose="brief",
+            track_objective=True,
+        )
+        t0 = time.time()
+        res = learn_masked(
+            jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0),
+            smooth_init=jnp.asarray(sm),
+        )
+        t = time.time() - t0
+        save_filters(
+            os.path.join(args.out, "bank_hs.mat"), res.d, res.trace,
+            layout="hyperspectral",
+        )
+        display.save_filter_mosaic(
+            os.path.join(args.out, "mosaic_hs.png"),
+            central_slice(np.asarray(res.d), fam),
+            title=f"HS bank, central band ({args.hs_max_it} it)",
+        )
+        # eval: spectral demosaicing — each pixel observes one band
+        test = synth_hyperspectral(2, args.hs_side, bands, seed=55)
+        rng = np.random.default_rng(3)
+        wl = rng.integers(0, bands, size=test.shape[-2:])
+        mask = np.zeros_like(test)
+        for w in range(bands):
+            mask[:, w][:, wl == w] = 1.0
+        # normalized convolution: gaussian(x*mask) / gaussian(mask) —
+        # a NaN-masked filter would propagate NaN everywhere at 1/31
+        # observed pixels per band
+        num = gaussian_filter(test * mask, sigma=(0, 0, 3, 3))
+        den = gaussian_filter(mask, sigma=(0, 0, 3, 3))
+        smt = (num / np.maximum(den, 1e-6)).astype(np.float32)
+        prob = ReconstructionProblem(geom, pad=False)
+        scfg = SolveConfig(
+            lambda_residual=100000.0, lambda_prior=1.0,
+            max_it=args.eval_max_it, tol=1e-5, verbose="none",
+        )
+
+        def psnrh(d):
+            r = reconstruct(
+                jnp.asarray(test * mask), jnp.asarray(d), prob, scfg,
+                mask=jnp.asarray(mask),
+                smooth_init=jnp.asarray(smt.astype(np.float32)),
+            )
+            rec = np.asarray(r.recon)
+            hidden = mask == 0.0
+            mse = np.mean((rec[hidden] - test[hidden]) ** 2)
+            span = float(test.max() - test.min()) or 1.0
+            return 10 * np.log10(span**2 / mse)
+
+        own = psnrh(np.asarray(res.d))
+        shipped_d = None if args.smoke else load_shipped(fam, "d")
+        ship = psnrh(shipped_d) if shipped_d is not None else float("nan")
+        results[fam] = dict(t_learn_s=round(float(t), 1),
+                            own_psnr=round(float(own), 2),
+                            shipped_psnr=round(float(ship), 2),
+                            obj=float(res.trace["obj_vals_z"][-1]))
+        print(json.dumps({"family": fam, **results[fam]}), flush=True)
+
+    # ---------------- summary ---------------------------------------
+    lines = [
+        "# ARTIFACTS — self-trained 3D / 4D / hyperspectral banks",
+        "",
+        "The reference's own 3D/4D/HS training blobs are absent from "
+        "its repo (SURVEY.md section 5), so these banks are trained on "
+        "SYNTHESIZED data carrying each family's real structure "
+        "(motion for 3D, parallax for 4D, low-rank spectra for HS) "
+        "derived from the 10 shipped Test images — provenance is in "
+        "scripts/family_banks.py. Evaluation: held-out reconstruction "
+        "with identical masks, own bank vs the shipped reference bank.",
+        "",
+        "| family | learn time (s) | platform | own-bank PSNR | "
+        "shipped-bank PSNR | final objective |",
+        "|---|---|---|---|---|---|",
+    ]
+    for fam, r in results.items():
+        lines.append(
+            f"| {fam} | {r['t_learn_s']} | {plat} | {r['own_psnr']} | "
+            f"{r['shipped_psnr']} | {r['obj']:.6g} |"
+        )
+    with open(os.path.join(args.out, "ARTIFACTS_FAMILY.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("\n".join(lines))
+    print(json.dumps({"families": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,39 +40,7 @@ run_bench() { # label, env pairs...
 # otherwise (and when baseline wins) any stale tuned file is removed
 # so defaults really are the defaults.
 pick() {
-  OUT="$OUT" python - <<'PYEOF' >> "$LOG" 2>&1
-import json
-import os
-
-DEFAULTS = {"fft_pad": "none", "storage_dtype": "float32",
-            "use_pallas": False}
-best, best_v, best_k, base_v = None, -1.0, {}, None
-for line in open(os.environ["OUT"]):
-    try:
-        rec = json.loads(line)
-    except Exception:
-        continue
-    res = rec.get("result") or {}
-    metric = res.get("metric", "")
-    v = float(res.get("value", 0.0))
-    if not rec.get("run") or "DEGRADED" in metric or "FAILED" in metric:
-        continue
-    if v <= 0:
-        continue
-    if rec["run"] == "baseline":
-        base_v = v if base_v is None else max(base_v, v)
-    if v > best_v:
-        best, best_v, best_k = rec["run"], v, res.get("knobs") or {}
-tuned = {k: v for k, v in best_k.items() if v != DEFAULTS.get(k)}
-if base_v is None or best in (None, "baseline") or best_v <= base_v or not tuned:
-    if os.path.exists("bench_tuned.json"):
-        os.remove("bench_tuned.json")
-    print(f"tuned: defaults (baseline={base_v}, best={best}@{best_v})")
-else:
-    with open("bench_tuned.json", "w") as f:
-        json.dump(tuned, f)
-    print(f"tuned: {best}@{best_v} it/s knobs={tuned}")
-PYEOF
+  python scripts/pick_tuned.py >> "$LOG" 2>&1
 }
 
 while true; do

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-4 on-chip queue, phase 5: after every measurement phase has
+# exited, re-pick bench_tuned.json over ALL recorded arms with the
+# full knob vocabulary (scripts/pick_tuned.py) so the driver's
+# end-of-round bench run adopts the measured winner.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/onchip_queue5.log
+
+while pgrep -f "scripts/onchip_queue[1-4]?\.sh" | grep -qv $$ 2>/dev/null; do
+  echo "$(date +%H:%M:%S) earlier phase still running" >> "$LOG"
+  sleep 180
+done
+python scripts/pick_tuned.py >> "$LOG" 2>&1
+echo "$(date +%H:%M:%S) final pick done" >> "$LOG"

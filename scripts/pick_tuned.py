@@ -27,6 +27,12 @@ DEFAULTS = {
 
 def main():
     best, best_v, best_k, base_v = None, -1.0, {}, None
+    if not os.path.exists(OUT):
+        # no records (fresh checkout / rotated file): defaults
+        if os.path.exists(TUNED):
+            os.remove(TUNED)
+        print("tuned: defaults (no records)")
+        return 0
     for line in open(OUT):
         try:
             rec = json.loads(line)
