@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 
 def out(d):
+    d["fft_impl"] = FFT_IMPL
     d["platform"] = jax.devices()[0].platform
     print(json.dumps(d), flush=True)
 
@@ -53,11 +54,13 @@ def bench_hs():
     # warm call compiles the jitted step (excluded from the rate, like
     # the other benches); the timed call then reuses the jit cache
     warm = LearnConfig(
-        max_it=1, max_it_d=10, max_it_z=10, tol=0.0, verbose="none"
+        max_it=1, max_it_d=10, max_it_z=10, tol=0.0, verbose="none",
+        fft_impl=FFT_IMPL,
     )
     learn_masked(b, geom, warm)
     cfg = LearnConfig(
-        max_it=iters, max_it_d=10, max_it_z=10, tol=0.0, verbose="none"
+        max_it=iters, max_it_d=10, max_it_z=10, tol=0.0, verbose="none",
+        fft_impl=FFT_IMPL,
     )
     t0 = time.perf_counter()
     res = learn_masked(b, geom, cfg)
@@ -91,9 +94,9 @@ def bench_3d():
     geom = ProblemGeom((11, 11, 11), k)
     cfg = LearnConfig(
         max_it=iters, max_it_d=5, max_it_z=10, num_blocks=blocks,
-        rho_d=5000.0, rho_z=1.0, verbose="none",
+        rho_d=5000.0, rho_z=1.0, verbose="none", fft_impl=FFT_IMPL,
     )
-    fg = common.FreqGeom.create(geom, (side, side, side))
+    fg = common.FreqGeom.create(geom, (side, side, side), fft_impl=FFT_IMPL)
     state = learn_mod.init_state(jax.random.PRNGKey(0), geom, fg, blocks, ni)
     b_blocks = jax.random.normal(
         jax.random.PRNGKey(1), (blocks, ni, side, side, side), jnp.float32
@@ -153,6 +156,7 @@ def _bench_recon(family, geom, k_shape, side, reduce_shape, lam_res):
     cfg = SolveConfig(
         lambda_residual=lam_res, lambda_prior=1.0, max_it=max_it,
         tol=0.0, verbose="none",
+        fft_impl=FFT_IMPL,
     )
     r = reconstruct(b * mask, d, prob, cfg, mask=mask)  # compile + run
     float(jnp.sum(r.recon))
@@ -196,6 +200,9 @@ def bench_viewsynth():
         (5, 5),
         10000.0,
     )
+
+
+FFT_IMPL = os.environ.get("CCSC_FAMILY_FFTIMPL", "xla")
 
 
 FAMILIES = {
