@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-4 on-chip queue, phase 6: train the self-owned 3D/4D/HS banks
+# on the chip (scripts/family_banks.py — ~50x the CPU rate at the 3D
+# reference operating point). Waits for all measurement phases and the
+# final pick, then runs once; artifacts land in artifacts_family/.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/onchip_queue6.log
+
+probe() {
+  timeout 60 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform in ('tpu', 'axon')
+x = jnp.ones((128, 128)); float((x @ x).sum())
+" > /dev/null 2>&1
+}
+
+while pgrep -f "scripts/onchip_queue[1-5]?\.sh" | grep -qv $$ 2>/dev/null; do
+  echo "$(date +%H:%M:%S) earlier phase still running" >> "$LOG"
+  sleep 180
+done
+
+while true; do
+  if probe; then
+    echo "$(date +%H:%M:%S) phase 6: family banks on chip" >> "$LOG"
+    timeout 7200 python scripts/family_banks.py --hs-n 12 \
+      --out artifacts_family >> "$LOG" 2>&1 \
+      && echo "$(date +%H:%M:%S) family banks DONE" >> "$LOG" \
+      || echo "$(date +%H:%M:%S) family banks FAILED" >> "$LOG"
+    break
+  fi
+  echo "$(date +%H:%M:%S) tunnel down" >> "$LOG"
+  sleep 240
+done
