@@ -1,0 +1,45 @@
+"""Layout guards for the family-bank synthetic data generators
+(scripts/family_banks.py): the 3D time axis must be LAST and the 4D
+view axes must lead, matching the canonical [n, *reduce, *spatial]
+contract and io_mat's shipped-bank layouts — a transposed axis would
+silently invalidate the own-vs-shipped comparisons."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+
+import family_banks as fb
+
+
+def test_video_time_axis_is_last():
+    v = fb.synth_video(2, side=16, frames=6, seed=1)
+    assert v.shape == (2, 16, 16, 6)
+    # consecutive frames are small translations: high correlation along
+    # the LAST axis, not the first spatial one
+    a, b = v[0, :, :, 0], v[0, :, :, 1]
+    c = np.corrcoef(a.ravel(), b.ravel())[0, 1]
+    assert c > 0.5, c
+
+
+def test_lightfield_views_lead_and_shift():
+    lf = fb.synth_lightfield(2, side=16, views=3, seed=2)
+    assert lf.shape == (2, 3, 3, 16, 16)
+    # the center view equals the unshifted window; corner views are
+    # translations of it (parallax), so mean|center - corner| > 0
+    center = lf[0, 1, 1]
+    corner = lf[0, 0, 0]
+    assert center.shape == (16, 16)
+    assert np.corrcoef(center.ravel(), corner.ravel())[0, 1] > 0.3
+
+
+def test_hyperspectral_bands_lead_and_smooth():
+    hs = fb.synth_hyperspectral(2, side=16, bands=7, seed=3)
+    assert hs.shape == (2, 7, 16, 16)
+    # spectra are smooth: band-to-band diffs much smaller than range
+    d = np.abs(np.diff(hs, axis=1)).mean()
+    r = hs.max() - hs.min()
+    assert d < 0.2 * r, (d, r)
