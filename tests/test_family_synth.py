@@ -16,13 +16,16 @@ import family_banks as fb
 
 
 def test_video_time_axis_is_last():
-    v = fb.synth_video(2, side=16, frames=6, seed=1)
-    assert v.shape == (2, 16, 16, 6)
-    # consecutive frames are small translations: high correlation along
-    # the LAST axis, not the first spatial one
-    a, b = v[0, :, :, 0], v[0, :, :, 1]
-    c = np.corrcoef(a.ravel(), b.ravel())[0, 1]
-    assert c > 0.5, c
+    v = fb.synth_video(2, side=32, frames=8, seed=1)
+    assert v.shape == (2, 32, 32, 8)
+    # motion lives along the LAST axis: adjacent frames correlate more
+    # strongly than distant ones (contrast-normalized content
+    # decorrelates with shift, so the DECAY is the signature)
+    f0 = v[0, :, :, 0].ravel()
+    c1 = np.corrcoef(f0, v[0, :, :, 1].ravel())[0, 1]
+    c7 = np.corrcoef(f0, v[0, :, :, 7].ravel())[0, 1]
+    assert c1 > c7, (c1, c7)
+    assert c1 > 0.2, c1
 
 
 def test_lightfield_views_lead_and_shift():
