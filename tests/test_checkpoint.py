@@ -106,3 +106,35 @@ def test_checkpoint_roundtrip_bf16_state():
                    checkpoint_every=1)
     assert r2.z.dtype == jnp.bfloat16
     assert len(r2.trace["obj_vals_z"]) >= len(r1.trace["obj_vals_z"])
+
+
+def test_checkpoint_roundtrip_new_knobs():
+    """Checkpoint/resume with the r4 execution-strategy knobs stacked
+    (bf16 code + dictionary state, matmul FFT, fused z kernel): resume
+    restores the storage dtypes and continues."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+    from ccsc_code_iccv2017_tpu.models.learn import learn
+
+    import tempfile
+
+    r = np.random.default_rng(5)
+    b = r.normal(size=(4, 12, 12)).astype(np.float32)
+    geom = ProblemGeom((3, 3), 4)
+    kw = dict(max_it=2, max_it_d=2, max_it_z=2, num_blocks=2,
+              verbose="none", storage_dtype="bfloat16",
+              d_storage_dtype="bfloat16", fft_impl="matmul",
+              fused_z=True)
+    with tempfile.TemporaryDirectory() as td:
+        r1 = learn(jnp.asarray(b), geom, LearnConfig(**kw),
+                   key=jax.random.PRNGKey(0), checkpoint_dir=td,
+                   checkpoint_every=1)
+        r2 = learn(jnp.asarray(b), geom,
+                   LearnConfig(**{**kw, "max_it": 3}),
+                   key=jax.random.PRNGKey(0), checkpoint_dir=td,
+                   checkpoint_every=1)
+    assert r2.z.dtype == jnp.bfloat16
+    assert len(r2.trace["obj_vals_z"]) >= len(r1.trace["obj_vals_z"])
