@@ -126,19 +126,24 @@ def synth_hyperspectral(n, side, bands, seed=0):
         y0 = rng.integers(0, H - side)
         x0 = rng.integers(0, W - side)
         patch = im[y0 : y0 + side, x0 : x0 + side]
-        m1 = patch
-        m2 = 1.0 - patch
-        # smooth random spectral envelopes per material
+        gy, gx = np.gradient(patch)
+        grad = np.sqrt(gy * gy + gx * gx)
+        grad = grad / max(float(grad.max()), 1e-6)
+        # three materials: bright regions, dark regions, edges — each
+        # with its own smooth spectral envelope (rank-3 spectra with
+        # spatially coherent abundances)
+        mats = (patch, 1.0 - patch, grad)
+
         def env():
-            c = rng.uniform(0.2, 0.8)
-            w = rng.uniform(0.15, 0.5)
+            c = rng.uniform(0.1, 0.9)
+            w = rng.uniform(0.1, 0.5)
             a = rng.uniform(0.4, 1.0)
             return a * np.exp(-((lam - c) ** 2) / (2 * w * w))
 
-        s1, s2 = env(), env()
-        out[i] = (
-            m1[None] * s1[:, None, None] + m2[None] * s2[:, None, None]
-        ).astype(np.float32)
+        cube = np.zeros((bands, side, side), np.float32)
+        for m in mats:
+            cube += m[None] * env()[:, None, None]
+        out[i] = cube
     return out
 
 
