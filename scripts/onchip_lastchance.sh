@@ -1,0 +1,58 @@
+#!/bin/bash
+# Round-4 last-chance runner: replaces the open-ended phase watchers
+# near round end. If the tunnel answers before the deadline, measure
+# ONLY the quick second-wave arms (fused kernel + bf16-precision FFT)
+# and re-pick bench_tuned.json; exit unconditionally at the deadline
+# so the driver's end-of-round bench never shares the tunnel with us
+# (two concurrent clients wedge a live tunnel — PERF.md protocol).
+set -u
+cd "$(dirname "$0")/.."
+OUT=onchip_r4.jsonl
+LOG=/tmp/onchip_lastchance.log
+DEADLINE_EPOCH=$(date -d "16:05" +%s 2>/dev/null || echo 0)
+
+probe() {
+  timeout 45 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform in ('tpu', 'axon')
+x = jnp.ones((128, 128)); float((x @ x).sum())
+" > /dev/null 2>&1
+}
+
+note() { echo "{\"note\": \"$1\", \"at\": \"$(date +%H:%M:%S)\"}" >> "$OUT"; }
+
+run_bench() {
+  local label=$1; shift
+  echo "=== $label $(date +%H:%M:%S)" >> "$LOG"
+  local line
+  line=$(env "$@" CCSC_BENCH_TIMEOUT=600 timeout 900 python bench.py 2>> "$LOG" | tail -1)
+  if [ -n "$line" ] && echo "$line" | python -c \
+      'import json,sys; json.load(sys.stdin)' > /dev/null 2>&1; then
+    echo "{\"run\": \"$label\", \"result\": $line}" >> "$OUT"
+  else
+    note "$label FAILED/empty"
+  fi
+}
+
+while true; do
+  now=$(date +%s)
+  if [ "$DEADLINE_EPOCH" -gt 0 ] && [ "$now" -ge "$DEADLINE_EPOCH" ]; then
+    echo "$(date +%H:%M:%S) deadline reached, exiting" >> "$LOG"
+    exit 0
+  fi
+  if probe; then
+    note "last-chance window"
+    run_bench fused_z_bf16 CCSC_BENCH_FUSEDZ=1 CCSC_BENCH_STORAGE=bfloat16 \
+      CCSC_BENCH_FFTIMPL=matmul CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none
+    run_bench fused_z_bf16_all CCSC_BENCH_FUSEDZ=1 CCSC_BENCH_STORAGE=bfloat16 \
+      CCSC_BENCH_DSTORAGE=bfloat16 CCSC_BENCH_FFTIMPL=matmul \
+      CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none
+    run_bench matmul_bf16prec CCSC_BENCH_FFTIMPL=matmul_bf16 \
+      CCSC_BENCH_STORAGE=bfloat16 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none
+    python scripts/pick_tuned.py >> "$LOG" 2>&1
+    note "last-chance complete"
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) tunnel down" >> "$LOG"
+  sleep 180
+done
