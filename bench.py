@@ -143,6 +143,19 @@ def run_workload():
         cur, m = compiled(cur, b_blocks)
     float(m.d_diff)  # fences the whole chain
     dt = time.perf_counter() - t0
+
+    # optional xprof capture (CCSC_BENCH_XPROF=<dir>) of two EXTRA
+    # steps AFTER the timed loop — tracing costs real time, and a
+    # traced rate would land in onchip_r*.jsonl as if it were the
+    # chip's true rate. scripts/xprof_report.py attributes the trace.
+    xprof_dir = os.environ.get("CCSC_BENCH_XPROF") or None
+    if xprof_dir:
+        from ccsc_code_iccv2017_tpu.utils.profiling import xla_trace
+
+        with xla_trace(xprof_dir):
+            for _ in range(2):
+                cur, m = compiled(cur, b_blocks)
+            float(m.d_diff)
     ips = iters / dt
 
     # ---- utilization: XLA's cost model, analytic fallback ----------
@@ -299,6 +312,47 @@ def profile_components(geom, cfg, fg, state, b_blocks, reps=None):
     return {k: round(v, 3) for k, v in table.items()}
 
 
+def last_onchip_record():
+    """Most recent real-chip bench record from onchip_r*.jsonl.
+
+    When the tunnel is down at snapshot time the fallback number is
+    200x off the chip's; carrying the last on-chip result (with its
+    source + age) keeps rounds comparable (VERDICT r4 weak #2)."""
+    import glob
+
+    best = None
+    for path in sorted(
+        glob.glob(os.path.join(REPO, "onchip_r*.jsonl")),
+        key=os.path.getmtime,
+    ):
+        age_h = (time.time() - os.path.getmtime(path)) / 3600.0
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except Exception:
+                continue
+            res = rec.get("result") or {}
+            metric = res.get("metric", "")
+            if (
+                rec.get("run")
+                and ", 1 chip" in metric
+                and float(res.get("value", 0.0)) > 0
+            ):
+                best = {
+                    "run": rec["run"],
+                    "value": res["value"],
+                    "vs_baseline": res.get("vs_baseline"),
+                    "knobs": res.get("knobs"),
+                    "source": os.path.basename(path),
+                    "source_age_hours": round(age_h, 1),
+                }
+    return best
+
+
 def emit(r, degraded=False):
     target_pace = 20.0 / 300.0  # north-star: 20 outer iters in 5 min
     if degraded:
@@ -331,6 +385,10 @@ def emit(r, degraded=False):
         out["bytes_per_step"] = u["bytes_per_step"]
         out["chip"] = u["chip"]
         out["cost_source"] = u["cost_source"]
+    if degraded:
+        last = last_onchip_record()
+        if last is not None:
+            out["last_onchip"] = last
     print(json.dumps(out))
 
 

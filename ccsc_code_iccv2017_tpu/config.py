@@ -163,6 +163,17 @@ class LearnConfig:
     # — one bf16 MXU pass each, ~3 decimal digits per transform;
     # validate trajectories before relying on it).
     fft_impl: str = "xla"
+    # Carry the frequency-domain iterate across the masked learner's
+    # inner scans instead of re-transforming the spatial iterate each
+    # iteration. The spatial iterate is ALWAYS produced by an inverse
+    # FFT of the frequency iterate one line earlier, so the re-FFT at
+    # the top of the next iteration recomputes (to float rounding, and
+    # exactly modulo storage_dtype rounding) what the solver just had
+    # — carrying it drops one full code-sized FFT pass per inner
+    # iteration (1 of 3 in the z-scan) and lets the objectives reuse
+    # the live spectra. Trajectory equal to float tolerance
+    # (tests/test_learn_masked_carry.py). Masked learner only.
+    carry_freq: bool = False
 
     @property
     def with_objective(self) -> bool:

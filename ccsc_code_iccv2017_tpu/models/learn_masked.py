@@ -108,14 +108,16 @@ def _outer_step_impl(
     # math runs f32 — only the stored iterate is rounded
     sd = state.z.dtype
     f32 = lambda x: x.astype(jnp.float32)
+    carry_freq = cfg.carry_freq
 
-    def objective(z, dhat):
-        z = f32(z)
-        zhat = common.codes_to_freq(z, fg)
-        Dz = common.recon_from_freq(dhat, zhat, fg)
+    def objective(z, zh, dhat):
+        """Masked objective from the LIVE spectrum zh of z — callers
+        always already hold it, so no re-transform (admm_learn.m
+        evaluates via the same Dz its iteration just built)."""
+        Dz = common.recon_from_freq(dhat, zh, fg)
         r = M_pad * (Dz + smoothinit - b_pad)
         return 0.5 * cfg.lambda_residual * jnp.sum(r * r) + common.l1_penalty(
-            z, cfg.lambda_prior
+            f32(z), cfg.lambda_prior
         )
 
     zhat = common.codes_to_freq(f32(state.z), fg)
@@ -125,8 +127,14 @@ def _outer_step_impl(
     dkern = freq_solvers.precompute_d_kernel(zhat_l, rho_d)
 
     def d_iter(carry, _):
-        d_full, du1, du2 = carry
-        dhat = common.full_filters_to_freq(d_full, fg)
+        d_full, dhat_c, du1, du2 = carry
+        # cfg.carry_freq: d_full was produced by the inverse FFT of
+        # dhat_c one line below — reuse the spectrum instead of
+        # re-transforming (equal to float tolerance; the solve's
+        # output is the spectrum of a real solution)
+        dhat = (
+            dhat_c if carry_freq else common.full_filters_to_freq(d_full, fg)
+        )
         v1 = common.recon_from_freq(dhat, zhat, fg)  # Dz
         u1 = proxes.masked_quadratic_prox(
             v1 - du1, cfg.lambda_residual / (g / gamma_div_d), MtM, Mtb
@@ -146,24 +154,28 @@ def _outer_step_impl(
             fg.spatial_shape,
             impl=fg.fft_impl,
         )
-        return (d_new, du1, du2), None
+        return (d_new, dhat_new, du1, du2), None
 
-    (d_full, dual_d1, dual_d2), _ = jax.lax.scan(
+    dhat0 = common.full_filters_to_freq(state.d_full, fg)
+    (d_full, dhat_end, dual_d1, dual_d2), _ = jax.lax.scan(
         d_iter,
-        (state.d_full, state.dual_d1, state.dual_d2),
+        (state.d_full, dhat0, state.dual_d1, state.dual_d2),
         None,
         length=cfg.max_it_d,
     )
     d_diff = common.rel_change(d_full, state.d_full)
-    dhat = common.full_filters_to_freq(d_full, fg)
-    obj_d = objective(state.z, dhat)
+    dhat = (
+        dhat_end if carry_freq else common.full_filters_to_freq(d_full, fg)
+    )
+    obj_d = objective(state.z, zhat, dhat)
 
     # ------------------ z-pass (:165-200) ---------------------------
     zkern = freq_solvers.precompute_z_kernel(fslice(dhat), rho_z)
 
     def z_iter(carry, _):
         z, du1, du2 = f32(carry[0]), carry[1], f32(carry[2])
-        zh = common.codes_to_freq(z, fg)
+        # same reuse as d_iter: zhat_c is the live spectrum of z
+        zh = carry[3] if carry_freq else common.codes_to_freq(z, fg)
         v1 = common.recon_from_freq(dhat, zh, fg)
         u1 = proxes.masked_quadratic_prox(
             v1 - du1, cfg.lambda_residual / (g / gamma_div_z), MtM, Mtb
@@ -179,16 +191,17 @@ def _outer_step_impl(
             )
         )
         z_new = common.codes_from_freq(zhat_new, fg)
-        return (z_new.astype(sd), du1, du2.astype(sd)), None
+        return (z_new.astype(sd), du1, du2.astype(sd), zhat_new), None
 
-    (z, dual_z1, dual_z2), _ = jax.lax.scan(
+    (z, dual_z1, dual_z2, zhat_end), _ = jax.lax.scan(
         z_iter,
-        (state.z, state.dual_z1, state.dual_z2),
+        (state.z, state.dual_z1, state.dual_z2, zhat),
         None,
         length=cfg.max_it_z,
     )
     z_diff = common.rel_change(z, state.z)
-    obj_z = objective(z, dhat)
+    zhat_z = zhat_end if carry_freq else common.codes_to_freq(f32(z), fg)
+    obj_z = objective(z, zhat_z, dhat)
 
     return (
         MaskedLearnState(d_full, dual_d1, dual_d2, z, dual_z1, dual_z2),

@@ -146,8 +146,16 @@ def _matmul_rfftn(
     input is still real — 2 real matmuls); the remaining axes get full
     complex DFTs on the narrowed spectrum.
     """
+    if x.dtype == jnp.float64:
+        # the xla path would run a true f64 transform; silently
+        # truncating here would make the two impls non-interchangeable
+        raise ValueError(
+            "fft_impl='matmul' computes in float32; use fft_impl='xla' "
+            "for float64 inputs"
+        )
     f = _rdft_mat(x.shape[-1])
-    x = x.astype(jnp.float32)
+    if x.dtype != jnp.float32:
+        x = x.astype(jnp.float32)
     # real input x complex matrix as two real matmuls
     xh = jax.lax.complex(
         _apply_last(x, np.ascontiguousarray(f.real), prec),
@@ -162,6 +170,18 @@ def _matmul_irfftn(
     xh: jnp.ndarray, spatial_shape: Tuple[int, ...], prec=_PREC
 ) -> jnp.ndarray:
     ndim_s = len(spatial_shape)
+    # unlike jnp.fft.irfftn(s=...), the matmul path does not crop/pad a
+    # mismatched spectrum — demand the exact rfreq shape up front so a
+    # mismatch fails with THIS message, not an opaque einsum error
+    expect = tuple(spatial_shape[:-1]) + (spatial_shape[-1] // 2 + 1,)
+    got = tuple(xh.shape[-ndim_s:])
+    if got != expect:
+        raise ValueError(
+            f"fft_impl='matmul' inverse expects the exact half-spectrum "
+            f"shape {expect} for spatial_shape={tuple(spatial_shape)}, "
+            f"got {got}; crop/pad semantics are only available via "
+            f"fft_impl='xla'"
+        )
     for i, ax in enumerate(range(xh.ndim - ndim_s, xh.ndim - 1)):
         xh = _apply_axis(xh, _dft_mat(spatial_shape[i], inverse=True), ax,
                          prec)

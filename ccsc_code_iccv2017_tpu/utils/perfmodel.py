@@ -143,8 +143,9 @@ def analytic_outer_step_cost(
     for _ in range(max_it_d):
         # filter FFT fwd+inv: N*k transforms each way
         flops += 2 * _fft_flops(spatial, N * k * W, fft_impl)
-        # solve_d einsums: t, s-apply, final — 8F(2 k ni W + ni^2)/blk
-        flops += 8.0 * N * F * (2 * k * ni * W + ni * ni)
+        # solve_d einsums: t, s-apply, final — 8F(2 k ni W + ni^2 W)/blk
+        # (the s-apply fnm,mwf->nwf einsum carries W: ni^2*W MACs)
+        flops += 8.0 * N * F * (2 * k * ni * W + ni * ni * W)
     # z-pass filter spectra + per-iteration solves
     flops += _fft_flops(spatial, k * W, fft_impl)
     for _ in range(max_it_z):

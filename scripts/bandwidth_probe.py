@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Adversarial validation of the "~100 GB/s platform ceiling" theory.
+
+r4's microbenchmark saw soft_threshold move ~1.2 GB in 14.9 ms
+(~83 GB/s) at ONE size and PERF.md took that as the platform's
+effective bandwidth — but a single fixed-size timing cannot separate
+per-dispatch overhead (tunnel round-trip + launch) from true streaming
+bandwidth. This probe measures time-vs-bytes across ~3 decades
+(8 MB -> 4 GB moved) for two op classes and fits
+
+    time(bytes) = overhead + bytes / BW
+
+by least squares; the slope is the real bandwidth, the intercept the
+fixed cost. Two op classes:
+
+  copy  - donated-buffer increment y = x + 1 (donate_argnums=0): the
+          purest stream XLA can run — read N, write N, no reduction,
+          the output is materialized by construction (it feeds the
+          next chained call). This is the "donated-buffer copy probe"
+          VERDICT r4 asked for.
+  sthr  - soft_threshold + full reduction (the r4 microbench op), for
+          continuity with the r4 data point.
+
+Fencing: the axon platform's block_until_ready is a no-op (PERF.md
+tunnel protocol), so each measurement chains R calls y=f(y) and fences
+once with a 1-element readback that depends on the whole chain; the
+per-call time is the chained total / R. Chaining also means dispatch
+overhead is counted once per call, exactly like production steps.
+
+Prints one JSON line per (op, size) plus one fit line per op. On a
+healthy v5e the copy slope should approach several hundred GB/s; if
+instead the slope itself is ~100 GB/s at 4 GB moved, the ceiling
+theory stands and the step is genuinely near the platform's memory
+roofline.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fit(rows):
+    """Least-squares time = a + bytes/BW over rows [(bytes, sec)]."""
+    if len(rows) < 2:
+        return None
+    x = np.array([r[0] for r in rows], np.float64)
+    y = np.array([r[1] for r in rows], np.float64)
+    slope, intercept = np.polyfit(x, y, 1)
+    if slope <= 0:
+        return {"overhead_ms": float(intercept * 1e3), "fit_gbps": None}
+    return {
+        "overhead_ms": float(intercept * 1e3),
+        "fit_gbps": float(1.0 / slope / 1e9),
+    }
+
+
+def main():
+    # bytes MOVED per call (read + write); buffer is half this
+    sizes_mb = [8, 32, 128, 512, 1536, 4096]
+    max_mb = float(os.environ.get("BW_MAX_MB", 4096))
+    sizes_mb = [s for s in sizes_mb if s <= max_mb]
+    platform = jax.devices()[0].platform
+
+    def copy_op(a):
+        return a + 1.0
+
+    def sthr_op(a):
+        return jnp.sign(a) * jnp.maximum(jnp.abs(a) - 0.1, 0.0)
+
+    f_copy = jax.jit(copy_op, donate_argnums=0)
+    f_sthr = jax.jit(sthr_op, donate_argnums=0)
+
+    fits = {}
+    for name, fn in (("copy", f_copy), ("sthr", f_sthr)):
+        rows = []
+        for mb in sizes_mb:
+            n = int(mb * 1e6 / 2 / 4)  # moved = 2 buffers of n f32
+            reps = 8 if mb <= 128 else (5 if mb <= 512 else 3)
+            y = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+            y = fn(y)  # compile (consumes y, returns fresh buffer)
+            float(y[0])  # fence compile
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                y = fn(y)
+            float(y[0])  # 1-element readback fences the whole chain
+            dt = (time.perf_counter() - t0) / reps
+            moved = 2 * n * 4
+            rows.append((moved, dt))
+            print(json.dumps({
+                "bwprobe": name,
+                "moved_mb": round(moved / 1e6, 1),
+                "ms": round(dt * 1e3, 3),
+                "gbps": round(moved / dt / 1e9, 2),
+                "platform": platform,
+            }), flush=True)
+            del y
+        # fit on the upper half only: small sizes are pure overhead
+        fits[name] = _fit(rows[len(rows) // 2:])
+        print(json.dumps({
+            "bwprobe_fit": name,
+            "platform": platform,
+            **(fits[name] or {}),
+        }), flush=True)
+
+    copy_bw = (fits.get("copy") or {}).get("fit_gbps")
+    verdict = None
+    if copy_bw is not None:
+        # the r4 theory said ~100 GB/s effective; >2x that at large
+        # sizes falsifies it (the step then has real headroom)
+        verdict = (
+            "ceiling-theory-falsified" if copy_bw > 200.0
+            else "ceiling-theory-stands"
+        )
+    print(json.dumps({
+        "bwprobe_verdict": verdict,
+        "copy_fit_gbps": copy_bw,
+        "platform": platform,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,8 +33,13 @@ import jax
 import jax.numpy as jnp
 
 
-def out(d):
+def out(d, **knobs):
+    """fft_impl reaches every bench; the other knobs are stamped by
+    the benches that actually apply them (kwargs) so records never
+    claim a knob their workload ignored."""
     d["fft_impl"] = FFT_IMPL
+    for k, v in knobs.items():
+        d[k] = v
     d["platform"] = jax.devices()[0].platform
     print(json.dumps(d), flush=True)
 
@@ -55,12 +60,12 @@ def bench_hs():
     # the other benches); the timed call then reuses the jit cache
     warm = LearnConfig(
         max_it=1, max_it_d=10, max_it_z=10, tol=0.0, verbose="none",
-        fft_impl=FFT_IMPL,
+        fft_impl=FFT_IMPL, storage_dtype=STORAGE, carry_freq=CARRY,
     )
     learn_masked(b, geom, warm)
     cfg = LearnConfig(
         max_it=iters, max_it_d=10, max_it_z=10, tol=0.0, verbose="none",
-        fft_impl=FFT_IMPL,
+        fft_impl=FFT_IMPL, storage_dtype=STORAGE, carry_freq=CARRY,
     )
     t0 = time.perf_counter()
     res = learn_masked(b, geom, cfg)
@@ -77,7 +82,9 @@ def bench_hs():
             "iters_per_sec": round(ips, 4),
             "iters_done": done,
             "wall_s": round(dt, 1),
-        }
+        },
+        storage_dtype=STORAGE,
+        carry_freq=CARRY,
     )
 
 
@@ -95,9 +102,13 @@ def bench_3d():
     cfg = LearnConfig(
         max_it=iters, max_it_d=5, max_it_z=10, num_blocks=blocks,
         rho_d=5000.0, rho_z=1.0, verbose="none", fft_impl=FFT_IMPL,
+        storage_dtype=STORAGE,
     )
     fg = common.FreqGeom.create(geom, (side, side, side), fft_impl=FFT_IMPL)
-    state = learn_mod.init_state(jax.random.PRNGKey(0), geom, fg, blocks, ni)
+    state = learn_mod.init_state(
+        jax.random.PRNGKey(0), geom, fg, blocks, ni,
+        z_dtype=jnp.dtype(STORAGE),
+    )
     b_blocks = jax.random.normal(
         jax.random.PRNGKey(1), (blocks, ni, side, side, side), jnp.float32
     )
@@ -129,7 +140,7 @@ def bench_3d():
             mfu=round(u["mfu_vs_bf16_peak"], 5),
             hbm_frac=round(u["hbm_frac"], 4),
         )
-    out(rec)
+    out(rec, storage_dtype=STORAGE)
 
 
 def _bench_recon(family, geom, k_shape, side, reduce_shape, lam_res):
@@ -203,6 +214,8 @@ def bench_viewsynth():
 
 
 FFT_IMPL = os.environ.get("CCSC_FAMILY_FFTIMPL", "xla")
+STORAGE = os.environ.get("CCSC_FAMILY_STORAGE", "float32")
+CARRY = os.environ.get("CCSC_FAMILY_CARRY", "0") == "1"
 
 
 FAMILIES = {

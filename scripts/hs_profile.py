@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Differential profile of the HS masked learner (VERDICT r4 weak #5).
+
+The masked learner is the slowest family on chip relative to CPU
+(6.9x vs 31.5x for the consensus learner, onchip_r4.jsonl). This
+script attributes one outer step's wall-clock WITHOUT trusting stage
+isolation (fusion makes separately-jitted stages add up to more than
+the real step): it times the full jitted outer step at
+(max_it_d, max_it_z) in {(10,10), (1,10), (10,1), (1,1)} and solves
+
+    t(d,z) = fixed + d*per_d + z*per_z
+
+for the per-inner-iteration costs of the d-ADMM and z-ADMM scans and
+the fixed overhead (top-of-step FFT, Gram/Cholesky precompute, the
+two objective evaluations). Runs at the family_bench operating point
+(k=100 11x11x31, n=2 cubes 96^2) so the numbers tie to the 6.9x row.
+
+Honors CCSC_FAMILY_FFTIMPL / CCSC_FAMILY_STORAGE / CCSC_FAMILY_CARRY
+so the attribution can be repeated per execution strategy. Prints one
+JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import jax
+import jax.numpy as jnp
+
+
+def time_step(b, geom, mk_cfg, d_it, z_it, reps=3):
+    """Seconds per outer step at (max_it_d, max_it_z) = (d_it, z_it).
+
+    Uses max_it=1 learn_masked calls: the first call compiles, later
+    calls reuse the jit cache (the step is jitted on static cfg)."""
+    from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
+
+    cfg = mk_cfg(d_it, z_it)
+    learn_masked(b, geom, cfg)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        learn_masked(b, geom, cfg)  # obj floats fence each call
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+
+    n = int(os.environ.get("HSP_N", 2))
+    side = int(os.environ.get("HSP_SIDE", 96))
+    bands = int(os.environ.get("HSP_BANDS", 31))
+    k = int(os.environ.get("HSP_K", 100))
+    fft_impl = os.environ.get("CCSC_FAMILY_FFTIMPL", "xla")
+    storage = os.environ.get("CCSC_FAMILY_STORAGE", "float32")
+    carry = os.environ.get("CCSC_FAMILY_CARRY", "0") == "1"
+    b = jax.random.uniform(
+        jax.random.PRNGKey(0), (n, bands, side, side), jnp.float32
+    )
+    geom = ProblemGeom((11, 11), k, (bands,))
+
+    def mk_cfg(d_it, z_it):
+        return LearnConfig(
+            max_it=1, max_it_d=d_it, max_it_z=z_it, tol=0.0,
+            verbose="none", fft_impl=fft_impl, storage_dtype=storage,
+            carry_freq=carry,
+        )
+
+    t = {}
+    for d_it, z_it in ((10, 10), (1, 10), (10, 1), (1, 1)):
+        t[(d_it, z_it)] = time_step(b, geom, mk_cfg, d_it, z_it)
+    per_d = (t[(10, 10)] - t[(1, 10)]) / 9.0
+    per_z = (t[(10, 10)] - t[(10, 1)]) / 9.0
+    fixed = t[(1, 1)] - per_d - per_z
+    full = t[(10, 10)]
+    print(json.dumps({
+        "hs_profile": {
+            "platform": jax.devices()[0].platform,
+            "fft_impl": fft_impl,
+            "storage_dtype": storage,
+            "carry_freq": carry,
+            "step_s_10_10": round(full, 4),
+            "per_d_iter_ms": round(per_d * 1e3, 2),
+            "per_z_iter_ms": round(per_z * 1e3, 2),
+            "fixed_ms": round(fixed * 1e3, 2),
+            "d_scan_pct": round(100 * 10 * per_d / full, 1),
+            "z_scan_pct": round(100 * 10 * per_z / full, 1),
+            "fixed_pct": round(100 * fixed / full, 1),
+        }
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,80 +1,206 @@
 #!/bin/bash
-# Round-4 on-chip measurement queue (PERF.md "On-chip queue").
+# Consolidated on-chip runner (round 5) — replaces the seven r4
+# pollers (onchip_queue{,2..6}.sh + onchip_lastchance.sh) with ONE
+# probe/lock/watchdog implementation and phases as data (VERDICT r4
+# weak #6 / next-step #8).
 #
-# Probes the axon TPU tunnel; the moment it answers, runs every queued
-# benchmark SERIALLY (the tunnel is single-client — see PERF.md's
-# tunnel-wedge protocol) and appends JSON lines to onchip_r4.jsonl.
-# Each step runs under `timeout`; bench.py additionally self-watchdogs
-# (CCSC_BENCH_TIMEOUT) with a CPU fallback we label and keep.
+# Usage: scripts/onchip_queue.sh [deadline_seconds_from_now]
+#   default deadline 34200 s (9.5 h) — the runner exits unconditionally
+#   at the deadline so it can never share the tunnel with the driver's
+#   end-of-round bench (two concurrent clients wedge a live tunnel —
+#   PERF.md protocol). The deadline is relative to start, so the
+#   script is reusable (ADVICE r4: no absolute wall-clock bake-in).
+#
+# Phase protocol:
+#   - single mkdir lock (stale-safe) guarantees one tunnel client
+#   - probe() is the only tunnel-liveness test; phases run only after
+#     a fresh successful probe
+#   - completed phases are recorded in $STATE so a restarted runner
+#     resumes where it left off (the tunnel died mid-run twice in r4)
+#   - every python invocation is double-watchdogged: CCSC_BENCH_TIMEOUT
+#     (in-process subprocess watchdog) + an outer `timeout`
+#   - bench_tuned.json is re-picked after EVERY measured arm, so even
+#     a short tunnel window leaves a valid (partial) tuned config
 set -u
 cd "$(dirname "$0")/.."
-OUT=onchip_r4.jsonl
-LOG=/tmp/onchip_queue.log
+OUT=onchip_r5.jsonl
+LOG=/tmp/onchip_r5.log
+STATE=/tmp/onchip_r5.phases
+LOCK=/tmp/ccsc_tunnel.lockfile
+DEADLINE=$(($(date +%s) + ${1:-34200}))
+POLL=240
+
+log() { echo "$(date +%H:%M:%S) $*" >> "$LOG"; }
+note() { echo "{\"note\": \"$1\", \"at\": \"$(date +%H:%M:%S)\"}" >> "$OUT"; }
+
+past_deadline() { [ "$(date +%s)" -ge "$DEADLINE" ]; }
+time_left() { echo $((DEADLINE - $(date +%s))); }
+capped() { # min(wanted_timeout, time to deadline) — a child started
+  # just before the deadline must not hold the tunnel past it (the
+  # driver's end-of-round bench may start then; two clients wedge it)
+  local want=$1 l
+  l=$(time_left)
+  [ "$l" -lt "$want" ] && echo "$l" || echo "$want"
+}
+too_late() { [ "$(time_left)" -le 120 ]; }
+
+# ---- single-client lock: flock on a persistent fd. The kernel
+# releases it when the holder dies (any signal, incl. kill -9), so
+# there is no stale-lock state and no steal race.
+acquire_lock() {
+  exec 9>"$LOCK"
+  until flock -n 9; do
+    log "tunnel lock held, waiting"
+    past_deadline && exit 0
+    sleep 60
+  done
+  echo $$ >&9
+}
 
 probe() {
-  timeout 60 python -c "
+  timeout 90 python -c "
 import jax, jax.numpy as jnp
 assert jax.devices()[0].platform in ('tpu', 'axon')
 x = jnp.ones((128, 128)); float((x @ x).sum())
 " > /dev/null 2>&1
 }
 
-note() { echo "{\"note\": \"$1\", \"at\": \"$(date +%H:%M:%S)\"}" >> "$OUT"; }
+phase_done() { grep -qx "$1" "$STATE" 2>/dev/null; }
+mark_done() { echo "$1" >> "$STATE"; }
+pick() { python scripts/pick_tuned.py >> "$LOG" 2>&1; }
 
 run_bench() { # label, env pairs...
   local label=$1; shift
-  echo "=== $label $(date +%H:%M:%S)" >> "$LOG"
+  too_late && return 1
+  log "bench arm: $label"
   local line
-  line=$(env "$@" CCSC_BENCH_TIMEOUT=2400 timeout 5400 python bench.py 2>> "$LOG" | tail -1)
-  if [ -n "$line" ]; then
+  # inner watchdog (bench.py's subprocess.run) fires first so the
+  # workload child is cleaned up; the outer timeout is the backstop
+  line=$(env "$@" CCSC_BENCH_TIMEOUT="$(capped 2000)" \
+    timeout "$(capped 2400)" python bench.py 2>> "$LOG" | tail -1)
+  if [ -n "$line" ] && echo "$line" | python -c \
+      'import json,sys; json.load(sys.stdin)' > /dev/null 2>&1; then
     echo "{\"run\": \"$label\", \"result\": $line}" >> "$OUT"
-  else
-    note "$label FAILED/empty"
+    case "$line" in *DEGRADED*) return 1 ;; esac
+    return 0
   fi
+  note "$label FAILED/empty"
+  return 1
 }
 
-# pick the fastest real-TPU arm measured SO FAR and persist its knobs
-# (read back from each record's own "knobs" field — single source of
-# truth) as bench_tuned.json for future `python bench.py` runs; env
-# still overrides. Requires a SUCCESSFUL baseline to compare against;
-# otherwise (and when baseline wins) any stale tuned file is removed
-# so defaults really are the defaults.
-pick() {
-  python scripts/pick_tuned.py >> "$LOG" 2>&1
+run_py() { # timeout_s, script args...
+  local t=$1; shift
+  too_late && return 1
+  log "py: $*"
+  timeout "$(capped "$t")" python "$@" >> "$OUT" 2>> "$LOG"
 }
+
+run_arms_file() { # one "label ENV=V ..." per line; re-picks per arm.
+  # Per-arm resume state ("arm:<label>" in $STATE): a phase retried
+  # after one failing arm must not re-burn tunnel time re-measuring
+  # the arms that already succeeded.
+  local file=$1 label envs rc=0
+  [ -f "$file" ] || { log "no arms file $file"; return 0; }
+  while read -r label envs; do
+    [ -z "$label" ] && continue
+    case "$label" in \#*) continue ;; esac
+    phase_done "arm:$label" && continue
+    past_deadline && return 1
+    # shellcheck disable=SC2086
+    if run_bench "$label" $envs; then
+      mark_done "arm:$label"
+      pick
+    else
+      rc=1
+    fi
+  done < "$file"
+  return $rc
+}
+
+run_family_arms() { # drives family_bench; one JSON line per family
+  local file=$1 label envs line got rc=0
+  [ -f "$file" ] || return 0
+  while read -r label envs; do
+    [ -z "$label" ] && continue
+    case "$label" in \#*) continue ;; esac
+    phase_done "farm:$label" && continue
+    past_deadline && return 1
+    too_late && return 1
+    log "family arm: $label"
+    got=0
+    # shellcheck disable=SC2086
+    while read -r line; do
+      if echo "$line" | python -c \
+          'import json,sys; json.load(sys.stdin)' > /dev/null 2>&1; then
+        echo "{\"family_arm\": \"$label\", \"result\": $line}" >> "$OUT"
+        got=1
+      fi
+    done < <(env $envs timeout "$(capped 2400)" \
+      python scripts/family_bench.py 2>> "$LOG")
+    if [ "$got" -eq 0 ]; then
+      note "family arm $label FAILED/empty"
+      rc=1
+    else
+      mark_done "farm:$label"
+    fi
+  done < "$file"
+  return $rc
+}
+
+# ---- phases ---------------------------------------------------------
+phase_baseline() {
+  run_bench baseline CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32 \
+    CCSC_BENCH_DSTORAGE=float32 CCSC_BENCH_FFTIMPL=xla \
+    CCSC_BENCH_PALLAS=0 CCSC_BENCH_FUSEDZ=0
+}
+phase_arms() { run_arms_file scripts/onchip_arms.txt; }
+phase_bandwidth() { run_py 2400 scripts/bandwidth_probe.py; }
+phase_accuracy() {
+  run_py 2400 scripts/accuracy_probe.py || return 1
+  run_py 1200 scripts/tpu_fused_parity.py
+}
+phase_hs() {
+  run_family_arms scripts/hs_arms.txt || return 1
+  run_py 2400 scripts/hs_profile.py
+}
+phase_profile() {
+  rm -rf artifacts_prof/tuned
+  run_bench profile_tuned CCSC_BENCH_PROFILE=1 CCSC_BENCH_PROFILE_REPS=2 \
+    CCSC_BENCH_XPROF=artifacts_prof/tuned || return 1
+  run_py 600 scripts/xprof_report.py artifacts_prof/tuned
+}
+phase_banks() {
+  # needs a real window: don't start a multi-hour train that the
+  # deadline cap would kill after minutes
+  [ "$(time_left)" -le 3600 ] && return 1
+  timeout "$(capped 10800)" python scripts/family_banks.py --hs-n 12 \
+    --out artifacts_family >> "$LOG" 2>&1
+}
+
+PHASES="baseline arms bandwidth accuracy hs profile banks"
+
+acquire_lock
+log "runner start, deadline in ${1:-34200}s, phases: $PHASES"
 
 while true; do
+  past_deadline && { log "deadline reached, exiting"; exit 0; }
+  remaining=""
+  for p in $PHASES; do phase_done "$p" || remaining="$remaining $p"; done
+  if [ -z "$remaining" ]; then log "all phases complete"; exit 0; fi
   if probe; then
-    # rotate any previous generation's records: the arm picker must
-    # only see THIS invocation's measurements
-    [ -f "$OUT" ] && mv "$OUT" "$OUT.$(date +%s).old"
-    note "tunnel UP - starting queue"
-    # pin the defaults during the A/Bs so a pre-existing
-    # bench_tuned.json can't contaminate the baseline arm. Arms run in
-    # expected-win order and the picker runs AFTER EVERY arm, so even
-    # a short tunnel window leaves a valid (partial) tuned config.
-    run_bench baseline CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32
-    pick
-    run_bench fftpad_pow2 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=float32
-    pick
-    run_bench fftpad_pow2_bf16 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=pow2 CCSC_BENCH_STORAGE=bfloat16
-    pick
-    run_bench bf16 CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=bfloat16
-    pick
-    run_bench fftpad_fast CCSC_BENCH_PALLAS=0 CCSC_BENCH_FFTPAD=fast CCSC_BENCH_STORAGE=float32
-    pick
-    run_bench pallas CCSC_BENCH_PALLAS=1 CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32
-    pick
-    echo "=== microbench $(date +%H:%M:%S)" >> "$LOG"
-    timeout 3600 python scripts/fft_microbench.py >> "$OUT" 2>> "$LOG" \
-      || note "fft_microbench FAILED"
-    echo "=== families $(date +%H:%M:%S)" >> "$LOG"
-    timeout 5400 python scripts/family_bench.py >> "$OUT" 2>> "$LOG" \
-      || note "family_bench FAILED"
-    run_bench profile CCSC_BENCH_PROFILE=1
-    note "queue complete"
-    break
+    for p in $remaining; do
+      past_deadline && { log "deadline reached mid-run"; exit 0; }
+      note "phase $p start"
+      if "phase_$p"; then
+        mark_done "$p"
+        note "phase $p complete"
+      else
+        note "phase $p FAILED (will retry when tunnel answers)"
+        probe || break  # tunnel died: back to polling, keep state
+      fi
+    done
+  else
+    log "tunnel down"
   fi
-  echo "$(date +%H:%M:%S) tunnel down" >> "$LOG"
-  sleep 240
+  sleep "$POLL"
 done

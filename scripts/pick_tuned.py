@@ -1,8 +1,10 @@
 #!/usr/bin/env python
-"""Pick the fastest real-TPU arm from onchip_r4.jsonl and persist its
-knobs as bench_tuned.json (bench.py applies them automatically on TPU;
-env vars still override). Requires a successful baseline to compare
-against; when the baseline wins, any stale tuned file is removed.
+"""Pick the fastest real-TPU arm from the NEWEST onchip_r*.jsonl that
+holds any valid record, and persist its knobs as bench_tuned.json
+(bench.py applies them automatically on TPU; env vars still override).
+Requires a successful baseline to compare against; when the baseline
+wins, any stale tuned file is removed. Older round files are never
+mixed in — their arms ran older code on an older tunnel.
 
 Single source of truth for knob defaults — the queue phases append
 records, this script decides.
@@ -12,7 +14,6 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "onchip_r4.jsonl")
 TUNED = os.path.join(REPO, "bench_tuned.json")
 
 DEFAULTS = {
@@ -25,28 +26,50 @@ DEFAULTS = {
 }
 
 
-def main():
-    best, best_v, best_k, base_v = None, -1.0, {}, None
-    if not os.path.exists(OUT):
-        # no records (fresh checkout / rotated file): defaults
-        if os.path.exists(TUNED):
-            os.remove(TUNED)
-        print("tuned: defaults (no records)")
-        return 0
-    for line in open(OUT):
+def _valid_runs(path):
+    for line in open(path):
         try:
             rec = json.loads(line)
         except Exception:
             continue
         res = rec.get("result") or {}
-        metric = res.get("metric", "")
         v = float(res.get("value", 0.0))
-        if not rec.get("run") or "DEGRADED" in metric or v <= 0:
+        if not rec.get("run") or "DEGRADED" in res.get("metric", "") \
+                or v <= 0:
             continue
-        if rec["run"] == "baseline":
+        yield rec["run"], v, res.get("knobs") or {}
+
+
+def main():
+    import glob
+
+    # ONLY the newest round file with any valid record: arms measured
+    # by an older round ran older code on an older tunnel and must not
+    # contaminate the pick (each round's queue measures its own
+    # baseline first, so the newest file is self-contained).
+    # mtime order, not lexicographic ('onchip_r10' would sort before
+    # 'onchip_r4'); matches bench.py's last_onchip_record ordering
+    files = sorted(
+        glob.glob(os.path.join(REPO, "onchip_r*.jsonl")),
+        key=os.path.getmtime,
+    )
+    current = None
+    for path in reversed(files):
+        if any(True for _ in _valid_runs(path)):
+            current = path
+            break
+    if current is None:
+        # no records (fresh checkout / rotated files): defaults
+        if os.path.exists(TUNED):
+            os.remove(TUNED)
+        print("tuned: defaults (no records)")
+        return 0
+    best, best_v, best_k, base_v = None, -1.0, {}, None
+    for run, v, knobs in _valid_runs(current):
+        if run == "baseline":
             base_v = v if base_v is None else max(base_v, v)
         if v > best_v:
-            best, best_v, best_k = rec["run"], v, res.get("knobs") or {}
+            best, best_v, best_k = run, v, knobs
     tuned = {k: v for k, v in best_k.items() if v != DEFAULTS.get(k)}
     if base_v is None or best in (None, "baseline") or best_v <= base_v \
             or not tuned:

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Real-TPU parity check for the pallas fused_z + shard_map branch.
+
+ADVICE r4: off-TPU, the mesh test routes to the jnp reference (pallas
+interpret mode cannot run under shard_map's vma checks), so the
+pvary/vma-lift lowering in ops/pallas_fused_z.py only ever executes on
+real hardware. This probe runs it there: a small consensus learn with
+fused_z under a 1-device 'block' shard_map mesh (shard_map marks the
+axis varying-manual even at size 1, so the lift branch and the mosaic
+lowering both engage) against the unsharded fused and unfused runs.
+Prints one JSON line; queued in scripts/onchip_queue.sh phase
+'accuracy'.
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+    from ccsc_code_iccv2017_tpu.models.learn import learn
+    from ccsc_code_iccv2017_tpu.parallel.mesh import block_mesh
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(6)
+    b = jnp.asarray(rng.standard_normal((4, 20, 20)).astype(np.float32))
+    geom = ProblemGeom((5, 5), 6)
+    kw = dict(
+        max_it=2, max_it_d=2, max_it_z=3, num_blocks=1,
+        verbose="none", track_objective=True,
+    )
+    key = jax.random.PRNGKey(0)
+    r_ref = learn(b, geom, LearnConfig(**kw), key=key)
+    r_fus = learn(b, geom, LearnConfig(**kw, fused_z=True), key=key)
+    r_msh = learn(
+        b, geom, LearnConfig(**kw, fused_z=True), key=key,
+        mesh=block_mesh(1),
+    )
+    d_ref = np.asarray(r_ref.d)
+    err_fused = float(
+        np.max(np.abs(np.asarray(r_fus.d) - d_ref))
+        / max(np.max(np.abs(d_ref)), 1e-12)
+    )
+    err_mesh = float(
+        np.max(np.abs(np.asarray(r_msh.d) - np.asarray(r_fus.d)))
+        / max(np.max(np.abs(np.asarray(r_fus.d))), 1e-12)
+    )
+    ok = err_fused < 1e-3 and err_mesh < 1e-3
+    print(json.dumps({
+        "tpu_fused_parity": "ok" if ok else "MISMATCH",
+        "platform": platform,
+        "fused_vs_einsum_rel": err_fused,
+        "mesh_vs_fused_rel": err_mesh,
+        "obj_z_ref": r_ref.trace["obj_vals_z"],
+        "obj_z_fused_mesh": r_msh.trace["obj_vals_z"],
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
