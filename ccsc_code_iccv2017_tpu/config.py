@@ -125,9 +125,10 @@ class LearnConfig:
     # fft2(D{1}); objectives at :128,:166 likewise) — used by the
     # MATLAB-anchored trajectory tests.
     compat_coding: str = "consensus"
-    # Route the W == 1 z-solve through the fused Pallas TPU kernel
-    # (ops.pallas_kernels; interpret mode off-TPU). Bit-compatible with
-    # the einsum path up to float reassociation.
+    # DEPRECATED no-op, kept for config/CLI compatibility: the
+    # per-solve Pallas kernel measured 0.93x the einsum path on the
+    # v5e (onchip_r4.jsonl) and was demoted to a test oracle
+    # (tests/test_pallas.py). The production Pallas path is fused_z.
     use_pallas: bool = False
     # Fuse the ENTIRE z inner iteration (prox + dual + DFT + rank-1
     # solve + inverse DFT) into the two-pass Pallas kernel of
@@ -219,7 +220,7 @@ class SolveConfig:
     # verbose != 'none'. PSNR additionally requires x_orig.
     track_objective: Optional[bool] = None
     track_psnr: Optional[bool] = None
-    # Route the W == 1 z-solve through the fused Pallas TPU kernel.
+    # DEPRECATED no-op — see LearnConfig.use_pallas.
     use_pallas: bool = False
     # Round the FFT domain up to a TPU-friendly size ('pow2' | 'fast');
     # requires a padded problem (ReconstructionProblem.pad=True) — see

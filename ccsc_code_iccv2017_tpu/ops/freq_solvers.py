@@ -33,8 +33,6 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-_PALLAS_SHARD_WARNED = False
-
 
 def hermitian_inverse(G: jnp.ndarray) -> jnp.ndarray:
     """Inverse of a batch of Hermitian positive-definite complex
@@ -152,41 +150,19 @@ def solve_z(
     Exact generalization of the reference's Sherman-Morrison
     (solve_conv_term, admm_solve_conv2D_weighted_sampling.m:170-190).
 
-    ``use_pallas`` routes the W == 1 case through the fused Pallas
-    kernel (ops.pallas_kernels; interpret mode off-TPU); W > 1 always
-    takes the einsum path.
+    ``use_pallas`` is accepted for call-site compatibility but no
+    longer routes anywhere: the per-solve Pallas kernel measured 0.93x
+    the einsum path on the v5e (onchip_r4.jsonl 'pallas' arm — the
+    z-solve einsum was never the bottleneck) and was demoted to a test
+    oracle (ops.pallas_kernels, exercised only by tests/test_pallas).
+    The ONE production Pallas path is the fused whole-iteration kernel
+    (ops.pallas_fused_z, LearnConfig.fused_z).
 
     ``axis_name``: filter-axis sharding — K here is the local shard;
     the data-side reduction t = A Ginv rhs is the one k-sum, psummed
     (the seam at dParallel.m:278-303); everything else is k-local.
     """
-    if axis_name is not None and use_pallas:
-        # fused kernel is single-shard only; say so once rather than
-        # silently taking the einsum path (the perf difference must be
-        # attributable to a visible downgrade)
-        global _PALLAS_SHARD_WARNED
-        if not _PALLAS_SHARD_WARNED:
-            _PALLAS_SHARD_WARNED = True
-            import warnings
-
-            warnings.warn(
-                "use_pallas=True ignored under filter-axis sharding: "
-                "the fused z-solve kernel is single-shard only; using "
-                "the einsum path",
-                stacklevel=2,
-            )
-        use_pallas = False
-    if use_pallas and kernel.minv is None:
-        from . import pallas_kernels
-
-        return pallas_kernels.solve_z_rank1_pallas(
-            kernel.dhat[:, 0, :],
-            xi1_hat[:, 0, :],
-            xi2_hat,
-            rho,
-            dinv=kernel.dinv,
-            interpret=_pallas_interpret(),
-        )
+    del use_pallas
     dhat, dinv = kernel.dhat, kernel.dinv
     rhs = jnp.einsum("kwf,nwf->nkf", jnp.conj(dhat), xi1_hat) + rho * xi2_hat
     g = dinv[None] * rhs  # Gamma^{-1} rhs, [N, K, F]

@@ -22,9 +22,14 @@ axon platform rejects complex buffers at kernel boundaries anyway —
 see freq_solvers module docstring). Layout: K on sublanes (padded to a
 multiple of 8), frequency on lanes (tiles of F_TILE).
 
-Use via freq_solvers.solve_z(..., use_pallas=True) or directly through
-solve_z_rank1_pallas; the einsum path remains the generic fallback
-(W > 1, CPU compile).
+STATUS: TEST ORACLE, not a production path. On the v5e this kernel
+measured 0.93x the einsum path (onchip_r4.jsonl 'pallas' arm) — XLA
+already fuses the rhs assembly well enough that the z-solve einsum was
+never the bottleneck — so `use_pallas` became a documented no-op and
+the ONE production Pallas path is the fused whole-iteration kernel
+(ops.pallas_fused_z). This kernel is kept as an independent
+implementation of the rank-1 solve, checked against the einsum path by
+tests/test_pallas.py.
 """
 from __future__ import annotations
 

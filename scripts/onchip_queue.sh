@@ -12,11 +12,16 @@
 #   script is reusable (ADVICE r4: no absolute wall-clock bake-in).
 #
 # Phase protocol:
-#   - single mkdir lock (stale-safe) guarantees one tunnel client
+#   - single-client lock: flock on $LOCK (a persistent fd the kernel
+#     releases when the holder dies — no stale state to clean up)
 #   - probe() is the only tunnel-liveness test; phases run only after
 #     a fresh successful probe
-#   - completed phases are recorded in $STATE so a restarted runner
-#     resumes where it left off (the tunnel died mid-run twice in r4)
+#   - completed phases AND arms are recorded in $STATE so a restarted
+#     runner resumes where it left off (the tunnel died mid-run twice
+#     in r4). $STATE is round-scoped; to re-measure from scratch after
+#     a code fix, `rm $STATE` (and rotate $OUT) before relaunching.
+#   - a phase that fails MAX_PHASE_FAILS times is given up (noted in
+#     $OUT) rather than retried every poll cycle until the deadline
 #   - every python invocation is double-watchdogged: CCSC_BENCH_TIMEOUT
 #     (in-process subprocess watchdog) + an outer `timeout`
 #   - bench_tuned.json is re-picked after EVERY measured arm, so even
@@ -67,6 +72,9 @@ x = jnp.ones((128, 128)); float((x @ x).sum())
 
 phase_done() { grep -qx "$1" "$STATE" 2>/dev/null; }
 mark_done() { echo "$1" >> "$STATE"; }
+MAX_PHASE_FAILS=3
+fail_count() { grep -cx "failed:$1" "$STATE" 2>/dev/null || true; }
+mark_failed() { echo "failed:$1" >> "$STATE"; }
 pick() { python scripts/pick_tuned.py >> "$LOG" 2>&1; }
 
 run_bench() { # label, env pairs...
@@ -76,12 +84,16 @@ run_bench() { # label, env pairs...
   local line
   # inner watchdog (bench.py's subprocess.run) fires first so the
   # workload child is cleaned up; the outer timeout is the backstop
-  line=$(env "$@" CCSC_BENCH_TIMEOUT="$(capped 2000)" \
+  # fallback disabled: a hung TPU attempt fails fast instead of
+  # burning another timeout on a DEGRADED CPU record the picker
+  # ignores (the outer timeout therefore only needs ONE attempt)
+  line=$(env "$@" CCSC_BENCH_NO_FALLBACK=1 \
+    CCSC_BENCH_TIMEOUT="$(capped 2000)" \
     timeout "$(capped 2400)" python bench.py 2>> "$LOG" | tail -1)
   if [ -n "$line" ] && echo "$line" | python -c \
       'import json,sys; json.load(sys.stdin)' > /dev/null 2>&1; then
     echo "{\"run\": \"$label\", \"result\": $line}" >> "$OUT"
-    case "$line" in *DEGRADED*) return 1 ;; esac
+    case "$line" in *DEGRADED*|*FAILED*) return 1 ;; esac
     return 0
   fi
   note "$label FAILED/empty"
@@ -194,9 +206,21 @@ while true; do
       if "phase_$p"; then
         mark_done "$p"
         note "phase $p complete"
+      elif probe; then
+        # tunnel is still alive, so the failure was the phase's own —
+        # count it; a deterministic failure must not retry forever
+        mark_failed "$p"
+        if [ "$(fail_count "$p")" -ge "$MAX_PHASE_FAILS" ]; then
+          mark_done "$p"
+          note "phase $p GIVEN UP after $MAX_PHASE_FAILS failures"
+        else
+          note "phase $p FAILED (will retry)"
+        fi
       else
-        note "phase $p FAILED (will retry when tunnel answers)"
-        probe || break  # tunnel died: back to polling, keep state
+        # tunnel died mid-phase: not the phase's fault — back to
+        # polling with per-arm state intact, no failure counted
+        note "phase $p interrupted (tunnel down)"
+        break
       fi
     done
   else
