@@ -61,7 +61,17 @@ def summarize(trace_dir, top=25):
             ops[names.get(ev.metadata_id, "?")] += ev.duration_ps
         line_tot[line.name] = sum(ops.values())
         line_ops[line.name] = ops
-    busiest = max(line_tot, key=line_tot.get) if line_tot else None
+    # prefer the op-level timeline by name: on TPU the plane carries
+    # both "XLA Modules" (one whole-program event — always the
+    # "busiest" line) and "XLA Ops" (per-HLO events, what we want)
+    op_lines = [
+        n for n, tot in line_tot.items()
+        if "xla ops" in n.lower() and tot > 0
+    ]
+    if op_lines:
+        busiest = max(op_lines, key=line_tot.get)
+    else:
+        busiest = max(line_tot, key=line_tot.get) if line_tot else None
     ops = line_ops.get(busiest, {})
     total_ps = sum(ops.values())
     rows = sorted(ops.items(), key=lambda kv: -kv[1])[:top]
