@@ -52,7 +52,7 @@ def summarize(trace_dir, top=25):
                 best = (is_tpu, total, plane, path)
     _, _, plane, path = best
     names = {m.id: m.name for m in plane.event_metadata.values()}
-    # the busiest line on the device plane is the XLA-op timeline
+    # aggregate per line, then choose which line to report from below
     line_tot = defaultdict(int)
     line_ops = {}
     for line in plane.lines:
