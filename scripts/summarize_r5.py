@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Render onchip_r5.jsonl as the PERF.md markdown tables.
+
+The tunnel historically answers in short windows (r4: 31 minutes in a
+12-hour round), so the write-up must be quick: this turns whatever the
+phase runner recorded — bench arms, bandwidth fit, accuracy probe,
+family arms, hs profile, xprof attribution — into paste-ready
+markdown. Usage: python scripts/summarize_r5.py [jsonl_path]
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rows(path):
+    if not os.path.exists(path):
+        return
+    for line in open(path):
+        try:
+            yield json.loads(line)
+        except Exception:
+            continue
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "onchip_r5.jsonl"
+    )
+    arms, fams, bw, bwfit, acc, hsp, xp, notes = [], [], [], [], [], [], [], []
+    for rec in rows(path):
+        if "run" in rec and "result" in rec:
+            arms.append(rec)
+        elif "family_arm" in rec:
+            fams.append(rec)
+        elif "bwprobe" in rec:
+            bw.append(rec)
+        elif "bwprobe_fit" in rec or "bwprobe_verdict" in rec:
+            bwfit.append(rec)
+        elif "config" in rec and "obj_dev" in str(rec):
+            acc.append(rec)
+        elif "hs_profile" in rec:
+            hsp.append(rec["hs_profile"])
+        elif "xprof" in rec:
+            xp.append(rec)
+        elif "tpu_fused_parity" in rec:
+            acc.append(rec)
+        elif "note" in rec:
+            notes.append(rec)
+        else:
+            acc.append(rec)  # accuracy-probe lines and anything else
+
+    def is_chip(a):
+        m = a["result"].get("metric", "")
+        return ", 1 chip" in m and float(a["result"].get("value", 0)) > 0
+
+    # baseline = best real-chip baseline (same filter as pick_tuned /
+    # last_onchip_record — a DEGRADED rerun must not replace it)
+    base = max(
+        (float(a["result"]["value"]) for a in arms
+         if a["run"] == "baseline" and is_chip(a)),
+        default=None,
+    )
+    if arms:
+        print("## Bench arms (onchip_r5.jsonl)\n")
+        print("| Arm | iters/sec | vs r5 baseline | knobs |")
+        print("|---|---|---|---|")
+        for a in arms:
+            r = a["result"]
+            v = float(r.get("value", 0))
+            rel = f"{v / base:.2f}x" if base and v and is_chip(a) else "-"
+            knobs = r.get("knobs") or {}
+            kn = ", ".join(
+                f"{k}={v2}" for k, v2 in knobs.items()
+                if v2 not in (False, "none", "float32", "xla")
+            ) or "defaults"
+            tag = "" if is_chip(a) else " (NOT ON CHIP)"
+            print(f"| {a['run']}{tag} | {v:.4g} | {rel} | {kn} |")
+        print()
+    if fams:
+        print("## Family arms\n")
+        print("| Arm | family | iters/sec | notes |")
+        print("|---|---|---|---|")
+        for f in fams:
+            r = f["result"]
+            print(
+                f"| {f['family_arm']} | {r.get('family', '?')} | "
+                f"{r.get('iters_per_sec', '?')} | {r.get('metric', '')} |"
+            )
+        print()
+    if bw or bwfit:
+        print("## Bandwidth probe\n")
+        if bw:
+            print("| Op | moved MB | ms | GB/s |")
+            print("|---|---|---|---|")
+            for b in bw:
+                print(
+                    f"| {b['bwprobe']} | {b['moved_mb']} | {b['ms']} | "
+                    f"{b['gbps']} |"
+                )
+        for f in bwfit:
+            print()
+            print(f"fit: `{json.dumps(f)}`")
+        print()
+    if hsp:
+        print("## HS differential profile\n")
+        print("| fft_impl | carry | s/step | d-iter ms | z-iter ms | fixed ms |")
+        print("|---|---|---|---|---|---|")
+        for h in hsp:
+            print(
+                f"| {h.get('fft_impl')} | {h.get('carry_freq')} | "
+                f"{h.get('step_s_10_10')} | {h.get('per_d_iter_ms')} | "
+                f"{h.get('per_z_iter_ms')} | {h.get('fixed_ms')} |"
+            )
+        print()
+    if xp:
+        print("## xprof attribution (top ops)\n")
+        for x in xp:
+            if x.get("xprof") != "ok":
+                print(f"- {json.dumps(x)}")
+                continue
+            print(f"plane `{x['plane']}`, line `{x['line']}`, "
+                  f"total {x['total_ms']} ms:\n")
+            print("| Op | ms | % |")
+            print("|---|---|---|")
+            for op in x.get("top_ops", [])[:12]:
+                print(f"| `{op['op'][:60]}` | {op['ms']} | {op['pct']} |")
+        print()
+    if acc:
+        print("## Accuracy / parity records\n")
+        for a in acc:
+            print(f"- `{json.dumps(a)[:240]}`")
+        print()
+    if notes:
+        print("## Runner notes\n")
+        for n in notes[-20:]:
+            print(f"- {n.get('at', '')} {n.get('note', '')}")
+
+
+if __name__ == "__main__":
+    main()
