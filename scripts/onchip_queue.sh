@@ -189,7 +189,10 @@ phase_banks() {
     --out artifacts_family >> "$LOG" 2>&1
 }
 
-PHASES="baseline arms bandwidth accuracy hs profile banks"
+# Ordered by value density under a short window (r4's only window was
+# 31 minutes): the round's #1 question (the bandwidth-ceiling theory)
+# right after the baseline, then the unmeasured second-wave arms.
+PHASES="baseline bandwidth arms accuracy hs profile banks"
 
 acquire_lock
 log "runner start, deadline in ${1:-34200}s, phases: $PHASES"
