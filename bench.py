@@ -408,6 +408,15 @@ def attempt(extra_env, timeout):
     except subprocess.TimeoutExpired:
         return None
     if out.returncode != 0:
+        # surface the child's failure (r5: the fused_z arms died in ~70s
+        # with the traceback swallowed by capture_output); the runner
+        # appends our stderr to its log, so the tail lands there
+        tail = (out.stderr or "").strip().splitlines()[-30:]
+        print(
+            "bench attempt failed (rc=%d):\n%s"
+            % (out.returncode, "\n".join(tail)),
+            file=sys.stderr,
+        )
         return None
     for line in out.stdout.splitlines()[::-1]:
         line = line.strip()
