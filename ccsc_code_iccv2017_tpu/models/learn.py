@@ -400,7 +400,11 @@ def eval_block(
             Dz, geom.psf_radius, bl.shape[-geom.ndim_spatial:]
         )
 
-    fids, l1s, Dz = jax.vmap(one)(state.z, b_blocks)
+    # sequential over blocks: evaluation is a once-per-run diagnostic,
+    # and vmap would materialize every block's code spectra at once —
+    # the r5 3D-bank OOM (8 blocks x f32[8,49,60,60,60] padded 2.3x
+    # blew 25.8G on a 15.75G chip) happened exactly here
+    fids, l1s, Dz = jax.lax.map(lambda a: one(*a), (state.z, b_blocks))
     global_axes = tuple(
         a for a in (axis_name, filter_axis_name) if a is not None
     ) or None

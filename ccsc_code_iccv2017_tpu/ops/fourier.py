@@ -130,11 +130,37 @@ def _apply_axis(
     order. A single einsum (dot_general contracting the given axis)
     rather than moveaxis+matmul+moveaxis — explicit transposes of the
     code-sized tensors would each cost a full HBM pass."""
+    axis = axis % x.ndim
+    trailing = x.shape[axis + 1:]
+    if len(trailing) > 1:
+        # collapse the (contiguous) trailing dims to one: the v5e/axon
+        # backend raises UNIMPLEMENTED on a complex dot_general with
+        # two-plus trailing dims after the contracted axis (hit by the
+        # 3-D hyperspectral transform, r5 on-chip log), while the
+        # single-trailing-dim form is the measured 2-D production path.
+        # The reshape is metadata-only (trailing dims are contiguous).
+        xc = x.reshape(x.shape[: axis + 1] + (-1,))
+        out = _apply_axis(xc, mat, axis, prec)
+        return out.reshape(x.shape[:axis] + (mat.shape[1],) + trailing)
     letters = "abcdefghijklmnopqrstuvwxy"
     sub = letters[: x.ndim]
     ax = sub[axis]
     out = sub.replace(ax, "z")
-    return jnp.einsum(f"{sub},{ax}z->{out}", x, mat, precision=prec)
+    spec = f"{sub},{ax}z->{out}"
+    if not (jnp.iscomplexobj(x) and np.iscomplexobj(mat)):
+        return jnp.einsum(spec, x, mat, precision=prec)
+    # complex x complex as four REAL contractions: the v5e/axon backend
+    # raises UNIMPLEMENTED lowering a standalone complex dot_general
+    # (r5 on-chip log, hyperspectral matmul-DFT) — the decomposition is
+    # exactly XLA's own complex-mult rewrite, done where the backend
+    # can't refuse it
+    xr, xi = jnp.real(x), jnp.imag(x)
+    mr = np.ascontiguousarray(mat.real)
+    mi = np.ascontiguousarray(mat.imag)
+    ein = functools.partial(jnp.einsum, spec, precision=prec)
+    return jax.lax.complex(
+        ein(xr, mr) - ein(xi, mi), ein(xr, mi) + ein(xi, mr)
+    )
 
 
 def _matmul_rfftn(

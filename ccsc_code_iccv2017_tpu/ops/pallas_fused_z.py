@@ -7,22 +7,31 @@ the rank-1 Sherman-Morrison solve (solve_conv_term_Z,
 2D/admm_learn_conv2D_large_dParallel.m:278-303), and inverse rfft2.
 The XLA composition materializes ~5 code-sized complex spectra in HBM
 per iteration (~6-7 GB at the north-star shape); this kernel keeps the
-entire chain VMEM-resident per (image, k-tile) block, touching HBM
+entire chain VMEM-resident per (image, filter) plane, touching HBM
 only for the bf16/f32 state in and out (~1.9 GB) — the r4 roofline
 work (PERF.md) showed the z-pass is bandwidth-bound, so traffic IS the
 step time.
 
 Structure (the k-reduction forces two passes):
 
-  pass A  grid (N, K/kt): prox -> dual' out -> DFT(xi) via the
-          matmul-DFT matrices (ops.fourier) -> accumulate the
-          k-reduction t_f = sum_k d_k g_k into a per-image [Sy, Fx]
-          buffer over consecutive k-tile grid steps.
+  pass A  grid (N*K,): per (image, filter) plane: prox -> dual' out ->
+          DFT(xi) via the matmul-DFT matrices (ops.fourier) ->
+          accumulate the k-reduction t_f = sum_k d_k g_k into a
+          per-image [Sy, Fx] buffer over the K consecutive grid steps
+          that revisit it.
   (jnp)   s_f = minv_diag_f * t_f   (tiny elementwise)
   pass B  same grid: recompute xi spectra (cheaper than a spectra
           HBM round-trip; the MXU is idle), apply the rank-1
           correction z_hat = g - (1/rho) conj(d) s, inverse DFT,
           write z'.
+
+Every in-kernel tensor is a 2-D [Sy, Sx]/[Sy, Fx] plane and every
+contraction a plain or transposed-A 2-D matmul: the r5 on-chip compile
+showed Mosaic rejects the k-batched 3-D dot_generals ("infer-vector-
+layout: unsupported shape cast" — the (k, Sy) collapse XLA emits is
+not tile-exact at Sy=110), while 2-D matmuls on the same shapes are
+the measured production path. The k axis therefore lives in the grid,
+not the block.
 
 Complex arithmetic is split into re/im planes (no complex buffers at
 kernel boundaries — axon). The filter spectra and DFT matrices ride in
@@ -46,24 +55,6 @@ from jax.experimental import pallas as pl
 from . import fourier, proxes
 
 
-def _ktile(K: int, cap: int = None) -> int:
-    """Largest divisor of K that is <= cap (VMEM sizing).
-
-    The default cap (25) keeps the worst-case per-step VMEM footprint
-    (state blocks + resident filter spectra + f32 DFT temporaries)
-    within the ~16 MB/core budget at the north-star shape; override
-    with CCSC_FUSEDZ_KT_CAP if a geometry compiles out of memory.
-    """
-    if cap is None:
-        import os
-
-        cap = int(os.environ.get("CCSC_FUSEDZ_KT_CAP", 25))
-    for kt in range(min(cap, K), 0, -1):
-        if K % kt == 0:
-            return kt
-    return 1
-
-
 def _mats(Sy: int, Sx: int):
     """f32 re/im DFT matrix constants for a [Sy, Sx] plane."""
     f = fourier._rdft_mat(Sx)  # [Sx, Fx] forward, last axis
@@ -79,38 +70,42 @@ def _mats(Sy: int, Sx: int):
     )
 
 
+# HIGHEST precision throughout: the kernel's contract is float-
+# tolerance parity with the einsum path (default precision would
+# silently be single-pass bf16 on the MXU — the matmul_bf16 class).
+_ein = functools.partial(
+    jnp.einsum,
+    preferred_element_type=jnp.float32,
+    precision=jax.lax.Precision.HIGHEST,
+)
+
+
 def _xi_spectra(z, du, theta, fre, fim, dre, dim):
     """prox + dual + forward DFT of the coding target, f32 in VMEM.
 
-    z, du: [kt, Sy, Sx] f32. Returns (xr, xi) [kt, Sy, Fx] spectra of
+    z, du: [Sy, Sx] f32 plane. Returns (xr, xi) [Sy, Fx] spectra of
     xi = 2*soft_threshold(z + du, theta) - (z + du), plus dual' =
-    (z + du) - soft_threshold(z + du, theta).
+    (z + du) - soft_threshold(z + du, theta). All contractions are
+    2-D matmuls in natural output order (no batched dots, no output
+    transposes — the forms Mosaic lowers without shape casts).
     """
     s = z + du
     u2 = proxes.soft_threshold(s, theta)
     dual_new = s - u2
     xi = 2.0 * u2 - s
-    # last-axis rfft: real @ complex as two real matmuls. HIGHEST
-    # precision throughout: the kernel's contract is float-tolerance
-    # parity with the einsum path (default precision would silently be
-    # single-pass bf16 on the MXU — the matmul_bf16 accuracy class).
-    ein = functools.partial(
-        jnp.einsum,
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-    ar = ein("kyx,xv->kyv", xi, fre)
-    ai = ein("kyx,xv->kyv", xi, fim)
-    # y-axis full complex DFT
-    xr = ein("kyv,yu->kuv", ar, dre) - ein("kyv,yu->kuv", ai, dim)
-    xi_ = ein("kyv,yu->kuv", ar, dim) + ein("kyv,yu->kuv", ai, dre)
+    # last-axis rfft: real @ complex as two real matmuls
+    ar = _ein("yx,xv->yv", xi, fre)
+    ai = _ein("yx,xv->yv", xi, fim)
+    # y-axis full complex DFT: transposed-A matmuls, out (u, v)
+    xr = _ein("yu,yv->uv", dre, ar) - _ein("yu,yv->uv", dim, ai)
+    xi_ = _ein("yu,yv->uv", dim, ar) + _ein("yu,yv->uv", dre, ai)
     return xr, xi_, dual_new
 
 
 def _g(xr, xi_, dr, di, br, bi, inv_rho):
-    """g = conj(d) * bhat / rho + xihat, per (k, y, v)."""
-    gr = (dr * br[None] + di * bi[None]) * inv_rho + xr
-    gi = (dr * bi[None] - di * br[None]) * inv_rho + xi_
+    """g = conj(d) * bhat / rho + xihat, one [Sy, Fx] plane."""
+    gr = (dr * br + di * bi) * inv_rho + xr
+    gi = (dr * bi - di * br) * inv_rho + xi_
     return gr, gi
 
 
@@ -134,8 +129,6 @@ def fused_z_iter(
     """
     N, K, Sy, Sx = z.shape
     Fx = Sx // 2 + 1
-    kt = _ktile(K)
-    nk = K // kt
     m = _mats(Sy, Sx)
     inv_rho = 1.0 / float(rho)
     sd = z.dtype
@@ -173,9 +166,13 @@ def fused_z_iter(
     br = lift(jnp.real(bhat).astype(jnp.float32))
     bi = lift(jnp.imag(bhat).astype(jnp.float32))
 
-    state_spec = pl.BlockSpec((1, kt, Sy, Sx), lambda i, j: (i, j, 0, 0))
-    img_spec = pl.BlockSpec((1, Sy, Fx), lambda i, j: (i, 0, 0))
-    d_spec = pl.BlockSpec((K, Sy, Fx), lambda i, j: (0, 0, 0))
+    # k lives in the grid: state as (N*K) planes (contiguous merge of
+    # leading dims — metadata-only), one [Sy, Sx] plane per grid step
+    z3 = z.reshape(N * K, Sy, Sx)
+    du3 = dual.reshape(N * K, Sy, Sx)
+    state_spec = pl.BlockSpec((1, Sy, Sx), lambda i: (i, 0, 0))
+    img_spec = pl.BlockSpec((1, Sy, Fx), lambda i: (i // K, 0, 0))
+    d_spec = pl.BlockSpec((K, Sy, Fx), lambda i: (0, 0, 0))
 
     def sds(shape, dtype):
         """Out aval; under shard_map the outputs vary across the same
@@ -188,7 +185,7 @@ def fused_z_iter(
         """Whole array as one VMEM block with a constant index — the
         pipeline fetches it once, not per grid step."""
         nd = a.ndim
-        return pl.BlockSpec(a.shape, lambda i, j, _nd=nd: (0,) * _nd)
+        return pl.BlockSpec(a.shape, lambda i, _nd=nd: (0,) * _nd)
 
     fwd_mats = tuple(
         lift(a) for a in (m["fre"], m["fim"], m["dre"], m["dim"])
@@ -202,19 +199,20 @@ def fused_z_iter(
     def kernel_a(z_ref, du_ref, dr_ref, di_ref, br_ref, bi_ref,
                  fre_ref, fim_ref, cre_ref, cim_ref,
                  dual_ref, tr_ref, ti_ref):
-        j = pl.program_id(1)
+        j = pl.program_id(0) % K
         zt = z_ref[0].astype(jnp.float32)
         dt = du_ref[0].astype(jnp.float32)
         xr, xi_, dual_new = _xi_spectra(
             zt, dt, theta, fre_ref[:], fim_ref[:], cre_ref[:], cim_ref[:]
         )
         dual_ref[0] = dual_new.astype(sd)
-        drt = dr_ref[pl.ds(j * kt, kt)]
-        dit = di_ref[pl.ds(j * kt, kt)]
+        drt = dr_ref[j]
+        dit = di_ref[j]
         gr, gi = _g(xr, xi_, drt, dit, br_ref[0], bi_ref[0], inv_rho)
-        # t += sum_k d_k * g_k (complex)
-        pr = jnp.sum(drt * gr - dit * gi, axis=0)
-        pi = jnp.sum(drt * gi + dit * gr, axis=0)
+        # t += d_k * g_k (complex), accumulated over the K grid steps
+        # that revisit this image's output block
+        pr = drt * gr - dit * gi
+        pi = drt * gi + dit * gr
 
         @pl.when(j == 0)
         def _():
@@ -226,17 +224,17 @@ def fused_z_iter(
 
     dual_new, t_re, t_im = pl.pallas_call(
         kernel_a,
-        grid=(N, nk),
+        grid=(N * K,),
         in_specs=[state_spec, state_spec, d_spec, d_spec, img_spec,
                   img_spec, *fwd_specs],
         out_specs=[state_spec, img_spec, img_spec],
         out_shape=[
-            sds(z.shape, sd),
+            sds((N * K, Sy, Sx), sd),
             sds((N, Sy, Fx), jnp.float32),
             sds((N, Sy, Fx), jnp.float32),
         ],
         interpret=interpret,
-    )(z, dual, dr, di, br, bi, *fwd_mats)
+    )(z3, du3, dr, di, br, bi, *fwd_mats)
 
     # rank-1 inner solve: s = minv_diag * t (tiny, plain XLA)
     s_re = minv_diag[None] * t_re
@@ -247,47 +245,45 @@ def fused_z_iter(
                  fre_ref, fim_ref, cre_ref, cim_ref,
                  ire_ref, iim_ref, wre_ref, wim_ref,
                  zout_ref):
-        j = pl.program_id(1)
+        j = pl.program_id(0) % K
         zt = z_ref[0].astype(jnp.float32)
         dt = du_ref[0].astype(jnp.float32)
         xr, xi_, _ = _xi_spectra(
             zt, dt, theta, fre_ref[:], fim_ref[:], cre_ref[:], cim_ref[:]
         )
-        drt = dr_ref[pl.ds(j * kt, kt)]
-        dit = di_ref[pl.ds(j * kt, kt)]
+        drt = dr_ref[j]
+        dit = di_ref[j]
         gr, gi = _g(xr, xi_, drt, dit, br_ref[0], bi_ref[0], inv_rho)
         # z_hat = g - (1/rho) conj(d) s
         sr = sr_ref[0]
         si = si_ref[0]
-        zr = gr - inv_rho * (drt * sr[None] + dit * si[None])
-        zi = gi - inv_rho * (drt * si[None] - dit * sr[None])
-        # inverse y-axis DFT (HIGHEST precision — see _xi_spectra)
-        ein = functools.partial(
-            jnp.einsum,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        zr = gr - inv_rho * (drt * sr + dit * si)
+        zi = gi - inv_rho * (drt * si - dit * sr)
+        # inverse y-axis DFT: transposed-A matmuls, out (y, v)
         ire, iim = ire_ref[:], iim_ref[:]
-        yr = ein("kuv,uy->kyv", zr, ire) - ein("kuv,uy->kyv", zi, iim)
-        yi = ein("kuv,uy->kyv", zr, iim) + ein("kuv,uy->kyv", zi, ire)
+        yr = _ein("uy,uv->yv", ire, zr) - _ein("uy,uv->yv", iim, zi)
+        yi = _ein("uy,uv->yv", iim, zr) + _ein("uy,uv->yv", ire, zi)
         # inverse last-axis half-spectrum transform (real output)
         out = (
-            ein("kyv,vx->kyx", yr, wre_ref[:])
-            - ein("kyv,vx->kyx", yi, wim_ref[:])
+            _ein("yv,vx->yx", yr, wre_ref[:])
+            - _ein("yv,vx->yx", yi, wim_ref[:])
         )
         zout_ref[0] = out.astype(sd)
 
     z_new = pl.pallas_call(
         kernel_b,
-        grid=(N, nk),
+        grid=(N * K,),
         in_specs=[state_spec, state_spec, d_spec, d_spec, img_spec,
                   img_spec, img_spec, img_spec, *fwd_specs, *inv_specs],
         out_specs=state_spec,
-        out_shape=sds(z.shape, sd),
+        out_shape=sds((N * K, Sy, Sx), sd),
         interpret=interpret,
-    )(z, dual, dr, di, br, bi, s_re, s_im, *fwd_mats, *inv_mats)
+    )(z3, du3, dr, di, br, bi, s_re, s_im, *fwd_mats, *inv_mats)
 
-    return z_new, dual_new
+    return (
+        z_new.reshape(N, K, Sy, Sx),
+        dual_new.reshape(N, K, Sy, Sx),
+    )
 
 
 def fused_z_iter_reference(z, dual, bhat, dhat, minv_diag, rho, theta):

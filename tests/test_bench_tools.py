@@ -128,3 +128,43 @@ def test_pick_tuned_defaults_when_baseline_wins(tmp_path):
         json.dump({"fft_impl": "matmul"}, f)
     assert pt.main() == 0
     assert not os.path.exists(pt.TUNED)
+
+
+def test_pick_tuned_accuracy_gate_rejects_measured_inaccurate_knob(
+    tmp_path, capsys
+):
+    """A faster arm whose knob has an on-chip accuracy record above
+    ACC_BOUND must lose to a slower arm in the documented accuracy
+    class (r5: matmul_bf16 at 2.6%% objective deviation must not become
+    the tuned DEFAULT on speed alone)."""
+    pt = _load_pick()
+    rows = [
+        _rec("baseline", 1.0),
+        _rec("fast_inaccurate", 2.0, knobs={"fft_impl": "matmul_bf16"}),
+        _rec("accurate", 1.5, knobs={"fft_impl": "matmul"}),
+        {"config": "matmul_bf16prec", "obj_final": 1.0, "platform": "tpu",
+         "max_rel_obj_dev_vs_ref": 0.026},
+        {"config": "matmul", "obj_final": 1.0, "platform": "tpu",
+         "max_rel_obj_dev_vs_ref": 8.6e-07},
+    ]
+    _write_jsonl(tmp_path / "onchip_r5.jsonl", rows)
+    pt.REPO = str(tmp_path)
+    pt.TUNED = str(tmp_path / "bench_tuned.json")
+    assert pt.main() == 0
+    assert json.load(open(pt.TUNED)) == {"fft_impl": "matmul"}
+    assert "accuracy gate" in capsys.readouterr().out
+
+
+def test_pick_tuned_accuracy_gate_passes_unmeasured_knob(tmp_path):
+    """Knobs without an accuracy record keep r4 behavior (the gate is
+    evidence-driven): a short tunnel window that only measured arms
+    must still yield a tuned config."""
+    pt = _load_pick()
+    _write_jsonl(tmp_path / "onchip_r5.jsonl", [
+        _rec("baseline", 1.0),
+        _rec("win", 1.5, knobs={"fft_impl": "matmul"}),
+    ])
+    pt.REPO = str(tmp_path)
+    pt.TUNED = str(tmp_path / "bench_tuned.json")
+    assert pt.main() == 0
+    assert json.load(open(pt.TUNED)) == {"fft_impl": "matmul"}
