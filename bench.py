@@ -90,6 +90,15 @@ def run_workload():
     d_storage = os.environ.get(
         "CCSC_BENCH_DSTORAGE", tuned.get("d_storage_dtype", "float32")
     )
+    fused_prec = os.environ.get(
+        "CCSC_BENCH_FUSEDZ_PREC", tuned.get("fused_z_precision", "highest")
+    )
+    # the Gram-inverse implementation is an env-level switch (same math
+    # to float rounding, freq_solvers.hermitian_inverse) — apply the
+    # tuned pick unless the caller overrides
+    os.environ.setdefault(
+        "CCSC_HERM_INV", tuned.get("herm_inv", "cholesky")
+    )
     geom = ProblemGeom((11, 11), k)
     cfg = LearnConfig(
         max_it=iters,
@@ -105,6 +114,7 @@ def run_workload():
         d_storage_dtype=d_storage,
         fft_impl=fft_impl,
         fused_z=fused_z,
+        fused_z_precision=fused_prec,
     )
     fg = common.FreqGeom.create(
         geom, (size, size), fft_pad=fft_pad, fft_impl=fft_impl
@@ -204,6 +214,8 @@ def run_workload():
             "use_pallas": use_pallas,
             "fft_impl": fft_impl,
             "fused_z": fused_z,
+            "fused_z_precision": fused_prec,
+            "herm_inv": os.environ.get("CCSC_HERM_INV", "cholesky"),
         },
     }
     if os.environ.get("CCSC_BENCH_PROFILE") == "1":

@@ -22,7 +22,7 @@ def add_perf_args(parser, fft_pad: bool = True, fused: bool = False) -> None:
         )
     parser.add_argument(
         "--fft-impl", default="xla",
-        choices=["xla", "matmul", "matmul_bf16"],
+        choices=["xla", "matmul", "matmul_high", "matmul_bf16"],
         help="FFT execution strategy (matmul = DFT matrices on the "
         "MXU; measured on-chip wins in PERF.md)",
     )

@@ -137,6 +137,13 @@ class LearnConfig:
     # shape). 2D, W == 1, unsharded inner axes only; the learner falls
     # back to the composition elsewhere. Matches it to float tolerance.
     fused_z: bool = False
+    # MXU precision of the fused kernel's DFT matmuls: 'highest'
+    # (6-pass bf16 emulation — float-tolerance parity, the kernel's
+    # default contract), 'high' (3-pass, ~1e-4/transform — half the
+    # MXU cost; the r5 on-chip profile showed the HIGHEST kernel is
+    # pure-MXU-bound), 'default' (single bf16 pass, the matmul_bf16
+    # accuracy class). Same three classes as fft_impl's matmul tiers.
+    fused_z_precision: str = "highest"
     # Round the FFT domain up to a TPU-friendly size ('pow2' | 'fast',
     # fourier.next_fast_size). 'none' keeps the reference's exact
     # s + 2*psf_radius padding (dParallel.m:16). A fast domain solves

@@ -343,6 +343,7 @@ def outer_step(
             cfg.rho_z,
             theta,
             interpret=freq_solvers._pallas_interpret(),
+            precision=cfg.fused_z_precision,
         )
         return (zn.reshape(z0.shape), dn.reshape(z0.shape)), None
 

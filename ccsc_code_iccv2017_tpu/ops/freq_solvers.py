@@ -28,25 +28,89 @@ batched matmuls on the MXU.
 """
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 
-def hermitian_inverse(G: jnp.ndarray) -> jnp.ndarray:
-    """Inverse of a batch of Hermitian positive-definite complex
-    matrices via the real block embedding (TPU-safe).
+def _hermitian_inverse_schur(G: jnp.ndarray) -> jnp.ndarray:
+    """Exact batched Hermitian-PD inverse by Schur-complement block
+    recursion — batched MATMULS all the way down (MXU), no linalg
+    custom-calls.
 
-    G: [..., m, m] complex -> G^{-1} [..., m, m] complex.
+    inv([[A, B], [B^H, D]]) =
+        [[Ai + T Si T^H, -T Si], [-Si T^H, Si]],
+    T = Ai B, S = D - B^H T, recursing on A and S (both Hermitian PD
+    when G is — this is block Cholesky in disguise, same stability
+    class as the unpivoted factorization, valid for SPD input).
 
-    The embedding [[Re,-Im],[Im,Re]] is symmetric PD whenever G is
-    Hermitian PD, so the batched factorization is a Cholesky (one
-    triangular factor + two triangular solves) rather than a general
-    LU — the cheaper and more stable choice for the d-pass, which
-    inverts one such system per frequency per outer iteration
-    (precompute_H_hat_D's pinv in the reference, dParallel.m:235).
+    Motivation (r5 xprof): the batched [F, 2ni, 2ni] Cholesky
+    custom-call took 21% of the tuned north-star step on the v5e —
+    XLA's TPU Cholesky serializes tiny batched factorizations, while
+    this recursion is ~10 einsums per level x log2(m) levels over the
+    full F-batch. Numerically equal to the Cholesky path to float
+    rounding (tests/test_ops.py).
     """
+    m = G.shape[-1]
+    if m == 1:
+        return 1.0 / G
+    if m == 2:
+        a = G[..., 0:1, 0:1]
+        b = G[..., 0:1, 1:2]
+        d = G[..., 1:2, 1:2]
+        det = a * d - b * jnp.conj(b)
+        top = jnp.concatenate([d, -b], axis=-1)
+        bot = jnp.concatenate([-jnp.conj(b), a], axis=-1)
+        return jnp.concatenate([top, bot], axis=-2) / det
+    h = m // 2
+    A = G[..., :h, :h]
+    B = G[..., :h, h:]
+    D = G[..., h:, h:]
+    # HIGHEST precision: this path's contract is exact-class parity
+    # with the Cholesky custom-call it replaces — at DEFAULT the MXU
+    # would run these as single-pass bf16 and silently demote the
+    # Gram inverse to the matmul_bf16 accuracy class (CPU tests cannot
+    # see the difference; lax.Precision is a TPU-only distinction)
+    ein = functools.partial(
+        jnp.einsum, precision=jax.lax.Precision.HIGHEST
+    )
+    Ai = _hermitian_inverse_schur(A)
+    T = ein("...ij,...jk->...ik", Ai, B)
+    S = D - ein("...ji,...jk->...ik", jnp.conj(B), T)
+    Si = _hermitian_inverse_schur(S)
+    TSi = ein("...ij,...jk->...ik", T, Si)
+    TL = Ai + ein("...ij,...kj->...ik", TSi, jnp.conj(T))
+    top = jnp.concatenate([TL, -TSi], axis=-1)
+    # bottom-left = -Si T^H = the top-right's conjugate transpose —
+    # derived, not recomputed (no extra MXU pass)
+    bl = -jnp.conj(jnp.swapaxes(TSi, -1, -2))
+    bot = jnp.concatenate([bl, Si], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def hermitian_inverse(
+    G: jnp.ndarray, method: Optional[str] = None
+) -> jnp.ndarray:
+    """Inverse of a batch of Hermitian positive-definite complex
+    matrices. G: [..., m, m] complex -> G^{-1} [..., m, m] complex.
+
+    method 'cholesky' (default): real block embedding + batched
+    Cholesky — [[Re,-Im],[Im,Re]] is symmetric PD whenever G is
+    Hermitian PD, so the factorization is a Cholesky (one triangular
+    factor + two triangular solves) rather than a general LU
+    (precompute_H_hat_D's pinv in the reference, dParallel.m:235).
+    method 'schur': the all-matmul block recursion above (same math to
+    float rounding; A/B-selectable via CCSC_HERM_INV for the on-chip
+    queue — trace-time env read, not a jit-visible value).
+    """
+    import os
+
+    if method is None:
+        method = os.environ.get("CCSC_HERM_INV", "cholesky")
+    if method == "schur":
+        return _hermitian_inverse_schur(G)
     m = G.shape[-1]
     re, im = jnp.real(G), jnp.imag(G)
     top = jnp.concatenate([re, -im], axis=-1)

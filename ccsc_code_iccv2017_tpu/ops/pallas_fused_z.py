@@ -70,17 +70,27 @@ def _mats(Sy: int, Sx: int):
     )
 
 
-# HIGHEST precision throughout: the kernel's contract is float-
-# tolerance parity with the einsum path (default precision would
-# silently be single-pass bf16 on the MXU — the matmul_bf16 class).
-_ein = functools.partial(
-    jnp.einsum,
-    preferred_element_type=jnp.float32,
-    precision=jax.lax.Precision.HIGHEST,
-)
+# Kernel matmul precision (LearnConfig.fused_z_precision): 'highest'
+# is the float-tolerance-parity contract (6-pass bf16 emulation);
+# 'high' halves the MXU cost (~1e-4/transform) — the r5 on-chip
+# numbers showed the HIGHEST kernel is pure-MXU-bound; 'default' is
+# the single-pass matmul_bf16 accuracy class.
+_PRECISIONS = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
 
 
-def _xi_spectra(z, du, theta, fre, fim, dre, dim):
+def _make_ein(precision: str):
+    return functools.partial(
+        jnp.einsum,
+        preferred_element_type=jnp.float32,
+        precision=_PRECISIONS[precision],
+    )
+
+
+def _xi_spectra(z, du, theta, fre, fim, dre, dim, _ein):
     """prox + dual + forward DFT of the coding target, f32 in VMEM.
 
     z, du: [Sy, Sx] f32 plane. Returns (xr, xi) [Sy, Fx] spectra of
@@ -118,6 +128,7 @@ def fused_z_iter(
     rho: float,
     theta: float,
     interpret: bool = False,
+    precision: str = "highest",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One fused z iteration.
 
@@ -132,6 +143,7 @@ def fused_z_iter(
     m = _mats(Sy, Sx)
     inv_rho = 1.0 / float(rho)
     sd = z.dtype
+    _ein = _make_ein(precision)
 
     try:
         vma_z = tuple(jax.typeof(z).vma)
@@ -203,7 +215,8 @@ def fused_z_iter(
         zt = z_ref[0].astype(jnp.float32)
         dt = du_ref[0].astype(jnp.float32)
         xr, xi_, dual_new = _xi_spectra(
-            zt, dt, theta, fre_ref[:], fim_ref[:], cre_ref[:], cim_ref[:]
+            zt, dt, theta, fre_ref[:], fim_ref[:], cre_ref[:],
+            cim_ref[:], _ein,
         )
         dual_ref[0] = dual_new.astype(sd)
         drt = dr_ref[j]
@@ -249,7 +262,8 @@ def fused_z_iter(
         zt = z_ref[0].astype(jnp.float32)
         dt = du_ref[0].astype(jnp.float32)
         xr, xi_, _ = _xi_spectra(
-            zt, dt, theta, fre_ref[:], fim_ref[:], cre_ref[:], cim_ref[:]
+            zt, dt, theta, fre_ref[:], fim_ref[:], cre_ref[:],
+            cim_ref[:], _ein,
         )
         drt = dr_ref[j]
         dit = di_ref[j]

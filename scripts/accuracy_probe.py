@@ -55,12 +55,31 @@ def main():
             "storage_dtype": "bfloat16",
             "d_storage_dtype": "bfloat16",
         },
+        "matmul_high": {"fft_impl": "matmul_high"},
+        "fused_z_high": {"fused_z": True, "fused_z_precision": "high"},
+        "fused_z_default": {
+            "fused_z": True, "fused_z_precision": "default",
+        },
+        # env-level switch (trace-time), not a LearnConfig field
+        "herm_schur": {"_env": {"CCSC_HERM_INV": "schur"}},
     }
     ref = None
     for name, kw in configs.items():
-        res = learn(
-            b, geom, LearnConfig(**base, **kw), key=jax.random.PRNGKey(0)
-        )
+        kw = dict(kw)
+        env = kw.pop("_env", {})
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            res = learn(
+                b, geom, LearnConfig(**base, **kw),
+                key=jax.random.PRNGKey(0),
+            )
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         obj = np.asarray(res.trace["obj_vals_z"], np.float64)
         row = {"config": name, "obj_final": float(obj[-1]),
                "platform": jax.devices()[0].platform}

@@ -161,9 +161,13 @@ run_family_arms() { # drives family_bench; one JSON line per family
 
 # ---- phases ---------------------------------------------------------
 phase_baseline() {
+  # pin EVERY lever (incl. env-level ones a tuned pick could apply
+  # via bench_tuned.json) — a baseline rerun after a pick must not
+  # silently inherit tuned settings
   run_bench baseline CCSC_BENCH_FFTPAD=none CCSC_BENCH_STORAGE=float32 \
     CCSC_BENCH_DSTORAGE=float32 CCSC_BENCH_FFTIMPL=xla \
-    CCSC_BENCH_PALLAS=0 CCSC_BENCH_FUSEDZ=0
+    CCSC_BENCH_PALLAS=0 CCSC_BENCH_FUSEDZ=0 \
+    CCSC_BENCH_FUSEDZ_PREC=highest CCSC_HERM_INV=cholesky
 }
 phase_arms() { run_arms_file scripts/onchip_arms.txt; }
 phase_bandwidth() { run_py 2400 scripts/bandwidth_probe.py; }
@@ -181,6 +185,12 @@ phase_profile() {
     CCSC_BENCH_XPROF=artifacts_prof/tuned || return 1
   run_py 600 scripts/xprof_report.py artifacts_prof/tuned
 }
+phase_arms2() { run_arms_file scripts/onchip_arms2.txt; }
+phase_accuracy2() {
+  # re-probe after wave B adds configs (fused_z_high / matmul_high /
+  # fused_z_default) so the picker's accuracy gate has records for them
+  run_py 2400 scripts/accuracy_probe.py
+}
 phase_banks() {
   # needs a real window: don't start a multi-hour train that the
   # deadline cap would kill after minutes
@@ -192,7 +202,7 @@ phase_banks() {
 # Ordered by value density under a short window (r4's only window was
 # 31 minutes): the round's #1 question (the bandwidth-ceiling theory)
 # right after the baseline, then the unmeasured second-wave arms.
-PHASES="baseline bandwidth arms accuracy hs profile banks"
+PHASES="${CCSC_PHASES:-baseline bandwidth arms accuracy hs profile banks}"
 
 acquire_lock
 log "runner start, deadline in ${1:-34200}s, phases: $PHASES"

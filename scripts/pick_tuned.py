@@ -23,6 +23,8 @@ DEFAULTS = {
     "use_pallas": False,
     "fft_impl": "xla",
     "fused_z": False,
+    "fused_z_precision": "highest",
+    "herm_inv": "cholesky",
 }
 
 # Accuracy gate (r5): the tuned default must stay in the "small
@@ -37,10 +39,14 @@ DEFAULTS = {
 ACC_BOUND = 0.01
 KNOB_TO_CONFIG = {
     ("fft_impl", "matmul"): "matmul",
+    ("fft_impl", "matmul_high"): "matmul_high",
     ("fft_impl", "matmul_bf16"): "matmul_bf16prec",
     ("storage_dtype", "bfloat16"): "bf16_storage",
     ("d_storage_dtype", "bfloat16"): "d_bf16_storage",
     ("fused_z", True): "fused_z",
+    ("fused_z_precision", "high"): "fused_z_high",
+    ("fused_z_precision", "default"): "fused_z_default",
+    ("herm_inv", "schur"): "herm_schur",
 }
 
 

@@ -318,3 +318,53 @@ def test_matmul_bf16_dft_error_bound():
         fourier.rfftn_spatial(jnp2.asarray(x), 2, impl="matmul_bf16")
     )
     np.testing.assert_allclose(exact, ref, atol=2e-5 * np.abs(ref).max())
+
+
+def test_hermitian_inverse_schur_matches_cholesky_and_numpy():
+    """The all-matmul Schur recursion (r5: replaces the 21%-of-step
+    batched Cholesky custom-call) must equal the Cholesky path and
+    numpy's inverse to float tolerance on Hermitian PD batches of the
+    d-pass (m=16 = Ni) and z-pass W sizes, incl. odd m."""
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.ops import freq_solvers
+
+    rng = np.random.default_rng(0)
+    for m in (1, 2, 3, 5, 16, 25, 32):
+        A = (
+            rng.standard_normal((7, m, m))
+            + 1j * rng.standard_normal((7, m, m))
+        ).astype(np.complex64)
+        # Hermitian PD with a safe diagonal shift (rho-like)
+        G = A @ np.conj(np.swapaxes(A, -1, -2)) + (m + 2.0) * np.eye(
+            m, dtype=np.complex64
+        )
+        inv_s = np.asarray(
+            freq_solvers.hermitian_inverse(jnp.asarray(G), method="schur")
+        )
+        inv_c = np.asarray(
+            freq_solvers.hermitian_inverse(
+                jnp.asarray(G), method="cholesky"
+            )
+        )
+        ref = np.linalg.inv(G.astype(np.complex128))
+        scale = np.max(np.abs(ref))
+        assert np.max(np.abs(inv_s - ref)) / scale < 5e-6, m
+        assert np.max(np.abs(inv_s - inv_c)) / scale < 5e-6, m
+
+
+def test_matmul_high_impl_matches_fft():
+    """'matmul_high' is the same DFT-matrix transform at HIGH MXU
+    precision — on CPU it must match jnp.fft like 'matmul' does."""
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.ops import fourier
+
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((3, 10, 12)), jnp.float32
+    )
+    xh = fourier.rfftn_spatial(x, 2, impl="matmul_high")
+    ref = jnp.fft.rfftn(x, axes=(1, 2))
+    assert float(jnp.max(jnp.abs(xh - ref))) < 1e-3
+    back = fourier.irfftn_spatial(xh, (10, 12), impl="matmul_high")
+    assert float(jnp.max(jnp.abs(back - x))) < 1e-4
