@@ -251,16 +251,21 @@ def main():
         k = 49 if not args.smoke else 6
         b = synth_video(args.n, args.side, args.side)
         geom = ProblemGeom((support,) * 3, k)
+        # On TPU: the measured-accurate tuned strategy (PERF.md) — the
+        # matmul-DFT also sidesteps the XLA-FFT's padded
+        # f32[..,60,60,60] temps that OOMed the full-scale (n=64) 3D
+        # train on the 16G chip, and bf16 state halves the rest. On
+        # CPU (tunnel-outage fallback) keep pocketfft/f32: the DFT
+        # matmuls are an MXU trade, not a host-CPU one.
+        knobs = (
+            dict(fft_impl="matmul", storage_dtype="bfloat16",
+                 d_storage_dtype="bfloat16")
+            if plat in ("tpu", "axon") else {}
+        )
         cfg = LearnConfig(
             max_it=args.max_it, tol=1e-2, rho_d=5000.0, rho_z=1.0,
             num_blocks=8 if not args.smoke else 2,
-            verbose="brief", track_objective=True,
-            # the measured-accurate tuned strategy (PERF.md): the
-            # matmul-DFT also sidesteps the XLA-FFT's padded
-            # f32[..,60,60,60] temps that OOMed the full-scale (n=64)
-            # 3D train on the 16G chip; bf16 state halves the rest
-            fft_impl="matmul", storage_dtype="bfloat16",
-            d_storage_dtype="bfloat16",
+            verbose="brief", track_objective=True, **knobs,
         )
         t0 = time.time()
         res = _learn_memory_bounded(b, geom, cfg)
