@@ -77,12 +77,36 @@ def _mats(Sy: int, Sx: int):
 # the single-pass matmul_bf16 accuracy class.
 _PRECISIONS = {
     "highest": jax.lax.Precision.HIGHEST,
-    "high": jax.lax.Precision.HIGH,
     "default": jax.lax.Precision.DEFAULT,
 }
 
 
 def _make_ein(precision: str):
+    if precision == "high":
+        # Mosaic rejects lax.Precision.HIGH in-kernel (r5 on-chip:
+        # "Unsupported dot precision: HIGH"), so the 3-pass bf16
+        # decomposition XLA would emit is spelled out: split each f32
+        # operand into bf16 hi + lo residual and take the three
+        # products that matter (hi*hi + hi*lo + lo*hi; the dropped
+        # lo*lo term is ~2^-32 of the result). Each product is a
+        # single-pass bf16 matmul accumulating in f32 — ops Mosaic
+        # lowers natively.
+        one = functools.partial(
+            jnp.einsum, preferred_element_type=jnp.float32
+        )
+
+        def ein(expr, a, b):
+            ah = a.astype(jnp.bfloat16)
+            al = (a - ah.astype(jnp.float32)).astype(jnp.bfloat16)
+            bh = b.astype(jnp.bfloat16)
+            bl = (b - bh.astype(jnp.float32)).astype(jnp.bfloat16)
+            return (
+                one(expr, ah, bh)
+                + one(expr, ah, bl)
+                + one(expr, al, bh)
+            )
+
+        return ein
     return functools.partial(
         jnp.einsum,
         preferred_element_type=jnp.float32,

@@ -80,6 +80,47 @@ def test_fused_z_iter_bf16_state():
     assert err < 0.02 * scale, (err, scale)
 
 
+def test_high_precision_decomposition():
+    """'high' is a hand-rolled 3-pass bf16 split (Mosaic rejects
+    lax.Precision.HIGH in-kernel — r5 on-chip): hi*hi + hi*lo + lo*hi
+    must sit within the ~1e-6 relative class of the f32 product, far
+    tighter than single-pass bf16."""
+    rng = np.random.default_rng(7)
+    a = jnp.asarray(rng.standard_normal((64, 48)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((48, 32)).astype(np.float32))
+    exact = np.asarray(jnp.einsum(
+        "yx,xv->yv", a, b, precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ))
+    scale = np.abs(exact).max()
+    high = np.asarray(pallas_fused_z._make_ein("high")("yx,xv->yv", a, b))
+    one = np.asarray(jnp.einsum(
+        "yx,xv->yv", a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ))
+    assert np.abs(high - exact).max() < 1e-5 * scale
+    # sanity: the 3-pass split is far more accurate than 1-pass bf16
+    assert np.abs(high - exact).max() < 0.01 * np.abs(one - exact).max()
+
+
+def test_fused_z_iter_high_precision_close():
+    """precision='high' keeps the whole fused iteration in the ~1e-4
+    accuracy class vs the exact composition (the documented tier)."""
+    z, du, bhat, dhat, minv, rho = _problem()
+    zk, _ = pallas_fused_z.fused_z_iter(
+        jnp.asarray(z), jnp.asarray(du), jnp.asarray(bhat),
+        jnp.asarray(dhat), jnp.asarray(minv), rho, 0.35,
+        interpret=True, precision="high",
+    )
+    zf, _ = pallas_fused_z.fused_z_iter_reference(
+        jnp.asarray(z), jnp.asarray(du), jnp.asarray(bhat),
+        jnp.asarray(dhat), jnp.asarray(minv), rho, 0.35,
+    )
+    err = float(jnp.abs(zk - zf).max())
+    scale = float(jnp.abs(zf).max())
+    assert err < 1e-3 * scale, (err, scale)
+
+
 def test_learner_fused_z_matches_composition():
     """LearnConfig(fused_z=True) reproduces the default learner
     trajectory to float tolerance (interpret mode on CPU)."""
