@@ -95,10 +95,11 @@ def run_workload():
     )
     # the Gram-inverse implementation is an env-level switch (same math
     # to float rounding, freq_solvers.hermitian_inverse) — apply the
-    # tuned pick unless the caller overrides
-    os.environ.setdefault(
-        "CCSC_HERM_INV", tuned.get("herm_inv", "cholesky")
-    )
+    # tuned pick unless the caller overrides; with neither, leave the
+    # env unset so the library's platform/size-aware default fires
+    if "herm_inv" in tuned:
+        os.environ.setdefault("CCSC_HERM_INV", tuned["herm_inv"])
+    herm_inv = os.environ.get("CCSC_HERM_INV", "auto")
     geom = ProblemGeom((11, 11), k)
     cfg = LearnConfig(
         max_it=iters,
@@ -215,7 +216,7 @@ def run_workload():
             "fft_impl": fft_impl,
             "fused_z": fused_z,
             "fused_z_precision": fused_prec,
-            "herm_inv": os.environ.get("CCSC_HERM_INV", "cholesky"),
+            "herm_inv": herm_inv,
         },
     }
     if os.environ.get("CCSC_BENCH_PROFILE") == "1":

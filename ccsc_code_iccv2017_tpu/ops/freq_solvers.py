@@ -96,19 +96,37 @@ def hermitian_inverse(
     """Inverse of a batch of Hermitian positive-definite complex
     matrices. G: [..., m, m] complex -> G^{-1} [..., m, m] complex.
 
-    method 'cholesky' (default): real block embedding + batched
-    Cholesky — [[Re,-Im],[Im,Re]] is symmetric PD whenever G is
-    Hermitian PD, so the factorization is a Cholesky (one triangular
-    factor + two triangular solves) rather than a general LU
-    (precompute_H_hat_D's pinv in the reference, dParallel.m:235).
+    method 'cholesky': real block embedding + batched Cholesky —
+    [[Re,-Im],[Im,Re]] is symmetric PD whenever G is Hermitian PD, so
+    the factorization is a Cholesky (one triangular factor + two
+    triangular solves) rather than a general LU (precompute_H_hat_D's
+    pinv in the reference, dParallel.m:235).
     method 'schur': the all-matmul block recursion above (same math to
     float rounding; A/B-selectable via CCSC_HERM_INV for the on-chip
     queue — trace-time env read, not a jit-visible value).
+
+    Default is platform- and size-aware: on TPU the Schur recursion
+    for small systems (XLA's TPU Cholesky serializes tiny batched
+    factorizations — the custom-call took 21% of the r5 tuned step on
+    a [F,16,16] Gram, and the schur arm measured +21% end-to-end; both
+    paths are exact, so this is a pure execution choice). Large/odd m
+    keeps Cholesky everywhere: the unrolled recursion tree for m=31
+    (the hyperspectral W-coupled z-kernel) compiled pathologically on
+    the axon service (>30 min vs ~2 min for the whole arm without it,
+    r5 on-chip), so the crossover is capped at m <= 16. CPU/GPU keep
+    the LAPACK-backed Cholesky.
     """
     import os
 
     if method is None:
-        method = os.environ.get("CCSC_HERM_INV", "cholesky")
+        method = os.environ.get("CCSC_HERM_INV") or "auto"
+    if method == "auto":
+        method = (
+            "schur"
+            if jax.default_backend() in ("tpu", "axon")
+            and G.shape[-1] <= 16
+            else "cholesky"
+        )
     if method == "schur":
         return _hermitian_inverse_schur(G)
     m = G.shape[-1]
