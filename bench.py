@@ -99,7 +99,12 @@ def run_workload():
     # env unset so the library's platform/size-aware default fires
     if "herm_inv" in tuned:
         os.environ.setdefault("CCSC_HERM_INV", tuned["herm_inv"])
-    herm_inv = os.environ.get("CCSC_HERM_INV", "auto")
+    # record the method that will actually execute, not the literal
+    # 'auto' — the jsonl knob records are authoritative for what ran
+    # (the north-star's one Gram is the d-pass [F, Ni, Ni], Ni = n/blocks)
+    from ccsc_code_iccv2017_tpu.ops.freq_solvers import resolve_herm_method
+
+    herm_inv = resolve_herm_method(n // blocks)
     geom = ProblemGeom((11, 11), k)
     cfg = LearnConfig(
         max_it=iters,

@@ -29,10 +29,36 @@ batched matmuls on the MXU.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def resolve_herm_method(m: int, method: Optional[str] = None) -> str:
+    """The concrete Gram-inverse method that will execute for an m x m
+    system on the current backend.
+
+    Public so tooling can record the method that actually RAN rather
+    than the literal 'auto' (bench.py's knob records are the on-chip
+    queue's source of truth — an unresolved 'auto' there would leave
+    the executed path undeterminable from the record). Resolution
+    order: explicit ``method`` arg > CCSC_HERM_INV env > 'auto'.
+
+    The 'auto' window is measured at both ends (r5 on-chip, see
+    hermitian_inverse): Schur recursion on TPU for m == 1 (pure
+    reciprocal) and 2 < m <= 16; Cholesky everywhere else.
+    """
+    if method is None:
+        method = os.environ.get("CCSC_HERM_INV") or "auto"
+    if method != "auto":
+        return method
+    if jax.default_backend() in ("tpu", "axon") and (
+        m == 1 or 2 < m <= 16
+    ):
+        return "schur"
+    return "cholesky"
 
 
 def _hermitian_inverse_schur(G: jnp.ndarray) -> jnp.ndarray:
@@ -106,27 +132,22 @@ def hermitian_inverse(
     queue — trace-time env read, not a jit-visible value).
 
     Default is platform- and size-aware: on TPU the Schur recursion
-    for small systems (XLA's TPU Cholesky serializes tiny batched
-    factorizations — the custom-call took 21% of the r5 tuned step on
-    a [F,16,16] Gram, and the schur arm measured +21% end-to-end; both
-    paths are exact, so this is a pure execution choice). Large/odd m
-    keeps Cholesky everywhere: the unrolled recursion tree for m=31
-    (the hyperspectral W-coupled z-kernel) compiled pathologically on
-    the axon service (>30 min vs ~2 min for the whole arm without it,
-    r5 on-chip), so the crossover is capped at m <= 16. CPU/GPU keep
-    the LAPACK-backed Cholesky.
+    for small-but-not-tiny systems (XLA's TPU Cholesky serializes tiny
+    batched factorizations — the custom-call took 21% of the r5 tuned
+    step on a [F,16,16] Gram, and the schur arm measured +21%
+    end-to-end; both paths are exact, so this is a pure execution
+    choice). The window is measured at BOTH ends (r5 on-chip):
+    - upper: the unrolled recursion tree for m=31 (the hyperspectral
+      W-coupled z-kernel) compiled pathologically on the axon service
+      (>30 min vs ~2 min for the whole arm without it) -> cap m <= 16.
+    - lower: at m=2 (the Ni=2 d-pass Gram of the masked/3D family
+      benches) the closed-form path's [F,1,1]-slice concatenates are
+      layout-hostile at TPU tile granularity and measured 0.169 vs
+      0.260 it/s end-to-end on the HS masked learner
+      (onchip_r5.jsonl hs_mm16_schur2x2 vs hs_matmul_bf16) -> m > 2.
+    CPU/GPU keep the LAPACK-backed Cholesky.
     """
-    import os
-
-    if method is None:
-        method = os.environ.get("CCSC_HERM_INV") or "auto"
-    if method == "auto":
-        method = (
-            "schur"
-            if jax.default_backend() in ("tpu", "axon")
-            and G.shape[-1] <= 16
-            else "cholesky"
-        )
+    method = resolve_herm_method(G.shape[-1], method)
     if method == "schur":
         return _hermitian_inverse_schur(G)
     m = G.shape[-1]

@@ -230,6 +230,38 @@ def main():
     fams = [f.strip() for f in args.families.split(",") if f.strip()]
     results = {}
 
+    # Per-family resume: each completed family writes result_<fam>.json
+    # next to its bank; a rerun after an interruption (the tunnel died
+    # 27 min into the r5 banks phase) skips families whose result file
+    # already exists instead of re-burning hours of chip time.
+    def _result_path(fam):
+        return os.path.join(args.out, f"result_{fam}.json")
+
+    def _record(fam):
+        results[fam]["platform"] = plat
+        if not args.smoke:
+            # atomic: a kill mid-write must not leave a truncated file
+            # that poisons every later resume (the motivating failure
+            # was exactly a mid-run death); smoke runs never write —
+            # tiny-shape smoke results must not be resumable as real
+            tmp = _result_path(fam) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(results[fam], f)
+            os.replace(tmp, _result_path(fam))
+        print(json.dumps({"family": fam, **results[fam]}), flush=True)
+
+    if not args.smoke:
+        for fam in list(fams):
+            if os.path.exists(_result_path(fam)):
+                try:
+                    with open(_result_path(fam)) as f:
+                        results[fam] = json.load(f)
+                except ValueError:
+                    continue  # truncated/corrupt: re-run the family
+                print(f"resume: {fam} already complete, skipping",
+                      flush=True)
+                fams.remove(fam)
+
     def load_shipped(fam, key):
         from ccsc_code_iccv2017_tpu.utils import io_mat
 
@@ -306,7 +338,7 @@ def main():
                             own_psnr=round(float(own), 2),
                             shipped_psnr=round(float(ship), 2),
                             obj=float(res.trace["obj_vals_z"][-1]))
-        print(json.dumps({"family": fam, **results[fam]}), flush=True)
+        _record(fam)
 
     # ---------------- 4D lightfield ---------------------------------
     if "4d" in fams:
@@ -363,7 +395,7 @@ def main():
                             own_psnr=round(float(own), 2),
                             shipped_psnr=round(float(ship), 2),
                             obj=float(res.trace["obj_vals_z"][-1]))
-        print(json.dumps({"family": fam, **results[fam]}), flush=True)
+        _record(fam)
 
     # ---------------- hyperspectral ---------------------------------
     if "hs" in fams:
@@ -438,7 +470,7 @@ def main():
                             own_psnr=round(float(own), 2),
                             shipped_psnr=round(float(ship), 2),
                             obj=float(res.trace["obj_vals_z"][-1]))
-        print(json.dumps({"family": fam, **results[fam]}), flush=True)
+        _record(fam)
 
     # ---------------- summary ---------------------------------------
     lines = [
@@ -458,8 +490,8 @@ def main():
     ]
     for fam, r in results.items():
         lines.append(
-            f"| {fam} | {r['t_learn_s']} | {plat} | {r['own_psnr']} | "
-            f"{r['shipped_psnr']} | {r['obj']:.6g} |"
+            f"| {fam} | {r['t_learn_s']} | {r.get('platform', plat)} | "
+            f"{r['own_psnr']} | {r['shipped_psnr']} | {r['obj']:.6g} |"
         )
     with open(os.path.join(args.out, "ARTIFACTS_FAMILY.md"), "w") as f:
         f.write("\n".join(lines) + "\n")

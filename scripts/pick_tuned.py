@@ -124,7 +124,16 @@ def main():
             continue
         if v > best_v:
             best, best_v, best_k = run, v, knobs
-    tuned = {k: v for k, v in best_k.items() if v != DEFAULTS.get(k)}
+    # herm_inv is never stripped: since the library's unset default
+    # became platform/size-aware ('auto' -> schur on TPU in the
+    # measured window), omitting 'cholesky' from the tuned file would
+    # make bench.py execute a different Gram-inverse path than the arm
+    # that was measured (bench records are authoritative for what ran)
+    tuned = {
+        k: v
+        for k, v in best_k.items()
+        if k == "herm_inv" or v != DEFAULTS.get(k)
+    }
     if base_v is None or best in (None, "baseline") or best_v <= base_v \
             or not tuned:
         if os.path.exists(TUNED):
