@@ -59,7 +59,17 @@ def _jit_pieces(geom: ProblemGeom, cfg: LearnConfig, fg: common.FreqGeom):
     @jax.jit
     def f_dkern(z_nn):
         zhat = common.codes_to_freq(f32(z_nn), fg)
-        return freq_solvers.precompute_d_kernel(zhat, cfg.rho_d)
+        kern = freq_solvers.precompute_d_kernel(zhat, cfg.rho_d)
+        # complex leaves leave the device as stacked [2, ...] re/im
+        # real views: the axon backend raises UNIMPLEMENTED on eager
+        # complex device<->host transfers (r5 on-chip, 3D full-scale
+        # train), and this host round-trip is the whole point of the
+        # streaming path — f_d_block re-forms the complex kernel
+        # on device
+        return (
+            jnp.stack([jnp.real(kern.zhat), jnp.imag(kern.zhat)]),
+            jnp.stack([jnp.real(kern.ginv), jnp.imag(kern.ginv)]),
+        )
 
     @jax.jit
     def f_prox(dbar, udbar):
@@ -68,7 +78,11 @@ def _jit_pieces(geom: ProblemGeom, cfg: LearnConfig, fg: common.FreqGeom):
         )
 
     @jax.jit
-    def f_d_block(kern, bhat_nn, d_local, dual_d, u):
+    def f_d_block(zhat_ri, ginv_ri, bhat_nn, d_local, dual_d, u):
+        kern = freq_solvers.DSolveKernel(
+            jax.lax.complex(zhat_ri[0], zhat_ri[1]),
+            jax.lax.complex(ginv_ri[0], ginv_ri[1]),
+        )
         dsd = d_local.dtype  # d-state storage (d_storage_dtype)
         dual_d = f32(dual_d) + (f32(d_local) - u)
         xi_hat = common.full_filters_to_freq(u - dual_d, fg)
@@ -187,7 +201,10 @@ def learn_streaming(
 
         # ---- d-pass: Grams fixed at incoming codes -----------------
         # (kernels stay on host; one lives on device at a time)
-        kerns = [jax.tree.map(np.asarray, f_dkern(z[nn])) for nn in range(N)]
+        kerns = [
+            tuple(np.asarray(p) for p in f_dkern(z[nn]))
+            for nn in range(N)
+        ]
         for _ in range(cfg.max_it_d):
             u = f_prox(dbar, udbar)
             d_sum = None
@@ -195,7 +212,8 @@ def learn_streaming(
             for nn in range(N):
                 bhat_nn = f_bhat(b_blocks[nn])
                 d_new, du_new = f_d_block(
-                    jax.tree.map(jnp.asarray, kerns[nn]),
+                    jnp.asarray(kerns[nn][0]),
+                    jnp.asarray(kerns[nn][1]),
                     bhat_nn,
                     jnp.asarray(d_local[nn]),
                     jnp.asarray(dual_d[nn]),
