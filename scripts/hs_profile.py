@@ -79,6 +79,42 @@ def main():
     per_z = (t[(10, 10)] - t[(10, 1)]) / 9.0
     fixed = t[(1, 1)] - per_d - per_z
     full = t[(10, 10)]
+
+    # Direct timing of the per-frequency Gram inverses hiding in the
+    # fixed cost, per method, at the step's real shapes: the z-kernel
+    # [F, W, W] (W=31 — above the schur window, whose m=31 recursion
+    # tree is compile-pathological on axon and is not timed) and the
+    # d-pass [F, n, n]. Answers whether the serialized batched
+    # Cholesky custom-call is what the 308 ms fixed cost is made of,
+    # and whether the Newton-Schulz matmul iteration buys it back.
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.ops.freq_solvers import hermitian_inverse
+
+    rng = np.random.default_rng(0)
+    Sy, Sx = side + 10, side + 10  # support 11 -> radius 5
+    F = Sy * (Sx // 2 + 1)
+    inv_ms = {}
+    for label, m, methods in (
+        ("zkern_w31", bands, ("cholesky", "newton")),
+        ("dgram_n2", n, ("cholesky", "schur", "newton")),
+    ):
+        A = rng.normal(size=(F, m, 2 * m)) + 1j * rng.normal(
+            size=(F, m, 2 * m)
+        )
+        G = jnp.asarray(
+            (A @ np.conj(np.swapaxes(A, -1, -2)) / (2 * m)
+             + np.eye(m)).astype(np.complex64)
+        )
+        for method in methods:
+            f = jax.jit(lambda g, _m=method: hermitian_inverse(g, _m))
+            jax.block_until_ready(f(G))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(3):
+                jax.block_until_ready(f(G))
+            inv_ms[f"{label}_{method}"] = round(
+                (time.perf_counter() - t0) / 3 * 1e3, 2
+            )
     print(json.dumps({
         "hs_profile": {
             "platform": jax.devices()[0].platform,
@@ -92,6 +128,7 @@ def main():
             "d_scan_pct": round(100 * 10 * per_d / full, 1),
             "z_scan_pct": round(100 * 10 * per_z / full, 1),
             "fixed_pct": round(100 * fixed / full, 1),
+            "inverse_ms": inv_ms,
         }
     }))
 

@@ -191,6 +191,13 @@ phase_accuracy2() {
   # fused_z_default) so the picker's accuracy gate has records for them
   run_py 2400 scripts/accuracy_probe.py
 }
+phase_hs2() {
+  # wave C: newton Gram-inverse arm + the extended profile (direct
+  # per-method inverse timings) at the measured-winner family knobs
+  run_family_arms scripts/hs_arms2.txt || return 1
+  CCSC_FAMILY_FFTIMPL=matmul CCSC_FAMILY_STORAGE=bfloat16 \
+    run_py 2400 scripts/hs_profile.py
+}
 phase_banks() {
   # needs a real window: don't start a multi-hour train that the
   # deadline cap would kill after minutes
