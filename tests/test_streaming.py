@@ -55,6 +55,19 @@ def test_streaming_matches_in_memory():
     )
 
 
+def test_streaming_host_paged_kernels_match(monkeypatch):
+    """CCSC_STREAM_RESIDENT_GB=0 forces the d-kernels through the
+    host-paging path (the O(one block) contract for kernels past the
+    HBM budget); results must equal the device-resident default
+    exactly — placement, not math."""
+    geom, cfg, b = _problem()
+    res_r = streaming.learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(0))
+    monkeypatch.setenv("CCSC_STREAM_RESIDENT_GB", "0")
+    res_p = streaming.learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(res_r.d), np.asarray(res_p.d))
+    np.testing.assert_array_equal(res_r.z.reshape(-1), res_p.z.reshape(-1))
+
+
 def test_streaming_reduce_geometry():
     """W > 1 (wavelength) geometry streams too."""
     geom = ProblemGeom((3, 3), 3, reduce_shape=(2,))
