@@ -5,10 +5,19 @@ splitting bounds working memory to ONE block's codes — the reference
 realizes it by keeping per-block cells in host RAM and touching one at
 a time (dzParallel.m:96-158). models.learn instead keeps every block
 live on device (fastest when z fits in HBM; shardable over a mesh when
-a pod is available). This module is the single-chip big-data path: all
-block state (codes, duals, local dictionaries, code-Gram factors)
-lives in HOST memory as numpy, and the device only ever holds one
-block's tensors plus the consensus variables.
+a pod is available). This module is the single-chip big-data path,
+with three placement tiers selected by a byte budget (same math, same
+block-sequential loop — see the placement comment in learn_streaming):
+
+- 'device': all block state device-resident, python only sequences
+  per-block compute. Bridges the gap where the state fits HBM but the
+  in-memory learner's full-batch spectra temps do not — and costs
+  zero host traffic per iteration (decisive on tunneled TPUs).
+- 'kern': state in host RAM, one block on device at a time, but the
+  d-pass kernels (constant within an outer step) stay device-resident.
+- 'paged': everything host-resident as numpy — the unbounded-n
+  contract; the device only ever holds one block's tensors plus the
+  consensus variables.
 
 Exactness: streaming is NOT an approximation. The z-pass decouples
 across blocks (no cross-block terms), so running each block's full
@@ -163,37 +172,84 @@ def learn_streaming(
     if key is None:
         key = jax.random.PRNGKey(0)
     # identical init to models.learn.init_state (shared across blocks /
-    # independent z per block), pulled to host; bf16 storage halves
-    # both the host-resident z/dual buffers and their PCIe streaming
+    # independent z per block); bf16 storage halves both the block
+    # state and, in the host modes, its PCIe streaming
     state0 = learn_mod.init_state(
         key, geom, fg, N, ni, jnp.float32,
         z_dtype=jnp.dtype(cfg.storage_dtype),
         d_dtype=jnp.dtype(cfg.d_storage_dtype),
     )
-    # np.array (copy): host buffers are mutated block-by-block below
-    d_local = np.array(state0.d_local)
-    dual_d = np.array(state0.dual_d)
     dbar = jnp.asarray(state0.dbar)
     udbar = jnp.asarray(state0.udbar)
-    z = np.array(state0.z)
-    dual_z = np.array(state0.dual_z)
 
     (
         f_bhat, f_dkern, f_prox, f_d_block, f_z_block, f_full_dhat,
         f_obj_block,
     ) = _jit_pieces(geom, cfg, fg)
 
-    # device-residency budget for the d-pass kernels (see the d-pass
-    # comment below): all-N kernels are [2, ni, K, F] + [2, F, ni, ni]
-    # f32 re/im pairs
+    # ---- state placement: three tiers, same math ------------------
+    # 'device': ALL block state lives on device and the python loop
+    #   only sequences per-block compute. This is the right mode when
+    #   the state fits HBM but the in-memory learner's FULL-BATCH
+    #   spectra temps do not (the r5 full-scale 3D bank train: state
+    #   ~3 GB + one block's temps ~1.5 GB on a 16 GB chip, while
+    #   models.learn OOMs on ~14 GB of all-blocks z-iteration temps).
+    #   Host traffic per outer iteration: none. On the tunneled v5e
+    #   (~25 MB/s host<->device) this is the difference between ~15
+    #   min/outer and pure compute.
+    # 'kern': z/dual state pages through host RAM one block at a
+    #   time, but the d-pass kernels (constant within an outer step)
+    #   stay device-resident — avoids re-uploading max_it_d * N
+    #   kernel tensors per outer step.
+    # 'paged': everything host-resident, one block on device at a
+    #   time — the unbounded-n contract.
+    # Auto-selection by a byte budget (CCSC_STREAM_RESIDENT_GB,
+    # default 10 GB); CCSC_STREAM_MODE=device|kern|paged forces a tier.
     import os as _os
 
-    kern_bytes = (
-        N * 2 * 4 * (ni * geom.num_filters + ni * ni) * fg.num_freq
+    spatial_elems = int(np.prod(fg.spatial_shape))
+    K = geom.num_filters
+    kern_bytes = N * 2 * 4 * (ni * K + ni * ni) * fg.num_freq
+    state_bytes = (
+        2 * N * ni * K * spatial_elems
+        * jnp.dtype(cfg.storage_dtype).itemsize  # z + dual_z
+        + 2 * N * K * fg.reduce_size * spatial_elems
+        * jnp.dtype(cfg.d_storage_dtype).itemsize  # d_local + dual_d
     )
-    kern_resident = kern_bytes <= float(
-        _os.environ.get("CCSC_STREAM_RESIDENT_GB", "4.0")
+    temp_bytes = 5 * ni * K * fg.num_freq * 8  # one block's cplx temps
+    # default sized for the 16 GB v5e: the full-scale 3D bank state
+    # estimates at 8.06 GB, and device mode additionally needs FFT
+    # workspace for one block — 10 GB admits it with headroom
+    budget = float(
+        _os.environ.get("CCSC_STREAM_RESIDENT_GB", "10.0")
     ) * 1e9
+    mode = _os.environ.get("CCSC_STREAM_MODE", "auto")
+    if mode == "auto":
+        if state_bytes + kern_bytes + temp_bytes <= budget:
+            mode = "device"
+        elif kern_bytes + temp_bytes <= budget:
+            mode = "kern"
+        else:
+            mode = "paged"
+    device_state = mode == "device"
+    kern_resident = mode in ("device", "kern")
+
+    # per-block state lists (one assignment frees exactly one block's
+    # buffer): device mode keeps jax arrays on device, host modes copy
+    # to numpy. hold() is the only placement seam in the loop below.
+    def hold(x):
+        return x if device_state else np.asarray(x)
+
+    d_local = [hold(state0.d_local[nn]) for nn in range(N)]
+    dual_d = [hold(state0.dual_d[nn]) for nn in range(N)]
+    z = [hold(state0.z[nn]) for nn in range(N)]
+    dual_z = [hold(state0.dual_z[nn]) for nn in range(N)]
+    del state0
+
+    @jax.jit
+    def f_zdiff(z_new, z_old):
+        a = z_new.astype(jnp.float32) - z_old.astype(jnp.float32)
+        return jnp.sum(a * a), jnp.sum(z_new.astype(jnp.float32) ** 2)
 
     trace = {
         # machine-readable producer identity: a .mat saved from a
@@ -241,8 +297,8 @@ def learn_streaming(
                     jnp.asarray(dual_d[nn]),
                     u,
                 )
-                d_local[nn] = np.asarray(d_new)
-                dual_d[nn] = np.asarray(du_new)
+                d_local[nn] = hold(d_new)
+                dual_d[nn] = hold(du_new)
                 d_sum = d_new if d_sum is None else d_sum + d_new
                 du_sum = du_new if du_sum is None else du_sum + du_new
             dbar = d_sum / N
@@ -274,14 +330,24 @@ def learn_streaming(
             z_new, du_new = f_z_block(
                 jnp.asarray(z[nn]), jnp.asarray(dual_z[nn]), bhat_nn, dhat_z
             )
-            z_new_h = np.asarray(z_new)
-            # bf16-safe accumulation; copy=False keeps f32 copy-free
-            zf_new = z_new_h.astype(np.float32, copy=False)
-            zf_old = z[nn].astype(np.float32, copy=False)
-            num += float(np.sum((zf_new - zf_old) ** 2))
-            den += float(np.sum(zf_new * zf_new))
-            z[nn] = z_new_h
-            dual_z[nn] = np.asarray(du_new)
+            if device_state:
+                # convergence sums on device: pulling z to host just
+                # for the norm would reintroduce the transfer this
+                # mode exists to avoid
+                ssd, ssq = f_zdiff(z_new, jnp.asarray(z[nn]))
+                num += float(ssd)
+                den += float(ssq)
+                z[nn] = z_new
+                dual_z[nn] = du_new
+            else:
+                z_new_h = np.asarray(z_new)
+                # bf16-safe accumulation; copy=False keeps f32 copy-free
+                zf_new = z_new_h.astype(np.float32, copy=False)
+                zf_old = z[nn].astype(np.float32, copy=False)
+                num += float(np.sum((zf_new - zf_old) ** 2))
+                den += float(np.sum(zf_new * zf_new))
+                z[nn] = z_new_h
+                dual_z[nn] = np.asarray(du_new)
             if cfg.with_objective:
                 obj_z += float(
                     f_obj_block(jnp.asarray(z[nn]), jnp.asarray(b_blocks[nn]), dhat_z)
@@ -317,6 +383,7 @@ def learn_streaming(
 
     for nn in range(N):
         Dz[nn] = np.asarray(f_dz_block(jnp.asarray(z[nn])))
+    z_out = np.stack([np.asarray(zz) for zz in z])
     return learn_mod.LearnResult(
-        np.asarray(d_sup), z, Dz.reshape(n, *Dz.shape[2:]), trace
+        np.asarray(d_sup), z_out, Dz.reshape(n, *Dz.shape[2:]), trace
     )

@@ -55,17 +55,31 @@ def test_streaming_matches_in_memory():
     )
 
 
-def test_streaming_host_paged_kernels_match(monkeypatch):
-    """CCSC_STREAM_RESIDENT_GB=0 forces the d-kernels through the
-    host-paging path (the O(one block) contract for kernels past the
-    HBM budget); results must equal the device-resident default
-    exactly — placement, not math."""
+def test_streaming_placement_tiers_match(monkeypatch):
+    """The three state-placement tiers (device-resident /
+    resident-kernels / fully host-paged) are placement choices, not
+    math: d and z must agree across all three. Trajectories are
+    float-identical except the z_diff reduction (numpy pairwise vs
+    on-device sum), which only gates early stopping — the test
+    problem runs a fixed iteration count."""
     geom, cfg, b = _problem()
-    res_r = streaming.learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(0))
-    monkeypatch.setenv("CCSC_STREAM_RESIDENT_GB", "0")
-    res_p = streaming.learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(0))
-    np.testing.assert_array_equal(np.asarray(res_r.d), np.asarray(res_p.d))
-    np.testing.assert_array_equal(res_r.z.reshape(-1), res_p.z.reshape(-1))
+    results = {}
+    for mode in ("device", "kern", "paged"):
+        monkeypatch.setenv("CCSC_STREAM_MODE", mode)
+        results[mode] = streaming.learn_streaming(
+            b, geom, cfg, key=jax.random.PRNGKey(0)
+        )
+    for mode in ("kern", "paged"):
+        np.testing.assert_allclose(
+            np.asarray(results["device"].d),
+            np.asarray(results[mode].d),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            results["device"].z.reshape(-1).astype(np.float32),
+            results[mode].z.reshape(-1).astype(np.float32),
+            atol=1e-6,
+        )
 
 
 def test_streaming_reduce_geometry():
