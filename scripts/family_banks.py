@@ -409,13 +409,20 @@ def main():
         from scipy.ndimage import gaussian_filter
 
         sm = gaussian_filter(b, sigma=(0, 0, 4.0, 4.0)).astype(np.float32)
-        # carry_freq: float-tolerance-equal trajectory at f32
-        # (tests/test_learn_masked_carry.py), 1.25x faster per outer
-        # step at this operating point (CPU, hs_profile) — bank
-        # quality is judged by held-out PSNR either way
+        # Execution strategy per platform, from the r5 family A/B
+        # (onchip_r5.jsonl): on chip matmul-DFT + bf16 state WITHOUT
+        # carry wins (0.260 vs 0.201 baseline; carry LOSES on chip,
+        # 0.237); on CPU carry wins 1.25x and pocketfft/f32 stays.
+        # Bank quality is judged by held-out PSNR either way.
+        on_tpu = plat in ("tpu", "axon")
+        hs_knobs = (
+            dict(fft_impl="matmul", storage_dtype="bfloat16",
+                 carry_freq=False)
+            if on_tpu else dict(carry_freq=True)
+        )
         cfg = LearnConfig(
             max_it=args.hs_max_it, tol=1e-3, verbose="brief",
-            track_objective=True, carry_freq=True,
+            track_objective=True, **hs_knobs,
         )
         t0 = time.time()
         res = learn_masked(
