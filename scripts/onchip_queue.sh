@@ -198,6 +198,15 @@ phase_hs2() {
   CCSC_FAMILY_FFTIMPL=matmul CCSC_FAMILY_STORAGE=bfloat16 \
     run_py 2400 scripts/hs_profile.py
 }
+phase_profile2() {
+  # xprof of the CURRENT tuned config (the phase-6 capture predates
+  # the wave-B pick: it profiled matmul_bf16-composition, not the
+  # fused-kernel + schur step now shipped in bench_tuned.json)
+  rm -rf artifacts_prof/tuned_r5
+  run_bench profile2 CCSC_BENCH_PROFILE=1 CCSC_BENCH_PROFILE_REPS=2 \
+    CCSC_BENCH_XPROF=artifacts_prof/tuned_r5 || return 1
+  run_py 600 scripts/xprof_report.py artifacts_prof/tuned_r5
+}
 phase_banks() {
   # needs a real window: don't start a multi-hour train that the
   # deadline cap would kill after minutes
