@@ -240,6 +240,19 @@ def learn_streaming(
     def hold(x):
         return x if device_state else np.asarray(x)
 
+    # device mode: the data spectra are constant — compute once from
+    # one upload per block instead of re-uploading b and re-running
+    # the forward transform at every d-iteration/z-pass/objective use.
+    # Host modes keep the recompute: holding all N complex spectra on
+    # device would scale with n, exactly what those tiers bound.
+    bhat_cache = (
+        [f_bhat(b_blocks[nn]) for nn in range(N)] if device_state
+        else None
+    )
+
+    def get_bhat(nn):
+        return bhat_cache[nn] if device_state else f_bhat(b_blocks[nn])
+
     d_local = [hold(state0.d_local[nn]) for nn in range(N)]
     dual_d = [hold(state0.dual_d[nn]) for nn in range(N)]
     z = [hold(state0.z[nn]) for nn in range(N)]
@@ -288,7 +301,7 @@ def learn_streaming(
             d_sum = None
             du_sum = None
             for nn in range(N):
-                bhat_nn = f_bhat(b_blocks[nn])
+                bhat_nn = get_bhat(nn)
                 d_new, du_new = f_d_block(
                     jnp.asarray(kerns[nn][0]),
                     jnp.asarray(kerns[nn][1]),
@@ -326,7 +339,7 @@ def learn_streaming(
         den = 0.0
         obj_z = 0.0
         for nn in range(N):
-            bhat_nn = f_bhat(b_blocks[nn])
+            bhat_nn = get_bhat(nn)
             z_new, du_new = f_z_block(
                 jnp.asarray(z[nn]), jnp.asarray(dual_z[nn]), bhat_nn, dhat_z
             )
