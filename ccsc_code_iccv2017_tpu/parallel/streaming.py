@@ -210,11 +210,15 @@ def learn_streaming(
     spatial_elems = int(np.prod(fg.spatial_shape))
     K = geom.num_filters
     kern_bytes = N * 2 * 4 * (ni * K + ni * ni) * fg.num_freq
+    # data spectra cache (complex64) — resident in both device and
+    # kern tiers, so its bytes join both budget checks
+    bhat_bytes = N * ni * fg.reduce_size * fg.num_freq * 8
     state_bytes = (
         2 * N * ni * K * spatial_elems
         * jnp.dtype(cfg.storage_dtype).itemsize  # z + dual_z
         + 2 * N * K * fg.reduce_size * spatial_elems
         * jnp.dtype(cfg.d_storage_dtype).itemsize  # d_local + dual_d
+        + b_blocks.nbytes  # raw data blocks (objective evaluations)
     )
     temp_bytes = 5 * ni * K * fg.num_freq * 8  # one block's cplx temps
     # default sized for the 16 GB v5e: the full-scale 3D bank state
@@ -225,9 +229,9 @@ def learn_streaming(
     ) * 1e9
     mode = _os.environ.get("CCSC_STREAM_MODE", "auto")
     if mode == "auto":
-        if state_bytes + kern_bytes + temp_bytes <= budget:
+        if state_bytes + kern_bytes + bhat_bytes + temp_bytes <= budget:
             mode = "device"
-        elif kern_bytes + temp_bytes <= budget:
+        elif kern_bytes + bhat_bytes + temp_bytes <= budget:
             mode = "kern"
         else:
             mode = "paged"
@@ -240,18 +244,28 @@ def learn_streaming(
     def hold(x):
         return x if device_state else np.asarray(x)
 
-    # device mode: the data spectra are constant — compute once from
-    # one upload per block instead of re-uploading b and re-running
-    # the forward transform at every d-iteration/z-pass/objective use.
-    # Host modes keep the recompute: holding all N complex spectra on
-    # device would scale with n, exactly what those tiers bound.
+    # The raw data blocks and their spectra are constant for the whole
+    # run. Device tier: both live on device — objectives and solves
+    # never re-upload data. Kern tier: the spectra cache (counted in
+    # its budget check, same scaling as the kernel cache it already
+    # admits) removes max_it_d * N redundant uploads + forward FFTs
+    # per outer step. Paged tier recomputes from host, bounding device
+    # memory by one block.
+    b_cache = (
+        [jnp.asarray(b_blocks[nn]) for nn in range(N)]
+        if device_state else None
+    )
+
+    def get_b(nn):
+        return b_cache[nn] if device_state else b_blocks[nn]
+
     bhat_cache = (
-        [f_bhat(b_blocks[nn]) for nn in range(N)] if device_state
+        [f_bhat(get_b(nn)) for nn in range(N)] if kern_resident
         else None
     )
 
     def get_bhat(nn):
-        return bhat_cache[nn] if device_state else f_bhat(b_blocks[nn])
+        return bhat_cache[nn] if kern_resident else f_bhat(b_blocks[nn])
 
     d_local = [hold(state0.d_local[nn]) for nn in range(N)]
     dual_d = [hold(state0.dual_d[nn]) for nn in range(N)]
@@ -329,9 +343,7 @@ def learn_streaming(
         if cfg.with_objective:
             for nn in range(N):
                 obj_d += float(
-                    f_obj_block(
-                        jnp.asarray(z[nn]), jnp.asarray(b_blocks[nn]), dhat_z
-                    )
+                    f_obj_block(jnp.asarray(z[nn]), get_b(nn), dhat_z)
                 )
 
         # ---- z-pass: blocks fully independent ----------------------
@@ -363,7 +375,7 @@ def learn_streaming(
                 dual_z[nn] = np.asarray(du_new)
             if cfg.with_objective:
                 obj_z += float(
-                    f_obj_block(jnp.asarray(z[nn]), jnp.asarray(b_blocks[nn]), dhat_z)
+                    f_obj_block(jnp.asarray(z[nn]), get_b(nn), dhat_z)
                 )
         z_diff = float(np.sqrt(num) / max(np.sqrt(den), 1e-30))
         t_total += time.perf_counter() - t0
