@@ -74,12 +74,28 @@ def test_last_onchip_record_picks_newest_real_chip_row(tmp_path):
     ])
     os.utime(old, (time.time() - 7200, time.time() - 7200))
     bench.REPO = str(tmp_path)
-    rec = bench.last_onchip_record()
+    rec, fastest = bench.last_onchip_record()
     assert rec["run"] == "fresh"
     assert rec["value"] == 2.5
     assert rec["source"] == "onchip_r5.jsonl"
     assert rec["knobs"] == {"fused_z": True}
     assert rec["source_age_hours"] < 1.0
+    # fastest is restricted to the SAME newest file: the r4 tuned row
+    # (1.81, older code) must not leak in even though it is a valid
+    # chip row
+    assert fastest["run"] == "fresh" and fastest["source"] == "onchip_r5.jsonl"
+
+
+def test_last_onchip_fastest_may_differ_from_last(tmp_path):
+    bench = _load_bench()
+    _write_jsonl(tmp_path / "onchip_r5.jsonl", [
+        _rec("speedster", 3.1, knobs={"fused_z_precision": "default"}),
+        _rec("tuned_rerun", 2.5, knobs={"fused_z": True}),
+    ])
+    bench.REPO = str(tmp_path)
+    last, fastest = bench.last_onchip_record()
+    assert last["run"] == "tuned_rerun"
+    assert fastest["run"] == "speedster" and fastest["value"] == 3.1
 
 
 def test_last_onchip_record_none_when_no_chip_rows(tmp_path):
@@ -89,7 +105,7 @@ def test_last_onchip_record_none_when_no_chip_rows(tmp_path):
         {"note": "nothing real"},
     ])
     bench.REPO = str(tmp_path)
-    assert bench.last_onchip_record() is None
+    assert bench.last_onchip_record() == (None, None)
 
 
 def test_pick_tuned_uses_only_newest_round(tmp_path, capsys):
