@@ -353,6 +353,28 @@ def test_hermitian_inverse_schur_matches_cholesky_and_numpy():
         assert np.max(np.abs(inv_s - inv_c)) / scale < 5e-6, m
 
 
+def test_resolve_herm_method_window(monkeypatch):
+    """The TPU 'auto' window is measured at both ends (r5 on-chip):
+    schur for m == 1 and 2 < m <= 16; cholesky at m == 2 (35% HS
+    regression) and m > 16 (pathological compile at m=31). CPU always
+    resolves cholesky; explicit method / env win over auto."""
+    from ccsc_code_iccv2017_tpu.ops import freq_solvers
+
+    monkeypatch.setattr(freq_solvers.jax, "default_backend", lambda: "tpu")
+    expect = {1: "schur", 2: "cholesky", 3: "schur", 8: "schur",
+              16: "schur", 17: "cholesky", 31: "cholesky"}
+    for m, want in expect.items():
+        assert freq_solvers.resolve_herm_method(m) == want, m
+    assert freq_solvers.resolve_herm_method(2, "schur") == "schur"
+    monkeypatch.setenv("CCSC_HERM_INV", "newton")
+    assert freq_solvers.resolve_herm_method(8) == "newton"
+    monkeypatch.delenv("CCSC_HERM_INV")
+    monkeypatch.setattr(freq_solvers.jax, "default_backend", lambda: "cpu")
+    assert all(
+        freq_solvers.resolve_herm_method(m) == "cholesky" for m in expect
+    )
+
+
 def test_hermitian_inverse_newton_converges():
     """The Newton-Schulz matmul iteration (r5: the compile-light
     option for m above the schur window — the [F,31,31] HS z-kernel)
