@@ -195,15 +195,40 @@ def main():
     print("platform:", plat, flush=True)
 
     def _learn_memory_bounded(b, geom, cfg):
-        """In-memory consensus learn, falling back to the host-
-        streaming learner (same math, device memory O(one block) —
-        parallel/streaming.py) when the all-blocks-resident path
-        exceeds HBM. The r5 full-scale 3D train OOMed the 16G v5e."""
+        """In-memory consensus learn, falling back to the block-
+        sequential streaming learner (same math — parallel/streaming.py)
+        when the all-blocks-resident path exceeds HBM. The r5
+        full-scale 3D train OOMed the 16G v5e; a pre-flight estimate
+        of the in-memory learner's full-batch spectra temps skips the
+        doomed ~5-minute compile-then-OOM attempt outright."""
         import numpy as np
 
+        from ccsc_code_iccv2017_tpu.models.common import FreqGeom
         from ccsc_code_iccv2017_tpu.parallel.streaming import (
             learn_streaming,
         )
+
+        fg_est = FreqGeom.create(
+            geom, b.shape[-geom.ndim_spatial:],
+            fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl,
+        )
+        # ~5 live full-batch complex code spectra inside the z
+        # iteration + f32 z/dual state — the measured OOM driver
+        est = (
+            5 * b.shape[0] * geom.num_filters * fg_est.num_freq * 8
+            + 2 * b.shape[0] * geom.num_filters
+            * int(np.prod(fg_est.spatial_shape))
+            * jnp.dtype(cfg.storage_dtype).itemsize
+        )
+        hbm_gb = float(os.environ.get("CCSC_INMEM_HBM_GB", "14"))
+        if plat in ("tpu", "axon") and est > hbm_gb * 1e9:
+            print(f"in-memory learn pre-flight: ~{est/1e9:.1f} GB "
+                  f"full-batch temps > {hbm_gb:.0f} GB budget; going "
+                  "straight to the streaming learner", flush=True)
+            return learn_streaming(
+                np.asarray(b, np.float32), geom, cfg,
+                key=jax.random.PRNGKey(0),
+            )
 
         try:
             return learn(jnp.asarray(b), geom, cfg,
