@@ -33,6 +33,12 @@ def add_perf_args(parser, fft_pad: bool = True, fused: bool = False) -> None:
             help="fused z-iteration Pallas kernel (2D W=1 learners; "
             "ops.pallas_fused_z)",
         )
+    parser.add_argument(
+        "--stream-mode", default=None,
+        choices=["auto", "device", "kern", "paged"],
+        help="state placement tier for --streaming (default auto by "
+        "byte budget, CCSC_STREAM_RESIDENT_GB; parallel.streaming)",
+    )
 
 
 def add_mat_layout_arg(parser) -> None:
@@ -74,6 +80,14 @@ def dispatch_learn(
     the data (the smooth_init the masked objective would model,
     learn_hyperspectral.m:16-17) and ``streaming_blocks`` shrinks to
     the nearest divisor of n before replacing cfg.num_blocks."""
+    # --stream-mode rides the env knob learn_streaming reads (set in
+    # the one shared dispatch so apps only forward the parsed flag;
+    # a no-op for the non-streaming arm, which never reads it)
+    stream_mode = kwargs.pop("stream_mode", None)
+    if stream_mode:
+        import os as _os
+
+        _os.environ["CCSC_STREAM_MODE"] = stream_mode
     if streaming:
         if mesh is not None:
             raise SystemExit(

@@ -127,6 +127,7 @@ def main(argv=None):
             jax.random.PRNGKey(args.seed),
             mesh,
             streaming=True,
+            stream_mode=args.stream_mode,
             forbidden={
                 "--init-filters": args.init_filters,
                 "--checkpoint-dir": args.checkpoint_dir,

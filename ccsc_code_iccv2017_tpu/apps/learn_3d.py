@@ -103,7 +103,8 @@ def main(argv=None):
 
     mesh = block_mesh(args.mesh) if args.mesh else None
     res = dispatch_learn(
-        b, geom, cfg, jax.random.PRNGKey(args.seed), mesh, args.streaming
+        b, geom, cfg, jax.random.PRNGKey(args.seed), mesh, args.streaming,
+        stream_mode=args.stream_mode,
     )
     save_filters(args.out, res.d, res.trace, layout="3d", Dz=res.Dz)
     print(f"saved {res.d.shape} filters to {args.out}")

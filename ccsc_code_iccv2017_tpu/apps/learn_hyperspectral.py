@@ -123,6 +123,7 @@ def main(argv=None):
             jax.random.PRNGKey(args.seed),
             mesh=None,
             streaming=True,
+            stream_mode=args.stream_mode,
             streaming_blocks=args.streaming_blocks,
             streaming_offset=sm,
             forbidden={
