@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Continue training the 3D bank from a saved checkpoint bank.
+
+The full-protocol (n=64, max_it=20 — learn_kernels_3D.m:15-16,71-82)
+3D train landed 0.13 dB behind the shipped reference bank with the
+objective still falling steadily at the protocol's iteration cap
+(Diff_z 0.33 vs tol 1e-2): the bank is undertrained at 20 iterations,
+not underpowered. This script warm-starts the consensus learner from
+the saved bank (LearnConfig init_d — the warm start the reference
+declares but ignores, dParallel.m:4) on the SAME synthesized clips
+(same seed) and runs additional outer iterations, then re-runs the
+identical held-out evaluation as scripts/family_banks.py.
+
+Duals restart at zero, so this is a fresh consensus solve initialized
+at the learned dictionary — standard ADMM practice; the trace confirms
+the objective continues DOWN from the warm start.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+from family_banks import SHIPPED, central_slice, synth_video  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--bank", required=True,
+                    help="bank_3d.mat to continue from")
+    ap.add_argument("--more", type=int, default=20,
+                    help="additional outer iterations")
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--side", type=int, default=50)
+    ap.add_argument("--out", default="artifacts_family_cpu64")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.config import (
+        LearnConfig, ProblemGeom, SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.learn import learn
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem, reconstruct,
+    )
+    from ccsc_code_iccv2017_tpu.utils import display, io_mat
+
+    os.makedirs(args.out, exist_ok=True)
+    plat = jax.devices()[0].platform
+    init = io_mat.load_filters_3d(args.bank)
+    k, support = init.shape[0], init.shape[1]
+    print(f"continuing from {args.bank} {init.shape} on {plat}",
+          flush=True)
+
+    b = synth_video(args.n, args.side, args.side)
+    geom = ProblemGeom((support,) * 3, k)
+    knobs = (
+        dict(fft_impl="matmul", storage_dtype="bfloat16",
+             d_storage_dtype="bfloat16")
+        if plat in ("tpu", "axon") else {}
+    )
+    cfg = LearnConfig(
+        max_it=args.more, tol=1e-2, rho_d=5000.0, rho_z=1.0,
+        num_blocks=8, verbose="brief", track_objective=True, **knobs,
+    )
+    t0 = time.time()
+    res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0),
+                init_d=jnp.asarray(init))
+    t = time.time() - t0
+    io_mat.save_filters(
+        os.path.join(args.out, "bank_3d_cont.mat"), res.d, res.trace,
+        layout="3d",
+    )
+    display.save_filter_mosaic(
+        os.path.join(args.out, "mosaic_3d_cont.png"),
+        central_slice(np.asarray(res.d), "3d"),
+        title=f"3D bank, +{args.more} warm-started iterations",
+    )
+
+    # identical held-out evaluation to family_banks.py's 3D leg
+    test = synth_video(4, args.side, args.side, seed=99)
+    rng = np.random.default_rng(5)
+    mask = (rng.uniform(size=test.shape) > 0.5).astype(np.float32)
+    prob = ReconstructionProblem(geom)
+    scfg = SolveConfig(
+        lambda_residual=100.0, lambda_prior=0.5,
+        max_it=80, tol=1e-5, verbose="none",
+    )
+
+    def psnr3(d):
+        r = reconstruct(
+            jnp.asarray(test * mask), jnp.asarray(d), prob, scfg,
+            mask=jnp.asarray(mask),
+        )
+        rec = np.asarray(r.recon)
+        mse = np.mean((rec - test) ** 2)
+        span = float(test.max() - test.min()) or 1.0
+        return 10 * np.log10(span**2 / mse)
+
+    own = float(psnr3(np.asarray(res.d)))
+    shipped = float(psnr3(io_mat.load_filters_3d(SHIPPED["3d"])))
+    out = {
+        "family": "3d_continued",
+        "extra_it": args.more,
+        "t_learn_s": round(t, 1),
+        "platform": plat,
+        "own_psnr": round(own, 2),
+        "shipped_psnr": round(shipped, 2),
+        "obj": float(res.trace["obj_vals_z"][-1]),
+    }
+    with open(os.path.join(args.out, "result_3d_cont.json"), "w") as f:
+        json.dump(out, f)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
