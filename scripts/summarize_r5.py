@@ -114,6 +114,11 @@ def main():
                 f"{h.get('per_z_iter_ms')} | {h.get('fixed_ms')} |"
             )
         print()
+        for h in hsp:
+            inv = h.get("inverse_ms")
+            if inv:
+                print(f"per-method Gram-inverse ms: `{json.dumps(inv)}`")
+        print()
     if xp:
         print("## xprof attribution (top ops)\n")
         for x in xp:
