@@ -82,3 +82,24 @@ def test_carry_freq_under_freq_mesh():
     np.testing.assert_allclose(
         np.asarray(shd.d), np.asarray(ref.d), rtol=0, atol=2e-4
     )
+
+
+def test_objective_gating_is_trajectory_neutral():
+    """With tracking off the masked learner skips BOTH per-outer
+    objective reconstructions and disarms the regression rollback
+    (r5; the reference evaluates unconditionally, admm_learn.m:138-146)
+    — the filters and iteration count must be identical either way,
+    and the untracked trace stays all-zeros."""
+    b, geom = _problem()
+    cfg_on = LearnConfig(
+        max_it=3, tol=0.0, verbose="none", track_objective=True
+    )
+    cfg_off = LearnConfig(
+        max_it=3, tol=0.0, verbose="none", track_objective=False
+    )
+    r_on = learn_masked(b, geom, cfg_on, key=jax.random.PRNGKey(0))
+    r_off = learn_masked(b, geom, cfg_off, key=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(r_on.d), np.asarray(r_off.d))
+    assert len(r_on.trace["obj_vals_z"]) == len(r_off.trace["obj_vals_z"])
+    assert all(v == 0.0 for v in r_off.trace["obj_vals_z"])
+    assert all(v > 0.0 for v in r_on.trace["obj_vals_z"])
