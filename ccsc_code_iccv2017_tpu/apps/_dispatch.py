@@ -7,14 +7,19 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 
-def add_perf_args(parser, fft_pad: bool = True, fused: bool = False) -> None:
+def add_perf_args(
+    parser, fft_pad: bool = True, fused: bool = False,
+    streaming: bool = False,
+) -> None:
     """The shared execution-strategy flags (one definition so the
     vocabulary and help text cannot drift across the 9 apps).
 
     ``fft_pad=False`` for unpadded (pure-circular) problems, where a
     fast FFT domain would change the problem (demosaic/view-synth);
     ``fused=True`` only where the fused z kernel can engage (2D W=1
-    learners)."""
+    learners); ``streaming=True`` only on the learner CLIs that have
+    a --streaming arm (a flag a coding app would silently ignore must
+    not parse there)."""
     if fft_pad:
         parser.add_argument(
             "--fft-pad", default="none", choices=["none", "pow2", "fast"],
@@ -33,12 +38,14 @@ def add_perf_args(parser, fft_pad: bool = True, fused: bool = False) -> None:
             help="fused z-iteration Pallas kernel (2D W=1 learners; "
             "ops.pallas_fused_z)",
         )
-    parser.add_argument(
-        "--stream-mode", default=None,
-        choices=["auto", "device", "kern", "paged"],
-        help="state placement tier for --streaming (default auto by "
-        "byte budget, CCSC_STREAM_RESIDENT_GB; parallel.streaming)",
-    )
+    if streaming:
+        parser.add_argument(
+            "--stream-mode", default=None,
+            choices=["auto", "device", "kern", "paged"],
+            help="state placement tier for --streaming (default auto "
+            "by byte budget, CCSC_STREAM_RESIDENT_GB; "
+            "parallel.streaming)",
+        )
 
 
 def add_mat_layout_arg(parser) -> None:

@@ -56,7 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="host-streaming mode: one consensus block on device at a "
         "time (bounded HBM; parallel.streaming)",
     )
-    add_perf_args(p, fused=True)
+    add_perf_args(p, fused=True, streaming=True)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],

@@ -41,7 +41,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--out", default="3D_video_filters.mat")
     from ._dispatch import add_perf_args
 
-    add_perf_args(p)
+    add_perf_args(p, streaming=True)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],

@@ -494,7 +494,13 @@ def learn_masked(
                 trace.setdefault("algorithm", "masked_admm")
             print(f"resumed from {checkpoint_dir} at iteration {start_it}")
 
-    seen = trace["obj_vals_d"] + trace["obj_vals_z"]
+    # untracked iterations persist 0.0 placeholders; resuming such a
+    # checkpoint with tracking ON must not seed obj_best=0.0 (the
+    # rollback would fire on the first real objective) — real
+    # objectives are strictly positive, so filter the placeholders
+    seen = [
+        v for v in trace["obj_vals_d"] + trace["obj_vals_z"] if v > 0.0
+    ]
     obj_best = min(seen) if seen else jnp.inf
     t_total = trace["tim_vals"][-1]
     prev = state

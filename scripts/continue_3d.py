@@ -28,7 +28,9 @@ from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
 
 honor_jax_platforms_env()
 
-from family_banks import SHIPPED, central_slice, synth_video  # noqa: E402
+from family_banks import (  # noqa: E402
+    SHIPPED, central_slice, heldout_psnr_3d, synth_video,
+)
 
 
 def main():
@@ -47,13 +49,8 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from ccsc_code_iccv2017_tpu.config import (
-        LearnConfig, ProblemGeom, SolveConfig,
-    )
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
     from ccsc_code_iccv2017_tpu.models.learn import learn
-    from ccsc_code_iccv2017_tpu.models.reconstruct import (
-        ReconstructionProblem, reconstruct,
-    )
     from ccsc_code_iccv2017_tpu.utils import display, io_mat
 
     os.makedirs(args.out, exist_ok=True)
@@ -88,28 +85,12 @@ def main():
         title=f"3D bank, +{args.more} warm-started iterations",
     )
 
-    # identical held-out evaluation to family_banks.py's 3D leg
-    test = synth_video(4, args.side, args.side, seed=99)
-    rng = np.random.default_rng(5)
-    mask = (rng.uniform(size=test.shape) > 0.5).astype(np.float32)
-    prob = ReconstructionProblem(geom)
-    scfg = SolveConfig(
-        lambda_residual=100.0, lambda_prior=0.5,
-        max_it=80, tol=1e-5, verbose="none",
+    # identical held-out evaluation to family_banks.py's 3D leg —
+    # the SAME function (family_banks.heldout_psnr_3d), not a copy
+    own = float(heldout_psnr_3d(np.asarray(res.d), args.side))
+    shipped = float(
+        heldout_psnr_3d(io_mat.load_filters_3d(SHIPPED["3d"]), args.side)
     )
-
-    def psnr3(d):
-        r = reconstruct(
-            jnp.asarray(test * mask), jnp.asarray(d), prob, scfg,
-            mask=jnp.asarray(mask),
-        )
-        rec = np.asarray(r.recon)
-        mse = np.mean((rec - test) ** 2)
-        span = float(test.max() - test.min()) or 1.0
-        return 10 * np.log10(span**2 / mse)
-
-    own = float(psnr3(np.asarray(res.d)))
-    shipped = float(psnr3(io_mat.load_filters_3d(SHIPPED["3d"])))
     out = {
         "family": "3d_continued",
         "extra_it": args.more,

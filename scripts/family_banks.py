@@ -147,6 +147,40 @@ def synth_hyperspectral(n, side, bands, seed=0):
     return out
 
 
+def heldout_psnr_3d(d, side, eval_max_it=80):
+    """Held-out 3D evaluation — THE protocol both the bank trainer and
+    the continuation tool (scripts/continue_3d.py) score against: 50%%
+    random masked subsampling on 4 seed-99 synth clips, reconstruction
+    PSNR over the full volume. One definition so the two comparisons
+    cannot desynchronize. ``d``: [k, s, s, s] filter bank."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.config import ProblemGeom, SolveConfig
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem, reconstruct,
+    )
+
+    d = np.asarray(d)
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    test = synth_video(4, side, side, seed=99)
+    rng = np.random.default_rng(5)
+    mask = (rng.uniform(size=test.shape) > 0.5).astype(np.float32)
+    scfg = SolveConfig(
+        lambda_residual=100.0, lambda_prior=0.5,
+        max_it=eval_max_it, tol=1e-5, verbose="none",
+    )
+    r = reconstruct(
+        jnp.asarray(test * mask), jnp.asarray(d),
+        ReconstructionProblem(geom), scfg, mask=jnp.asarray(mask),
+    )
+    rec = np.asarray(r.recon)
+    mse = np.mean((rec - test) ** 2)
+    span = float(test.max() - test.min()) or 1.0
+    return 10 * np.log10(span**2 / mse)
+
+
 def central_slice(d, fam):
     """[k, ...] -> [k, s, s] 2D view for the mosaic."""
     if fam == "3d":
@@ -336,29 +370,15 @@ def main():
             central_slice(np.asarray(res.d), fam),
             title=f"3D bank, central temporal slice ({args.max_it} it)",
         )
-        # eval: 50% masked subsampling on held-out clips
-        test = synth_video(4, args.side, args.side, seed=99)
-        rng = np.random.default_rng(5)
-        mask = (rng.uniform(size=test.shape) > 0.5).astype(np.float32)
-        prob = ReconstructionProblem(geom)
-        scfg = SolveConfig(
-            lambda_residual=100.0, lambda_prior=0.5,
-            max_it=args.eval_max_it, tol=1e-5, verbose="none",
-        )
-
-        def psnr3(d):
-            r = reconstruct(
-                jnp.asarray(test * mask), jnp.asarray(d), prob, scfg,
-                mask=jnp.asarray(mask),
-            )
-            rec = np.asarray(r.recon)
-            mse = np.mean((rec - test) ** 2)
-            span = float(test.max() - test.min()) or 1.0
-            return 10 * np.log10(span**2 / mse)
-
-        own = psnr3(np.asarray(res.d))
+        # eval: heldout_psnr_3d — the shared protocol (also scored by
+        # scripts/continue_3d.py; one definition, no drift)
+        own = heldout_psnr_3d(np.asarray(res.d), args.side,
+                              args.eval_max_it)
         shipped_d = None if args.smoke else load_shipped(fam, "d")
-        ship = psnr3(shipped_d) if shipped_d is not None else float("nan")
+        ship = (
+            heldout_psnr_3d(shipped_d, args.side, args.eval_max_it)
+            if shipped_d is not None else float("nan")
+        )
         results[fam] = dict(t_learn_s=round(float(t), 1),
                             own_psnr=round(float(own), 2),
                             shipped_psnr=round(float(ship), 2),
