@@ -93,6 +93,15 @@ def run_workload():
     fused_prec = os.environ.get(
         "CCSC_BENCH_FUSEDZ_PREC", tuned.get("fused_z_precision", "highest")
     )
+    # chunked/donated outer driver (LearnConfig.outer_chunk /
+    # donate_state): >1 runs that many outer iterations per dispatch
+    # with one readback; donation aliases the state buffers in place
+    outer_chunk = int(
+        os.environ.get("CCSC_BENCH_CHUNK", tuned.get("outer_chunk", 1))
+    )
+    donate = os.environ.get(
+        "CCSC_BENCH_DONATE", "1" if tuned.get("donate_state") else "0"
+    ) == "1"
     # the Gram-inverse implementation is an env-level switch (same math
     # to float rounding, freq_solvers.hermitian_inverse) — apply the
     # tuned pick unless the caller overrides; with neither, leave the
@@ -113,6 +122,9 @@ def run_workload():
         num_blocks=blocks,
         rho_d=5000.0,
         rho_z=1.0,
+        # tol=0 so the chunked scan's in-jit early-stop can never fire
+        # mid-bench (the per-step bench loop never checked tol either)
+        tol=0.0,
         verbose="none",
         use_pallas=use_pallas,
         fft_pad=fft_pad,
@@ -121,6 +133,8 @@ def run_workload():
         fft_impl=fft_impl,
         fused_z=fused_z,
         fused_z_precision=fused_prec,
+        outer_chunk=outer_chunk,
+        donate_state=donate,
     )
     fg = common.FreqGeom.create(
         geom, (size, size), fft_pad=fft_pad, fft_impl=fft_impl
@@ -137,7 +151,24 @@ def run_workload():
         d_dtype=jnp.dtype(d_storage),
     )
 
-    step = consensus.make_outer_step(geom, cfg, fg, mesh=None)
+    chunked = cfg.chunked_driver
+    if chunked:
+        # chunked arm: one dispatch per outer_chunk iterations; with
+        # donate the state buffers alias in place call-to-call. The
+        # warmup consumes `state` (donated) — keep a copy only if the
+        # component profile will need it afterwards.
+        if donate and os.environ.get("CCSC_BENCH_PROFILE") == "1":
+            state_profile = jax.tree.map(jnp.copy, state)
+        else:
+            state_profile = state
+        step = consensus.make_outer_chunk_step(
+            geom, cfg, fg, outer_chunk, mesh=None, donate=donate
+        )
+        fence = lambda out: float(out.metrics.d_diff[-1])
+    else:
+        state_profile = state
+        step = consensus.make_outer_step(geom, cfg, fg, mesh=None)
+        fence = lambda out: float(out.d_diff)
 
     # ONE AOT compile, reused for warmup, timing, and cost analysis
     # (a second .lower().compile() would recompile from scratch —
@@ -150,14 +181,16 @@ def run_workload():
     # warmup. NB: jax.block_until_ready is a no-op on the axon TPU
     # platform — a scalar readback is the only reliable fence.
     s1, m0 = compiled(state, b_blocks)
-    float(m0.d_diff)  # real scalar computed from the chain, not the
+    fence(m0)  # real scalar computed from the chain, not the
     # constant-0 objective (verbose='none' skips the objective)
 
+    calls = max(1, iters // outer_chunk) if chunked else iters
+    eff_iters = calls * outer_chunk if chunked else iters
     t0 = time.perf_counter()
     cur = s1
-    for _ in range(iters):
+    for _ in range(calls):
         cur, m = compiled(cur, b_blocks)
-    float(m.d_diff)  # fences the whole chain
+    fence(m)  # fences the whole chain
     dt = time.perf_counter() - t0
 
     # optional xprof capture (CCSC_BENCH_XPROF=<dir>) of two EXTRA
@@ -171,8 +204,8 @@ def run_workload():
         with xla_trace(xprof_dir):
             for _ in range(2):
                 cur, m = compiled(cur, b_blocks)
-            float(m.d_diff)
-    ips = iters / dt
+            fence(m)
+    ips = eff_iters / dt
 
     # ---- utilization: XLA's cost model, analytic fallback ----------
     from ccsc_code_iccv2017_tpu.utils import perfmodel
@@ -186,6 +219,10 @@ def run_workload():
         else None
     )
     cost_src = "xla_cost_analysis"
+    if cost is not None and chunked:
+        # the compiled executable is a CHUNK of outer_chunk steps;
+        # utilization() wants per-step cost
+        cost = {kk: v / outer_chunk for kk, v in cost.items()}
     if cost is None:
         cost = perfmodel.analytic_outer_step_cost(
             num_blocks=blocks,
@@ -199,6 +236,7 @@ def run_workload():
             d_state_dtype_bytes=2 if d_storage == "bfloat16" else 4,
             fft_impl=fft_impl,
             fused_z=fused_z,
+            donate_state=donate,
         )
         cost_src = "analytic_fused_z" if fused_z else "analytic"
     util = perfmodel.utilization(cost, ips)
@@ -222,11 +260,13 @@ def run_workload():
             "fused_z": fused_z,
             "fused_z_precision": fused_prec,
             "herm_inv": herm_inv,
+            "outer_chunk": outer_chunk,
+            "donate_state": donate,
         },
     }
     if os.environ.get("CCSC_BENCH_PROFILE") == "1":
         out["components"] = profile_components(
-            geom, cfg, fg, state, b_blocks
+            geom, cfg, fg, state_profile, b_blocks
         )
     return out
 
@@ -414,7 +454,14 @@ def emit(r, degraded=False):
         last, fastest = last_onchip_record()
         if last is not None:
             out["last_onchip"] = last
-        if fastest is not None and fastest is not last:
+        # compare VALUES, not object identity: an earlier arm that
+        # merely ties the newest record is not a distinct faster
+        # record and must not be re-emitted as one (ADVICE r5)
+        if (
+            fastest is not None
+            and last is not None
+            and fastest["value"] > last["value"]
+        ):
             out["best_onchip"] = fastest
     print(json.dumps(out))
 

@@ -9,7 +9,7 @@ from typing import Dict, Optional
 
 def add_perf_args(
     parser, fft_pad: bool = True, fused: bool = False,
-    streaming: bool = False,
+    streaming: bool = False, chunk: bool = False,
 ) -> None:
     """The shared execution-strategy flags (one definition so the
     vocabulary and help text cannot drift across the 9 apps).
@@ -19,7 +19,8 @@ def add_perf_args(
     ``fused=True`` only where the fused z kernel can engage (2D W=1
     learners); ``streaming=True`` only on the learner CLIs that have
     a --streaming arm (a flag a coding app would silently ignore must
-    not parse there)."""
+    not parse there); ``chunk=True`` only on the learner CLIs (the
+    chunked/donated outer driver is a LearnConfig knob)."""
     if fft_pad:
         parser.add_argument(
             "--fft-pad", default="none", choices=["none", "pow2", "fast"],
@@ -38,13 +39,29 @@ def add_perf_args(
             help="fused z-iteration Pallas kernel (2D W=1 learners; "
             "ops.pallas_fused_z)",
         )
+    if chunk:
+        parser.add_argument(
+            "--outer-chunk", type=int, default=1,
+            help="outer iterations per jitted lax.scan chunk: one "
+            "dispatch + one metrics readback per chunk instead of per "
+            "iteration (tol/rollback semantics preserved at chunk "
+            "granularity; checkpoint/figure cadence moves to chunk "
+            "boundaries; LearnConfig.outer_chunk)",
+        )
+        parser.add_argument(
+            "--donate-state", action="store_true",
+            help="donate the ADMM state to the jitted step so XLA "
+            "aliases the multi-GB state buffers in place instead of "
+            "allocating a fresh copy per step "
+            "(LearnConfig.donate_state)",
+        )
     if streaming:
         parser.add_argument(
             "--stream-mode", default=None,
             choices=["auto", "device", "kern", "paged"],
             help="state placement tier for --streaming (default auto "
             "by byte budget, CCSC_STREAM_RESIDENT_GB; "
-            "parallel.streaming)",
+            "parallel.streaming). Requires --streaming.",
         )
 
 
@@ -87,14 +104,13 @@ def dispatch_learn(
     the data (the smooth_init the masked objective would model,
     learn_hyperspectral.m:16-17) and ``streaming_blocks`` shrinks to
     the nearest divisor of n before replacing cfg.num_blocks."""
-    # --stream-mode rides the env knob learn_streaming reads (set in
-    # the one shared dispatch so apps only forward the parsed flag;
-    # a no-op for the non-streaming arm, which never reads it)
+    # --stream-mode is passed straight into learn_streaming as an
+    # argument (no process-global env mutation that would leak into
+    # later learns in the same process); without --streaming it is an
+    # explicit error, per the same contract as ``forbidden``
     stream_mode = kwargs.pop("stream_mode", None)
-    if stream_mode:
-        import os as _os
-
-        _os.environ["CCSC_STREAM_MODE"] = stream_mode
+    if stream_mode and not streaming:
+        raise SystemExit("--stream-mode requires --streaming")
     if streaming:
         if mesh is not None:
             raise SystemExit(
@@ -126,7 +142,7 @@ def dispatch_learn(
             while n % blocks:
                 blocks -= 1
             cfg = dataclasses.replace(cfg, num_blocks=blocks)
-        res = learn_streaming(b, geom, cfg, key=key)
+        res = learn_streaming(b, geom, cfg, key=key, stream_mode=stream_mode)
         if streaming_offset is not None:
             # learn_streaming codes the offset-subtracted data; restore
             # the offset so Dz means "full reconstruction" exactly like

@@ -56,7 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="host-streaming mode: one consensus block on device at a "
         "time (bounded HBM; parallel.streaming)",
     )
-    add_perf_args(p, fused=True, streaming=True)
+    add_perf_args(p, fused=True, streaming=True, chunk=True)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -112,6 +112,8 @@ def main(argv=None):
         fused_z=args.fused_z,
         storage_dtype=args.storage_dtype,
         d_storage_dtype=args.d_storage_dtype,
+        outer_chunk=args.outer_chunk,
+        donate_state=args.donate_state,
     )
     mesh = block_mesh(args.mesh) if args.mesh else None
     init_d = (

@@ -41,7 +41,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--out", default="4d_filters_lightfield.mat")
     from ._dispatch import add_perf_args
 
-    add_perf_args(p, streaming=True)
+    add_perf_args(p, streaming=True, chunk=True)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -105,6 +105,8 @@ def main(argv=None):
         fft_impl=args.fft_impl,
         storage_dtype=args.storage_dtype,
         d_storage_dtype=args.d_storage_dtype,
+        outer_chunk=args.outer_chunk,
+        donate_state=args.donate_state,
     )
     from ._dispatch import dispatch_learn
 

@@ -43,7 +43,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=5)
     from ._dispatch import add_perf_args
 
-    add_perf_args(p, streaming=True)
+    add_perf_args(p, streaming=True, chunk=True)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -107,6 +107,8 @@ def main(argv=None):
         fft_pad=args.fft_pad,
         fft_impl=args.fft_impl,
         storage_dtype=args.storage_dtype,
+        outer_chunk=args.outer_chunk,
+        donate_state=args.donate_state,
     )
     init_d = (
         jnp.asarray(load_filters_hyperspectral(args.init))

@@ -171,6 +171,29 @@ class LearnConfig:
     # — one bf16 MXU pass each, ~3 decimal digits per transform;
     # validate trajectories before relying on it).
     fft_impl: str = "xla"
+    # Number of outer consensus iterations executed inside ONE jitted
+    # lax.scan chunk. 1 (default) keeps the reference's per-step driver
+    # (one dispatch + four scalar readbacks per outer iteration); > 1
+    # removes the host from the inner pacing loop: the chunk runs as a
+    # single dispatch, metrics stack inside the scan and are read back
+    # once per chunk, and the driver's non-finite rollback / tol
+    # early-stop move to chunk granularity (a "last finite state" is
+    # carried through the scan, so divergence mid-chunk still returns
+    # the last good iterate — same contract as the per-step driver).
+    # Checkpoint/figure cadence also lands on chunk boundaries. The
+    # r5 bandwidth probe measured ~20 ms of per-dispatch tunnel
+    # overhead (PERF.md); at outer_chunk=4 the driver pays it (and the
+    # readback fence) once per 4 iterations instead of every one.
+    outer_chunk: int = 1
+    # Donate the input ADMM state to the jitted outer step
+    # (jax.jit(..., donate_argnums=...)): XLA aliases every state
+    # buffer in place instead of allocating a fresh multi-GB copy per
+    # step (z + dual_z alone are ~1.9 GB each f32 at the north-star
+    # shape — the xprof-visible layout copies). Implies routing through
+    # the chunked step (even at outer_chunk=1) so the rollback state
+    # lives inside the jitted program — the driver never touches a
+    # donated buffer after the call.
+    donate_state: bool = False
     # Carry the frequency-domain iterate across the masked learner's
     # inner scans instead of re-transforming the spatial iterate each
     # iteration. The spatial iterate is ALWAYS produced by an inverse
@@ -188,6 +211,22 @@ class LearnConfig:
         if self.track_objective is None:
             return self.verbose != "none"
         return self.track_objective
+
+    def __post_init__(self):
+        # fail at construction, not mid-run (and identically on every
+        # learner path — streaming never reads chunked_driver)
+        if self.outer_chunk < 1:
+            raise ValueError(
+                f"outer_chunk must be >= 1, got {self.outer_chunk}"
+            )
+
+    @property
+    def chunked_driver(self) -> bool:
+        """True when the learner drivers must route through the chunked
+        (scan + optional donation) outer step: donation requires the
+        rollback state to live inside the jitted program, so
+        donate_state implies chunking even at outer_chunk=1."""
+        return self.outer_chunk > 1 or self.donate_state
 
 
 @dataclasses.dataclass(frozen=True)

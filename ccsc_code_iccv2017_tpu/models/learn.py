@@ -67,6 +67,22 @@ class OuterMetrics(NamedTuple):
     z_diff: jnp.ndarray  # rel change of codes (global norm)
 
 
+class ChunkTrace(NamedTuple):
+    """Per-step records of one chunked outer scan (each leaf [chunk]).
+
+    ``active``: the step actually attempted an iteration (False once the
+    chunk has early-stopped). ``adopted``: the step's iterate was
+    finite and became the new state — only these steps append trace
+    entries in the driver; an active-but-not-adopted step is the
+    non-finite divergence the per-step driver guards at
+    parallel/consensus.py (its metrics are reported so the driver can
+    print them, but the carried state is the last good iterate)."""
+
+    metrics: OuterMetrics
+    active: jnp.ndarray
+    adopted: jnp.ndarray
+
+
 def init_state(
     key: jax.Array,
     geom: ProblemGeom,
@@ -360,6 +376,81 @@ def outer_step(
 
     new_state = LearnState(d_local, dual_d, dbar, udbar, z, dual_z)
     return new_state, OuterMetrics(obj_d, obj_z, d_diff, z_diff)
+
+
+def outer_chunk_scan(
+    state: LearnState,
+    b_blocks: jnp.ndarray,
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    fg: common.FreqGeom,
+    num_blocks: int,
+    chunk: int,
+    axis_name: Optional[str] = None,
+    freq_axis_name: Optional[str] = None,
+    num_freq_shards: int = 1,
+    filter_axis_name: Optional[str] = None,
+) -> Tuple[LearnState, ChunkTrace]:
+    """``chunk`` outer consensus iterations as ONE lax.scan — a single
+    XLA dispatch, no host in the pacing loop (the multi-step-scan shape
+    of a training stack's inner loop; MPAX's jit-resident solver loops,
+    PAPERS.md arXiv:2412.09734).
+
+    The scan carry holds (state, done). Each step reproduces the
+    per-step driver's contract (parallel/consensus.py) at chunk
+    granularity:
+
+    - non-finite metrics -> the step is not adopted: the carry keeps
+      the last finite state, and ``done`` latches so the rest of the
+      chunk passes it through unchanged (the "last finite state" the
+      driver would have kept by breaking);
+    - tol early-stop -> the converged step IS adopted (the per-step
+      driver appends its trace entry before breaking), then ``done``
+      latches, so the chunked run lands on the same iterate.
+
+    Steps after ``done`` still execute arithmetically (a lax.cond
+    around a psum-bearing step does not compose with every shard_map
+    path) but their results are discarded and ``active`` marks them for
+    the driver; the waste is bounded by one chunk at the end of a run.
+    """
+
+    def body(carry, _):
+        st, done = carry
+        new_st, m = outer_step(
+            st,
+            b_blocks,
+            geom=geom,
+            cfg=cfg,
+            fg=fg,
+            num_blocks=num_blocks,
+            axis_name=axis_name,
+            freq_axis_name=freq_axis_name,
+            num_freq_shards=num_freq_shards,
+            filter_axis_name=filter_axis_name,
+        )
+        finite = jnp.all(
+            jnp.isfinite(jnp.stack([m.obj_d, m.obj_z, m.d_diff, m.z_diff]))
+        )
+        active = jnp.logical_not(done)
+        adopted = jnp.logical_and(active, finite)
+        st_out = jax.tree.map(
+            lambda n, o: jnp.where(adopted, n, o), new_st, st
+        )
+        converged = jnp.logical_and(
+            m.d_diff < cfg.tol, m.z_diff < cfg.tol
+        )
+        done_out = jnp.logical_or(
+            done,
+            jnp.logical_and(
+                active, jnp.logical_or(jnp.logical_not(finite), converged)
+            ),
+        )
+        return (st_out, done_out), ChunkTrace(m, active, adopted)
+
+    (state, _), tr = jax.lax.scan(
+        body, (state, jnp.zeros((), jnp.bool_)), None, length=chunk
+    )
+    return state, tr
 
 
 def eval_block(

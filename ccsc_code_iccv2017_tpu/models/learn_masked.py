@@ -231,6 +231,122 @@ _outer_step = functools.partial(
 )(_outer_step_impl)
 
 
+def _chunk_scan_impl(
+    state: MaskedLearnState,
+    prev: MaskedLearnState,
+    obj_best: jnp.ndarray,
+    b_pad: jnp.ndarray,
+    M_pad: jnp.ndarray,
+    smoothinit: jnp.ndarray,
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    fg: common.FreqGeom,
+    gamma_div_d: float,
+    gamma_div_z: float,
+    chunk: int,
+    freq_axis_name: Optional[str] = None,
+    num_freq_shards: int = 1,
+):
+    """``chunk`` masked outer iterations as ONE lax.scan dispatch — the
+    masked learner's equivalent of models.learn.outer_chunk_scan.
+
+    The per-step driver's two stopping rules move inside the scan:
+
+    - objective rollback (admm_learn.m:204-213): when neither pass
+      improved the best objective, the carry reverts BOTH iterates to
+      ``prev`` (the state before the previous adopted step — exactly
+      the per-step driver's ``state = prev``) and latches done;
+    - tol early-stop: the converged step is adopted first (its trace
+      entry counts), then done latches.
+
+    Returns (state, prev, obj_best, per-step records [chunk]):
+    (obj_d, obj_z, d_diff, z_diff, active, adopted, rolled). Steps
+    after done still execute arithmetically but are discarded
+    (``active`` False) — same trade as the consensus chunk scan.
+    """
+
+    def body(carry, _):
+        st, pv, best, done = carry
+        new, obj_d, obj_z, d_diff, z_diff = _outer_step_impl(
+            st, b_pad, M_pad, smoothinit, geom, cfg, fg,
+            gamma_div_d, gamma_div_z,
+            freq_axis_name=freq_axis_name,
+            num_freq_shards=num_freq_shards,
+        )
+        active = jnp.logical_not(done)
+        if cfg.with_objective:
+            regressed = jnp.logical_and(best <= obj_d, best <= obj_z)
+        else:
+            # rollback is disarmed without the objective (the step
+            # returns 0.0 placeholders — see the per-step driver note)
+            regressed = jnp.zeros((), jnp.bool_)
+        adopted = jnp.logical_and(active, jnp.logical_not(regressed))
+        rolled = jnp.logical_and(active, regressed)
+        st_out = jax.tree.map(
+            lambda p, s, n: jnp.where(rolled, p, jnp.where(adopted, n, s)),
+            pv, st, new,
+        )
+        pv_out = jax.tree.map(
+            lambda p, s: jnp.where(adopted, s, p), pv, st
+        )
+        best_out = jnp.where(
+            adopted, jnp.minimum(best, jnp.minimum(obj_d, obj_z)), best
+        )
+        converged = jnp.logical_and(d_diff < cfg.tol, z_diff < cfg.tol)
+        done_out = jnp.logical_or(
+            done, jnp.logical_and(active, jnp.logical_or(regressed, converged))
+        )
+        ys = (obj_d, obj_z, d_diff, z_diff, active, adopted, rolled)
+        return (st_out, pv_out, best_out, done_out), ys
+
+    (state, prev, obj_best, _), ys = jax.lax.scan(
+        body,
+        (state, prev, obj_best, jnp.zeros((), jnp.bool_)),
+        None,
+        length=chunk,
+    )
+    return state, prev, obj_best, ys
+
+
+@functools.lru_cache(maxsize=16)
+def _chunk_step(
+    geom, cfg, fg, gamma_div_d, gamma_div_z, chunk, donate, mesh=None
+):
+    """Jitted chunked masked step; with ``donate`` the two state trees
+    (current and rollback) are donated so XLA aliases every
+    MaskedLearnState leaf in place — the driver rebinds both and never
+    touches the old buffers. ``mesh``: optional 1-D ('freq',) mesh,
+    same TP scheme as _sharded_outer_step, the whole chunk shard_mapped
+    as one program."""
+    kwargs = dict(
+        geom=geom, cfg=cfg, fg=fg, gamma_div_d=gamma_div_d,
+        gamma_div_z=gamma_div_z, chunk=chunk,
+    )
+    donate_argnums = (0, 1) if donate else ()
+    if mesh is None:
+        fn = functools.partial(_chunk_scan_impl, **kwargs)
+        return jax.jit(fn, donate_argnums=donate_argnums)
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map
+
+    fn = functools.partial(
+        _chunk_scan_impl,
+        **kwargs,
+        freq_axis_name="freq",
+        num_freq_shards=mesh.shape["freq"],
+    )
+    rep = P()
+    sharded = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(rep,) * 6,
+        out_specs=(rep, rep, rep, (rep,) * 7),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=donate_argnums)
+
+
 @functools.lru_cache(maxsize=16)
 def _sharded_outer_step(geom, cfg, fg, gamma_div_d, gamma_div_z, mesh):
     """shard_map'd outer step over a 1-D 'freq' mesh: state and data
@@ -503,6 +619,94 @@ def learn_masked(
     ]
     obj_best = min(seen) if seen else jnp.inf
     t_total = trace["tim_vals"][-1]
+
+    if cfg.chunked_driver:
+        # ---- chunked driver: lax.scan chunks with the rollback and
+        # tol stop carried inside the scan (_chunk_scan_impl); ONE
+        # stacked readback per chunk; checkpoint cadence at chunk
+        # boundaries. The drain walk mirrors parallel/consensus.py's
+        # chunked branch (non-finite branch + figures there) —
+        # semantic fixes must land in BOTH.
+        import numpy as np
+
+        from ..utils import checkpoint as ckpt
+
+        # the rollback carry must be a DISTINCT buffer from the live
+        # state when both are donated (donating one buffer through two
+        # params is undefined) — pay one state copy up front
+        prev = (
+            jax.tree.map(jnp.copy, state) if cfg.donate_state else state
+        )
+        best = jnp.asarray(obj_best, jnp.float32)
+        i = start_it
+        stop = False
+        while i < cfg.max_it and not stop:
+            clen = min(cfg.outer_chunk, cfg.max_it - i)
+            stepc = _chunk_step(
+                geom, cfg, fg, gamma_div_d, gamma_div_z, clen,
+                cfg.donate_state, mesh,
+            )
+            t0 = time.perf_counter()
+            # state and prev are DONATED when cfg.donate_state —
+            # rebind both, never touch the old arrays
+            state, prev, best, ys = stepc(
+                state, prev, best, b_pad, M_pad, smoothinit
+            )
+            obj_d, obj_z, d_diff, z_diff, active, adopted, rolled = (
+                np.asarray(a, np.float64) if k < 4 else np.asarray(a)
+                for k, a in enumerate(ys)
+            )
+            dt = time.perf_counter() - t0
+            n_adopted = 0
+            for j in range(clen):
+                if not active[j]:
+                    break
+                if rolled[j]:
+                    if cfg.verbose in ("brief", "all"):
+                        print(
+                            f"Iter {i + j + 1}: objective regressed, "
+                            "rolling back"
+                        )
+                    stop = True
+                    break
+                n_adopted += 1
+                t_total += dt / clen
+                trace["obj_vals_d"].append(float(obj_d[j]))
+                trace["obj_vals_z"].append(float(obj_z[j]))
+                trace["tim_vals"].append(t_total)
+                trace["d_diff"].append(float(d_diff[j]))
+                trace["z_diff"].append(float(z_diff[j]))
+                if cfg.verbose in ("brief", "all"):
+                    print(
+                        f"Iter {i + j + 1}, Obj_d {obj_d[j]:.5g}, "
+                        f"Obj_z {obj_z[j]:.5g}, Diff_d {d_diff[j]:.3g}, "
+                        f"Diff_z {z_diff[j]:.3g}"
+                    )
+                if d_diff[j] < cfg.tol and z_diff[j] < cfg.tol:
+                    stop = True
+                    break
+            it_end = i + n_adopted
+            if (
+                checkpoint_dir is not None
+                and n_adopted
+                and it_end // checkpoint_every > i // checkpoint_every
+            ):
+                ckpt.save(checkpoint_dir, state, trace, it_end)
+            i = it_end
+
+        if checkpoint_dir is not None:
+            ckpt.save(checkpoint_dir, state, trace, cfg.max_it)
+        dhat = common.full_filters_to_freq(state.d_full, fg)
+        d_proj = proxes.kernel_constraint_proj(
+            state.d_full, geom.spatial_support, fg.spatial_shape
+        )
+        zhat = common.codes_to_freq(state.z.astype(jnp.float32), fg)
+        Dz = common.recon_from_freq(dhat, zhat, fg) + smoothinit
+        Dz = fourier.crop_spatial(Dz, radius, b.shape[-ndim_s:])
+        return LearnResult(
+            extract_filters(d_proj, geom), state.z[None], Dz, trace
+        )
+
     prev = state
     for i in range(start_it, cfg.max_it):
         t0 = time.perf_counter()

@@ -54,6 +54,29 @@ def _state_specs(batched: bool = True, filter_sharded: bool = False):
     )
 
 
+def _mesh_axis_kwargs(geom: ProblemGeom, mesh: Mesh):
+    """Shared mesh-axis wiring of the (chunked and per-step) outer
+    steps: the axis-name kwargs for models.learn.outer_step plus the
+    filter-sharding flag."""
+    has_freq = "freq" in mesh.axis_names
+    has_filter = "filter" in mesh.axis_names
+    nf = mesh.shape["freq"] if has_freq else 1
+    if has_filter:
+        nk = mesh.shape["filter"]
+        if geom.num_filters % nk:
+            raise ValueError(
+                f"num_filters={geom.num_filters} not divisible by "
+                f"mesh 'filter' axis {nk}"
+            )
+    kwargs = dict(
+        axis_name="block",
+        freq_axis_name="freq" if has_freq else None,
+        num_freq_shards=nf,
+        filter_axis_name="filter" if has_filter else None,
+    )
+    return kwargs, has_filter, not (has_freq or has_filter)
+
+
 def make_outer_step(
     geom: ProblemGeom,
     cfg: LearnConfig,
@@ -80,26 +103,14 @@ def make_outer_step(
         )
         return jax.jit(step)
 
-    has_freq = "freq" in mesh.axis_names
-    has_filter = "filter" in mesh.axis_names
-    nf = mesh.shape["freq"] if has_freq else 1
-    if has_filter:
-        nk = mesh.shape["filter"]
-        if geom.num_filters % nk:
-            raise ValueError(
-                f"num_filters={geom.num_filters} not divisible by "
-                f"mesh 'filter' axis {nk}"
-            )
+    axis_kwargs, has_filter, check_vma = _mesh_axis_kwargs(geom, mesh)
     step = functools.partial(
         learn_mod.outer_step,
         geom=geom,
         cfg=cfg,
         fg=fg,
         num_blocks=cfg.num_blocks,
-        axis_name="block",
-        freq_axis_name="freq" if has_freq else None,
-        num_freq_shards=nf,
-        filter_axis_name="filter" if has_filter else None,
+        **axis_kwargs,
     )
     metrics_specs = learn_mod.OuterMetrics(P(), P(), P(), P())
     specs = _state_specs(filter_sharded=has_filter)
@@ -108,9 +119,72 @@ def make_outer_step(
         mesh=mesh,
         in_specs=(specs, P("block")),
         out_specs=(specs, metrics_specs),
-        check_vma=not (has_freq or has_filter),
+        check_vma=check_vma,
     )
     return jax.jit(sharded)
+
+
+def make_outer_chunk_step(
+    geom: ProblemGeom,
+    cfg: LearnConfig,
+    fg: common.FreqGeom,
+    chunk: int,
+    mesh: Optional[Mesh] = None,
+    donate: bool = False,
+):
+    """Jitted CHUNKED outer step: ``chunk`` consensus iterations as one
+    lax.scan inside one dispatch (models.learn.outer_chunk_scan), with
+    the per-step driver's non-finite rollback and tol early-stop
+    carried inside the scan. Returns (state, models.learn.ChunkTrace).
+
+    ``donate=True`` donates the input LearnState
+    (jax.jit(..., donate_argnums=(0,))): XLA aliases every state leaf's
+    buffer in place instead of allocating a fresh output copy per call
+    — the caller MUST NOT touch the passed-in state afterwards (jax
+    raises on a deleted buffer; the learn driver immediately rebinds).
+    Works identically on the shard_map mesh path: donation is a
+    property of the outer jit, sharding of the aliased buffers is
+    unchanged."""
+    donate_argnums = (0,) if donate else ()
+    if mesh is None:
+        fn = functools.partial(
+            learn_mod.outer_chunk_scan,
+            geom=geom,
+            cfg=cfg,
+            fg=fg,
+            num_blocks=cfg.num_blocks,
+            chunk=chunk,
+            axis_name=None,
+        )
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
+    axis_kwargs, has_filter, check_vma = _mesh_axis_kwargs(geom, mesh)
+    fn = functools.partial(
+        learn_mod.outer_chunk_scan,
+        geom=geom,
+        cfg=cfg,
+        fg=fg,
+        num_blocks=cfg.num_blocks,
+        chunk=chunk,
+        **axis_kwargs,
+    )
+    tr_specs = learn_mod.ChunkTrace(
+        learn_mod.OuterMetrics(P(), P(), P(), P()), P(), P()
+    )
+    specs = _state_specs(filter_sharded=has_filter)
+    sharded = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(specs, P("block")),
+        out_specs=(specs, tr_specs),
+        # the scan's `done` carry enters as a constant (unknown
+        # replication) and leaves psum-derived (replicated) — the
+        # replication checker rejects that mismatch even though the
+        # value is identical on every device; the per-step path keeps
+        # the check
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=donate_argnums)
 
 
 def make_eval_fn(
@@ -316,6 +390,110 @@ def learn(
     from ..utils import profiling
 
     t_total = trace["tim_vals"][-1]
+    if cfg.chunked_driver:
+        # -------- chunked driver: lax.scan chunks, one readback per
+        # chunk, optional state donation (see make_outer_chunk_step).
+        # Trace entries stay per-iteration; non-finite rollback and tol
+        # early-stop keep the per-step contract at chunk granularity;
+        # checkpoint/figure cadence moves to chunk boundaries.
+        # NB the chunk-drain walk below (readback -> per-step trace ->
+        # stop checks -> checkpoint-crossing save) is mirrored in
+        # models/learn_masked.py's chunked branch (rolled branch + no
+        # figures there) — semantic fixes must land in BOTH.
+        import numpy as np
+
+        chunk_steps = {}
+
+        def _chunk_step(clen):
+            # at most 3 distinct lengths compile: outer_chunk, a
+            # partial first chunk after a mid-cadence resume, and a
+            # partial final chunk when max_it % outer_chunk != 0
+            if clen not in chunk_steps:
+                chunk_steps[clen] = make_outer_chunk_step(
+                    geom, cfg, fg, clen, mesh=mesh,
+                    donate=cfg.donate_state,
+                )
+            return chunk_steps[clen]
+
+        with profiling.xla_trace(profile_dir):
+            i = start_it
+            stop = False
+            while i < cfg.max_it and not stop:
+                clen = min(cfg.outer_chunk, cfg.max_it - i)
+                t0 = time.perf_counter()
+                with profiling.annotate(f"ccsc_outer_{i}_{i + clen}"):
+                    # state is DONATED when cfg.donate_state: the old
+                    # binding's buffers die inside this call; rebind
+                    # immediately and never touch the old arrays
+                    state, tr = _chunk_step(clen)(state, b_blocks)
+                    # ONE stacked readback per chunk — also the device
+                    # fence (block_until_ready is a no-op on axon)
+                    obj_d = np.asarray(tr.metrics.obj_d, np.float64)
+                    obj_z = np.asarray(tr.metrics.obj_z, np.float64)
+                    d_diff = np.asarray(tr.metrics.d_diff, np.float64)
+                    z_diff = np.asarray(tr.metrics.z_diff, np.float64)
+                    active = np.asarray(tr.active)
+                    adopted = np.asarray(tr.adopted)
+                dt = time.perf_counter() - t0
+                n_adopted = 0
+                for j in range(clen):
+                    if not active[j]:
+                        break  # post-early-stop tail of the chunk
+                    vals = (obj_d[j], obj_z[j], d_diff[j], z_diff[j])
+                    if not adopted[j]:
+                        # the per-step driver's divergence guard, at
+                        # chunk granularity: the scan already kept the
+                        # last finite iterate in `state`
+                        print(
+                            f"Iter {i + j + 1}: non-finite metrics "
+                            f"(obj_d={vals[0]}, obj_z={vals[1]}, "
+                            f"d_diff={vals[2]}, z_diff={vals[3]}); "
+                            "keeping last good state"
+                        )
+                        stop = True
+                        break
+                    n_adopted += 1
+                    # per-step wall time is not observable inside one
+                    # dispatch; the chunk's time is split evenly
+                    t_total += dt / clen
+                    trace["obj_vals_d"].append(float(vals[0]))
+                    trace["obj_vals_z"].append(float(vals[1]))
+                    trace["tim_vals"].append(t_total)
+                    trace["d_diff"].append(float(vals[2]))
+                    trace["z_diff"].append(float(vals[3]))
+                    if cfg.verbose in ("brief", "all"):
+                        print(
+                            f"Iter {i + j + 1}, Obj_d {vals[0]:.4g}, "
+                            f"Obj_z {vals[1]:.4g}, Diff_d {vals[2]:.3g}, "
+                            f"Diff_z {vals[3]:.3g}, t {t_total:.2f}s"
+                        )
+                    if vals[2] < cfg.tol and vals[3] < cfg.tol:
+                        stop = True
+                        break
+                it_end = i + n_adopted
+                if cfg.verbose == "all" and n_adopted:
+                    # figure cadence is per CHUNK here (the per-step
+                    # driver writes one panel per iteration)
+                    _write_figures(
+                        figures_dir or "ccsc_figures", it_end, eval_fn,
+                        state, b_blocks,
+                    )
+                if (
+                    checkpoint_dir is not None
+                    and n_adopted
+                    and it_end // checkpoint_every > i // checkpoint_every
+                ):
+                    # chunk-boundary cadence: save whenever this chunk
+                    # crossed a checkpoint_every multiple
+                    ckpt.save(checkpoint_dir, state, trace, it_end)
+                i = it_end
+
+        if checkpoint_dir is not None:
+            ckpt.save(checkpoint_dir, state, trace, cfg.max_it)
+        _, d_sup, Dz = eval_fn(state, b_blocks)
+        Dz = Dz.reshape(n, *Dz.shape[2:])
+        return learn_mod.LearnResult(d_sup, state.z, Dz, trace)
+
     with profiling.xla_trace(profile_dir):
         for i in range(start_it, cfg.max_it):
             t0 = time.perf_counter()

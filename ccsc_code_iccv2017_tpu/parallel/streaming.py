@@ -146,12 +146,31 @@ def learn_streaming(
     geom: ProblemGeom,
     cfg: LearnConfig,
     key: Optional[jax.Array] = None,
+    stream_mode: Optional[str] = None,
 ) -> learn_mod.LearnResult:
     """models.learn semantics with host-resident block state.
 
     b: [n, *reduce, *data_spatial] numpy (host). Device memory use is
     O(one block), independent of n.
-    """
+
+    ``stream_mode``: force a placement tier ('auto' | 'device' | 'kern'
+    | 'paged') — takes precedence over the CCSC_STREAM_MODE env knob
+    (kept as a fallback for scripts); 'auto'/None selects by the byte
+    budget below.
+
+    ``cfg.outer_chunk > 1`` moves the host fences of this
+    block-sequential loop to chunk granularity: the per-outer metric
+    scalars (objectives, d_diff, the z-diff sums) stay device-resident
+    and are read back in one flush every ``outer_chunk`` outer
+    iterations, with the verbose trace and the tol early-stop checked
+    at the same cadence. Unlike the in-memory chunked drivers there is
+    no last-good-state carry to freeze — the block state advances in
+    place — so iterations past a mid-chunk tol hit ARE part of the
+    returned state and are recorded in the trace too (state and trace
+    stay consistent); the stop can land up to outer_chunk-1 iterations
+    after the per-step driver's. tim_vals are charged per chunk
+    (readback-fenced wall time split evenly across the chunk's
+    iterations, same accounting as the in-memory chunked drivers)."""
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
     N = cfg.num_blocks
@@ -160,6 +179,14 @@ def learn_streaming(
         raise ValueError(
             "compat_coding is only supported by the in-memory consensus "
             "learner (models.learn)"
+        )
+    if cfg.donate_state:
+        # same contract: streaming has no whole-state jitted step to
+        # donate (its block tensors page by design); outer_chunk IS
+        # supported (chunk-granular readbacks, see docstring)
+        raise ValueError(
+            "donate_state is only supported by the in-memory learners "
+            "(models.learn / models.learn_masked)"
         )
     if n % N:
         raise ValueError(f"n={n} not divisible by num_blocks={N}")
@@ -227,7 +254,7 @@ def learn_streaming(
     budget = float(
         _os.environ.get("CCSC_STREAM_RESIDENT_GB", "10.0")
     ) * 1e9
-    mode = _os.environ.get("CCSC_STREAM_MODE", "auto")
+    mode = stream_mode or _os.environ.get("CCSC_STREAM_MODE", "auto")
     if mode == "auto":
         if state_bytes + kern_bytes + bhat_bytes + temp_bytes <= budget:
             mode = "device"
@@ -290,8 +317,56 @@ def learn_streaming(
         "z_diff": [0.0],
     }
     t_total = 0.0
+    # chunk-granular host fences: metric entries accumulate (as device
+    # scalars where the math ran on device) and are flushed — read
+    # back, appended to the trace, tol-checked — once per outer_chunk
+    # iterations. outer_chunk=1 flushes every iteration (the original
+    # per-step cadence).
+    pending = []
+    t_chunk0 = 0.0
+
+    def _flush():
+        """-> True when a flushed entry hit tol (stop the run).
+
+        EVERY pending entry is appended — the block state has already
+        advanced through all of them in place, so the trace must cover
+        them to stay consistent with the returned state. Reading the
+        floats first fences the chunk's device work, so the chunk wall
+        time (split evenly across its iterations, same accounting as
+        the in-memory chunked drivers) includes execution, not just
+        host enqueue."""
+        nonlocal t_total
+        vals = [
+            (
+                it,
+                float(o_d),
+                float(o_z),
+                float(dd),
+                float(np.sqrt(float(num)) / max(np.sqrt(float(den)), 1e-30)),
+            )
+            for it, o_d, o_z, dd, num, den in pending
+        ]
+        dt = time.perf_counter() - t_chunk0  # fenced by the floats above
+        stop = False
+        for it, o_d, o_z, dd, zd in vals:
+            t_total += dt / len(vals)
+            trace["obj_vals_z"].append(o_z)
+            trace["obj_vals_d"].append(o_d)
+            trace["tim_vals"].append(t_total)
+            trace["d_diff"].append(dd)
+            trace["z_diff"].append(zd)
+            if cfg.verbose in ("brief", "all"):
+                print(
+                    f"Iter {it + 1}, Obj_z {o_z:.4g}, Diff_d {dd:.3g}, "
+                    f"Diff_z {zd:.3g}, t {t_total:.2f}s"
+                )
+            if dd < cfg.tol and zd < cfg.tol:
+                stop = True
+        return stop
+
     for i in range(cfg.max_it):
-        t0 = time.perf_counter()
+        if not pending:
+            t_chunk0 = time.perf_counter()
         dbar_prev = dbar
 
         # ---- d-pass: Grams fixed at incoming codes -----------------
@@ -331,7 +406,8 @@ def learn_streaming(
             dbar = d_sum / N
             udbar = du_sum / N
         del kerns
-        d_diff = float(common.rel_change(dbar, dbar_prev))
+        # deferred scalar: stays on device until the chunk flush
+        d_diff = common.rel_change(dbar, dbar_prev)
 
         d_proj = f_prox(dbar, udbar)
         dhat_z = f_full_dhat(d_proj)
@@ -342,8 +418,8 @@ def learn_streaming(
         obj_d = 0.0
         if cfg.with_objective:
             for nn in range(N):
-                obj_d += float(
-                    f_obj_block(jnp.asarray(z[nn]), get_b(nn), dhat_z)
+                obj_d = obj_d + f_obj_block(
+                    jnp.asarray(z[nn]), get_b(nn), dhat_z
                 )
 
         # ---- z-pass: blocks fully independent ----------------------
@@ -358,10 +434,10 @@ def learn_streaming(
             if device_state:
                 # convergence sums on device: pulling z to host just
                 # for the norm would reintroduce the transfer this
-                # mode exists to avoid
+                # mode exists to avoid (read back at the chunk flush)
                 ssd, ssq = f_zdiff(z_new, jnp.asarray(z[nn]))
-                num += float(ssd)
-                den += float(ssq)
+                num = num + ssd
+                den = den + ssq
                 z[nn] = z_new
                 dual_z[nn] = du_new
             else:
@@ -374,23 +450,15 @@ def learn_streaming(
                 z[nn] = z_new_h
                 dual_z[nn] = np.asarray(du_new)
             if cfg.with_objective:
-                obj_z += float(
-                    f_obj_block(jnp.asarray(z[nn]), get_b(nn), dhat_z)
+                obj_z = obj_z + f_obj_block(
+                    jnp.asarray(z[nn]), get_b(nn), dhat_z
                 )
-        z_diff = float(np.sqrt(num) / max(np.sqrt(den), 1e-30))
-        t_total += time.perf_counter() - t0
-        trace["obj_vals_z"].append(obj_z)
-        trace["obj_vals_d"].append(obj_d)
-        trace["tim_vals"].append(t_total)
-        trace["d_diff"].append(d_diff)
-        trace["z_diff"].append(z_diff)
-        if cfg.verbose in ("brief", "all"):
-            print(
-                f"Iter {i + 1}, Obj_z {obj_z:.4g}, Diff_d {d_diff:.3g}, "
-                f"Diff_z {z_diff:.3g}, t {t_total:.2f}s"
-            )
-        if d_diff < cfg.tol and z_diff < cfg.tol:
-            break
+        pending.append((i, obj_d, obj_z, d_diff, num, den))
+        if len(pending) >= cfg.outer_chunk or i == cfg.max_it - 1:
+            stop = _flush()
+            pending = []
+            if stop:
+                break
 
     # final outputs, streamed per block
     d_sup = learn_mod.extract_filters(np.asarray(d_proj), geom)

@@ -113,6 +113,7 @@ def analytic_outer_step_cost(
     fused_z: bool = False,
     state_dtype_bytes: Optional[int] = None,
     d_state_dtype_bytes: Optional[int] = None,
+    donate_state: bool = False,
 ) -> Dict[str, float]:
     """Closed-form FLOP / HBM-byte count of ONE consensus outer step
     (models.learn.outer_step): the d-pass code-Gram + Cholesky +
@@ -187,6 +188,21 @@ def analytic_outer_step_cost(
         else:
             bytes_ += 4 * z_bytes  # z, dual, u2, xi2
             bytes_ += 3 * zh_bytes  # spectra through the solve
+    if not donate_state:
+        # absent donation, XLA materializes the step's output state
+        # into freshly allocated buffers at the jit boundary (the
+        # ~48 ms of pure layout copies the r5 xprof attributed in the
+        # tuned step): one extra read+write of the full ADMM state per
+        # outer step. LearnConfig.donate_state aliases the buffers in
+        # place and the copy disappears — so the donated cost model
+        # stops charging it.
+        db = d_state_dtype_bytes or dtype_bytes
+        state_out = (
+            2 * z_bytes  # z + dual_z
+            + 2 * N * k * W * S * db  # d_local + dual_d
+            + 2 * k * W * S * dtype_bytes  # dbar + udbar
+        )
+        bytes_ += 2 * state_out
     return {"flops": flops, "bytes": bytes_}
 
 

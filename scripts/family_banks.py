@@ -289,15 +289,48 @@ def main():
     fams = [f.strip() for f in args.families.split(",") if f.strip()]
     results = {}
 
+    # Execution-strategy knobs per family (used both for training and
+    # for the resume fingerprint below). 3D on TPU: the measured-
+    # accurate tuned strategy (PERF.md); HS per the r5 family A/B.
+    on_tpu = plat in ("tpu", "axon")
+    knobs_3d = (
+        dict(fft_impl="matmul", storage_dtype="bfloat16",
+             d_storage_dtype="bfloat16")
+        if on_tpu else {}
+    )
+    hs_knobs = (
+        dict(fft_impl="matmul", storage_dtype="bfloat16",
+             carry_freq=False)
+        if on_tpu else dict(carry_freq=True)
+    )
+
+    def _run_params(fam):
+        """Fingerprint of every input that shapes a family's result.
+        Stored inside result_<fam>.json; resume only skips the family
+        on an EXACT match, so a rerun with different --n/--side/
+        --max-it or knob picks cannot silently report stale results as
+        current (ADVICE r5)."""
+        base = dict(eval_max_it=args.eval_max_it)
+        if fam == "3d":
+            return dict(n=args.n, side=args.side, max_it=args.max_it,
+                        knobs=knobs_3d, **base)
+        if fam == "4d":
+            return dict(n=args.n, side=args.side, max_it=args.max_it,
+                        knobs={}, **base)
+        return dict(n=args.hs_n, side=args.hs_side,
+                    max_it=args.hs_max_it, knobs=hs_knobs, **base)
+
     # Per-family resume: each completed family writes result_<fam>.json
     # next to its bank; a rerun after an interruption (the tunnel died
     # 27 min into the r5 banks phase) skips families whose result file
-    # already exists instead of re-burning hours of chip time.
+    # already exists — and whose embedded run parameters exactly match
+    # this invocation — instead of re-burning hours of chip time.
     def _result_path(fam):
         return os.path.join(args.out, f"result_{fam}.json")
 
     def _record(fam):
         results[fam]["platform"] = plat
+        results[fam]["params"] = _run_params(fam)
         if not args.smoke:
             # atomic: a kill mid-write must not leave a truncated file
             # that poisons every later resume (the motivating failure
@@ -314,9 +347,19 @@ def main():
             if os.path.exists(_result_path(fam)):
                 try:
                     with open(_result_path(fam)) as f:
-                        results[fam] = json.load(f)
+                        stored = json.load(f)
                 except ValueError:
                     continue  # truncated/corrupt: re-run the family
+                if stored.get("params") != _run_params(fam):
+                    # different flags (or a pre-fingerprint file):
+                    # the stored result answers a different question
+                    print(
+                        f"resume: {fam} result exists but was produced "
+                        f"with params {stored.get('params')} != current "
+                        f"{_run_params(fam)}; re-running", flush=True,
+                    )
+                    continue
+                results[fam] = stored
                 print(f"resume: {fam} already complete, skipping",
                       flush=True)
                 fams.remove(fam)
@@ -348,11 +391,7 @@ def main():
         # train on the 16G chip, and bf16 state halves the rest. On
         # CPU (tunnel-outage fallback) keep pocketfft/f32: the DFT
         # matmuls are an MXU trade, not a host-CPU one.
-        knobs = (
-            dict(fft_impl="matmul", storage_dtype="bfloat16",
-                 d_storage_dtype="bfloat16")
-            if plat in ("tpu", "axon") else {}
-        )
+        knobs = knobs_3d
         cfg = LearnConfig(
             max_it=args.max_it, tol=1e-2, rho_d=5000.0, rho_z=1.0,
             num_blocks=8 if not args.smoke else 2,
@@ -459,12 +498,7 @@ def main():
         # carry wins (0.260 vs 0.201 baseline; carry LOSES on chip,
         # 0.237); on CPU carry wins 1.25x and pocketfft/f32 stays.
         # Bank quality is judged by held-out PSNR either way.
-        on_tpu = plat in ("tpu", "axon")
-        hs_knobs = (
-            dict(fft_impl="matmul", storage_dtype="bfloat16",
-                 carry_freq=False)
-            if on_tpu else dict(carry_freq=True)
-        )
+        # (hs_knobs hoisted above — shared with the resume fingerprint)
         cfg = LearnConfig(
             max_it=args.hs_max_it, tol=1e-3, verbose="brief",
             track_objective=True, **hs_knobs,

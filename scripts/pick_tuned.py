@@ -25,6 +25,10 @@ DEFAULTS = {
     "fused_z": False,
     "fused_z_precision": "highest",
     "herm_inv": "cholesky",
+    # chunked/donated outer driver (r6): trajectory-exact execution
+    # knobs, no accuracy-gate entry needed (tests/test_outer_chunk.py)
+    "outer_chunk": 1,
+    "donate_state": False,
 }
 
 # Accuracy gate (r5): the tuned default must stay in the "small

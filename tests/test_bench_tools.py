@@ -98,6 +98,32 @@ def test_last_onchip_fastest_may_differ_from_last(tmp_path):
     assert fastest["run"] == "speedster" and fastest["value"] == 3.1
 
 
+def test_emit_best_onchip_only_when_strictly_faster(tmp_path, capsys):
+    """emit() must compare VALUES, not object identity: an earlier arm
+    that ties the newest record is not a distinct faster record and
+    must not be re-emitted as best_onchip (ADVICE r5)."""
+    bench = _load_bench()
+    bench.REPO = str(tmp_path)
+    r = {"iters_per_sec": 0.01, "n": 8, "size": 24, "k": 8, "blocks": 2,
+         "platform": "cpu"}
+    _write_jsonl(tmp_path / "onchip_r5.jsonl", [
+        _rec("early_tie", 2.5),
+        _rec("newest", 2.5),
+    ])
+    bench.emit(r, degraded=True)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["last_onchip"]["run"] == "newest"
+    assert "best_onchip" not in out
+    # a strictly faster earlier arm still surfaces
+    _write_jsonl(tmp_path / "onchip_r5.jsonl", [
+        _rec("speedster", 3.0),
+        _rec("newest", 2.5),
+    ])
+    bench.emit(r, degraded=True)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["best_onchip"]["run"] == "speedster"
+
+
 def test_last_onchip_record_none_when_no_chip_rows(tmp_path):
     bench = _load_bench()
     _write_jsonl(tmp_path / "onchip_r5.jsonl", [

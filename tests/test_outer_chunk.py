@@ -1,0 +1,433 @@
+"""Chunked (lax.scan) outer driver + donated ADMM state
+(LearnConfig.outer_chunk / donate_state):
+
+- trajectory equality vs the per-step driver for the consensus AND
+  masked learners (the chunk is an execution strategy, not a new
+  algorithm), including partial chunks and mesh paths;
+- donation metadata: every LearnState leaf is input-output aliased in
+  the lowered executable, and the driver never touches a donated
+  buffer;
+- checkpoint/resume crossing chunk boundaries;
+- tol early-stop landing on the same iterate at chunk granularity;
+- the masked rollback carried inside the scan;
+- streaming chunk-granular readback cadence.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+from ccsc_code_iccv2017_tpu.models import common, learn as learn_mod
+from ccsc_code_iccv2017_tpu.models.learn import learn
+from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
+from ccsc_code_iccv2017_tpu.parallel import consensus
+
+
+def _b2d(n=8, size=16, seed=0):
+    r = np.random.default_rng(seed)
+    return jnp.asarray(r.normal(size=(n, size, size)).astype(np.float32))
+
+
+CFG = dict(
+    max_it=6, max_it_d=3, max_it_z=3, num_blocks=2, rho_d=500.0,
+    rho_z=10.0, lambda_prior=0.1, verbose="none", track_objective=True,
+    tol=0.0,
+)
+
+TRACE_KEYS = ("obj_vals_d", "obj_vals_z", "d_diff", "z_diff")
+
+
+def _assert_same_traj(ref, res, atol=1e-6, rtol=1e-6):
+    np.testing.assert_allclose(
+        np.asarray(ref.d), np.asarray(res.d), atol=atol
+    )
+    for k in TRACE_KEYS:
+        np.testing.assert_allclose(
+            ref.trace[k], res.trace[k], rtol=rtol, atol=atol,
+            err_msg=k,
+        )
+
+
+@pytest.mark.parametrize(
+    "chunk,donate", [(4, False), (4, True), (1, True), (3, False)]
+)
+def test_consensus_chunked_matches_per_step(chunk, donate):
+    """outer_chunk folds N iterations into one dispatch; max_it=6 with
+    chunk 4 exercises the partial final chunk. donate_state must not
+    change a single trace value (pure buffer aliasing)."""
+    b = _b2d()
+    geom = ProblemGeom((5, 5), 6)
+    ref = learn(b, geom, LearnConfig(**CFG), key=jax.random.PRNGKey(0))
+    res = learn(
+        b, geom,
+        LearnConfig(**CFG, outer_chunk=chunk, donate_state=donate),
+        key=jax.random.PRNGKey(0),
+    )
+    assert len(res.trace["obj_vals_z"]) == len(ref.trace["obj_vals_z"])
+    _assert_same_traj(ref, res)
+
+
+def test_chunked_matches_per_step_on_golden_fixture():
+    """The acceptance fixture: outer_chunk=4 on the golden 2D problem
+    (tests/test_golden.py seed/shape/config) equals the per-step driver
+    to float tolerance — chunking is an execution strategy, not a
+    behavioral change the golden strategy would need new values for."""
+    r = np.random.default_rng(7)
+    b = jnp.asarray(r.normal(size=(4, 16, 16)).astype(np.float32))
+    geom = ProblemGeom((5, 5), 6)
+    mk = lambda **e: LearnConfig(
+        max_it=4, max_it_d=3, max_it_z=3, num_blocks=2,
+        rho_d=500.0, rho_z=10.0, lambda_prior=0.5,
+        verbose="none", track_objective=True, **e,
+    )
+    ref = learn(b, geom, mk(), key=jax.random.PRNGKey(42))
+    res = learn(
+        b, geom, mk(outer_chunk=4, donate_state=True),
+        key=jax.random.PRNGKey(42),
+    )
+    _assert_same_traj(ref, res)
+
+
+def test_consensus_chunked_matches_on_block_mesh():
+    from ccsc_code_iccv2017_tpu.parallel.mesh import block_mesh
+
+    b = _b2d()
+    geom = ProblemGeom((5, 5), 6)
+    ref = learn(b, geom, LearnConfig(**CFG), key=jax.random.PRNGKey(0))
+    res = learn(
+        b, geom, LearnConfig(**CFG, outer_chunk=3, donate_state=True),
+        key=jax.random.PRNGKey(0), mesh=block_mesh(2),
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref.d), np.asarray(res.d), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        ref.trace["obj_vals_z"], res.trace["obj_vals_z"], rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("donate", [False, True])
+def test_masked_chunked_matches_per_step(donate):
+    geom = ProblemGeom((3, 3), 3, reduce_shape=(2,))
+    r = np.random.default_rng(0)
+    b = jnp.asarray(r.uniform(0.1, 1.0, (2, 2, 8, 8)).astype(np.float32))
+    kw = dict(
+        gamma_div_d=50.0, gamma_div_z=10.0, key=jax.random.PRNGKey(0)
+    )
+    mk = lambda **e: LearnConfig(
+        max_it=5, max_it_d=2, max_it_z=2, verbose="none", tol=0.0,
+        track_objective=True, **e,
+    )
+    ref = learn_masked(b, geom, mk(), **kw)
+    res = learn_masked(
+        b, geom, mk(outer_chunk=3, donate_state=donate), **kw
+    )
+    assert len(res.trace["obj_vals_z"]) == len(ref.trace["obj_vals_z"])
+    _assert_same_traj(ref, res)
+
+
+def test_donation_metadata_aliases_every_state_leaf():
+    """With donate_state the compiled chunk step must alias EVERY
+    LearnState leaf input->output (the acceptance criterion: assert on
+    the executable's donation metadata, which exists on CPU too)."""
+    b = _b2d()
+    geom = ProblemGeom((5, 5), 6)
+    cfg = LearnConfig(**CFG, outer_chunk=2, donate_state=True)
+    fg = common.FreqGeom.create(geom, b.shape[-2:])
+    state = learn_mod.init_state(
+        jax.random.PRNGKey(0), geom, fg, 2, 4
+    )
+    b_blocks = jnp.asarray(np.asarray(b).reshape(2, 4, 16, 16))
+    step = consensus.make_outer_chunk_step(
+        geom, cfg, fg, 2, mesh=None, donate=True
+    )
+    lowered = step.lower(state, b_blocks)
+    n_leaves = len(state)  # 6 LearnState arrays
+    assert lowered.as_text().count("tf.aliasing_output") == n_leaves
+    # and the HLO the executable actually carries records the aliasing
+    compiled = lowered.compile()
+    assert "input_output_alias" in compiled.as_text()
+
+
+def test_donated_buffers_are_not_reused_by_driver():
+    """After a donated call the old state buffers are dead (jax deletes
+    them on CPU): the direct-call probe shows the deletion actually
+    happens, and the learn() driver — which rebinds immediately — runs
+    to completion with results identical to the undonated path."""
+    b = _b2d()
+    geom = ProblemGeom((5, 5), 6)
+    cfg = LearnConfig(**CFG, outer_chunk=2, donate_state=True)
+    fg = common.FreqGeom.create(geom, b.shape[-2:])
+    state = learn_mod.init_state(jax.random.PRNGKey(0), geom, fg, 2, 4)
+    b_blocks = jnp.asarray(np.asarray(b).reshape(2, 4, 16, 16))
+    step = consensus.make_outer_chunk_step(
+        geom, cfg, fg, 2, mesh=None, donate=True
+    )
+    new_state, _ = step(state, b_blocks)
+    with pytest.raises(RuntimeError):
+        np.asarray(state.z)  # donated away
+    assert np.isfinite(np.asarray(new_state.z)).all()
+
+
+def test_chunk_checkpoint_resume_mid_chunk(tmp_path):
+    """A chunked run interrupted at an iteration that is NOT a chunk
+    multiple of the resumed run must still reproduce the uninterrupted
+    trajectory — the resume's first chunk is partial."""
+    ck = str(tmp_path / "ck")
+    b = _b2d(n=4, size=12, seed=1)
+    geom = ProblemGeom((3, 3), 4)
+    mk = lambda it, chunk: LearnConfig(
+        max_it=it, max_it_d=2, max_it_z=2, num_blocks=2, rho_d=50.0,
+        rho_z=2.0, tol=0.0, verbose="none", track_objective=True,
+        outer_chunk=chunk, donate_state=True,
+    )
+    full = learn(b, geom, mk(7, 4), key=jax.random.PRNGKey(0))
+    # interrupted after 3 iterations (chunks of 2: 2 + 1)
+    learn(
+        b, geom, mk(3, 2), key=jax.random.PRNGKey(0),
+        checkpoint_dir=ck, checkpoint_every=2,
+    )
+    # resume with chunk 4 from start_it=3: first chunk covers 3..7
+    resumed = learn(
+        b, geom, mk(7, 4), key=jax.random.PRNGKey(0),
+        checkpoint_dir=ck, checkpoint_every=2,
+    )
+    _assert_same_traj(full, resumed, atol=2e-5, rtol=1e-4)
+
+
+def test_chunk_tol_early_stop_lands_on_same_iterate():
+    """With a mid-trajectory tol both drivers must stop at the SAME
+    iteration with the same final iterate: the chunked scan adopts the
+    converged step (its trace entry counts) then freezes the carry."""
+    b = _b2d()
+    geom = ProblemGeom((5, 5), 6)
+    probe = learn(
+        b, geom, LearnConfig(**{**CFG, "max_it": 8}),
+        key=jax.random.PRNGKey(0),
+    )
+    # a tol that triggers strictly inside the run: the per-iteration
+    # max of both diffs, taken at 2/3 of the trajectory
+    dd = np.maximum(
+        np.asarray(probe.trace["d_diff"][1:]),
+        np.asarray(probe.trace["z_diff"][1:]),
+    )
+    tol = float(dd[len(dd) * 2 // 3] * 1.000001)
+    cfg_kw = {**CFG, "max_it": 8, "tol": tol}
+    ref = learn(b, geom, LearnConfig(**cfg_kw), key=jax.random.PRNGKey(0))
+    assert len(ref.trace["d_diff"]) < 9, "tol never triggered"
+    res = learn(
+        b, geom, LearnConfig(**cfg_kw, outer_chunk=3, donate_state=True),
+        key=jax.random.PRNGKey(0),
+    )
+    assert len(res.trace["d_diff"]) == len(ref.trace["d_diff"])
+    _assert_same_traj(ref, res)
+
+
+def test_chunk_nan_guard_keeps_last_good_state():
+    """Divergence mid-chunk: the scan's last-finite-state carry must
+    return the pre-divergence iterate (the per-step driver's contract
+    at tests/test_learn.py::test_nan_guard_keeps_last_good_state)."""
+    geom = ProblemGeom((3, 3), 4)
+    b = np.array(
+        jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12)), np.float32
+    )
+    b[0, 0, 0] = np.inf  # poison the data -> metrics go non-finite
+    cfg = LearnConfig(
+        max_it=4, max_it_d=1, max_it_z=1, num_blocks=2,
+        rho_d=50.0, rho_z=2.0, verbose="none", track_objective=True,
+        outer_chunk=2, donate_state=True,
+    )
+    res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0))
+    assert np.isfinite(np.asarray(res.d)).all()
+    assert np.isfinite(np.asarray(res.z)).all()
+    # no diverged iteration was adopted into the trace (entry 0 is the
+    # pre-loop obj0, inf for this poisoned data in BOTH drivers)
+    assert all(np.isfinite(res.trace["obj_vals_z"][1:]))
+
+
+def test_masked_chunk_rollback_returns_prev_state():
+    """The objective rollback carried inside the masked chunk scan:
+    with obj_best already below any reachable objective, the first
+    step must roll back — the scan returns the PREV iterate unchanged
+    and flags the step rolled, exactly the per-step driver's
+    state = prev; break."""
+    from ccsc_code_iccv2017_tpu.models import learn_masked as lm
+    from ccsc_code_iccv2017_tpu.ops import fourier
+
+    geom = ProblemGeom((3, 3), 3, reduce_shape=(2,))
+    r = np.random.default_rng(0)
+    b = jnp.asarray(r.uniform(0.1, 1.0, (2, 2, 8, 8)).astype(np.float32))
+    cfg = LearnConfig(
+        max_it=3, max_it_d=2, max_it_z=2, verbose="none", tol=0.0,
+        track_objective=True, outer_chunk=3,
+    )
+    fg = common.FreqGeom.create(geom, (8, 8))
+    radius = geom.psf_radius
+    b_pad = fourier.pad_spatial(b, radius, target=fg.spatial_shape)
+    M_pad = fourier.pad_spatial(
+        jnp.ones_like(b), radius, target=fg.spatial_shape
+    )
+    sm = jnp.zeros_like(b_pad)
+    kd, kz = jax.random.split(jax.random.PRNGKey(0))
+    d0 = jax.random.normal(kd, (3, 3, 3), jnp.float32)
+    d0 = jnp.broadcast_to(d0.reshape(3, 1, 3, 3), geom.filter_shape)
+    d_full = fourier.circ_embed(d0, fg.spatial_shape)
+    z0 = jax.random.normal(kz, (2, 3, *fg.spatial_shape), jnp.float32)
+    x_shape = (2, 2, *fg.spatial_shape)
+    state = lm.MaskedLearnState(
+        d_full, jnp.zeros(x_shape), jnp.zeros_like(d_full),
+        z0, jnp.zeros(x_shape), jnp.zeros_like(z0),
+    )
+    prev = jax.tree.map(lambda x: x + 1.0, state)  # distinguishable
+    stepc = lm._chunk_step(geom, cfg, fg, 50.0, 10.0, 3, False, None)
+    st, pv, best, ys = stepc(
+        state, prev, jnp.float32(1e-30), b_pad, M_pad, sm
+    )
+    rolled = np.asarray(ys[6])
+    active = np.asarray(ys[4])
+    assert rolled[0] and not rolled[1:].any()
+    assert active[0] and not active[1:].any()
+    # rollback adopted prev (the reference's revert-both-iterates)
+    for got, want in zip(st, prev):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_streaming_chunk_cadence_matches_per_step():
+    from ccsc_code_iccv2017_tpu.parallel import streaming
+
+    geom = ProblemGeom((3, 3), 4)
+    cfg = LearnConfig(
+        max_it=3, max_it_d=2, max_it_z=3, num_blocks=2, rho_d=50.0,
+        rho_z=2.0, verbose="none", track_objective=True,
+    )
+    b = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12)), np.float32
+    )
+    ref = streaming.learn_streaming(b, geom, cfg, key=jax.random.PRNGKey(0))
+    for mode in ("device", "paged"):
+        res = streaming.learn_streaming(
+            b, geom, dataclasses.replace(cfg, outer_chunk=2),
+            key=jax.random.PRNGKey(0), stream_mode=mode,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref.d), np.asarray(res.d), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            ref.trace["obj_vals_z"], res.trace["obj_vals_z"], rtol=1e-6
+        )
+
+
+def test_streaming_chunk_tol_stop_trace_consistent_with_state():
+    """Streaming has no last-good-state carry: a tol hit mid-chunk
+    stops at the CHUNK boundary, and the trace covers every iteration
+    the in-place state actually advanced through — the result equals a
+    fixed-iteration run of that length."""
+    from ccsc_code_iccv2017_tpu.parallel import streaming
+
+    geom = ProblemGeom((3, 3), 4)
+    base = LearnConfig(
+        max_it=6, max_it_d=2, max_it_z=3, num_blocks=2, rho_d=50.0,
+        rho_z=2.0, verbose="none", track_objective=True, tol=0.0,
+    )
+    b = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12)), np.float32
+    )
+    probe = streaming.learn_streaming(b, geom, base, key=jax.random.PRNGKey(0))
+    dd = np.maximum(
+        np.asarray(probe.trace["d_diff"][1:]),
+        np.asarray(probe.trace["z_diff"][1:]),
+    )
+    # 0-based trigger index 2 (1-based iteration 3): mid-chunk for
+    # chunk=2, and its boundary (4) is strictly before max_it
+    k = 2
+    tol = float(dd[k] * 1.000001)
+    chunk = 2
+    res = streaming.learn_streaming(
+        b, geom, dataclasses.replace(base, tol=tol, outer_chunk=chunk),
+        key=jax.random.PRNGKey(0),
+    )
+    n_done = len(res.trace["d_diff"]) - 1  # iterations actually run
+    assert n_done < 6, "tol never triggered"
+    assert n_done >= k + 1  # stopped at or after the per-step point
+    assert n_done % chunk == 0  # ...on a chunk boundary
+    # state is consistent with the trace: equals a fixed-length run
+    ref = streaming.learn_streaming(
+        b, geom, dataclasses.replace(base, max_it=n_done),
+        key=jax.random.PRNGKey(0),
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.d), np.asarray(ref.d), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        res.trace["obj_vals_z"], ref.trace["obj_vals_z"], rtol=1e-6
+    )
+
+
+def test_streaming_rejects_donate_state():
+    from ccsc_code_iccv2017_tpu.parallel import streaming
+
+    b = np.zeros((2, 8, 8), np.float32)
+    geom = ProblemGeom((3, 3), 2)
+    cfg = LearnConfig(
+        max_it=1, num_blocks=2, verbose="none", donate_state=True
+    )
+    with pytest.raises(ValueError, match="donate_state"):
+        streaming.learn_streaming(b, geom, cfg)
+
+
+def test_dispatch_stream_mode_requires_streaming():
+    """--stream-mode without --streaming is an explicit error, not a
+    silently-ignored env mutation (ADVICE r5)."""
+    import os
+
+    from ccsc_code_iccv2017_tpu.apps._dispatch import dispatch_learn
+
+    b = np.zeros((2, 8, 8), np.float32)
+    geom = ProblemGeom((3, 3), 2)
+    cfg = LearnConfig(max_it=1, num_blocks=2, verbose="none")
+    before = os.environ.get("CCSC_STREAM_MODE")
+    with pytest.raises(SystemExit, match="stream-mode"):
+        dispatch_learn(
+            b, geom, cfg, jax.random.PRNGKey(0), None,
+            streaming=False, stream_mode="device",
+        )
+    assert os.environ.get("CCSC_STREAM_MODE") == before
+
+
+def test_perfmodel_donation_drops_state_output_copy():
+    from ccsc_code_iccv2017_tpu.utils import perfmodel
+
+    kw = dict(
+        num_blocks=2, ni=4, k=8, spatial=(24, 24), num_freq=24 * 13,
+        max_it_d=3, max_it_z=5,
+    )
+    base = perfmodel.analytic_outer_step_cost(**kw)
+    don = perfmodel.analytic_outer_step_cost(**kw, donate_state=True)
+    assert don["flops"] == base["flops"]
+    assert don["bytes"] < base["bytes"]
+    # the delta is exactly one read+write of the full ADMM state
+    S = 24 * 24
+    state = (2 * 2 * 4 * 8 + 2 * 2 * 8 + 2 * 8) * S * 4
+    assert base["bytes"] - don["bytes"] == pytest.approx(2 * state)
+
+
+def test_outer_chunk_validated_at_construction():
+    """An invalid outer_chunk fails when the config is BUILT — the same
+    error on every learner path (streaming never reads chunked_driver,
+    so a property-time check would let it slip through there)."""
+    with pytest.raises(ValueError, match="outer_chunk"):
+        LearnConfig(outer_chunk=0)
+
+
+def test_newton_iters_env_resolution(monkeypatch):
+    from ccsc_code_iccv2017_tpu.ops import freq_solvers
+
+    monkeypatch.delenv("CCSC_HERM_INV_ITERS", raising=False)
+    assert freq_solvers.resolve_newton_iters() == 30
+    assert freq_solvers.resolve_newton_iters(7) == 7
+    monkeypatch.setenv("CCSC_HERM_INV_ITERS", "42")
+    assert freq_solvers.resolve_newton_iters() == 42
+    assert freq_solvers.resolve_newton_iters(7) == 7
