@@ -65,6 +65,30 @@ def add_perf_args(
         )
 
 
+def add_resilience_args(parser, checkpoint: bool = False) -> None:
+    """The shared resilience flags of the learner CLIs (one definition
+    so the vocabulary cannot drift): rho-backoff divergence recovery
+    (LearnConfig.max_recoveries / rho_backoff, utils.resilience).
+    ``checkpoint=True`` additionally adds --checkpoint-dir /
+    --checkpoint-every for the apps that did not already define them
+    (3D/4D)."""
+    parser.add_argument(
+        "--max-recoveries", type=int, default=0,
+        help="divergence recoveries per run: on non-finite metrics, "
+        "restore the last good state, back off rho by --rho-backoff "
+        "and retry (0 = historical stop-and-keep behavior; "
+        "LearnConfig.max_recoveries)",
+    )
+    parser.add_argument(
+        "--rho-backoff", type=float, default=0.5,
+        help="multiplicative rho backoff per recovery "
+        "(LearnConfig.rho_backoff)",
+    )
+    if checkpoint:
+        parser.add_argument("--checkpoint-dir", default=None)
+        parser.add_argument("--checkpoint-every", type=int, default=5)
+
+
 def add_mat_layout_arg(parser) -> None:
     """The shared --mat-layout flag for apps that accept .mat image
     stacks (one definition so the vocabulary cannot drift)."""
@@ -96,14 +120,17 @@ def dispatch_learn(
 
     ``solver`` is the non-streaming callable (default models.learn.learn;
     the hyperspectral app passes models.learn_masked.learn_masked) and
-    receives ``kwargs``. The streaming arm supports none of those
-    options: callers pass ``forbidden`` — a {"--cli-flag": value} map —
-    and any truthy entry is rejected BY ITS CLI NAME (an explicit error
-    beats silently ignoring a requested option). The hyperspectral
-    adjustments live here too: ``streaming_offset`` is subtracted from
-    the data (the smooth_init the masked objective would model,
-    learn_hyperspectral.m:16-17) and ``streaming_blocks`` shrinks to
-    the nearest divisor of n before replacing cfg.num_blocks."""
+    receives ``kwargs``. The streaming arm supports checkpointing
+    (checkpoint_dir / checkpoint_every ride through to
+    parallel.streaming's block-sequential snapshots) but none of the
+    other options: callers pass ``forbidden`` — a {"--cli-flag": value}
+    map — and any truthy entry is rejected BY ITS CLI NAME (an explicit
+    error beats silently ignoring a requested option). The
+    hyperspectral adjustments live here too: ``streaming_offset`` is
+    subtracted from the data (the smooth_init the masked objective
+    would model, learn_hyperspectral.m:16-17) and ``streaming_blocks``
+    shrinks to the nearest divisor of n before replacing
+    cfg.num_blocks."""
     # --stream-mode is passed straight into learn_streaming as an
     # argument (no process-global env mutation that would leak into
     # later learns in the same process); without --streaming it is an
@@ -117,6 +144,8 @@ def dispatch_learn(
                 "--streaming is single-device and does not combine "
                 "with --mesh"
             )
+        checkpoint_dir = kwargs.pop("checkpoint_dir", None)
+        checkpoint_every = kwargs.pop("checkpoint_every", 5)
         set_flags = [k for k, v in (forbidden or {}).items() if v]
         if set_flags:
             raise SystemExit(
@@ -142,7 +171,11 @@ def dispatch_learn(
             while n % blocks:
                 blocks -= 1
             cfg = dataclasses.replace(cfg, num_blocks=blocks)
-        res = learn_streaming(b, geom, cfg, key=key, stream_mode=stream_mode)
+        res = learn_streaming(
+            b, geom, cfg, key=key, stream_mode=stream_mode,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+        )
         if streaming_offset is not None:
             # learn_streaming codes the offset-subtracted data; restore
             # the offset so Dz means "full reconstruction" exactly like

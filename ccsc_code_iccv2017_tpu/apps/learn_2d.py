@@ -17,7 +17,9 @@ import time
 
 
 def build_parser() -> argparse.ArgumentParser:
-    from ._dispatch import add_mat_layout_arg, add_perf_args
+    from ._dispatch import (
+        add_mat_layout_arg, add_perf_args, add_resilience_args,
+    )
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="image folder")
@@ -57,6 +59,7 @@ def build_parser() -> argparse.ArgumentParser:
         "time (bounded HBM; parallel.streaming)",
     )
     add_perf_args(p, fused=True, streaming=True, chunk=True)
+    add_resilience_args(p)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -114,6 +117,8 @@ def main(argv=None):
         d_storage_dtype=args.d_storage_dtype,
         outer_chunk=args.outer_chunk,
         donate_state=args.donate_state,
+        max_recoveries=args.max_recoveries,
+        rho_backoff=args.rho_backoff,
     )
     mesh = block_mesh(args.mesh) if args.mesh else None
     init_d = (
@@ -130,9 +135,10 @@ def main(argv=None):
             mesh,
             streaming=True,
             stream_mode=args.stream_mode,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
             forbidden={
                 "--init-filters": args.init_filters,
-                "--checkpoint-dir": args.checkpoint_dir,
                 "--profile-dir": args.profile_dir,
             },
         )

@@ -39,9 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
         "time (bounded HBM; parallel.streaming)",
     )
     p.add_argument("--out", default="4d_filters_lightfield.mat")
-    from ._dispatch import add_perf_args
+    from ._dispatch import add_perf_args, add_resilience_args
 
     add_perf_args(p, streaming=True, chunk=True)
+    add_resilience_args(p, checkpoint=True)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -107,6 +108,8 @@ def main(argv=None):
         d_storage_dtype=args.d_storage_dtype,
         outer_chunk=args.outer_chunk,
         donate_state=args.donate_state,
+        max_recoveries=args.max_recoveries,
+        rho_backoff=args.rho_backoff,
     )
     from ._dispatch import dispatch_learn
 
@@ -114,6 +117,8 @@ def main(argv=None):
     res = dispatch_learn(
         b, geom, cfg, jax.random.PRNGKey(args.seed), mesh, args.streaming,
         stream_mode=args.stream_mode,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
     )
     save_filters(args.out, res.d, res.trace, layout="lightfield", Dz=res.Dz)
     print(f"saved {res.d.shape} filters to {args.out}")

@@ -41,9 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--streaming-blocks", type=int, default=4)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=5)
-    from ._dispatch import add_perf_args
+    from ._dispatch import add_perf_args, add_resilience_args
 
     add_perf_args(p, streaming=True, chunk=True)
+    add_resilience_args(p)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -109,6 +110,8 @@ def main(argv=None):
         storage_dtype=args.storage_dtype,
         outer_chunk=args.outer_chunk,
         donate_state=args.donate_state,
+        max_recoveries=args.max_recoveries,
+        rho_backoff=args.rho_backoff,
     )
     init_d = (
         jnp.asarray(load_filters_hyperspectral(args.init))
@@ -128,9 +131,10 @@ def main(argv=None):
             stream_mode=args.stream_mode,
             streaming_blocks=args.streaming_blocks,
             streaming_offset=sm,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
             forbidden={
                 "--init": args.init,
-                "--checkpoint-dir": args.checkpoint_dir,
             },
         )
         save_filters(args.out, res.d, res.trace, layout="hyperspectral", Dz=res.Dz)

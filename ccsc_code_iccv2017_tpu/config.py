@@ -194,6 +194,21 @@ class LearnConfig:
     # lives inside the jitted program — the driver never touches a
     # donated buffer after the call.
     donate_state: bool = False
+    # Divergence recovery (utils.resilience.RecoveryManager): when the
+    # non-finite metrics guard fires, restore the last good state,
+    # multiply rho_d/rho_z by rho_backoff, and retry — up to
+    # max_recoveries times per run, each event recorded in
+    # trace['recoveries']. 0 (default) keeps the historical
+    # stop-and-keep behavior exactly. The masked learner scales its
+    # gamma divisors (its rho analogs) by the same factor; the
+    # streaming learner restores the snapshot taken at the last
+    # readback flush (it keeps one only while recovery is armed).
+    max_recoveries: int = 0
+    # Multiplicative penalty backoff applied per recovery (the ADMM
+    # restart discipline of the multi-block literature, PAPERS.md
+    # arXiv:1312.3040 — a diverged rho was too aggressive for the data
+    # scale, so retry softer).
+    rho_backoff: float = 0.5
     # Carry the frequency-domain iterate across the masked learner's
     # inner scans instead of re-transforming the spatial iterate each
     # iteration. The spatial iterate is ALWAYS produced by an inverse
@@ -218,6 +233,14 @@ class LearnConfig:
         if self.outer_chunk < 1:
             raise ValueError(
                 f"outer_chunk must be >= 1, got {self.outer_chunk}"
+            )
+        if self.max_recoveries < 0:
+            raise ValueError(
+                f"max_recoveries must be >= 0, got {self.max_recoveries}"
+            )
+        if not (0.0 < self.rho_backoff <= 1.0):
+            raise ValueError(
+                f"rho_backoff must be in (0, 1], got {self.rho_backoff}"
             )
 
     @property

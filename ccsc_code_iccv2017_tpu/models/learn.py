@@ -142,6 +142,7 @@ def outer_step(
     freq_axis_name: Optional[str] = None,
     num_freq_shards: int = 1,
     filter_axis_name: Optional[str] = None,
+    poison=None,
 ) -> Tuple[LearnState, OuterMetrics]:
     """One outer consensus iteration over this device's L local blocks.
 
@@ -166,6 +167,13 @@ def outer_step(
     (code Gram, both solves' data-side sums, the Dz reconstruction) is
     one psum over this axis, everything else is k-local. Mutually
     exclusive with ``freq_axis_name`` (one inner TP axis at a time).
+
+    ``poison`` (chaos testing only, utils.faults): a static True or a
+    traced boolean scalar; when truthy the z iterate is overwritten
+    with NaN after the z-pass — the exact signature of a diverged
+    inner solve, so the drivers' non-finite guards and recovery paths
+    can be exercised deterministically. None (default) compiles to the
+    production program unchanged.
     """
     support = geom.spatial_support
     radius = geom.psf_radius
@@ -369,6 +377,10 @@ def outer_step(
         None,
         length=cfg.max_it_z,
     )
+    if poison is not None:
+        # chaos injection: NaN the iterate so every downstream metric
+        # (z_diff, obj_z) goes non-finite exactly like a real blow-up
+        z = jnp.where(poison, jnp.asarray(jnp.nan, z.dtype), z)
     num = _psum(jnp.sum((f32(z) - f32(state.z)) ** 2), global_axes)
     den = _psum(jnp.sum(f32(z) ** 2), global_axes)
     z_diff = jnp.sqrt(num) / jnp.maximum(jnp.sqrt(den), 1e-30)
@@ -390,6 +402,7 @@ def outer_chunk_scan(
     freq_axis_name: Optional[str] = None,
     num_freq_shards: int = 1,
     filter_axis_name: Optional[str] = None,
+    poison_at: Optional[int] = None,
 ) -> Tuple[LearnState, ChunkTrace]:
     """``chunk`` outer consensus iterations as ONE lax.scan — a single
     XLA dispatch, no host in the pacing loop (the multi-step-scan shape
@@ -412,9 +425,14 @@ def outer_chunk_scan(
     around a psum-bearing step does not compose with every shard_map
     path) but their results are discarded and ``active`` marks them for
     the driver; the waste is bounded by one chunk at the end of a run.
+
+    ``poison_at`` (chaos testing, utils.faults): 0-based step index
+    within this chunk whose z iterate is NaN-poisoned — exercising the
+    in-scan divergence guard and the driver's chunk-granular recovery
+    at the readback fence. None compiles the production scan.
     """
 
-    def body(carry, _):
+    def body(carry, x):
         st, done = carry
         new_st, m = outer_step(
             st,
@@ -427,6 +445,7 @@ def outer_chunk_scan(
             freq_axis_name=freq_axis_name,
             num_freq_shards=num_freq_shards,
             filter_axis_name=filter_axis_name,
+            poison=None if poison_at is None else (x == poison_at),
         )
         finite = jnp.all(
             jnp.isfinite(jnp.stack([m.obj_d, m.obj_z, m.d_diff, m.z_diff]))
@@ -447,8 +466,9 @@ def outer_chunk_scan(
         )
         return (st_out, done_out), ChunkTrace(m, active, adopted)
 
+    xs = None if poison_at is None else jnp.arange(chunk)
     (state, _), tr = jax.lax.scan(
-        body, (state, jnp.zeros((), jnp.bool_)), None, length=chunk
+        body, (state, jnp.zeros((), jnp.bool_)), xs, length=chunk
     )
     return state, tr
 

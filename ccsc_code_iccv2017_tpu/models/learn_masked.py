@@ -23,6 +23,7 @@ geom.reduce_shape=(31,); plain 2D works with reduce_shape=().
 from __future__ import annotations
 
 import functools
+import math
 import time
 from typing import NamedTuple, Optional, Tuple
 
@@ -56,6 +57,7 @@ def _outer_step_impl(
     gamma_div_z: float,
     freq_axis_name: Optional[str] = None,
     num_freq_shards: int = 1,
+    poison=None,
 ):
     """One outer iteration: d-ADMM (admm_learn.m:102-136) then z-ADMM
     (:165-200). Returns (state, obj_d, obj_z, d_diff, z_diff).
@@ -67,6 +69,11 @@ def _outer_step_impl(
     reassembles it for the replicated FFT boundary. State and data stay
     replicated — n is small in the hyperspectral workloads
     (learn_hyperspectral.m), the spectrum is the big axis.
+
+    ``poison`` (chaos testing only, utils.faults): static True or a
+    traced boolean scalar; when truthy the z iterate is NaN-poisoned
+    after the z-pass so the drivers' non-finite guards fire exactly as
+    on a real divergence. None compiles the production program.
     """
     support = geom.spatial_support
     radius = geom.psf_radius
@@ -207,6 +214,10 @@ def _outer_step_impl(
         None,
         length=cfg.max_it_z,
     )
+    if poison is not None:
+        # chaos injection: NaN the iterate so z_diff/obj_z go
+        # non-finite exactly like a real blow-up
+        z = jnp.where(poison, jnp.asarray(jnp.nan, z.dtype), z)
     z_diff = common.rel_change(z, state.z)
     if cfg.with_objective:
         zhat_z = (
@@ -246,12 +257,17 @@ def _chunk_scan_impl(
     chunk: int,
     freq_axis_name: Optional[str] = None,
     num_freq_shards: int = 1,
+    poison_at: Optional[int] = None,
 ):
     """``chunk`` masked outer iterations as ONE lax.scan dispatch — the
     masked learner's equivalent of models.learn.outer_chunk_scan.
 
-    The per-step driver's two stopping rules move inside the scan:
+    The per-step driver's three stopping rules move inside the scan:
 
+    - non-finite metrics -> the step is not adopted: the carry keeps
+      the last finite state and latches done (the divergence the
+      driver's guard — and optionally its rho-backoff recovery —
+      handles at the readback fence);
     - objective rollback (admm_learn.m:204-213): when neither pass
       improved the best objective, the carry reverts BOTH iterates to
       ``prev`` (the state before the previous adopted step — exactly
@@ -260,18 +276,27 @@ def _chunk_scan_impl(
       entry counts), then done latches.
 
     Returns (state, prev, obj_best, per-step records [chunk]):
-    (obj_d, obj_z, d_diff, z_diff, active, adopted, rolled). Steps
-    after done still execute arithmetically but are discarded
-    (``active`` False) — same trade as the consensus chunk scan.
+    (obj_d, obj_z, d_diff, z_diff, active, adopted, rolled). A step
+    with ``active`` True but neither ``adopted`` nor ``rolled`` is a
+    non-finite divergence. Steps after done still execute
+    arithmetically but are discarded (``active`` False) — same trade
+    as the consensus chunk scan.
+
+    ``poison_at`` (chaos testing, utils.faults): 0-based step index
+    within this chunk whose z iterate is NaN-poisoned.
     """
 
-    def body(carry, _):
+    def body(carry, x):
         st, pv, best, done = carry
         new, obj_d, obj_z, d_diff, z_diff = _outer_step_impl(
             st, b_pad, M_pad, smoothinit, geom, cfg, fg,
             gamma_div_d, gamma_div_z,
             freq_axis_name=freq_axis_name,
             num_freq_shards=num_freq_shards,
+            poison=None if poison_at is None else (x == poison_at),
+        )
+        finite = jnp.all(
+            jnp.isfinite(jnp.stack([obj_d, obj_z, d_diff, z_diff]))
         )
         active = jnp.logical_not(done)
         if cfg.with_objective:
@@ -280,8 +305,10 @@ def _chunk_scan_impl(
             # rollback is disarmed without the objective (the step
             # returns 0.0 placeholders — see the per-step driver note)
             regressed = jnp.zeros((), jnp.bool_)
-        adopted = jnp.logical_and(active, jnp.logical_not(regressed))
-        rolled = jnp.logical_and(active, regressed)
+        adopted = jnp.logical_and(
+            active, jnp.logical_and(finite, jnp.logical_not(regressed))
+        )
+        rolled = jnp.logical_and(active, jnp.logical_and(finite, regressed))
         st_out = jax.tree.map(
             lambda p, s, n: jnp.where(rolled, p, jnp.where(adopted, n, s)),
             pv, st, new,
@@ -294,15 +321,23 @@ def _chunk_scan_impl(
         )
         converged = jnp.logical_and(d_diff < cfg.tol, z_diff < cfg.tol)
         done_out = jnp.logical_or(
-            done, jnp.logical_and(active, jnp.logical_or(regressed, converged))
+            done,
+            jnp.logical_and(
+                active,
+                jnp.logical_or(
+                    jnp.logical_not(finite),
+                    jnp.logical_or(regressed, converged),
+                ),
+            ),
         )
         ys = (obj_d, obj_z, d_diff, z_diff, active, adopted, rolled)
         return (st_out, pv_out, best_out, done_out), ys
 
+    xs = None if poison_at is None else jnp.arange(chunk)
     (state, prev, obj_best, _), ys = jax.lax.scan(
         body,
         (state, prev, obj_best, jnp.zeros((), jnp.bool_)),
-        None,
+        xs,
         length=chunk,
     )
     return state, prev, obj_best, ys
@@ -310,17 +345,19 @@ def _chunk_scan_impl(
 
 @functools.lru_cache(maxsize=16)
 def _chunk_step(
-    geom, cfg, fg, gamma_div_d, gamma_div_z, chunk, donate, mesh=None
+    geom, cfg, fg, gamma_div_d, gamma_div_z, chunk, donate, mesh=None,
+    poison_at=None,
 ):
     """Jitted chunked masked step; with ``donate`` the two state trees
     (current and rollback) are donated so XLA aliases every
     MaskedLearnState leaf in place — the driver rebinds both and never
     touches the old buffers. ``mesh``: optional 1-D ('freq',) mesh,
     same TP scheme as _sharded_outer_step, the whole chunk shard_mapped
-    as one program."""
+    as one program. ``poison_at``: chaos NaN injection at that 0-based
+    step of the chunk (baked statically — no in_spec changes)."""
     kwargs = dict(
         geom=geom, cfg=cfg, fg=fg, gamma_div_d=gamma_div_d,
-        gamma_div_z=gamma_div_z, chunk=chunk,
+        gamma_div_z=gamma_div_z, chunk=chunk, poison_at=poison_at,
     )
     donate_argnums = (0, 1) if donate else ()
     if mesh is None:
@@ -348,10 +385,13 @@ def _chunk_step(
 
 
 @functools.lru_cache(maxsize=16)
-def _sharded_outer_step(geom, cfg, fg, gamma_div_d, gamma_div_z, mesh):
+def _sharded_outer_step(
+    geom, cfg, fg, gamma_div_d, gamma_div_z, mesh, poison=None
+):
     """shard_map'd outer step over a 1-D 'freq' mesh: state and data
     replicated, per-frequency solves sharded (TP), one tiled all_gather
-    per inner iteration."""
+    per inner iteration. ``poison``: chaos NaN injection, baked
+    statically (no in_spec changes)."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import shard_map
@@ -366,6 +406,7 @@ def _sharded_outer_step(geom, cfg, fg, gamma_div_d, gamma_div_z, mesh):
         gamma_div_z=gamma_div_z,
         freq_axis_name="freq",
         num_freq_shards=nf,
+        poison=poison,
     )
     rep = P()
     sharded = shard_map(
@@ -488,7 +529,18 @@ def learn_masked(
 
     ``checkpoint_dir``: atomic full-state snapshots every
     ``checkpoint_every`` outer iterations and resume-on-restart, same
-    protocol as the consensus learner (utils.checkpoint)."""
+    protocol as the consensus learner (utils.checkpoint).
+
+    Resilience (utils.resilience): with ``cfg.max_recoveries > 0`` a
+    non-finite step restores the last good state, backs off the gamma
+    divisors (this learner's rho analogs) by ``cfg.rho_backoff`` and
+    retries; SIGTERM/SIGINT checkpoint-and-exit cleanly at the next
+    boundary; checkpoints carry a config fingerprint. The objective-
+    regression rollback (admm_learn.m:204-213) keeps its historical
+    stop semantics — recovery only arms the non-finite guard."""
+    from ..utils import checkpoint as ckpt
+    from ..utils import faults, resilience
+
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
     radius = geom.psf_radius
@@ -571,30 +623,16 @@ def learn_masked(
         "d_diff": [],
         "z_diff": [],
     }
-    if mesh is not None:
-        if mesh.axis_names != ("freq",):
-            raise ValueError(
-                f"learn_masked expects a 1-D ('freq',) mesh, got "
-                f"{mesh.axis_names}"
-            )
-        step = _sharded_outer_step(
-            geom, cfg, fg, gamma_div_d, gamma_div_z, mesh
-        )
-    else:
-        step = functools.partial(
-            _outer_step,
-            geom=geom,
-            cfg=cfg,
-            fg=fg,
-            gamma_div_d=gamma_div_d,
-            gamma_div_z=gamma_div_z,
+    if mesh is not None and mesh.axis_names != ("freq",):
+        raise ValueError(
+            f"learn_masked expects a 1-D ('freq',) mesh, got "
+            f"{mesh.axis_names}"
         )
 
+    fingerprint = resilience.config_fingerprint(geom, cfg, "masked_admm")
     start_it = 0
     if checkpoint_dir is not None:
-        from ..utils import checkpoint as ckpt
-
-        snap = ckpt.load(checkpoint_dir)
+        snap = ckpt.load(checkpoint_dir, expect_fingerprint=fingerprint)
         if snap is not None:
             fields, resumed_trace, start_it = snap
             expect = {f: getattr(state, f).shape for f in state._fields}
@@ -619,6 +657,38 @@ def learn_masked(
     ]
     obj_best = min(seen) if seen else jnp.inf
     t_total = trace["tim_vals"][-1]
+    it_done = start_it
+    saved_it = None  # last iteration committed to the checkpoint dir
+
+    # rho-backoff recovery: the gamma divisors are this learner's rho
+    # analogs; recov.scale re-applies any recoveries a resumed trace
+    # recorded so the retried run keeps its backed-off penalties
+    recov = resilience.RecoveryManager(cfg, trace)
+
+    def _gammas():
+        return gamma_div_d * recov.scale, gamma_div_z * recov.scale
+
+    def _make_step():
+        gd, gz = _gammas()
+        if mesh is not None:
+            return _sharded_outer_step(geom, cfg, fg, gd, gz, mesh)
+        return functools.partial(
+            _outer_step, geom=geom, cfg=cfg, fg=fg,
+            gamma_div_d=gd, gamma_div_z=gz,
+        )
+
+    def _make_poisoned_step():
+        gd, gz = _gammas()
+        if mesh is not None:
+            return _sharded_outer_step(
+                geom, cfg, fg, gd, gz, mesh, poison=True
+            )
+        return functools.partial(
+            _outer_step, geom=geom, cfg=cfg, fg=fg,
+            gamma_div_d=gd, gamma_div_z=gz, poison=True,
+        )
+
+    step = _make_step()
 
     if cfg.chunked_driver:
         # ---- chunked driver: lax.scan chunks with the rollback and
@@ -629,8 +699,6 @@ def learn_masked(
         # semantic fixes must land in BOTH.
         import numpy as np
 
-        from ..utils import checkpoint as ckpt
-
         # the rollback carry must be a DISTINCT buffer from the live
         # state when both are donated (donating one buffer through two
         # params is undefined) — pay one state copy up front
@@ -638,64 +706,112 @@ def learn_masked(
             jax.tree.map(jnp.copy, state) if cfg.donate_state else state
         )
         best = jnp.asarray(obj_best, jnp.float32)
-        i = start_it
-        stop = False
-        while i < cfg.max_it and not stop:
-            clen = min(cfg.outer_chunk, cfg.max_it - i)
-            stepc = _chunk_step(
-                geom, cfg, fg, gamma_div_d, gamma_div_z, clen,
-                cfg.donate_state, mesh,
-            )
-            t0 = time.perf_counter()
-            # state and prev are DONATED when cfg.donate_state —
-            # rebind both, never touch the old arrays
-            state, prev, best, ys = stepc(
-                state, prev, best, b_pad, M_pad, smoothinit
-            )
-            obj_d, obj_z, d_diff, z_diff, active, adopted, rolled = (
-                np.asarray(a, np.float64) if k < 4 else np.asarray(a)
-                for k, a in enumerate(ys)
-            )
-            dt = time.perf_counter() - t0
-            n_adopted = 0
-            for j in range(clen):
-                if not active[j]:
-                    break
-                if rolled[j]:
+        with resilience.GracefulShutdown() as gs:
+            i = start_it
+            stop = False
+            while i < cfg.max_it and not stop:
+                clen = min(cfg.outer_chunk, cfg.max_it - i)
+                gd, gz = _gammas()
+                na = faults.nan_iteration()
+                poisoned = na is not None and i + 1 <= na <= i + clen
+                stepc = _chunk_step(
+                    geom, cfg, fg, gd, gz, clen, cfg.donate_state, mesh,
+                    poison_at=na - (i + 1) if poisoned else None,
+                )
+                t0 = time.perf_counter()
+                # state and prev are DONATED when cfg.donate_state —
+                # rebind both, never touch the old arrays
+                state, prev, best, ys = stepc(
+                    state, prev, best, b_pad, M_pad, smoothinit
+                )
+                obj_d, obj_z, d_diff, z_diff, active, adopted, rolled = (
+                    np.asarray(a, np.float64) if k < 4 else np.asarray(a)
+                    for k, a in enumerate(ys)
+                )
+                if poisoned:
+                    faults.consume_nan()
+                dt = time.perf_counter() - t0
+                n_adopted = 0
+                for j in range(clen):
+                    if not active[j]:
+                        break
+                    if rolled[j]:
+                        if cfg.verbose in ("brief", "all"):
+                            print(
+                                f"Iter {i + j + 1}: objective regressed, "
+                                "rolling back"
+                            )
+                        stop = True
+                        break
+                    if not adopted[j]:
+                        # non-finite divergence (neither adopted nor
+                        # rolled): the scan kept the last finite state
+                        # in `state` — recover at the readback fence
+                        # or keep today's stop-and-keep behavior
+                        print(
+                            f"Iter {i + j + 1}: non-finite metrics "
+                            f"(obj_d={obj_d[j]}, obj_z={obj_z[j]}, "
+                            f"d_diff={d_diff[j]}, z_diff={z_diff[j]}); "
+                            "keeping last good state"
+                        )
+                        ev = recov.on_divergence(i + j + 1)
+                        if ev is None:
+                            stop = True
+                        else:
+                            trace.setdefault("recoveries", []).append(ev)
+                        break
+                    n_adopted += 1
+                    t_total += dt / clen
+                    trace["obj_vals_d"].append(float(obj_d[j]))
+                    trace["obj_vals_z"].append(float(obj_z[j]))
+                    trace["tim_vals"].append(t_total)
+                    trace["d_diff"].append(float(d_diff[j]))
+                    trace["z_diff"].append(float(z_diff[j]))
                     if cfg.verbose in ("brief", "all"):
                         print(
-                            f"Iter {i + j + 1}: objective regressed, "
-                            "rolling back"
+                            f"Iter {i + j + 1}, Obj_d {obj_d[j]:.5g}, "
+                            f"Obj_z {obj_z[j]:.5g}, Diff_d {d_diff[j]:.3g}, "
+                            f"Diff_z {z_diff[j]:.3g}"
                         )
-                    stop = True
-                    break
-                n_adopted += 1
-                t_total += dt / clen
-                trace["obj_vals_d"].append(float(obj_d[j]))
-                trace["obj_vals_z"].append(float(obj_z[j]))
-                trace["tim_vals"].append(t_total)
-                trace["d_diff"].append(float(d_diff[j]))
-                trace["z_diff"].append(float(z_diff[j]))
-                if cfg.verbose in ("brief", "all"):
-                    print(
-                        f"Iter {i + j + 1}, Obj_d {obj_d[j]:.5g}, "
-                        f"Obj_z {obj_z[j]:.5g}, Diff_d {d_diff[j]:.3g}, "
-                        f"Diff_z {z_diff[j]:.3g}"
+                    if d_diff[j] < cfg.tol and z_diff[j] < cfg.tol:
+                        stop = True
+                        break
+                it_end = i + n_adopted
+                it_done = it_end
+                if n_adopted:
+                    faults.sigterm_tick(it_end)
+                # marker BEFORE the save: one write carries both the
+                # state and the preemption marker
+                preempting = (
+                    gs.requested and not stop and it_end < cfg.max_it
+                )
+                if preempting:
+                    trace.setdefault("preemptions", []).append(it_end)
+                crossed = (
+                    n_adopted
+                    and it_end // checkpoint_every > i // checkpoint_every
+                )
+                if checkpoint_dir is not None and (
+                    (crossed and saved_it != it_end) or preempting
+                ):
+                    ckpt.save(
+                        checkpoint_dir, state, trace, it_end,
+                        fingerprint=fingerprint,
                     )
-                if d_diff[j] < cfg.tol and z_diff[j] < cfg.tol:
+                    saved_it = it_end
+                if preempting:
+                    print(
+                        f"preempted: checkpointed iteration {it_end}, "
+                        "exiting cleanly"
+                    )
                     stop = True
-                    break
-            it_end = i + n_adopted
-            if (
-                checkpoint_dir is not None
-                and n_adopted
-                and it_end // checkpoint_every > i // checkpoint_every
-            ):
-                ckpt.save(checkpoint_dir, state, trace, it_end)
-            i = it_end
+                i = it_end
 
-        if checkpoint_dir is not None:
-            ckpt.save(checkpoint_dir, state, trace, cfg.max_it)
+        if checkpoint_dir is not None and saved_it != it_done:
+            ckpt.save(
+                checkpoint_dir, state, trace, it_done,
+                fingerprint=fingerprint,
+            )
         dhat = common.full_filters_to_freq(state.d_full, fg)
         d_proj = proxes.kernel_constraint_proj(
             state.d_full, geom.spatial_support, fg.spatial_shape
@@ -708,49 +824,96 @@ def learn_masked(
         )
 
     prev = state
-    for i in range(start_it, cfg.max_it):
-        t0 = time.perf_counter()
-        new_state, obj_d, obj_z, d_diff, z_diff = step(
-            state,
-            b_pad,
-            M_pad,
-            smoothinit,
-        )
-        obj_d, obj_z = float(obj_d), float(obj_z)  # also the fence
-        d_diff, z_diff = float(d_diff), float(z_diff)
-        t_total += time.perf_counter() - t0
-        # rollback (admm_learn.m:204-213): no pass improved the best.
-        # Requires tracking: with with_objective off the step returns
-        # 0.0 placeholders and the regression test would always fire —
-        # objective-rollback failure detection is only armed when the
-        # objective is computed (the reference always computes it;
-        # with tracking off you trade that guard for ~2 fewer
-        # reconstruction passes per outer iteration)
-        if cfg.with_objective and obj_best <= obj_d and obj_best <= obj_z:
-            if cfg.verbose in ("brief", "all"):
-                print(f"Iter {i + 1}: objective regressed, rolling back")
-            state = prev
-            break
-        prev = state
-        state = new_state
-        obj_best = min(obj_best, obj_d, obj_z)
-        trace["obj_vals_d"].append(obj_d)
-        trace["obj_vals_z"].append(obj_z)
-        trace["tim_vals"].append(t_total)
-        trace["d_diff"].append(d_diff)
-        trace["z_diff"].append(z_diff)
-        if cfg.verbose in ("brief", "all"):
-            print(
-                f"Iter {i + 1}, Obj_d {obj_d:.5g}, Obj_z {obj_z:.5g}, "
-                f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}"
+    with resilience.GracefulShutdown() as gs:
+        i = start_it
+        while i < cfg.max_it:
+            t0 = time.perf_counter()
+            na = faults.nan_iteration()
+            stepf = _make_poisoned_step() if na == i + 1 else step
+            new_state, obj_d, obj_z, d_diff, z_diff = stepf(
+                state,
+                b_pad,
+                M_pad,
+                smoothinit,
             )
-        if checkpoint_dir is not None and (i + 1) % checkpoint_every == 0:
-            ckpt.save(checkpoint_dir, state, trace, i + 1)
-        if d_diff < cfg.tol and z_diff < cfg.tol:
-            break
+            if na == i + 1:
+                faults.consume_nan()
+            obj_d, obj_z = float(obj_d), float(obj_z)  # also the fence
+            d_diff, z_diff = float(d_diff), float(z_diff)
+            t_total += time.perf_counter() - t0
+            # non-finite guard (mirrors the consensus driver): NaN
+            # metrics would sail through the regression test below
+            # (best <= nan is False) and poison the adopted state —
+            # keep the last good iterate instead, and with
+            # cfg.max_recoveries back off the gammas and retry
+            if not all(
+                math.isfinite(v) for v in (obj_d, obj_z, d_diff, z_diff)
+            ):
+                print(
+                    f"Iter {i + 1}: non-finite metrics "
+                    f"(obj_d={obj_d}, obj_z={obj_z}, d_diff={d_diff}, "
+                    f"z_diff={z_diff}); keeping last good state"
+                )
+                ev = recov.on_divergence(i + 1)
+                if ev is None:
+                    break
+                trace.setdefault("recoveries", []).append(ev)
+                step = _make_step()
+                continue  # retry iteration i with backed-off gammas
+            # rollback (admm_learn.m:204-213): no pass improved the best.
+            # Requires tracking: with with_objective off the step returns
+            # 0.0 placeholders and the regression test would always fire —
+            # objective-rollback failure detection is only armed when the
+            # objective is computed (the reference always computes it;
+            # with tracking off you trade that guard for ~2 fewer
+            # reconstruction passes per outer iteration)
+            if cfg.with_objective and obj_best <= obj_d and obj_best <= obj_z:
+                if cfg.verbose in ("brief", "all"):
+                    print(f"Iter {i + 1}: objective regressed, rolling back")
+                state = prev
+                break
+            prev = state
+            state = new_state
+            obj_best = min(obj_best, obj_d, obj_z)
+            trace["obj_vals_d"].append(obj_d)
+            trace["obj_vals_z"].append(obj_z)
+            trace["tim_vals"].append(t_total)
+            trace["d_diff"].append(d_diff)
+            trace["z_diff"].append(z_diff)
+            if cfg.verbose in ("brief", "all"):
+                print(
+                    f"Iter {i + 1}, Obj_d {obj_d:.5g}, Obj_z {obj_z:.5g}, "
+                    f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}"
+                )
+            it_done = i + 1
+            faults.sigterm_tick(i + 1)
+            # marker BEFORE the save: one write carries both the state
+            # and the preemption marker
+            preempting = gs.requested and i + 1 < cfg.max_it
+            if preempting:
+                trace.setdefault("preemptions", []).append(i + 1)
+            if checkpoint_dir is not None and (
+                (i + 1) % checkpoint_every == 0 or preempting
+            ):
+                ckpt.save(
+                    checkpoint_dir, state, trace, i + 1,
+                    fingerprint=fingerprint,
+                )
+                saved_it = i + 1
+            if preempting:
+                print(
+                    f"preempted: checkpointed iteration {i + 1}, "
+                    "exiting cleanly"
+                )
+                break
+            if d_diff < cfg.tol and z_diff < cfg.tol:
+                break
+            i += 1
 
-    if checkpoint_dir is not None:
-        ckpt.save(checkpoint_dir, state, trace, cfg.max_it)
+    if checkpoint_dir is not None and saved_it != it_done:
+        ckpt.save(
+            checkpoint_dir, state, trace, it_done, fingerprint=fingerprint
+        )
 
     dhat = common.full_filters_to_freq(state.d_full, fg)
     d_proj = proxes.kernel_constraint_proj(

@@ -182,6 +182,98 @@ def _hermitian_inverse_newton(
     return 0.5 * (x + jnp.conj(jnp.swapaxes(x, -1, -2)))
 
 
+def _newton_cond_window() -> float:
+    """Condition-number validity window of the default Newton-Schulz
+    iteration count (resolve_newton_iters): cond <= ~3e4 measured on
+    the real HS z-kernel Gram (r5). CCSC_NEWTON_COND_MAX overrides."""
+    env = os.environ.get("CCSC_NEWTON_COND_MAX")
+    return float(env) if env else 3e4
+
+
+def _power_lam_max(A: jnp.ndarray, iters: int = 12) -> jnp.ndarray:
+    """Largest-eigenvalue estimate of a batch of Hermitian PD matrices
+    [..., m, m] by ``iters`` deterministic power-iteration steps (an
+    all-ones start; a few matvecs on the MXU — negligible next to the
+    Newton iteration it guards)."""
+    v0 = jnp.ones((*A.shape[:-2], A.shape[-1]), A.dtype)
+
+    def step(v, _):
+        w = jnp.einsum("...ij,...j->...i", A, v)
+        nrm = jnp.linalg.norm(w, axis=-1, keepdims=True)
+        return w / jnp.maximum(nrm, 1e-30), None
+
+    v, _ = jax.lax.scan(step, v0, None, length=iters)
+    return jnp.linalg.norm(
+        jnp.einsum("...ij,...j->...i", A, v), axis=-1
+    )
+
+
+def _warn_newton_cond(bad, cond):  # host callback (jax.debug.callback)
+    if bad:
+        import warnings
+
+        warnings.warn(
+            f"Newton-Schulz Gram inverse: estimated condition number "
+            f"{float(cond):.3g} exceeds the ~{_newton_cond_window():.0e} "
+            "validity window of the default iteration count — falling "
+            "back to the direct (Cholesky) inverse for this kernel. "
+            "Raise CCSC_HERM_INV_ITERS to stay on the matmul path."
+        )
+
+
+def _newton_with_cond_guard(
+    G: jnp.ndarray, newton_iters: Optional[int]
+) -> jnp.ndarray:
+    """Newton-Schulz inverse with a cheap runtime condition estimate
+    and automatic fallback to the direct inverse.
+
+    The iteration needs ~4 + log2(cond * m) steps, so past the
+    documented ~3e4 window the fixed default can stop short of the f32
+    floor WITHOUT WARNING (resolve_newton_iters). Guard: cond(G) is
+    estimated as lam_max(G) * lam_max(X) by two power iterations (X,
+    the computed Newton inverse, approximates G^-1 well enough that
+    its top eigenvalue tracks 1/lam_min(G)); when the batch-max
+    estimate exceeds the window, the Cholesky inverse replaces the
+    result (lax.cond — only one branch executes) and a warning fires
+    via host callback. CCSC_NEWTON_COND_GUARD=0 disables the guard
+    (trusting the iterate count), CCSC_NEWTON_COND_MAX moves the
+    window."""
+    X = _hermitian_inverse_newton(G, newton_iters)
+    if os.environ.get("CCSC_NEWTON_COND_GUARD", "").strip() == "0":
+        return X
+    cond = jnp.max(_power_lam_max(G) * _power_lam_max(X))
+    # fail CLOSED on a non-finite estimate: a NaN/inf cond means the
+    # Newton iterate itself blew up, exactly when the fallback matters
+    bad = jnp.logical_not(cond <= _newton_cond_window())
+    try:
+        jax.debug.callback(_warn_newton_cond, bad, cond)
+    except Exception:  # pragma: no cover - exotic tracing contexts
+        pass
+    return jax.lax.cond(
+        bad,
+        lambda g: _hermitian_inverse_cholesky(g),
+        lambda g: X,
+        G,
+    )
+
+
+def _hermitian_inverse_cholesky(G: jnp.ndarray) -> jnp.ndarray:
+    """Real block embedding + batched Cholesky (see hermitian_inverse)."""
+    m = G.shape[-1]
+    re, im = jnp.real(G), jnp.imag(G)
+    top = jnp.concatenate([re, -im], axis=-1)
+    bot = jnp.concatenate([im, re], axis=-1)
+    R = jnp.concatenate([top, bot], axis=-2)  # [..., 2m, 2m] sym PD
+    L = jnp.linalg.cholesky(R)
+    eye = jnp.broadcast_to(jnp.eye(2 * m, dtype=R.dtype), R.shape)
+    # R^{-1} = L^{-T} L^{-1}: two batched triangular solves
+    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    Rinv = jax.scipy.linalg.solve_triangular(
+        L, Linv, lower=True, trans=1
+    )
+    return Rinv[..., :m, :m] + 1j * Rinv[..., m:, :m]
+
+
 def hermitian_inverse(
     G: jnp.ndarray,
     method: Optional[str] = None,
@@ -227,20 +319,12 @@ def hermitian_inverse(
     if method == "schur":
         return _hermitian_inverse_schur(G)
     if method == "newton":
-        return _hermitian_inverse_newton(G, newton_iters)
-    m = G.shape[-1]
-    re, im = jnp.real(G), jnp.imag(G)
-    top = jnp.concatenate([re, -im], axis=-1)
-    bot = jnp.concatenate([im, re], axis=-1)
-    R = jnp.concatenate([top, bot], axis=-2)  # [..., 2m, 2m] sym PD
-    L = jnp.linalg.cholesky(R)
-    eye = jnp.broadcast_to(jnp.eye(2 * m, dtype=R.dtype), R.shape)
-    # R^{-1} = L^{-T} L^{-1}: two batched triangular solves
-    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
-    Rinv = jax.scipy.linalg.solve_triangular(
-        L, Linv, lower=True, trans=1
-    )
-    return Rinv[..., :m, :m] + 1j * Rinv[..., m:, :m]
+        # condition-guarded: falls back to the direct inverse (with a
+        # warning) past the default iteration count's documented ~3e4
+        # validity window instead of silently stopping short of the
+        # f32 floor
+        return _newton_with_cond_guard(G, newton_iters)
+    return _hermitian_inverse_cholesky(G)
 
 
 class ZSolveKernel(NamedTuple):

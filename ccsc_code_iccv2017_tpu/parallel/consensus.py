@@ -82,6 +82,7 @@ def make_outer_step(
     cfg: LearnConfig,
     fg: common.FreqGeom,
     mesh: Optional[Mesh] = None,
+    poison: Optional[bool] = None,
 ):
     """Jitted outer step. Input state is the global view: block-local
     fields [N, ...], consensus fields unbatched.
@@ -91,7 +92,11 @@ def make_outer_step(
     freq_axis_name) — DP x TP. With a ('block', 'filter') mesh the
     filter bank's k axis shards instead (filter_axis_name) — the
     third parallelism axis of SURVEY.md section 2.5, for very large
-    banks."""
+    banks.
+
+    ``poison=True`` bakes the chaos NaN injection into the step
+    (models.learn.outer_step poison; built only for the one faulted
+    iteration by the driver)."""
     if mesh is None:
         step = functools.partial(
             learn_mod.outer_step,
@@ -100,6 +105,7 @@ def make_outer_step(
             fg=fg,
             num_blocks=cfg.num_blocks,
             axis_name=None,
+            poison=poison,
         )
         return jax.jit(step)
 
@@ -110,6 +116,7 @@ def make_outer_step(
         cfg=cfg,
         fg=fg,
         num_blocks=cfg.num_blocks,
+        poison=poison,
         **axis_kwargs,
     )
     metrics_specs = learn_mod.OuterMetrics(P(), P(), P(), P())
@@ -131,6 +138,7 @@ def make_outer_chunk_step(
     chunk: int,
     mesh: Optional[Mesh] = None,
     donate: bool = False,
+    poison_at: Optional[int] = None,
 ):
     """Jitted CHUNKED outer step: ``chunk`` consensus iterations as one
     lax.scan inside one dispatch (models.learn.outer_chunk_scan), with
@@ -155,6 +163,7 @@ def make_outer_chunk_step(
             num_blocks=cfg.num_blocks,
             chunk=chunk,
             axis_name=None,
+            poison_at=poison_at,
         )
         return jax.jit(fn, donate_argnums=donate_argnums)
 
@@ -166,6 +175,7 @@ def make_outer_chunk_step(
         fg=fg,
         num_blocks=cfg.num_blocks,
         chunk=chunk,
+        poison_at=poison_at,
         **axis_kwargs,
     )
     tr_specs = learn_mod.ChunkTrace(
@@ -294,8 +304,16 @@ def learn(
     consensus learners declare this parameter but never read it
     (dParallel.m:4, SURVEY.md section 5); the intent — wired in the
     hyperspectral learner, admm_learn.m:50-58 — is implemented here.
+
+    Resilience (utils.resilience): with ``cfg.max_recoveries > 0`` a
+    non-finite step restores the last good state, backs off rho by
+    ``cfg.rho_backoff`` and retries (events in trace['recoveries']);
+    SIGTERM/SIGINT checkpoint-and-exit cleanly at the next iteration
+    (or chunk) boundary; checkpoints carry a config fingerprint and
+    resume refuses a mismatched run.
     """
     from ..utils import checkpoint as ckpt
+    from ..utils import faults, resilience
 
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
@@ -339,8 +357,9 @@ def learn(
         )
     start_it = 0
     resumed_trace = None
+    fingerprint = resilience.config_fingerprint(geom, cfg, "consensus")
     if checkpoint_dir is not None:
-        snap = ckpt.load(checkpoint_dir)
+        snap = ckpt.load(checkpoint_dir, expect_fingerprint=fingerprint)
         if snap is not None:
             fields, resumed_trace, start_it = snap
             expect = {f: getattr(state, f).shape for f in state._fields}
@@ -365,7 +384,6 @@ def learn(
         )
         b_blocks = jax.device_put(b_blocks, mesh_lib.block_sharding(mesh))
 
-    step = make_outer_step(geom, cfg, fg, mesh)
     eval_fn = make_eval_fn(geom, cfg, fg, mesh)
     obj_fn = make_eval_fn(geom, cfg, fg, mesh, with_outputs=False)
 
@@ -387,9 +405,16 @@ def learn(
             "d_diff": [0.0],
             "z_diff": [0.0],
         }
+    # rho-backoff divergence recovery: re-applies any recoveries the
+    # resumed trace recorded, so the step functions below are built
+    # with the rho the interrupted run had already backed off to
+    recov = resilience.RecoveryManager(cfg, trace)
+    step = make_outer_step(geom, recov.cfg, fg, mesh)
     from ..utils import profiling
 
     t_total = trace["tim_vals"][-1]
+    it_done = start_it
+    saved_it = None  # last iteration committed to the checkpoint dir
     if cfg.chunked_driver:
         # -------- chunked driver: lax.scan chunks, one readback per
         # chunk, optional state donation (see make_outer_chunk_step).
@@ -408,24 +433,36 @@ def learn(
             # at most 3 distinct lengths compile: outer_chunk, a
             # partial first chunk after a mid-cadence resume, and a
             # partial final chunk when max_it % outer_chunk != 0
+            # (cleared and rebuilt after a rho-backoff recovery)
             if clen not in chunk_steps:
                 chunk_steps[clen] = make_outer_chunk_step(
-                    geom, cfg, fg, clen, mesh=mesh,
+                    geom, recov.cfg, fg, clen, mesh=mesh,
                     donate=cfg.donate_state,
                 )
             return chunk_steps[clen]
 
-        with profiling.xla_trace(profile_dir):
+        with resilience.GracefulShutdown() as gs, \
+                profiling.xla_trace(profile_dir):
             i = start_it
             stop = False
             while i < cfg.max_it and not stop:
                 clen = min(cfg.outer_chunk, cfg.max_it - i)
+                na = faults.nan_iteration()
+                poisoned = na is not None and i + 1 <= na <= i + clen
+                stepc = (
+                    make_outer_chunk_step(
+                        geom, recov.cfg, fg, clen, mesh=mesh,
+                        donate=cfg.donate_state, poison_at=na - (i + 1),
+                    )
+                    if poisoned
+                    else _chunk_step(clen)
+                )
                 t0 = time.perf_counter()
                 with profiling.annotate(f"ccsc_outer_{i}_{i + clen}"):
                     # state is DONATED when cfg.donate_state: the old
                     # binding's buffers die inside this call; rebind
                     # immediately and never touch the old arrays
-                    state, tr = _chunk_step(clen)(state, b_blocks)
+                    state, tr = stepc(state, b_blocks)
                     # ONE stacked readback per chunk — also the device
                     # fence (block_until_ready is a no-op on axon)
                     obj_d = np.asarray(tr.metrics.obj_d, np.float64)
@@ -434,6 +471,8 @@ def learn(
                     z_diff = np.asarray(tr.metrics.z_diff, np.float64)
                     active = np.asarray(tr.active)
                     adopted = np.asarray(tr.adopted)
+                if poisoned:
+                    faults.consume_nan()
                 dt = time.perf_counter() - t0
                 n_adopted = 0
                 for j in range(clen):
@@ -450,7 +489,17 @@ def learn(
                             f"d_diff={vals[2]}, z_diff={vals[3]}); "
                             "keeping last good state"
                         )
-                        stop = True
+                        # chunk-granular recovery at the readback
+                        # fence: `state` is already the scan-carried
+                        # last good iterate (donation-safe — the
+                        # pre-chunk buffers may be gone), so only rho
+                        # backs off and the chunk re-runs from it_end
+                        ev = recov.on_divergence(i + j + 1)
+                        if ev is None:
+                            stop = True
+                        else:
+                            trace.setdefault("recoveries", []).append(ev)
+                            chunk_steps.clear()  # rho changed
                         break
                     n_adopted += 1
                     # per-step wall time is not observable inside one
@@ -471,6 +520,7 @@ def learn(
                         stop = True
                         break
                 it_end = i + n_adopted
+                it_done = it_end
                 if cfg.verbose == "all" and n_adopted:
                     # figure cadence is per CHUNK here (the per-step
                     # driver writes one panel per iteration)
@@ -478,27 +528,63 @@ def learn(
                         figures_dir or "ccsc_figures", it_end, eval_fn,
                         state, b_blocks,
                     )
-                if (
-                    checkpoint_dir is not None
-                    and n_adopted
+                if n_adopted:
+                    faults.sigterm_tick(it_end)
+                # the preemption marker is recorded BEFORE the save so
+                # ONE write carries both the state and the marker (no
+                # duplicate multi-GB save when the chunk boundary is
+                # also a cadence multiple)
+                preempting = (
+                    gs.requested and not stop and it_end < cfg.max_it
+                )
+                if preempting:
+                    trace.setdefault("preemptions", []).append(it_end)
+                crossed = (
+                    n_adopted
                     and it_end // checkpoint_every > i // checkpoint_every
+                )
+                if checkpoint_dir is not None and (
+                    (crossed and saved_it != it_end) or preempting
                 ):
-                    # chunk-boundary cadence: save whenever this chunk
-                    # crossed a checkpoint_every multiple
-                    ckpt.save(checkpoint_dir, state, trace, it_end)
+                    # chunk-boundary cadence / preemption save
+                    ckpt.save(
+                        checkpoint_dir, state, trace, it_end,
+                        fingerprint=fingerprint,
+                    )
+                    saved_it = it_end
+                if preempting:
+                    print(
+                        f"preempted: checkpointed iteration {it_end}, "
+                        "exiting cleanly"
+                    )
+                    stop = True
                 i = it_end
 
-        if checkpoint_dir is not None:
-            ckpt.save(checkpoint_dir, state, trace, cfg.max_it)
+        if checkpoint_dir is not None and saved_it != it_done:
+            ckpt.save(
+                checkpoint_dir, state, trace, it_done,
+                fingerprint=fingerprint,
+            )
         _, d_sup, Dz = eval_fn(state, b_blocks)
         Dz = Dz.reshape(n, *Dz.shape[2:])
         return learn_mod.LearnResult(d_sup, state.z, Dz, trace)
 
-    with profiling.xla_trace(profile_dir):
-        for i in range(start_it, cfg.max_it):
+    with resilience.GracefulShutdown() as gs, \
+            profiling.xla_trace(profile_dir):
+        i = start_it
+        while i < cfg.max_it:
             t0 = time.perf_counter()
             with profiling.annotate(f"ccsc_outer_{i}"):
-                new_state, m = step(state, b_blocks)
+                na = faults.nan_iteration()
+                if na == i + 1:
+                    # chaos injection: a one-off step compiled with
+                    # the NaN poison baked in (utils.faults)
+                    new_state, m = make_outer_step(
+                        geom, recov.cfg, fg, mesh, poison=True
+                    )(state, b_blocks)
+                    faults.consume_nan()
+                else:
+                    new_state, m = step(state, b_blocks)
                 # scalar readbacks double as the device fence
                 # (block_until_ready is a no-op on the axon platform)
                 obj_d, obj_z = float(m.obj_d), float(m.obj_z)
@@ -510,7 +596,8 @@ def learn(
             # mechanism is the objective rollback in admm_learn.m:204-213.
             # The metrics are computed on new_state inside step(), so
             # `state` itself is still the last verified-good iterate —
-            # just stop without adopting new_state.
+            # just stop without adopting new_state (or, with
+            # cfg.max_recoveries, back off rho and retry from it).
             if not all(
                 math.isfinite(v) for v in (obj_d, obj_z, d_diff, z_diff)
             ):
@@ -519,7 +606,12 @@ def learn(
                     f"(obj_d={obj_d}, obj_z={obj_z}, d_diff={d_diff}, "
                     f"z_diff={z_diff}); keeping last good state"
                 )
-                break
+                ev = recov.on_divergence(i + 1)
+                if ev is None:
+                    break
+                trace.setdefault("recoveries", []).append(ev)
+                step = make_outer_step(geom, recov.cfg, fg, mesh)
+                continue  # retry iteration i with the backed-off rho
             state = new_state
             t_total += time.perf_counter() - t0
             trace["obj_vals_d"].append(obj_d)
@@ -538,13 +630,35 @@ def learn(
                     figures_dir or "ccsc_figures", i + 1, eval_fn,
                     state, b_blocks,
                 )
-            if checkpoint_dir is not None and (i + 1) % checkpoint_every == 0:
-                ckpt.save(checkpoint_dir, state, trace, i + 1)
+            it_done = i + 1
+            faults.sigterm_tick(i + 1)
+            # marker recorded BEFORE the save: one write carries both
+            # the state and the preemption marker
+            preempting = gs.requested and i + 1 < cfg.max_it
+            if preempting:
+                trace.setdefault("preemptions", []).append(i + 1)
+            if checkpoint_dir is not None and (
+                (i + 1) % checkpoint_every == 0 or preempting
+            ):
+                ckpt.save(
+                    checkpoint_dir, state, trace, i + 1,
+                    fingerprint=fingerprint,
+                )
+                saved_it = i + 1
+            if preempting:
+                print(
+                    f"preempted: checkpointed iteration {i + 1}, "
+                    "exiting cleanly"
+                )
+                break
             if d_diff < cfg.tol and z_diff < cfg.tol:
                 break
+            i += 1
 
-    if checkpoint_dir is not None:
-        ckpt.save(checkpoint_dir, state, trace, cfg.max_it)
+    if checkpoint_dir is not None and saved_it != it_done:
+        ckpt.save(
+            checkpoint_dir, state, trace, it_done, fingerprint=fingerprint
+        )
     _, d_sup, Dz = eval_fn(state, b_blocks)
     Dz = Dz.reshape(n, *Dz.shape[2:])
     return learn_mod.LearnResult(d_sup, state.z, Dz, trace)

@@ -52,6 +52,8 @@ def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    connect_retries: Optional[int] = None,
+    connect_backoff: Optional[float] = None,
 ) -> None:
     """Bootstrap multi-process JAX (jax.distributed.initialize).
 
@@ -59,6 +61,18 @@ def initialize(
     environment; pass them explicitly for CPU/GPU clusters. No-op if
     the distributed runtime is already initialized. Call this before
     anything that touches a device (jax.devices(), jit, ...).
+
+    Explicit-coordinator connections are retried with exponential
+    backoff — on a real cluster the workers race the coordinator's
+    startup, and failing the whole multi-host job because one peer
+    bound its port a few seconds late is exactly the kind of
+    non-failure the resilience layer exists to absorb.
+    ``connect_retries`` (default env CCSC_DIST_CONNECT_RETRIES, else
+    5) extra attempts; ``connect_backoff`` (default env
+    CCSC_DIST_CONNECT_BACKOFF, else 1.0) seconds before the first
+    retry, doubling each attempt, capped at 30 s. The autodetection
+    path keeps its single attempt: its failure mode is "not a
+    cluster", which retrying cannot fix.
     """
     global _initialized
     if _initialized or _runtime_already_initialized():
@@ -78,11 +92,43 @@ def initialize(
             return
         _initialized = True
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    import os
+    import time
+
+    if connect_retries is None:
+        connect_retries = int(
+            os.environ.get("CCSC_DIST_CONNECT_RETRIES", "5")
+        )
+    if connect_backoff is None:
+        connect_backoff = float(
+            os.environ.get("CCSC_DIST_CONNECT_BACKOFF", "1.0")
+        )
+    for attempt in range(connect_retries + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            break
+        except (ValueError, TypeError):
+            # deterministic misconfiguration (bad process_id, malformed
+            # address): retrying cannot fix it — fail fast
+            raise
+        except Exception as e:
+            if attempt >= connect_retries:
+                raise
+            delay = min(connect_backoff * (2.0 ** attempt), 30.0)
+            _log.warning(
+                "jax.distributed.initialize(%s) failed (%s); retry "
+                "%d/%d in %.1fs",
+                coordinator_address,
+                e,
+                attempt + 1,
+                connect_retries,
+                delay,
+            )
+            time.sleep(delay)
     _initialized = True
 
 

@@ -37,6 +37,7 @@ TPU hosts this rides PCIe; overlap is left to XLA's async dispatch
 from __future__ import annotations
 
 import functools
+import math
 import time
 from typing import Optional
 
@@ -147,6 +148,8 @@ def learn_streaming(
     cfg: LearnConfig,
     key: Optional[jax.Array] = None,
     stream_mode: Optional[str] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 5,
 ) -> learn_mod.LearnResult:
     """models.learn semantics with host-resident block state.
 
@@ -170,7 +173,27 @@ def learn_streaming(
     stay consistent); the stop can land up to outer_chunk-1 iterations
     after the per-step driver's. tim_vals are charged per chunk
     (readback-fenced wall time split evenly across the chunk's
-    iterations, same accounting as the in-memory chunked drivers)."""
+    iterations, same accounting as the in-memory chunked drivers).
+
+    ``checkpoint_dir``: full checkpoint/resume with the same
+    utils.checkpoint protocol as the in-memory learners. The snapshot
+    is assembled BLOCK-SEQUENTIALLY (one block pulled to host at a
+    time, so device memory stays O(one block)) into the stacked
+    models.learn.LearnState layout; cadence is every
+    ``checkpoint_every`` outer iterations, landing on flush
+    boundaries.
+
+    Resilience (utils.resilience): non-finite metrics at a flush stop
+    the run (the state has advanced in place, so without recovery the
+    guard can only stop and report); with ``cfg.max_recoveries > 0``
+    the learner keeps a zero-copy snapshot of the block state at each
+    successful flush, restores it on divergence, backs off rho by
+    ``cfg.rho_backoff`` and replays the chunk — events recorded in
+    trace['recoveries']. SIGTERM/SIGINT checkpoint-and-exit cleanly at
+    the next flush boundary."""
+    from ..utils import checkpoint as ckpt
+    from ..utils import faults, resilience
+
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
     N = cfg.num_blocks
@@ -209,10 +232,51 @@ def learn_streaming(
     dbar = jnp.asarray(state0.dbar)
     udbar = jnp.asarray(state0.udbar)
 
+    fingerprint = resilience.config_fingerprint(
+        geom, cfg, "consensus_streaming"
+    )
+    start_it = 0
+    resumed_fields = None
+    resumed_trace = None
+    if checkpoint_dir is not None:
+        snap = ckpt.load(checkpoint_dir, expect_fingerprint=fingerprint)
+        if snap is not None:
+            resumed_fields, resumed_trace, start_it = snap
+            expect = {f: getattr(state0, f).shape for f in state0._fields}
+            got = {k: v.shape for k, v in resumed_fields.items()}
+            if expect != got:
+                raise ValueError(
+                    f"checkpoint shapes {got} do not match problem {expect}"
+                )
+            dbar = jnp.asarray(resumed_fields["dbar"])
+            udbar = jnp.asarray(resumed_fields["udbar"])
+            print(f"resumed from {checkpoint_dir} at iteration {start_it}")
+
+    if resumed_trace is not None:
+        trace = resumed_trace
+        trace.setdefault("algorithm", "consensus_streaming")
+    else:
+        trace = {
+            # machine-readable producer identity: a .mat saved from a
+            # --streaming run records WHICH objective produced it (the
+            # HS CLI's streaming arm switches algorithms, not just
+            # memory)
+            "algorithm": "consensus_streaming",
+            "obj_vals_d": [0.0],
+            "obj_vals_z": [0.0],
+            "tim_vals": [0.0],
+            "d_diff": [0.0],
+            "z_diff": [0.0],
+        }
+
+    # rho-backoff recovery: re-applies recoveries a resumed trace
+    # recorded, so the jitted pieces below bake the backed-off rho
+    recov = resilience.RecoveryManager(cfg, trace)
+
     (
         f_bhat, f_dkern, f_prox, f_d_block, f_z_block, f_full_dhat,
         f_obj_block,
-    ) = _jit_pieces(geom, cfg, fg)
+    ) = _jit_pieces(geom, recov.cfg, fg)
 
     # ---- state placement: three tiers, same math ------------------
     # 'device': ALL block state lives on device and the python loop
@@ -294,29 +358,28 @@ def learn_streaming(
     def get_bhat(nn):
         return bhat_cache[nn] if kern_resident else f_bhat(b_blocks[nn])
 
-    d_local = [hold(state0.d_local[nn]) for nn in range(N)]
-    dual_d = [hold(state0.dual_d[nn]) for nn in range(N)]
-    z = [hold(state0.z[nn]) for nn in range(N)]
-    dual_z = [hold(state0.dual_z[nn]) for nn in range(N)]
-    del state0
+    # resumed blocks arrive as numpy [N, ...] stacks; block slices are
+    # re-held per placement tier exactly like the fresh init (device
+    # mode uploads, host modes keep numpy — no round-trip either way)
+    src = (
+        learn_mod.LearnState(**resumed_fields)
+        if resumed_fields is not None else state0
+    )
+    hold_init = jnp.asarray if device_state else np.asarray
+    d_local = [hold_init(src.d_local[nn]) for nn in range(N)]
+    dual_d = [hold_init(src.dual_d[nn]) for nn in range(N)]
+    z = [hold_init(src.z[nn]) for nn in range(N)]
+    dual_z = [hold_init(src.dual_z[nn]) for nn in range(N)]
+    del state0, src, resumed_fields
 
     @jax.jit
     def f_zdiff(z_new, z_old):
         a = z_new.astype(jnp.float32) - z_old.astype(jnp.float32)
         return jnp.sum(a * a), jnp.sum(z_new.astype(jnp.float32) ** 2)
 
-    trace = {
-        # machine-readable producer identity: a .mat saved from a
-        # --streaming run records WHICH objective produced it (the HS
-        # CLI's streaming arm switches algorithms, not just memory)
-        "algorithm": "consensus_streaming",
-        "obj_vals_d": [0.0],
-        "obj_vals_z": [0.0],
-        "tim_vals": [0.0],
-        "d_diff": [0.0],
-        "z_diff": [0.0],
-    }
-    t_total = 0.0
+    t_total = trace["tim_vals"][-1]
+    it_done = start_it
+    saved_it = None  # last iteration committed to the checkpoint dir
     # chunk-granular host fences: metric entries accumulate (as device
     # scalars where the math ran on device) and are flushed — read
     # back, appended to the trace, tol-checked — once per outer_chunk
@@ -325,140 +388,265 @@ def learn_streaming(
     pending = []
     t_chunk0 = 0.0
 
-    def _flush():
-        """-> True when a flushed entry hit tol (stop the run).
+    def _save_ckpt(it):
+        """Block-sequential checkpoint: pull one block to host at a
+        time, assemble the stacked models.learn.LearnState layout and
+        snapshot it with the shared utils.checkpoint protocol."""
+        st = learn_mod.LearnState(
+            d_local=np.stack([np.asarray(x) for x in d_local]),
+            dual_d=np.stack([np.asarray(x) for x in dual_d]),
+            dbar=np.asarray(dbar),
+            udbar=np.asarray(udbar),
+            z=np.stack([np.asarray(x) for x in z]),
+            dual_z=np.stack([np.asarray(x) for x in dual_z]),
+        )
+        ckpt.save(checkpoint_dir, st, trace, it, fingerprint=fingerprint)
 
-        EVERY pending entry is appended — the block state has already
-        advanced through all of them in place, so the trace must cover
-        them to stay consistent with the returned state. Reading the
-        floats first fences the chunk's device work, so the chunk wall
-        time (split evenly across its iterations, same accounting as
-        the in-memory chunked drivers) includes execution, not just
-        host enqueue."""
+    def _append_entry(it, o_d, o_z, dd, zd, dt_share):
+        """-> True when this entry hit tol. EVERY finite flushed entry
+        is appended — the block state has already advanced through it
+        in place, so the trace must cover it to stay consistent with
+        the returned state."""
         nonlocal t_total
-        vals = [
-            (
-                it,
-                float(o_d),
-                float(o_z),
-                float(dd),
-                float(np.sqrt(float(num)) / max(np.sqrt(float(den)), 1e-30)),
+        t_total += dt_share
+        trace["obj_vals_z"].append(o_z)
+        trace["obj_vals_d"].append(o_d)
+        trace["tim_vals"].append(t_total)
+        trace["d_diff"].append(dd)
+        trace["z_diff"].append(zd)
+        if cfg.verbose in ("brief", "all"):
+            print(
+                f"Iter {it + 1}, Obj_z {o_z:.4g}, Diff_d {dd:.3g}, "
+                f"Diff_z {zd:.3g}, t {t_total:.2f}s"
             )
-            for it, o_d, o_z, dd, num, den in pending
-        ]
-        dt = time.perf_counter() - t_chunk0  # fenced by the floats above
+        return dd < cfg.tol and zd < cfg.tol
+
+    # divergence-recovery snapshot: the block lists only ever REBIND
+    # entries (arrays are immutable), so a snapshot is shallow list
+    # copies + the consensus refs — zero copies, but it does keep the
+    # previous flush's arrays alive, which is why it is only taken
+    # while recovery is armed
+    rec_snap = (
+        (list(d_local), list(dual_d), list(z), list(dual_z),
+         dbar, udbar, start_it)
+        if recov.enabled else None
+    )
+
+    # always defined even when the loop never runs (resume at or past
+    # max_it): the final outputs project the restored consensus state
+    d_proj = f_prox(dbar, udbar)
+    dhat_z = f_full_dhat(d_proj)
+
+    gs = resilience.GracefulShutdown()
+    with gs:
+        i = start_it
         stop = False
-        for it, o_d, o_z, dd, zd in vals:
-            t_total += dt / len(vals)
-            trace["obj_vals_z"].append(o_z)
-            trace["obj_vals_d"].append(o_d)
-            trace["tim_vals"].append(t_total)
-            trace["d_diff"].append(dd)
-            trace["z_diff"].append(zd)
-            if cfg.verbose in ("brief", "all"):
-                print(
-                    f"Iter {it + 1}, Obj_z {o_z:.4g}, Diff_d {dd:.3g}, "
-                    f"Diff_z {zd:.3g}, t {t_total:.2f}s"
-                )
-            if dd < cfg.tol and zd < cfg.tol:
-                stop = True
-        return stop
+        diverged_stop = False
+        while i < cfg.max_it and not stop:
+            if not pending:
+                t_chunk0 = time.perf_counter()
+            na = faults.nan_iteration()
+            dbar_prev = dbar
 
-    for i in range(cfg.max_it):
-        if not pending:
-            t_chunk0 = time.perf_counter()
-        dbar_prev = dbar
+            # ---- d-pass: Grams fixed at incoming codes -----------------
+            # The kernels are CONSTANT across the max_it_d inner
+            # iterations, so when all N of them fit in a bounded slice of
+            # HBM they stay device-resident for the whole d-pass — the
+            # host round-trip otherwise re-uploads max_it_d * N kernel
+            # tensors per outer iteration, and on a tunneled TPU that
+            # transfer (not compute) dominates the d-pass. Past the
+            # budget, kernels page through host RAM one block at a time
+            # (the original O(one block) contract).
+            if kern_resident:
+                kerns = [f_dkern(z[nn]) for nn in range(N)]
+            else:
+                kerns = [
+                    tuple(np.asarray(p) for p in f_dkern(z[nn]))
+                    for nn in range(N)
+                ]
+            for _ in range(cfg.max_it_d):
+                u = f_prox(dbar, udbar)
+                d_sum = None
+                du_sum = None
+                for nn in range(N):
+                    bhat_nn = get_bhat(nn)
+                    d_new, du_new = f_d_block(
+                        jnp.asarray(kerns[nn][0]),
+                        jnp.asarray(kerns[nn][1]),
+                        bhat_nn,
+                        jnp.asarray(d_local[nn]),
+                        jnp.asarray(dual_d[nn]),
+                        u,
+                    )
+                    d_local[nn] = hold(d_new)
+                    dual_d[nn] = hold(du_new)
+                    d_sum = d_new if d_sum is None else d_sum + d_new
+                    du_sum = du_new if du_sum is None else du_sum + du_new
+                dbar = d_sum / N
+                udbar = du_sum / N
+            del kerns
+            # deferred scalar: stays on device until the chunk flush
+            d_diff = common.rel_change(dbar, dbar_prev)
 
-        # ---- d-pass: Grams fixed at incoming codes -----------------
-        # The kernels are CONSTANT across the max_it_d inner
-        # iterations, so when all N of them fit in a bounded slice of
-        # HBM they stay device-resident for the whole d-pass — the
-        # host round-trip otherwise re-uploads max_it_d * N kernel
-        # tensors per outer iteration, and on a tunneled TPU that
-        # transfer (not compute) dominates the d-pass. Past the
-        # budget, kernels page through host RAM one block at a time
-        # (the original O(one block) contract).
-        if kern_resident:
-            kerns = [f_dkern(z[nn]) for nn in range(N)]
-        else:
-            kerns = [
-                tuple(np.asarray(p) for p in f_dkern(z[nn]))
-                for nn in range(N)
-            ]
-        for _ in range(cfg.max_it_d):
-            u = f_prox(dbar, udbar)
-            d_sum = None
-            du_sum = None
+            d_proj = f_prox(dbar, udbar)
+            dhat_z = f_full_dhat(d_proj)
+
+            # post-d-pass objective (codes not yet updated) — keeps the
+            # trace protocol of the in-memory learner and the reference
+            # (obj_vals_d = objective after the d-pass, dParallel.m:62-71)
+            obj_d = 0.0
+            if cfg.with_objective:
+                for nn in range(N):
+                    obj_d = obj_d + f_obj_block(
+                        jnp.asarray(z[nn]), get_b(nn), dhat_z
+                    )
+
+            # ---- z-pass: blocks fully independent ----------------------
+            num = 0.0
+            den = 0.0
+            obj_z = 0.0
             for nn in range(N):
                 bhat_nn = get_bhat(nn)
-                d_new, du_new = f_d_block(
-                    jnp.asarray(kerns[nn][0]),
-                    jnp.asarray(kerns[nn][1]),
-                    bhat_nn,
-                    jnp.asarray(d_local[nn]),
-                    jnp.asarray(dual_d[nn]),
-                    u,
+                z_new, du_new = f_z_block(
+                    jnp.asarray(z[nn]), jnp.asarray(dual_z[nn]), bhat_nn, dhat_z
                 )
-                d_local[nn] = hold(d_new)
-                dual_d[nn] = hold(du_new)
-                d_sum = d_new if d_sum is None else d_sum + d_new
-                du_sum = du_new if du_sum is None else du_sum + du_new
-            dbar = d_sum / N
-            udbar = du_sum / N
-        del kerns
-        # deferred scalar: stays on device until the chunk flush
-        d_diff = common.rel_change(dbar, dbar_prev)
+                if na == i + 1 and nn == 0:
+                    # chaos injection (utils.faults): NaN block 0's
+                    # iterate so the flush's metrics go non-finite
+                    # exactly like a real blow-up
+                    z_new = jnp.full_like(z_new, jnp.nan)
+                if device_state:
+                    # convergence sums on device: pulling z to host just
+                    # for the norm would reintroduce the transfer this
+                    # mode exists to avoid (read back at the chunk flush)
+                    ssd, ssq = f_zdiff(z_new, jnp.asarray(z[nn]))
+                    num = num + ssd
+                    den = den + ssq
+                    z[nn] = z_new
+                    dual_z[nn] = du_new
+                else:
+                    z_new_h = np.asarray(z_new)
+                    # bf16-safe accumulation; copy=False keeps f32 copy-free
+                    zf_new = z_new_h.astype(np.float32, copy=False)
+                    zf_old = z[nn].astype(np.float32, copy=False)
+                    num += float(np.sum((zf_new - zf_old) ** 2))
+                    den += float(np.sum(zf_new * zf_new))
+                    z[nn] = z_new_h
+                    dual_z[nn] = np.asarray(du_new)
+                if cfg.with_objective:
+                    obj_z = obj_z + f_obj_block(
+                        jnp.asarray(z[nn]), get_b(nn), dhat_z
+                    )
+            if na == i + 1:
+                faults.consume_nan()
+            pending.append((i, obj_d, obj_z, d_diff, num, den))
+            if len(pending) < cfg.outer_chunk and i < cfg.max_it - 1:
+                i += 1
+                continue
 
-        d_proj = f_prox(dbar, udbar)
-        dhat_z = f_full_dhat(d_proj)
-
-        # post-d-pass objective (codes not yet updated) — keeps the
-        # trace protocol of the in-memory learner and the reference
-        # (obj_vals_d = objective after the d-pass, dParallel.m:62-71)
-        obj_d = 0.0
-        if cfg.with_objective:
-            for nn in range(N):
-                obj_d = obj_d + f_obj_block(
-                    jnp.asarray(z[nn]), get_b(nn), dhat_z
+            # ---- chunk fence: one readback flush --------------------
+            chunk_start = pending[0][0]
+            vals = [
+                (
+                    it,
+                    float(o_d),
+                    float(o_z),
+                    float(dd),
+                    float(
+                        np.sqrt(float(num_))
+                        / max(np.sqrt(float(den_)), 1e-30)
+                    ),
                 )
-
-        # ---- z-pass: blocks fully independent ----------------------
-        num = 0.0
-        den = 0.0
-        obj_z = 0.0
-        for nn in range(N):
-            bhat_nn = get_bhat(nn)
-            z_new, du_new = f_z_block(
-                jnp.asarray(z[nn]), jnp.asarray(dual_z[nn]), bhat_nn, dhat_z
-            )
-            if device_state:
-                # convergence sums on device: pulling z to host just
-                # for the norm would reintroduce the transfer this
-                # mode exists to avoid (read back at the chunk flush)
-                ssd, ssq = f_zdiff(z_new, jnp.asarray(z[nn]))
-                num = num + ssd
-                den = den + ssq
-                z[nn] = z_new
-                dual_z[nn] = du_new
-            else:
-                z_new_h = np.asarray(z_new)
-                # bf16-safe accumulation; copy=False keeps f32 copy-free
-                zf_new = z_new_h.astype(np.float32, copy=False)
-                zf_old = z[nn].astype(np.float32, copy=False)
-                num += float(np.sum((zf_new - zf_old) ** 2))
-                den += float(np.sum(zf_new * zf_new))
-                z[nn] = z_new_h
-                dual_z[nn] = np.asarray(du_new)
-            if cfg.with_objective:
-                obj_z = obj_z + f_obj_block(
-                    jnp.asarray(z[nn]), get_b(nn), dhat_z
-                )
-        pending.append((i, obj_d, obj_z, d_diff, num, den))
-        if len(pending) >= cfg.outer_chunk or i == cfg.max_it - 1:
-            stop = _flush()
+                for it, o_d, o_z, dd, num_, den_ in pending
+            ]
+            dt = time.perf_counter() - t_chunk0  # fenced by the floats
             pending = []
-            if stop:
+            bad = next(
+                (
+                    idx
+                    for idx, v in enumerate(vals)
+                    if not all(math.isfinite(x) for x in v[1:])
+                ),
+                None,
+            )
+            if bad is not None:
+                it_b, o_d, o_z, dd, zd = vals[bad]
+                # unlike the in-memory drivers there is no last-good
+                # carry here — the block state advanced in place — so
+                # the message must not claim one was kept
+                print(
+                    f"Iter {it_b + 1}: non-finite metrics "
+                    f"(obj_d={o_d}, obj_z={o_z}, d_diff={dd}, "
+                    f"z_diff={zd})"
+                )
+                ev = recov.on_divergence(it_b + 1)
+                if ev is not None:
+                    # restore the snapshot taken at the last good
+                    # flush, back off rho, replay the chunk with the
+                    # rebuilt (softer) jitted pieces
+                    trace.setdefault("recoveries", []).append(ev)
+                    (d_snap, du_snap, z_snap, dz_snap, dbar, udbar,
+                     i_snap) = rec_snap
+                    d_local = list(d_snap)
+                    dual_d = list(du_snap)
+                    z = list(z_snap)
+                    dual_z = list(dz_snap)
+                    i = i_snap
+                    (
+                        f_bhat, f_dkern, f_prox, f_d_block, f_z_block,
+                        f_full_dhat, f_obj_block,
+                    ) = _jit_pieces(geom, recov.cfg, fg)
+                    continue
+                # stop-and-keep: the block state advanced in place, so
+                # only the finite prefix of the chunk enters the trace,
+                # and the poisoned state must NOT reach the checkpoint
+                # (the newest on-disk generation stays the last good
+                # flush — resuming from it replays the failed chunk)
+                for it, o_d, o_z, dd, zd in vals[:bad]:
+                    _append_entry(it, o_d, o_z, dd, zd, dt / len(vals))
+                trace["diverged_at"] = it_b + 1
+                print(
+                    "stopping: the streamed state advanced through the "
+                    "diverged chunk — resume from the last checkpoint "
+                    "or enable max_recoveries"
+                )
+                diverged_stop = True
+                stop = True
                 break
+            for it, o_d, o_z, dd, zd in vals:
+                if _append_entry(it, o_d, o_z, dd, zd, dt / len(vals)):
+                    stop = True
+            it_end = vals[-1][0] + 1
+            it_done = it_end
+            if recov.enabled:
+                rec_snap = (
+                    list(d_local), list(dual_d), list(z), list(dual_z),
+                    dbar, udbar, it_end,
+                )
+            faults.sigterm_tick(it_end)
+            # marker BEFORE the save: one write carries both the state
+            # and the preemption marker
+            preempting = gs.requested and not stop and it_end < cfg.max_it
+            if preempting:
+                trace.setdefault("preemptions", []).append(it_end)
+            crossed = (
+                it_end // checkpoint_every > chunk_start // checkpoint_every
+            )
+            if checkpoint_dir is not None and (
+                (crossed and saved_it != it_end) or preempting
+            ):
+                _save_ckpt(it_end)
+                saved_it = it_end
+            if preempting:
+                print(
+                    f"preempted: checkpointed iteration {it_end}, "
+                    "exiting cleanly"
+                )
+                stop = True
+            i += 1
+
+    if checkpoint_dir is not None and not diverged_stop and saved_it != it_done:
+        _save_ckpt(it_done)
 
     # final outputs, streamed per block
     d_sup = learn_mod.extract_filters(np.asarray(d_proj), geom)

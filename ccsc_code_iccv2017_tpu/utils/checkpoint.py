@@ -1,4 +1,4 @@
-"""Mid-run checkpoint/resume for the consensus learner.
+"""Hardened mid-run checkpoint/resume for the learners.
 
 The reference only saves terminal state (learn_kernels_2D_large.m:45);
 a warm-start hook exists but is wired only in the hyperspectral learner
@@ -7,25 +7,92 @@ state (filters, codes, duals, consensus averages) plus the trace is
 snapshotted atomically, so a preempted TPU job resumes exactly where it
 stopped — including dual variables, which a filters-only warm start
 would lose.
+
+Durability contract (the production half of the resilience layer,
+utils.resilience):
+
+- every write is tempfile + ``os.replace`` — a crash mid-write never
+  corrupts an existing snapshot (this includes ``trace.json``, whose
+  plain ``open(..., 'w')`` used to be the one torn-write hole);
+- the last TWO generations are kept (``ccsc_state.npz`` +
+  ``ccsc_state.prev.npz``, each with its trace); ``load`` verifies the
+  newest against its sha256 sidecar and falls back to the previous
+  generation when the newest is torn, truncated, or silently
+  corrupted;
+- a config fingerprint (utils.resilience.config_fingerprint) is stored
+  in the payload; ``load`` REFUSES to resume when the caller's
+  fingerprint differs — resuming a different problem from a stale
+  directory is an error, not a fallback.
+
+State and trace are rotated as a PAIR: a generation whose trace file
+exists but cannot be parsed is treated as corrupt as a whole, because a
+state snapshot resumed against someone else's trace would silently
+misalign the recorded trajectory.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
+import warnings
 from typing import Optional, Tuple
 
 import numpy as np
 
+from . import faults
 
-def save(path_dir: str, state, trace: dict, it: int) -> str:
-    """Atomically snapshot ``state`` (a models.learn.LearnState) at
-    outer iteration ``it``.
+# newest / previous generation file names
+_STATE = "ccsc_state.npz"
+_STATE_PREV = "ccsc_state.prev.npz"
+_TRACE = "trace.json"
+_TRACE_PREV = "trace.prev.json"
+_SHA_SUFFIX = ".sha256"
+
+_META_KEYS = {"__iteration__", "__bf16_fields__", "__fingerprint__"}
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _atomic_write_bytes(path_dir: str, final: str, data: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(dir=path_dir, suffix=".tmp")
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+    os.replace(tmp, os.path.join(path_dir, final))
+
+
+def _rotate(path_dir: str, name: str, prev_name: str) -> None:
+    cur = os.path.join(path_dir, name)
+    if os.path.exists(cur):
+        os.replace(cur, os.path.join(path_dir, prev_name))
+
+
+def save(
+    path_dir: str,
+    state,
+    trace: dict,
+    it: int,
+    fingerprint: Optional[str] = None,
+) -> str:
+    """Atomically snapshot ``state`` (a NamedTuple of arrays, e.g.
+    models.learn.LearnState) at outer iteration ``it``, rotating the
+    existing snapshot to the previous generation.
 
     bfloat16 fields (LearnConfig.storage_dtype) are stored as their
     uint16 bit pattern with a dtype sidecar — np.savez accepts an
     ml_dtypes bfloat16 array but np.load hands it back as a void
-    '|V2' dtype, which would crash the resumed run."""
+    '|V2' dtype, which would crash the resumed run.
+
+    ``fingerprint``: opaque identity string of the producing run
+    (utils.resilience.config_fingerprint); ``load`` refuses a resume
+    whose expected fingerprint differs.
+    """
     os.makedirs(path_dir, exist_ok=True)
     payload = {}
     dtypes = {}
@@ -39,39 +106,155 @@ def save(path_dir: str, state, trace: dict, it: int) -> str:
     payload["__bf16_fields__"] = np.asarray(
         json.dumps(sorted(dtypes)).encode()
     )
+    if fingerprint is not None:
+        payload["__fingerprint__"] = np.asarray(fingerprint.encode())
     fd, tmp = tempfile.mkstemp(dir=path_dir, suffix=".npz.tmp")
     os.close(fd)
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
-    final = os.path.join(path_dir, "ccsc_state.npz")
+    trace_blob = json.dumps(trace).encode()
+    # chaos hook: simulate a crash after the payload is written but
+    # before anything is committed — the directory must still hold the
+    # previous valid generation (tests/test_resilience.py)
+    try:
+        faults.ckpt_save_hook()
+    except BaseException:
+        os.unlink(tmp)
+        raise
+    sha = _sha256_file(tmp)
+    # rotate the current generation (sidecar + trace FIRST, then the
+    # state) to prev, then commit the new one. The order matters for
+    # crash safety: while the newest npz is still in place a missing
+    # sidecar/trace is benign (load skips the sha check), and once the
+    # npz rotates its sidecar and trace are already in prev with it —
+    # every crash point leaves at least one loadable generation.
+    _rotate(path_dir, _STATE + _SHA_SUFFIX, _STATE_PREV + _SHA_SUFFIX)
+    _rotate(path_dir, _TRACE, _TRACE_PREV)
+    _rotate(path_dir, _STATE, _STATE_PREV)
+    final = os.path.join(path_dir, _STATE)
     os.replace(tmp, final)
-    with open(os.path.join(path_dir, "trace.json"), "w") as f:
-        json.dump(trace, f)
+    _atomic_write_bytes(path_dir, _STATE + _SHA_SUFFIX, sha.encode())
+    _atomic_write_bytes(path_dir, _TRACE, trace_blob)
     return final
 
 
-def load(path_dir: str):
-    """-> (field dict, trace, iteration) or None if no checkpoint."""
-    final = os.path.join(path_dir, "ccsc_state.npz")
+def _load_generation(
+    path_dir: str, state_name: str, trace_name: str,
+    expect_fingerprint: Optional[str],
+    require_trace: bool = False,
+):
+    """-> (fields, trace, it) for one generation, or None when absent
+    or corrupt. Raises ValueError on a fingerprint mismatch (a valid
+    snapshot of a DIFFERENT run must refuse, not fall back).
+
+    ``require_trace``: treat a MISSING trace file as invalidating the
+    generation too (save() always writes one, so a missing trace marks
+    a crash window between the state commit and the trace commit —
+    resuming state without its trace would silently drop the recorded
+    recoveries/history). The caller retries without the requirement
+    when no complete generation exists anywhere."""
+    final = os.path.join(path_dir, state_name)
     if not os.path.exists(final):
         return None
-    with np.load(final) as z:
-        meta = {"__iteration__", "__bf16_fields__"}
-        fields = {k: z[k] for k in z.files if k not in meta}
-        it = int(z["__iteration__"])
-        bf16 = (
-            json.loads(bytes(z["__bf16_fields__"]).decode())
-            if "__bf16_fields__" in z.files
-            else []
+    sha_path = final + _SHA_SUFFIX
+    if os.path.exists(sha_path):
+        with open(sha_path) as f:
+            expect_sha = f.read().strip()
+        if _sha256_file(final) != expect_sha:
+            warnings.warn(
+                f"checkpoint {final} fails its sha256 sidecar check "
+                "(torn or corrupted write)"
+            )
+            return None
+    try:
+        with np.load(final) as z:
+            fields = {k: z[k] for k in z.files if k not in _META_KEYS}
+            it = int(z["__iteration__"])
+            bf16 = (
+                json.loads(bytes(z["__bf16_fields__"]).decode())
+                if "__bf16_fields__" in z.files
+                else []
+            )
+            fp = (
+                bytes(z["__fingerprint__"]).decode()
+                if "__fingerprint__" in z.files
+                else None
+            )
+    except Exception as e:  # torn zip, truncated member, bad pickle...
+        warnings.warn(f"checkpoint {final} unreadable ({e})")
+        return None
+    if (
+        expect_fingerprint is not None
+        and fp is not None
+        and fp != expect_fingerprint
+    ):
+        raise ValueError(
+            f"checkpoint {final} was written by a different run "
+            f"(fingerprint {fp[:12]}… != expected "
+            f"{expect_fingerprint[:12]}…); refusing to resume — point "
+            "checkpoint_dir at a fresh directory or delete the stale one"
         )
     if bf16:
         import ml_dtypes
 
         for k in bf16:
             fields[k] = fields[k].view(ml_dtypes.bfloat16)
-    trace_path = os.path.join(path_dir, "trace.json")
     trace = None
+    trace_path = os.path.join(path_dir, trace_name)
     if os.path.exists(trace_path):
-        with open(trace_path) as f:
-            trace = json.load(f)
+        try:
+            with open(trace_path) as f:
+                trace = json.load(f)
+        except Exception as e:
+            # state + trace rotate as a pair: an unreadable trace
+            # invalidates the whole generation
+            warnings.warn(f"checkpoint trace {trace_path} unreadable ({e})")
+            return None
+    elif require_trace:
+        return None
     return fields, trace, it
+
+
+def load(path_dir: str, expect_fingerprint: Optional[str] = None):
+    """-> (field dict, trace, iteration) or None if no checkpoint.
+
+    Tries the newest COMPLETE (state + trace) generation first; on a
+    torn/corrupt/trace-less newest (sha256 sidecar mismatch,
+    unreadable npz, missing or unparsable trace) falls back to the
+    previous complete generation with a warning. When no complete
+    generation exists, a state snapshot without its trace is still
+    accepted (degraded: history and recorded recoveries are lost, the
+    iterate is not). Raises ValueError when ``expect_fingerprint``
+    does not match the snapshot's stored fingerprint, and RuntimeError
+    when snapshots exist but every generation is corrupt (silently
+    restarting from scratch would throw away the work the snapshots
+    represent)."""
+    gens = ((_STATE, _TRACE), (_STATE_PREV, _TRACE_PREV))
+    had_newest = os.path.exists(os.path.join(path_dir, _STATE))
+    for require_trace in (True, False):
+        for idx, (state_name, trace_name) in enumerate(gens):
+            got = _load_generation(
+                path_dir, state_name, trace_name, expect_fingerprint,
+                require_trace=require_trace,
+            )
+            if got is None:
+                continue
+            if idx > 0 and had_newest:
+                warnings.warn(
+                    f"resuming from the previous checkpoint generation "
+                    f"in {path_dir} (newest snapshot corrupt or "
+                    "incomplete)"
+                )
+            if not require_trace and got[1] is None:
+                warnings.warn(
+                    f"checkpoint {state_name} in {path_dir} has no "
+                    "paired trace (crash mid-save?) — resuming its "
+                    "state with a fresh trace"
+                )
+            return got
+    if had_newest or os.path.exists(os.path.join(path_dir, _STATE_PREV)):
+        raise RuntimeError(
+            f"checkpoint directory {path_dir} holds snapshots but no "
+            "generation is readable — refusing to silently restart"
+        )
+    return None

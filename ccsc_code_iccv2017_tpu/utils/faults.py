@@ -1,0 +1,120 @@
+"""Deterministic fault injection for chaos-testing the learners.
+
+Failure handling is only trustworthy when every recovery path is
+exercised end-to-end — on CPU, in CI, every run (the MPAX-style
+"solver-level safeguard" discipline, PAPERS.md arXiv:2412.09734).
+This module is the single switchboard of injectable faults; the
+learner drivers and ``utils.checkpoint`` query it at well-defined
+points, so a test (or ``scripts/chaos_smoke.py``) can prove:
+
+- divergence recovery: ``CCSC_FAULT_NAN_IT=k`` poisons the code
+  iterate INSIDE the jitted step that computes outer iteration ``k``
+  (1-based) — the non-finite metrics guard then fires exactly as it
+  would on a real blow-up, in both the per-step drivers and inside
+  the ``outer_chunk`` scan;
+- checkpoint atomicity: ``CCSC_FAULT_CKPT_SAVE=1`` raises
+  ``InjectedFault`` inside ``checkpoint.save`` after the payload is
+  written but BEFORE the atomic commit — the on-disk snapshot must
+  remain the previous valid one;
+- preemption: ``CCSC_FAULT_SIGTERM_IT=k`` raises SIGTERM in the
+  driver thread at the boundary after outer iteration ``k``
+  completes — the graceful-shutdown path must checkpoint and exit
+  cleanly.
+
+Every fault fires AT MOST ONCE per process (else a recovered/resumed
+run would re-fail forever); ``reset()`` re-arms them for the next
+test. Reads go through the environment on every query so tests can
+arm/disarm with monkeypatch.setenv.
+"""
+from __future__ import annotations
+
+import os
+import signal
+from typing import Optional
+
+__all__ = [
+    "InjectedFault",
+    "nan_iteration",
+    "consume_nan",
+    "ckpt_save_hook",
+    "sigterm_tick",
+    "reset",
+]
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed fault point (never by production paths)."""
+
+
+# fault points that already fired in this process (the fire-once
+# contract keeps a recovered or resumed run from re-failing on the
+# same injection)
+_fired: set = set()
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        # chaos tooling must never be able to crash a production run:
+        # a typo'd fault env disarms the fault, loudly, instead of
+        # raising from inside the learner loop
+        if name not in _fired:
+            _fired.add(name)
+            import warnings
+
+            warnings.warn(
+                f"ignoring malformed fault env {name}={raw!r} "
+                "(expected an integer iteration)"
+            )
+        return None
+
+
+def nan_iteration() -> Optional[int]:
+    """1-based outer iteration whose step should poison the iterate
+    with NaN, or None. Stays armed until ``consume_nan()`` — the
+    driver consumes it when the poisoned step has actually run, so a
+    rho-backoff retry of the same iteration runs clean."""
+    if "nan" in _fired:
+        return None
+    return _env_int("CCSC_FAULT_NAN_IT")
+
+
+def consume_nan() -> None:
+    """Mark the NaN injection as delivered (the poisoned step ran)."""
+    _fired.add("nan")
+
+
+def ckpt_save_hook() -> None:
+    """Called by ``utils.checkpoint.save`` between writing the payload
+    and the atomic commit; raises ``InjectedFault`` once when armed
+    (CCSC_FAULT_CKPT_SAVE truthy) — simulating a crash mid-save."""
+    if "ckpt" in _fired:
+        return
+    if os.environ.get("CCSC_FAULT_CKPT_SAVE", "").strip() not in ("", "0"):
+        _fired.add("ckpt")
+        raise InjectedFault("injected checkpoint-save crash")
+
+
+def sigterm_tick(completed_it: int) -> None:
+    """Called by the drivers at the boundary after outer iteration
+    ``completed_it`` (1-based); raises SIGTERM in the calling thread
+    once when armed (CCSC_FAULT_SIGTERM_IT <= completed_it).
+
+    ``signal.raise_signal`` (not ``os.kill``) so delivery is
+    synchronous in the driver thread — the graceful-shutdown flag is
+    deterministically set before the driver's next boundary check."""
+    if "sigterm" in _fired:
+        return
+    k = _env_int("CCSC_FAULT_SIGTERM_IT")
+    if k is not None and completed_it >= k:
+        _fired.add("sigterm")
+        signal.raise_signal(signal.SIGTERM)
+
+
+def reset() -> None:
+    """Re-arm all fault points (test isolation)."""
+    _fired.clear()

@@ -1,0 +1,206 @@
+"""Run resilience: divergence recovery, graceful preemption, run identity.
+
+The reference MATLAB code's only failure mechanism is the objective
+rollback in admm_learn.m:204-213 — everything else (a diverged rho, a
+preempted job, a torn snapshot) is an operator problem. On preemptible
+TPU fleets failure handling must be part of the solver (the stance of
+the multi-block ADMM literature on penalty restarts, PAPERS.md
+arXiv:1312.3040, and of JAX solver libraries like MPAX,
+arXiv:2412.09734). Three pieces, shared by all three learner drivers
+(parallel/consensus.py, models/learn_masked.py, parallel/streaming.py):
+
+- ``RecoveryManager`` — rho-backoff divergence recovery. When a
+  driver's non-finite guard fires it restores the last good state
+  (which every driver already holds), multiplies the ADMM penalties
+  by ``cfg.rho_backoff`` and retries, up to ``cfg.max_recoveries``
+  times; each event is recorded in the trace (``trace['recoveries']``)
+  so a resumed run re-applies the same backoff. Default-off
+  (``max_recoveries=0``): the guards keep today's stop-and-keep
+  behavior exactly.
+- ``GracefulShutdown`` — SIGTERM/SIGINT request checkpoint-and-clean-
+  exit at the next iteration/chunk boundary instead of killing the
+  process between a TPU dispatch and its checkpoint. A second signal
+  forces the previous (default) behavior.
+- ``config_fingerprint`` — a stable identity hash of the problem
+  (geometry + the config fields that change the optimization problem),
+  stored inside every checkpoint; resume refuses a mismatched run
+  instead of silently continuing a different problem
+  (utils.checkpoint). Execution-strategy knobs (chunking, donation,
+  fused kernels) and run-length knobs (max_it, tol, verbose) are
+  deliberately excluded, as are the rho values themselves — a
+  recovered run checkpoints with backed-off rho but is still the same
+  problem.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import signal
+import threading
+from typing import Optional
+
+__all__ = [
+    "RecoveryManager",
+    "GracefulShutdown",
+    "config_fingerprint",
+]
+
+
+def config_fingerprint(geom, cfg, algorithm: str) -> str:
+    """sha256 hex identity of (problem geometry, problem-defining
+    config fields, producing algorithm). Checked on resume by
+    utils.checkpoint.load — same fingerprint = same optimization
+    problem, so a checkpoint may be resumed with a different max_it,
+    tol, chunking, donation, or (post-backoff) rho.
+
+    The input DATA is deliberately not part of the identity: hashing
+    multi-GB training sets on every save is not free, and byte-exact
+    data equality is too strict for legitimate resumes (re-decoded
+    images, re-sampled loaders). The shape check in each driver still
+    rejects gross mismatches; pointing a checkpoint_dir at a different
+    same-shape dataset remains the operator's responsibility."""
+    ident = {
+        "algorithm": algorithm,
+        "spatial_support": list(geom.spatial_support),
+        "num_filters": geom.num_filters,
+        "reduce_shape": list(geom.reduce_shape),
+        "lambda_residual": cfg.lambda_residual,
+        "lambda_prior": cfg.lambda_prior,
+        "num_blocks": cfg.num_blocks,
+        "max_it_d": cfg.max_it_d,
+        "max_it_z": cfg.max_it_z,
+        "storage_dtype": cfg.storage_dtype,
+        "d_storage_dtype": cfg.d_storage_dtype,
+        "fft_pad": cfg.fft_pad,
+        "compat_coding": cfg.compat_coding,
+    }
+    blob = json.dumps(ident, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+class RecoveryManager:
+    """Budgeted rho-backoff for the non-finite divergence guards.
+
+    Holds the BASE config and the cumulative backoff scale
+    (``rho_backoff ** recoveries_used``). ``cfg`` exposes the working
+    config with scaled ``rho_d``/``rho_z`` — the consensus learners
+    rebuild their jitted steps from it after each recovery; the masked
+    learner scales its gamma divisors (its rho analogs) by ``scale``
+    directly.
+
+    ``trace``: when resuming, past recovery events recorded in
+    ``trace['recoveries']`` are re-applied so the resumed run uses the
+    same backed-off penalties it diverged away from.
+    """
+
+    def __init__(self, base_cfg, trace: Optional[dict] = None):
+        self._base = base_cfg
+        self.used = len((trace or {}).get("recoveries", []))
+
+    @property
+    def enabled(self) -> bool:
+        return self._base.max_recoveries > 0
+
+    @property
+    def scale(self) -> float:
+        return float(self._base.rho_backoff ** self.used)
+
+    @property
+    def cfg(self):
+        """The working config: base with rho_d/rho_z scaled by the
+        cumulative backoff (identical object when no recovery fired,
+        so the no-recovery path recompiles nothing)."""
+        if self.used == 0:
+            return self._base
+        return dataclasses.replace(
+            self._base,
+            rho_d=self._base.rho_d * self.scale,
+            rho_z=self._base.rho_z * self.scale,
+        )
+
+    def on_divergence(self, failed_it: int) -> Optional[dict]:
+        """The guard fired at outer iteration ``failed_it`` (1-based).
+        Returns the recovery event to record (the caller appends it to
+        ``trace['recoveries']`` and rebuilds its step functions from
+        ``self.cfg``), or None when recovery is disabled or the budget
+        is exhausted — the caller then keeps today's stop-and-keep
+        behavior."""
+        if not self.enabled or self.used >= self._base.max_recoveries:
+            return None
+        self.used += 1
+        ev = {
+            "iteration": int(failed_it),
+            "recovery": self.used,
+            "rho_scale": self.scale,
+            "rho_d": float(self._base.rho_d * self.scale),
+            "rho_z": float(self._base.rho_z * self.scale),
+        }
+        print(
+            f"Iter {failed_it}: divergence recovery {self.used}/"
+            f"{self._base.max_recoveries} — restoring last good state, "
+            f"backing off rho to scale {self.scale:g} "
+            f"(rho_d={ev['rho_d']:g}, rho_z={ev['rho_z']:g})"
+        )
+        return ev
+
+
+class GracefulShutdown:
+    """Context manager turning SIGTERM/SIGINT into a checkpoint
+    request at the next iteration/chunk boundary.
+
+    First signal: sets ``requested``; the driver sees it at its next
+    boundary, saves a checkpoint and returns cleanly. Second signal:
+    restores the previous handlers and re-raises through them (force
+    kill / KeyboardInterrupt). Degrades to a no-op outside the main
+    thread (signal handlers cannot be installed there) — ``requested``
+    then simply stays False.
+    """
+
+    _SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._prev = {}
+        self._active = False
+
+    def _handler(self, signum, frame):
+        if self.requested:
+            # second signal: stop being graceful
+            self._restore()
+            if signum == signal.SIGINT:
+                raise KeyboardInterrupt
+            signal.raise_signal(signum)
+            return
+        self.requested = True
+        self.signum = signum
+        print(
+            f"received signal {signum}: will checkpoint and exit at "
+            "the next iteration boundary (signal again to force)"
+        )
+
+    def _restore(self):
+        if not self._active:
+            return
+        for s, h in self._prev.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._prev = {}
+        self._active = False
+
+    def __enter__(self):
+        if threading.current_thread() is threading.main_thread():
+            try:
+                for s in self._SIGNALS:
+                    self._prev[s] = signal.signal(s, self._handler)
+                self._active = True
+            except ValueError:  # pragma: no cover - race on thread id
+                self._restore()
+        return self
+
+    def __exit__(self, *exc):
+        self._restore()
+        return False

@@ -250,14 +250,16 @@ def test_all_reference_artifacts_load():
 
 def test_streaming_guard_names_cli_flags(tmp_path):
     # the shared dispatch guard must name the CLI flag as typed
-    # (--init-filters), not the Python kwarg (init_filters)
+    # (--profile-dir), not the Python kwarg (profile_dir).
+    # --checkpoint-dir is no longer forbidden: the streaming learner
+    # checkpoints natively (parallel.streaming).
     from ccsc_code_iccv2017_tpu.apps import learn_2d
 
-    with pytest.raises(SystemExit, match="--checkpoint-dir"):
+    with pytest.raises(SystemExit, match="--profile-dir"):
         learn_2d.main(
             [
                 "--data", "/root/reference/2D/Inpainting/Test",
-                "--streaming", "--checkpoint-dir", str(tmp_path),
+                "--streaming", "--profile-dir", str(tmp_path / "prof"),
                 "--filters", "4", "--support", "5",
                 "--limit", "2", "--size", "16",
             ]
