@@ -243,8 +243,28 @@ def run_workload():
     util["cost_source"] = cost_src
 
     platform = jax.devices()[0].platform
+    # optional telemetry stream for the bench itself
+    # (CCSC_BENCH_METRICS_DIR): run metadata + the measured numbers as
+    # a summary record; the emitted jsonl record points at it via
+    # event_stream so PERF.md numbers are traceable to raw telemetry
+    event_stream = None
+    metrics_dir = os.environ.get("CCSC_BENCH_METRICS_DIR") or None
+    if metrics_dir:
+        from ccsc_code_iccv2017_tpu.utils import obs
+
+        brun = obs.start_run(
+            metrics_dir, algorithm="bench", verbose="none", cfg=cfg,
+            geom=geom, workload="2d_consensus_outer_step",
+        )
+        brun.chunk(0, eff_iters, eff_iters, dt, cost=cost)
+        brun.close(
+            status="ok", iters_per_sec=round(ips, 4), n=n, size=size,
+            k=k, blocks=blocks, platform=platform,
+        )
+        event_stream = brun.writer.path
     out = {
         "iters_per_sec": ips,
+        "event_stream": event_stream,
         "n": n,
         "size": size,
         "k": k,
@@ -428,6 +448,13 @@ def emit(r, degraded=False):
         suffix = ", 1 chip"
     else:
         suffix = f", {r['platform']}"
+    # telemetry provenance (utils.obs): an explicit machine-readable
+    # degraded boolean (the four-of-five degraded-CPU records of r5
+    # were only distinguishable by parsing the metric STRING), the git
+    # sha of the producing tree, and the event-stream path when the
+    # bench wrote one (CCSC_BENCH_METRICS_DIR)
+    from ccsc_code_iccv2017_tpu.utils import obs as _obs
+
     out = {
         "metric": (
             f"2D consensus ADMM outer iters/sec "
@@ -437,6 +464,9 @@ def emit(r, degraded=False):
         "value": round(r["iters_per_sec"], 4),
         "unit": "outer_iters/sec",
         "vs_baseline": round(r["iters_per_sec"] / target_pace, 3),
+        "degraded": bool(degraded),
+        "git_sha": _obs.git_sha(),
+        "event_stream": r.get("event_stream"),
     }
     if r.get("knobs"):
         out["knobs"] = r["knobs"]
@@ -523,6 +553,8 @@ def main():
     # end-of-round run keeps the fallback (a degraded number beats a
     # hang there).
     if os.environ.get("CCSC_BENCH_NO_FALLBACK") == "1":
+        from ccsc_code_iccv2017_tpu.utils import obs as _obs
+
         print(
             json.dumps(
                 {
@@ -532,6 +564,8 @@ def main():
                     "value": 0.0,
                     "unit": "outer_iters/sec",
                     "vs_baseline": 0.0,
+                    "degraded": True,
+                    "git_sha": _obs.git_sha(),
                 }
             )
         )
@@ -541,6 +575,8 @@ def main():
     if r is not None:
         emit(r, degraded=True)
         return
+    from ccsc_code_iccv2017_tpu.utils import obs as _obs
+
     print(
         json.dumps(
             {
@@ -549,6 +585,8 @@ def main():
                 "value": 0.0,
                 "unit": "outer_iters/sec",
                 "vs_baseline": 0.0,
+                "degraded": True,
+                "git_sha": _obs.git_sha(),
             }
         )
     )

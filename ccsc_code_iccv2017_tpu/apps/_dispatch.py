@@ -65,6 +65,19 @@ def add_perf_args(
         )
 
 
+def add_obs_args(parser) -> None:
+    """The shared telemetry flag (one definition so the vocabulary
+    cannot drift across the apps): --metrics-dir maps to
+    LearnConfig.metrics_dir / SolveConfig.metrics_dir (utils.obs)."""
+    parser.add_argument(
+        "--metrics-dir", default=None,
+        help="write a structured JSONL telemetry stream (run metadata, "
+        "per-step metrics, compile/recompile events, roofline, "
+        "heartbeats) into this directory; render with "
+        "scripts/obs_report.py (utils.obs)",
+    )
+
+
 def add_resilience_args(parser, checkpoint: bool = False) -> None:
     """The shared resilience flags of the learner CLIs (one definition
     so the vocabulary cannot drift): rho-backoff divergence recovery

@@ -27,9 +27,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lambda-residual", type=float, default=10000.0)
     p.add_argument("--lambda-prior", type=float, default=0.125)
     p.add_argument("--max-it", type=int, default=120)
-    from ._dispatch import add_perf_args
+    from ._dispatch import add_obs_args, add_perf_args
 
     add_perf_args(p)
+    add_obs_args(p)
     p.add_argument("--tol", type=float, default=1e-6)
     p.add_argument("--seed", type=int, default=0)
     return p
@@ -96,6 +97,7 @@ def main(argv=None):
     geom = ProblemGeom(d.shape[1:], d.shape[0])
     prob = ReconstructionProblem(geom, dirac="prepend")
     cfg = SolveConfig(
+        metrics_dir=args.metrics_dir,
         lambda_residual=args.lambda_residual,
         lambda_prior=args.lambda_prior,
         max_it=args.max_it,

@@ -28,9 +28,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-it", type=int, default=200)
     p.add_argument("--tol", type=float, default=1e-4)
     p.add_argument("--seed", type=int, default=0)
-    from ._dispatch import add_perf_args
+    from ._dispatch import add_obs_args, add_perf_args
 
     add_perf_args(p, fft_pad=False)
+    add_obs_args(p)
     return p
 
 
@@ -100,6 +101,7 @@ def main(argv=None):
     geom = ProblemGeom(d.shape[2:], k, (bands,))
     prob = ReconstructionProblem(geom, pad=False)
     cfg = SolveConfig(
+        metrics_dir=args.metrics_dir,
         fft_impl=args.fft_impl,
         lambda_residual=args.lambda_residual,
         lambda_prior=args.lambda_prior,

@@ -23,7 +23,7 @@ import numpy as np
 
 
 def build_parser() -> argparse.ArgumentParser:
-    from ._dispatch import add_mat_layout_arg, add_perf_args
+    from ._dispatch import add_obs_args, add_mat_layout_arg, add_perf_args
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="test image folder")
@@ -33,6 +33,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lambda-prior", type=float, default=2.0)
     p.add_argument("--max-it", type=int, default=100)
     add_perf_args(p)
+    add_obs_args(p)
     p.add_argument("--tol", type=float, default=1e-3)
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--size", type=int, default=None)
@@ -68,6 +69,7 @@ def main(argv=None):
 
     geom = ProblemGeom(d.shape[1:], d.shape[0])
     cfg = SolveConfig(
+        metrics_dir=args.metrics_dir,
         lambda_residual=args.lambda_residual,
         lambda_prior=args.lambda_prior,
         max_it=args.max_it,

@@ -18,7 +18,8 @@ import time
 
 def build_parser() -> argparse.ArgumentParser:
     from ._dispatch import (
-        add_mat_layout_arg, add_perf_args, add_resilience_args,
+        add_mat_layout_arg, add_obs_args, add_perf_args,
+        add_resilience_args,
     )
 
     p = argparse.ArgumentParser(description=__doc__)
@@ -60,6 +61,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     add_perf_args(p, fused=True, streaming=True, chunk=True)
     add_resilience_args(p)
+    add_obs_args(p)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -119,6 +121,7 @@ def main(argv=None):
         donate_state=args.donate_state,
         max_recoveries=args.max_recoveries,
         rho_backoff=args.rho_backoff,
+        metrics_dir=args.metrics_dir,
     )
     mesh = block_mesh(args.mesh) if args.mesh else None
     init_d = (

@@ -39,10 +39,13 @@ def build_parser() -> argparse.ArgumentParser:
         "time (bounded HBM; parallel.streaming)",
     )
     p.add_argument("--out", default="4d_filters_lightfield.mat")
-    from ._dispatch import add_perf_args, add_resilience_args
+    from ._dispatch import (
+        add_obs_args, add_perf_args, add_resilience_args,
+    )
 
     add_perf_args(p, streaming=True, chunk=True)
     add_resilience_args(p, checkpoint=True)
+    add_obs_args(p)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -110,6 +113,7 @@ def main(argv=None):
         donate_state=args.donate_state,
         max_recoveries=args.max_recoveries,
         rho_backoff=args.rho_backoff,
+        metrics_dir=args.metrics_dir,
     )
     from ._dispatch import dispatch_learn
 

@@ -41,10 +41,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--streaming-blocks", type=int, default=4)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=5)
-    from ._dispatch import add_perf_args, add_resilience_args
+    from ._dispatch import (
+        add_obs_args, add_perf_args, add_resilience_args,
+    )
 
     add_perf_args(p, streaming=True, chunk=True)
     add_resilience_args(p)
+    add_obs_args(p)
     p.add_argument(
         "--storage-dtype", default="float32",
         choices=["float32", "bfloat16"],
@@ -112,6 +115,7 @@ def main(argv=None):
         donate_state=args.donate_state,
         max_recoveries=args.max_recoveries,
         rho_backoff=args.rho_backoff,
+        metrics_dir=args.metrics_dir,
     )
     init_d = (
         jnp.asarray(load_filters_hyperspectral(args.init))

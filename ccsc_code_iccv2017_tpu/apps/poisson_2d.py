@@ -21,7 +21,7 @@ import numpy as np
 
 
 def build_parser() -> argparse.ArgumentParser:
-    from ._dispatch import add_mat_layout_arg, add_perf_args
+    from ._dispatch import add_obs_args, add_mat_layout_arg, add_perf_args
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data", required=True, help="image folder")
@@ -32,6 +32,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lambda-smooth", type=float, default=0.5)
     p.add_argument("--max-it", type=int, default=50)
     add_perf_args(p)
+    add_obs_args(p)
     p.add_argument("--tol", type=float, default=1e-4)
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--size", type=int, default=None)
@@ -63,6 +64,7 @@ def main(argv=None):
         clamp_nonneg=True,
     )
     cfg = SolveConfig(
+        metrics_dir=args.metrics_dir,
         lambda_residual=args.lambda_residual,
         lambda_prior=args.lambda_prior,
         lambda_smooth=args.lambda_smooth,

@@ -209,6 +209,19 @@ class LearnConfig:
     # arXiv:1312.3040 — a diverged rho was too aggressive for the data
     # scale, so retry softer).
     rho_backoff: float = 0.5
+    # Run telemetry (utils.obs): when set, the learner appends a
+    # structured JSONL event stream under this directory — run
+    # metadata (git sha, chip, mesh shape, knob dict, config
+    # fingerprint), per-step metrics with the on-device extra scalars
+    # (objective terms, consensus disagreement, non-finite counts —
+    # accumulated inside the jitted step/scan and read back only at
+    # the existing chunk fence, zero extra dispatches), compile /
+    # recompile events, per-chunk roofline lines, checkpoint /
+    # recovery / preemption events, and per-host heartbeats in
+    # multi-host runs. None (default) = telemetry off; the stream is
+    # append-only and crash-safe (a preempted run's telemetry
+    # survives). Render with scripts/obs_report.py.
+    metrics_dir: Optional[str] = None
     # Carry the frequency-domain iterate across the masked learner's
     # inner scans instead of re-transforming the spatial iterate each
     # iteration. The spatial iterate is ALWAYS produced by an inverse
@@ -226,6 +239,14 @@ class LearnConfig:
         if self.track_objective is None:
             return self.verbose != "none"
         return self.track_objective
+
+    @property
+    def with_obs_metrics(self) -> bool:
+        """True when the jitted step should accumulate the extra
+        telemetry scalars (models.learn.ObsExtras) — gated on the
+        telemetry flag so an un-instrumented run compiles the exact
+        historical program."""
+        return self.metrics_dir is not None
 
     def __post_init__(self):
         # fail at construction, not mid-run (and identically on every
@@ -297,6 +318,11 @@ class SolveConfig:
     fft_pad: str = "none"
     # FFT implementation ('xla' | 'matmul') — see LearnConfig.fft_impl.
     fft_impl: str = "xla"
+    # Run telemetry (utils.obs) — see LearnConfig.metrics_dir. The
+    # reconstruction solve is one jitted while_loop, so its stream
+    # carries run metadata, compile events, the per-iteration trace
+    # replayed from the returned arrays, and the final summary.
+    metrics_dir: Optional[str] = None
 
     @property
     def with_objective(self) -> bool:

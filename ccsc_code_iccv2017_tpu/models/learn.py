@@ -60,11 +60,39 @@ class LearnState(NamedTuple):
     dual_z: jnp.ndarray  # [L, ni, k, *spatial]
 
 
+class ObsExtras(NamedTuple):
+    """On-device telemetry scalars (LearnConfig.metrics_dir,
+    utils.obs): accumulated INSIDE the jitted step/scan next to the
+    existing metrics, so they ride the chunk-cadence readback fence —
+    instrumentation adds zero extra dispatches or readbacks
+    (tests/test_obs.py asserts dispatch parity).
+
+    - ``obj_fid`` / ``obj_l1``: the z-pass objective split into its
+      data-fidelity and sparsity terms (0.0 when the objective is not
+      tracked, matching obj_z).
+    - ``consensus_dis``: RMS consensus disagreement of the per-block
+      dictionaries, sqrt(mean_i ||d_i - dbar||^2) / ||dbar|| — the
+      per-block/per-worker visibility scalar of the multi-block ADMM
+      literature (PAPERS.md arXiv:1312.3040).
+    - ``nonfinite_z``: count of non-finite entries in the new code
+      iterate (0 on a healthy step; localizes a blow-up to its size).
+    """
+
+    obj_fid: jnp.ndarray
+    obj_l1: jnp.ndarray
+    consensus_dis: jnp.ndarray
+    nonfinite_z: jnp.ndarray
+
+
 class OuterMetrics(NamedTuple):
     obj_d: jnp.ndarray  # global objective after the d-pass
     obj_z: jnp.ndarray  # global objective after the z-pass
     d_diff: jnp.ndarray  # rel change of the consensus dictionary
     z_diff: jnp.ndarray  # rel change of codes (global norm)
+    # telemetry scalars, None unless cfg.with_obs_metrics (a None leaf
+    # is an empty pytree, so specs/donation/scan stacking are untouched
+    # for un-instrumented runs)
+    extras: Optional[ObsExtras] = None
 
 
 class ChunkTrace(NamedTuple):
@@ -223,12 +251,14 @@ def outer_step(
         u, support, fg.spatial_shape
     )
 
-    def objective(z, dhat):
+    def objective_parts(z, dhat):
         # matching the reference, the objective is only evaluated when
         # monitoring wants it (dParallel.m:126-129,161-167) — it costs
-        # an extra Dz reconstruction (two FFT passes) per call
+        # an extra Dz reconstruction (two FFT passes) per call. The
+        # fidelity/sparsity split feeds ObsExtras; the sum is the
+        # historical objective.
         if not cfg.with_objective:
-            return jnp.float32(0.0)
+            return jnp.float32(0.0), jnp.float32(0.0)
 
         def one(zl, bl):
             zl = f32(zl)
@@ -242,9 +272,13 @@ def outer_step(
         fids, l1s = jax.vmap(one)(z, b_blocks)
         # fid is replicated across filter shards after the psum above;
         # the l1 term is k-local and reduces over block AND filter
-        return _psum(jnp.sum(fids), axis_name) + _psum(
+        return _psum(jnp.sum(fids), axis_name), _psum(
             jnp.sum(l1s), global_axes
         )
+
+    def objective(z, dhat):
+        fid, l1 = objective_parts(z, dhat)
+        return fid + l1
 
     # ---------------- d-pass (dzParallel.m:95-135) -------------------
     zhat = jax.vmap(lambda zl: common.codes_to_freq(f32(zl), fg))(state.z)
@@ -384,10 +418,29 @@ def outer_step(
     num = _psum(jnp.sum((f32(z) - f32(state.z)) ** 2), global_axes)
     den = _psum(jnp.sum(f32(z) ** 2), global_axes)
     z_diff = jnp.sqrt(num) / jnp.maximum(jnp.sqrt(den), 1e-30)
-    obj_z = objective(z, dhat_z)
+    fid_z, l1_z = objective_parts(z, dhat_z)
+    obj_z = fid_z + l1_z
+
+    extras = None
+    if cfg.with_obs_metrics:
+        # telemetry scalars next to the existing metrics: they ride
+        # the same readback fence, never a fresh one (utils.obs)
+        nonfinite_z = _psum(
+            jnp.sum(jnp.logical_not(jnp.isfinite(f32(z)))).astype(
+                jnp.float32
+            ),
+            global_axes,
+        )
+        dn = f32(d_local) - dbar[None]
+        cons_num = _psum(jnp.sum(dn * dn), global_axes)
+        cons_den = _psum(jnp.sum(dbar * dbar), filter_axis_name)
+        consensus_dis = jnp.sqrt(cons_num / num_blocks) / jnp.maximum(
+            jnp.sqrt(cons_den), 1e-30
+        )
+        extras = ObsExtras(fid_z, l1_z, consensus_dis, nonfinite_z)
 
     new_state = LearnState(d_local, dual_d, dbar, udbar, z, dual_z)
-    return new_state, OuterMetrics(obj_d, obj_z, d_diff, z_diff)
+    return new_state, OuterMetrics(obj_d, obj_z, d_diff, z_diff, extras)
 
 
 def outer_chunk_scan(

@@ -362,6 +362,9 @@ def _chunk_step(
     donate_argnums = (0, 1) if donate else ()
     if mesh is None:
         fn = functools.partial(_chunk_scan_impl, **kwargs)
+        # length-specific identity for profiler timelines and the obs
+        # compile records (see consensus.make_outer_chunk_step)
+        fn.__name__ = f"ccsc_masked_chunk{chunk}"
         return jax.jit(fn, donate_argnums=donate_argnums)
     from jax.sharding import PartitionSpec as P
 
@@ -537,7 +540,38 @@ def learn_masked(
     retries; SIGTERM/SIGINT checkpoint-and-exit cleanly at the next
     boundary; checkpoints carry a config fingerprint. The objective-
     regression rollback (admm_learn.m:204-213) keeps its historical
-    stop semantics — recovery only arms the non-finite guard."""
+    stop semantics — recovery only arms the non-finite guard.
+
+    Telemetry (utils.obs): ``cfg.metrics_dir`` enables the structured
+    event stream — run metadata, per-step metrics, compile events,
+    per-chunk throughput, heartbeats, checkpoint/recovery events."""
+    from ..utils import obs, resilience
+
+    run = obs.start_run(
+        cfg.metrics_dir,
+        algorithm="masked_admm",
+        verbose=cfg.verbose,
+        geom=geom,
+        cfg=cfg,
+        fingerprint=resilience.config_fingerprint(geom, cfg, "masked_admm"),
+        mesh=mesh,
+        data_shape=list(b.shape),
+    )
+    try:
+        return _learn_masked_impl(
+            b, geom, cfg, smooth_init, init_d, key, gamma_div_d,
+            gamma_div_z, mesh, checkpoint_dir, checkpoint_every, run,
+        )
+    finally:
+        # idempotent backstop: only an escaping exception lands here
+        # with the run still open
+        run.close(status="error")
+
+
+def _learn_masked_impl(
+    b, geom, cfg, smooth_init, init_d, key, gamma_div_d, gamma_div_z,
+    mesh, checkpoint_dir, checkpoint_every, run,
+):
     from ..utils import checkpoint as ckpt
     from ..utils import faults, resilience
 
@@ -646,7 +680,10 @@ def learn_masked(
                 trace = resumed_trace
                 # checkpoints written before the identity key existed
                 trace.setdefault("algorithm", "masked_admm")
-            print(f"resumed from {checkpoint_dir} at iteration {start_it}")
+            run.console(
+                f"resumed from {checkpoint_dir} at iteration {start_it}",
+                tier="always",
+            )
 
     # untracked iterations persist 0.0 placeholders; resuming such a
     # checkpoint with tracking ON must not seed obj_best=0.0 (the
@@ -724,9 +761,11 @@ def learn_masked(
                 state, prev, best, ys = stepc(
                     state, prev, best, b_pad, M_pad, smoothinit
                 )
+                # ONE stacked readback per chunk — also the fence
+                ys_h = jax.device_get(ys)
                 obj_d, obj_z, d_diff, z_diff, active, adopted, rolled = (
                     np.asarray(a, np.float64) if k < 4 else np.asarray(a)
-                    for k, a in enumerate(ys)
+                    for k, a in enumerate(ys_h)
                 )
                 if poisoned:
                     faults.consume_nan()
@@ -736,11 +775,11 @@ def learn_masked(
                     if not active[j]:
                         break
                     if rolled[j]:
-                        if cfg.verbose in ("brief", "all"):
-                            print(
-                                f"Iter {i + j + 1}: objective regressed, "
-                                "rolling back"
-                            )
+                        run.console(
+                            f"Iter {i + j + 1}: objective regressed, "
+                            "rolling back",
+                            tier="brief",
+                        )
                         stop = True
                         break
                     if not adopted[j]:
@@ -748,17 +787,19 @@ def learn_masked(
                         # rolled): the scan kept the last finite state
                         # in `state` — recover at the readback fence
                         # or keep today's stop-and-keep behavior
-                        print(
+                        run.console(
                             f"Iter {i + j + 1}: non-finite metrics "
                             f"(obj_d={obj_d[j]}, obj_z={obj_z[j]}, "
                             f"d_diff={d_diff[j]}, z_diff={z_diff[j]}); "
-                            "keeping last good state"
+                            "keeping last good state",
+                            tier="always",
                         )
                         ev = recov.on_divergence(i + j + 1)
                         if ev is None:
                             stop = True
                         else:
                             trace.setdefault("recoveries", []).append(ev)
+                            run.event("recovery", **ev)
                         break
                     n_adopted += 1
                     t_total += dt / clen
@@ -767,18 +808,30 @@ def learn_masked(
                     trace["tim_vals"].append(t_total)
                     trace["d_diff"].append(float(d_diff[j]))
                     trace["z_diff"].append(float(z_diff[j]))
-                    if cfg.verbose in ("brief", "all"):
-                        print(
-                            f"Iter {i + j + 1}, Obj_d {obj_d[j]:.5g}, "
-                            f"Obj_z {obj_z[j]:.5g}, Diff_d {d_diff[j]:.3g}, "
-                            f"Diff_z {z_diff[j]:.3g}"
-                        )
+                    run.step(
+                        it=i + j + 1,
+                        obj_d=float(obj_d[j]),
+                        obj_z=float(obj_z[j]),
+                        d_diff=float(d_diff[j]),
+                        z_diff=float(z_diff[j]),
+                        t_total=round(t_total, 4),
+                    )
+                    run.console(
+                        f"Iter {i + j + 1}, Obj_d {obj_d[j]:.5g}, "
+                        f"Obj_z {obj_z[j]:.5g}, Diff_d {d_diff[j]:.3g}, "
+                        f"Diff_z {z_diff[j]:.3g}",
+                        tier="brief",
+                    )
                     if d_diff[j] < cfg.tol and z_diff[j] < cfg.tol:
                         stop = True
                         break
                 it_end = i + n_adopted
                 it_done = it_end
                 if n_adopted:
+                    # no analytic cost model for the masked objective:
+                    # the chunk record carries achieved it/s only
+                    run.chunk(i, clen, n_adopted, dt)
+                    run.heartbeat(it_end, dt)
                     faults.sigterm_tick(it_end)
                 # marker BEFORE the save: one write carries both the
                 # state and the preemption marker
@@ -787,6 +840,9 @@ def learn_masked(
                 )
                 if preempting:
                     trace.setdefault("preemptions", []).append(it_end)
+                    run.event(
+                        "preemption", iteration=it_end, signum=gs.signum
+                    )
                 crossed = (
                     n_adopted
                     and it_end // checkpoint_every > i // checkpoint_every
@@ -800,9 +856,10 @@ def learn_masked(
                     )
                     saved_it = it_end
                 if preempting:
-                    print(
+                    run.console(
                         f"preempted: checkpointed iteration {it_end}, "
-                        "exiting cleanly"
+                        "exiting cleanly",
+                        tier="always",
                     )
                     stop = True
                 i = it_end
@@ -819,6 +876,7 @@ def learn_masked(
         zhat = common.codes_to_freq(state.z.astype(jnp.float32), fg)
         Dz = common.recon_from_freq(dhat, zhat, fg) + smoothinit
         Dz = fourier.crop_spatial(Dz, radius, b.shape[-ndim_s:])
+        run.close(status="ok", iterations=it_done, wall_s=round(t_total, 4))
         return LearnResult(
             extract_filters(d_proj, geom), state.z[None], Dz, trace
         )
@@ -840,7 +898,8 @@ def learn_masked(
                 faults.consume_nan()
             obj_d, obj_z = float(obj_d), float(obj_z)  # also the fence
             d_diff, z_diff = float(d_diff), float(z_diff)
-            t_total += time.perf_counter() - t0
+            dt_step = time.perf_counter() - t0
+            t_total += dt_step
             # non-finite guard (mirrors the consensus driver): NaN
             # metrics would sail through the regression test below
             # (best <= nan is False) and poison the adopted state —
@@ -849,15 +908,17 @@ def learn_masked(
             if not all(
                 math.isfinite(v) for v in (obj_d, obj_z, d_diff, z_diff)
             ):
-                print(
+                run.console(
                     f"Iter {i + 1}: non-finite metrics "
                     f"(obj_d={obj_d}, obj_z={obj_z}, d_diff={d_diff}, "
-                    f"z_diff={z_diff}); keeping last good state"
+                    f"z_diff={z_diff}); keeping last good state",
+                    tier="always",
                 )
                 ev = recov.on_divergence(i + 1)
                 if ev is None:
                     break
                 trace.setdefault("recoveries", []).append(ev)
+                run.event("recovery", **ev)
                 step = _make_step()
                 continue  # retry iteration i with backed-off gammas
             # rollback (admm_learn.m:204-213): no pass improved the best.
@@ -868,8 +929,10 @@ def learn_masked(
             # with tracking off you trade that guard for ~2 fewer
             # reconstruction passes per outer iteration)
             if cfg.with_objective and obj_best <= obj_d and obj_best <= obj_z:
-                if cfg.verbose in ("brief", "all"):
-                    print(f"Iter {i + 1}: objective regressed, rolling back")
+                run.console(
+                    f"Iter {i + 1}: objective regressed, rolling back",
+                    tier="brief",
+                )
                 state = prev
                 break
             prev = state
@@ -880,11 +943,17 @@ def learn_masked(
             trace["tim_vals"].append(t_total)
             trace["d_diff"].append(d_diff)
             trace["z_diff"].append(z_diff)
-            if cfg.verbose in ("brief", "all"):
-                print(
-                    f"Iter {i + 1}, Obj_d {obj_d:.5g}, Obj_z {obj_z:.5g}, "
-                    f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}"
-                )
+            run.step(
+                it=i + 1, obj_d=obj_d, obj_z=obj_z, d_diff=d_diff,
+                z_diff=z_diff, t_total=round(t_total, 4),
+            )
+            run.chunk(i, 1, 1, dt_step)
+            run.heartbeat(i + 1, dt_step)
+            run.console(
+                f"Iter {i + 1}, Obj_d {obj_d:.5g}, Obj_z {obj_z:.5g}, "
+                f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}",
+                tier="brief",
+            )
             it_done = i + 1
             faults.sigterm_tick(i + 1)
             # marker BEFORE the save: one write carries both the state
@@ -892,6 +961,7 @@ def learn_masked(
             preempting = gs.requested and i + 1 < cfg.max_it
             if preempting:
                 trace.setdefault("preemptions", []).append(i + 1)
+                run.event("preemption", iteration=i + 1, signum=gs.signum)
             if checkpoint_dir is not None and (
                 (i + 1) % checkpoint_every == 0 or preempting
             ):
@@ -901,9 +971,10 @@ def learn_masked(
                 )
                 saved_it = i + 1
             if preempting:
-                print(
+                run.console(
                     f"preempted: checkpointed iteration {i + 1}, "
-                    "exiting cleanly"
+                    "exiting cleanly",
+                    tier="always",
                 )
                 break
             if d_diff < cfg.tol and z_diff < cfg.tol:
@@ -922,6 +993,7 @@ def learn_masked(
     zhat = common.codes_to_freq(state.z.astype(jnp.float32), fg)
     Dz = common.recon_from_freq(dhat, zhat, fg) + smoothinit
     Dz = fourier.crop_spatial(Dz, radius, b.shape[-ndim_s:])
+    run.close(status="ok", iterations=it_done, wall_s=round(t_total, 4))
     return LearnResult(
         extract_filters(d_proj, geom), state.z[None], Dz, trace
     )

@@ -140,6 +140,10 @@ def reconstruct(
     sharded run matches the unsharded one (same stopping iteration,
     same objective values) up to float reduction order.
     """
+    if cfg.metrics_dir is not None:
+        return _reconstruct_observed(
+            b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig, mesh
+        )
     if mesh is None:
         return _reconstruct_jit(
             b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig
@@ -168,6 +172,78 @@ def reconstruct(
         x_orig is not None,
     )
     return fn(b, d, mask, smooth_init, blur_psf, x_orig)
+
+
+def _reconstruct_observed(
+    b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig, mesh
+):
+    """Telemetry wrapper (utils.obs, SolveConfig.metrics_dir): the
+    coding solve is ONE jitted while_loop, so the stream carries run
+    metadata, the compile events, the per-iteration trace replayed
+    from the returned arrays, and the final summary — no extra fences
+    are added to the solve itself."""
+    import dataclasses as _dc
+    import time as _time
+
+    import numpy as np
+
+    from ..utils import obs
+
+    run = obs.start_run(
+        cfg.metrics_dir,
+        algorithm="reconstruct",
+        verbose=cfg.verbose,
+        geom=prob.geom,
+        cfg=cfg,
+        mesh=mesh,
+        data_shape=list(b.shape),
+        problem={
+            "pad": prob.pad,
+            "dirac": prob.dirac,
+            "data_term": prob.data_term,
+        },
+    )
+    try:
+        t0 = _time.perf_counter()
+        res = reconstruct(
+            b,
+            d,
+            prob,
+            _dc.replace(cfg, metrics_dir=None),
+            mask=mask,
+            smooth_init=smooth_init,
+            blur_psf=blur_psf,
+            x_orig=x_orig,
+            mesh=mesh,
+        )
+        tr = res.trace
+        n_it = int(tr.num_iters)
+        dt = _time.perf_counter() - t0  # fenced by num_iters above
+        obj = np.asarray(tr.obj_vals, np.float64)
+        psnr = np.asarray(tr.psnr_vals, np.float64)
+        diff = np.asarray(tr.diff_vals, np.float64)
+        # trace index 0 is the pre-iteration state; step records are
+        # 1-based like every learner's
+        for it in range(1, min(n_it + 1, obj.shape[0])):
+            run.step(
+                it=it,
+                obj=float(obj[it]),
+                psnr=float(psnr[it]),
+                diff=float(diff[it]),
+            )
+        if n_it > 0:
+            run.chunk(0, n_it, n_it, dt)
+            run.heartbeat(n_it, dt)
+        run.close(
+            status="ok",
+            iterations=n_it,
+            wall_s=round(dt, 4),
+            initial_obj=float(obj[0]) if obj.shape[0] else None,
+            final_obj=float(obj[min(n_it, obj.shape[0] - 1)]),
+        )
+        return res
+    finally:
+        run.close(status="error")
 
 
 @functools.lru_cache(maxsize=64)

@@ -348,6 +348,33 @@ class ZSolveKernel(NamedTuple):
     minv_diag: Optional[jnp.ndarray]
 
 
+_use_pallas_warned = False
+
+
+def _warn_use_pallas_noop() -> None:
+    """One-time warning that ``use_pallas=True`` no longer routes
+    anywhere (fires at trace time, so jitted callers see it too): the
+    per-solve Pallas kernel measured 0.93x the einsum path on the v5e
+    (onchip_r4.jsonl 'pallas' arm) and was demoted to a test oracle in
+    r5. Callers who believe they enabled an optimization must hear
+    otherwise (VERDICT weak #6)."""
+    global _use_pallas_warned
+    if _use_pallas_warned:
+        return
+    _use_pallas_warned = True
+    import warnings
+
+    warnings.warn(
+        "use_pallas=True is a no-op since the r5 demotion: the "
+        "per-solve Pallas z-kernel measured 0.93x the einsum path on "
+        "the v5e (onchip_r4.jsonl) and now lives only as a test "
+        "oracle (ops.pallas_kernels / tests/test_pallas.py). The "
+        "production Pallas path is the fused whole-iteration kernel — "
+        "set LearnConfig.fused_z / --fused-z instead.",
+        stacklevel=3,
+    )
+
+
 def _ksum(x, axis_name: Optional[str]):
     """Sum a k-reduced partial across filter-axis shards (SURVEY.md
     section 2.5: the filter bank is the third shardable axis; the
@@ -427,7 +454,8 @@ def solve_z(
     the data-side reduction t = A Ginv rhs is the one k-sum, psummed
     (the seam at dParallel.m:278-303); everything else is k-local.
     """
-    del use_pallas
+    if use_pallas:
+        _warn_use_pallas_noop()
     dhat, dinv = kernel.dhat, kernel.dinv
     rhs = jnp.einsum("kwf,nwf->nkf", jnp.conj(dhat), xi1_hat) + rho * xi2_hat
     g = dinv[None] * rhs  # Gamma^{-1} rhs, [N, K, F]

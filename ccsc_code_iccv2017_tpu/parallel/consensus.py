@@ -33,6 +33,40 @@ from . import mesh as mesh_lib
 from .mesh import shard_map
 
 
+def _readback(tree):
+    """ONE host readback of a device tree — the drivers' per-step /
+    per-chunk fence. A single seam (instead of scattered np.asarray
+    calls) so tests can count fences and assert that telemetry
+    (utils.obs) adds none."""
+    return jax.device_get(tree)
+
+
+def _extras_fields(extras, j=None):
+    """ObsExtras (or None) -> step-record fields; ``j`` indexes a
+    chunk-stacked trace."""
+    if extras is None:
+        return {}
+    pick = (lambda a: float(a)) if j is None else (lambda a: float(a[j]))
+    return {
+        "obj_fid": pick(extras.obj_fid),
+        "obj_l1": pick(extras.obj_l1),
+        "consensus_dis": pick(extras.consensus_dis),
+        "nonfinite_z": int(pick(extras.nonfinite_z)),
+    }
+
+
+def _metrics_specs(cfg: LearnConfig):
+    """OuterMetrics PartitionSpecs, matching the extras leaf count the
+    step compiles with (telemetry scalars are replicated like every
+    other metric)."""
+    extras = (
+        learn_mod.ObsExtras(P(), P(), P(), P())
+        if cfg.with_obs_metrics
+        else None
+    )
+    return learn_mod.OuterMetrics(P(), P(), P(), P(), extras)
+
+
 def _state_specs(batched: bool = True, filter_sharded: bool = False):
     """PartitionSpecs of LearnState: block-local fields on 'block';
     with filter sharding the k axis (axis 1 of d fields, axis 2 of z
@@ -107,6 +141,9 @@ def make_outer_step(
             axis_name=None,
             poison=poison,
         )
+        # a readable identity in profiler timelines and the obs
+        # compile/recompile records (a bare partial is '<unnamed>')
+        step.__name__ = "ccsc_outer_step"
         return jax.jit(step)
 
     axis_kwargs, has_filter, check_vma = _mesh_axis_kwargs(geom, mesh)
@@ -119,7 +156,7 @@ def make_outer_step(
         poison=poison,
         **axis_kwargs,
     )
-    metrics_specs = learn_mod.OuterMetrics(P(), P(), P(), P())
+    metrics_specs = _metrics_specs(cfg)
     specs = _state_specs(filter_sharded=has_filter)
     sharded = shard_map(
         step,
@@ -128,6 +165,10 @@ def make_outer_step(
         out_specs=(specs, metrics_specs),
         check_vma=check_vma,
     )
+    try:
+        sharded.__name__ = "ccsc_outer_step_sharded"
+    except AttributeError:  # pragma: no cover - shard_map wrapper type
+        pass
     return jax.jit(sharded)
 
 
@@ -165,6 +206,10 @@ def make_outer_chunk_step(
             axis_name=None,
             poison_at=poison_at,
         )
+        # length-specific name: a partial final chunk compiles under
+        # its OWN identity, so the obs recompile summary doesn't flag
+        # the expected second length as a silent recompile
+        fn.__name__ = f"ccsc_outer_chunk{chunk}"
         return jax.jit(fn, donate_argnums=donate_argnums)
 
     axis_kwargs, has_filter, check_vma = _mesh_axis_kwargs(geom, mesh)
@@ -178,9 +223,7 @@ def make_outer_chunk_step(
         poison_at=poison_at,
         **axis_kwargs,
     )
-    tr_specs = learn_mod.ChunkTrace(
-        learn_mod.OuterMetrics(P(), P(), P(), P()), P(), P()
-    )
+    tr_specs = learn_mod.ChunkTrace(_metrics_specs(cfg), P(), P())
     specs = _state_specs(filter_sharded=has_filter)
     sharded = shard_map(
         fn,
@@ -194,6 +237,10 @@ def make_outer_chunk_step(
         # the check
         check_vma=False,
     )
+    try:
+        sharded.__name__ = f"ccsc_outer_chunk{chunk}_sharded"
+    except AttributeError:  # pragma: no cover - shard_map wrapper type
+        pass
     return jax.jit(sharded, donate_argnums=donate_argnums)
 
 
@@ -208,6 +255,10 @@ def make_eval_fn(
 
     ``with_outputs=False`` builds an objective-only variant that never
     materializes the Dz reconstructions."""
+    # distinct identities for the full eval vs the objective-only
+    # variant — in profiler timelines and the obs compile records the
+    # pair would otherwise read as one function recompiling
+    name = "ccsc_eval" if with_outputs else "ccsc_objective"
     if mesh is None:
         f = functools.partial(
             learn_mod.eval_block,
@@ -217,6 +268,7 @@ def make_eval_fn(
             axis_name=None,
             with_outputs=with_outputs,
         )
+        f.__name__ = name
         return jax.jit(f)
     has_filter = "filter" in mesh.axis_names
     f = functools.partial(
@@ -228,21 +280,24 @@ def make_eval_fn(
         with_outputs=with_outputs,
         filter_axis_name="filter" if has_filter else None,
     )
-    return jax.jit(
-        shard_map(
-            f,
-            mesh=mesh,
-            in_specs=(_state_specs(filter_sharded=has_filter), P("block")),
-            # d_sup is the local k slice under filter sharding; the
-            # out_spec gathers the full bank
-            out_specs=(
-                P(),
-                P("filter") if has_filter else P(),
-                P("block"),
-            ),
-            check_vma=not has_filter,
-        )
+    sharded = shard_map(
+        f,
+        mesh=mesh,
+        in_specs=(_state_specs(filter_sharded=has_filter), P("block")),
+        # d_sup is the local k slice under filter sharding; the
+        # out_spec gathers the full bank
+        out_specs=(
+            P(),
+            P("filter") if has_filter else P(),
+            P("block"),
+        ),
+        check_vma=not has_filter,
     )
+    try:
+        sharded.__name__ = name + "_sharded"
+    except AttributeError:  # pragma: no cover - shard_map wrapper type
+        pass
+    return jax.jit(sharded)
 
 
 def _write_figures(figdir, it, eval_fn, state, b_blocks):
@@ -312,8 +367,7 @@ def learn(
     (or chunk) boundary; checkpoints carry a config fingerprint and
     resume refuses a mismatched run.
     """
-    from ..utils import checkpoint as ckpt
-    from ..utils import faults, resilience
+    from ..utils import obs, resilience
 
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
@@ -332,8 +386,65 @@ def learn(
     )
     b_blocks = b.reshape(N, ni, *b.shape[1:])
 
+    run = obs.start_run(
+        cfg.metrics_dir,
+        algorithm="consensus",
+        verbose=cfg.verbose,
+        geom=geom,
+        cfg=cfg,
+        fingerprint=resilience.config_fingerprint(geom, cfg, "consensus"),
+        mesh=mesh,
+        data_shape=list(b.shape),
+    )
+    try:
+        step_cost = None
+        if run.active:
+            from ..utils import perfmodel
+
+            # analytic per-outer-step cost of THIS problem, priced
+            # once — each chunk's achieved rate is scored against it
+            # live (the roofline records obs_report renders as the
+            # trajectory)
+            step_cost = perfmodel.analytic_outer_step_cost(
+                num_blocks=N,
+                ni=ni,
+                k=geom.num_filters,
+                spatial=fg.spatial_shape,
+                num_freq=fg.num_freq,
+                max_it_d=cfg.max_it_d,
+                max_it_z=cfg.max_it_z,
+                reduce_size=geom.reduce_size,
+                state_dtype_bytes=jnp.dtype(cfg.storage_dtype).itemsize,
+                d_state_dtype_bytes=jnp.dtype(
+                    cfg.d_storage_dtype
+                ).itemsize,
+                fft_impl=cfg.fft_impl,
+                fused_z=cfg.fused_z,
+                donate_state=cfg.donate_state,
+            )
+        return _learn_impl(
+            b, geom, cfg, key, mesh, checkpoint_dir, checkpoint_every,
+            init_d, profile_dir, figures_dir, run, step_cost, fg,
+            b_blocks, n, N, ni,
+        )
+    finally:
+        # idempotent: the normal path closed with status='ok' already;
+        # this only fires on an exception escaping the driver
+        run.close(status="error")
+
+
+def _learn_impl(
+    b, geom, cfg, key, mesh, checkpoint_dir, checkpoint_every, init_d,
+    profile_dir, figures_dir, run, step_cost, fg, b_blocks, n, N, ni,
+):
+    from ..utils import checkpoint as ckpt
+    from ..utils import faults, profiling, resilience
+
+    timers = profiling.SectionTimers()
+
     if key is None:
         key = jax.random.PRNGKey(0)
+    t_setup0 = time.perf_counter()
     state = learn_mod.init_state(
         key, geom, fg, N, ni, b.dtype,
         z_dtype=jnp.dtype(cfg.storage_dtype),
@@ -369,7 +480,10 @@ def learn(
                     f"checkpoint shapes {got} do not match problem {expect}"
                 )
             state = learn_mod.LearnState(**fields)
-            print(f"resumed from {checkpoint_dir} at iteration {start_it}")
+            run.console(
+                f"resumed from {checkpoint_dir} at iteration {start_it}",
+                tier="always",
+            )
 
     if mesh is not None:
         specs = _state_specs(
@@ -410,8 +524,7 @@ def learn(
     # with the rho the interrupted run had already backed off to
     recov = resilience.RecoveryManager(cfg, trace)
     step = make_outer_step(geom, recov.cfg, fg, mesh)
-    from ..utils import profiling
-
+    timers.add("setup", time.perf_counter() - t_setup0)
     t_total = trace["tim_vals"][-1]
     it_done = start_it
     saved_it = None  # last iteration committed to the checkpoint dir
@@ -465,15 +578,18 @@ def learn(
                     state, tr = stepc(state, b_blocks)
                     # ONE stacked readback per chunk — also the device
                     # fence (block_until_ready is a no-op on axon)
-                    obj_d = np.asarray(tr.metrics.obj_d, np.float64)
-                    obj_z = np.asarray(tr.metrics.obj_z, np.float64)
-                    d_diff = np.asarray(tr.metrics.d_diff, np.float64)
-                    z_diff = np.asarray(tr.metrics.z_diff, np.float64)
-                    active = np.asarray(tr.active)
-                    adopted = np.asarray(tr.adopted)
+                    tr_h = _readback(tr)
+                    obj_d = np.asarray(tr_h.metrics.obj_d, np.float64)
+                    obj_z = np.asarray(tr_h.metrics.obj_z, np.float64)
+                    d_diff = np.asarray(tr_h.metrics.d_diff, np.float64)
+                    z_diff = np.asarray(tr_h.metrics.z_diff, np.float64)
+                    active = np.asarray(tr_h.active)
+                    adopted = np.asarray(tr_h.adopted)
+                    extras = tr_h.metrics.extras  # [chunk] leaves, host
                 if poisoned:
                     faults.consume_nan()
                 dt = time.perf_counter() - t0
+                timers.add("step", dt)
                 n_adopted = 0
                 for j in range(clen):
                     if not active[j]:
@@ -483,11 +599,12 @@ def learn(
                         # the per-step driver's divergence guard, at
                         # chunk granularity: the scan already kept the
                         # last finite iterate in `state`
-                        print(
+                        run.console(
                             f"Iter {i + j + 1}: non-finite metrics "
                             f"(obj_d={vals[0]}, obj_z={vals[1]}, "
                             f"d_diff={vals[2]}, z_diff={vals[3]}); "
-                            "keeping last good state"
+                            "keeping last good state",
+                            tier="always",
                         )
                         # chunk-granular recovery at the readback
                         # fence: `state` is already the scan-carried
@@ -499,6 +616,7 @@ def learn(
                             stop = True
                         else:
                             trace.setdefault("recoveries", []).append(ev)
+                            run.event("recovery", **ev)
                             chunk_steps.clear()  # rho changed
                         break
                     n_adopted += 1
@@ -510,17 +628,29 @@ def learn(
                     trace["tim_vals"].append(t_total)
                     trace["d_diff"].append(float(vals[2]))
                     trace["z_diff"].append(float(vals[3]))
-                    if cfg.verbose in ("brief", "all"):
-                        print(
-                            f"Iter {i + j + 1}, Obj_d {vals[0]:.4g}, "
-                            f"Obj_z {vals[1]:.4g}, Diff_d {vals[2]:.3g}, "
-                            f"Diff_z {vals[3]:.3g}, t {t_total:.2f}s"
-                        )
+                    run.step(
+                        it=i + j + 1,
+                        obj_d=float(vals[0]),
+                        obj_z=float(vals[1]),
+                        d_diff=float(vals[2]),
+                        z_diff=float(vals[3]),
+                        t_total=round(t_total, 4),
+                        **_extras_fields(extras, j),
+                    )
+                    run.console(
+                        f"Iter {i + j + 1}, Obj_d {vals[0]:.4g}, "
+                        f"Obj_z {vals[1]:.4g}, Diff_d {vals[2]:.3g}, "
+                        f"Diff_z {vals[3]:.3g}, t {t_total:.2f}s",
+                        tier="brief",
+                    )
                     if vals[2] < cfg.tol and vals[3] < cfg.tol:
                         stop = True
                         break
                 it_end = i + n_adopted
                 it_done = it_end
+                if n_adopted:
+                    run.chunk(i, clen, n_adopted, dt, cost=step_cost)
+                    run.heartbeat(it_end, dt)
                 if cfg.verbose == "all" and n_adopted:
                     # figure cadence is per CHUNK here (the per-step
                     # driver writes one panel per iteration)
@@ -539,6 +669,9 @@ def learn(
                 )
                 if preempting:
                     trace.setdefault("preemptions", []).append(it_end)
+                    run.event(
+                        "preemption", iteration=it_end, signum=gs.signum
+                    )
                 crossed = (
                     n_adopted
                     and it_end // checkpoint_every > i // checkpoint_every
@@ -547,26 +680,33 @@ def learn(
                     (crossed and saved_it != it_end) or preempting
                 ):
                     # chunk-boundary cadence / preemption save
-                    ckpt.save(
-                        checkpoint_dir, state, trace, it_end,
-                        fingerprint=fingerprint,
-                    )
+                    with timers.section("checkpoint"):
+                        ckpt.save(
+                            checkpoint_dir, state, trace, it_end,
+                            fingerprint=fingerprint,
+                        )
                     saved_it = it_end
+                    run.drain_timers(timers)
                 if preempting:
-                    print(
+                    run.console(
                         f"preempted: checkpointed iteration {it_end}, "
-                        "exiting cleanly"
+                        "exiting cleanly",
+                        tier="always",
                     )
                     stop = True
                 i = it_end
 
         if checkpoint_dir is not None and saved_it != it_done:
-            ckpt.save(
-                checkpoint_dir, state, trace, it_done,
-                fingerprint=fingerprint,
-            )
-        _, d_sup, Dz = eval_fn(state, b_blocks)
-        Dz = Dz.reshape(n, *Dz.shape[2:])
+            with timers.section("checkpoint"):
+                ckpt.save(
+                    checkpoint_dir, state, trace, it_done,
+                    fingerprint=fingerprint,
+                )
+        with timers.section("final_eval"):
+            _, d_sup, Dz = eval_fn(state, b_blocks)
+            Dz = Dz.reshape(n, *Dz.shape[2:])
+        run.drain_timers(timers)
+        run.close(status="ok", iterations=it_done, wall_s=round(t_total, 4))
         return learn_mod.LearnResult(d_sup, state.z, Dz, trace)
 
     with resilience.GracefulShutdown() as gs, \
@@ -585,10 +725,11 @@ def learn(
                     faults.consume_nan()
                 else:
                     new_state, m = step(state, b_blocks)
-                # scalar readbacks double as the device fence
+                # the metrics readback doubles as the device fence
                 # (block_until_ready is a no-op on the axon platform)
-                obj_d, obj_z = float(m.obj_d), float(m.obj_z)
-                d_diff, z_diff = float(m.d_diff), float(m.z_diff)
+                m_h = _readback(m)
+                obj_d, obj_z = float(m_h.obj_d), float(m_h.obj_z)
+                d_diff, z_diff = float(m_h.d_diff), float(m_h.z_diff)
             # failure detection: a non-finite metric means the iterate
             # diverged (bad rho for the data scale, or a numeric fault);
             # keep the last good state instead of propagating NaNs into
@@ -601,30 +742,45 @@ def learn(
             if not all(
                 math.isfinite(v) for v in (obj_d, obj_z, d_diff, z_diff)
             ):
-                print(
+                run.console(
                     f"Iter {i + 1}: non-finite metrics "
                     f"(obj_d={obj_d}, obj_z={obj_z}, d_diff={d_diff}, "
-                    f"z_diff={z_diff}); keeping last good state"
+                    f"z_diff={z_diff}); keeping last good state",
+                    tier="always",
                 )
                 ev = recov.on_divergence(i + 1)
                 if ev is None:
                     break
                 trace.setdefault("recoveries", []).append(ev)
+                run.event("recovery", **ev)
                 step = make_outer_step(geom, recov.cfg, fg, mesh)
                 continue  # retry iteration i with the backed-off rho
             state = new_state
-            t_total += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            timers.add("step", dt)
+            t_total += dt
             trace["obj_vals_d"].append(obj_d)
             trace["obj_vals_z"].append(obj_z)
             trace["tim_vals"].append(t_total)
             trace["d_diff"].append(d_diff)
             trace["z_diff"].append(z_diff)
-            if cfg.verbose in ("brief", "all"):
-                print(
-                    f"Iter {i + 1}, Obj_d {obj_d:.4g}, Obj_z {obj_z:.4g}, "
-                    f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}, "
-                    f"t {t_total:.2f}s"
-                )
+            run.step(
+                it=i + 1,
+                obj_d=obj_d,
+                obj_z=obj_z,
+                d_diff=d_diff,
+                z_diff=z_diff,
+                t_total=round(t_total, 4),
+                **_extras_fields(m_h.extras),
+            )
+            run.chunk(i, 1, 1, dt, cost=step_cost)
+            run.heartbeat(i + 1, dt)
+            run.console(
+                f"Iter {i + 1}, Obj_d {obj_d:.4g}, Obj_z {obj_z:.4g}, "
+                f"Diff_d {d_diff:.3g}, Diff_z {z_diff:.3g}, "
+                f"t {t_total:.2f}s",
+                tier="brief",
+            )
             if cfg.verbose == "all":
                 _write_figures(
                     figures_dir or "ccsc_figures", i + 1, eval_fn,
@@ -637,18 +793,24 @@ def learn(
             preempting = gs.requested and i + 1 < cfg.max_it
             if preempting:
                 trace.setdefault("preemptions", []).append(i + 1)
+                run.event(
+                    "preemption", iteration=i + 1, signum=gs.signum
+                )
             if checkpoint_dir is not None and (
                 (i + 1) % checkpoint_every == 0 or preempting
             ):
-                ckpt.save(
-                    checkpoint_dir, state, trace, i + 1,
-                    fingerprint=fingerprint,
-                )
+                with timers.section("checkpoint"):
+                    ckpt.save(
+                        checkpoint_dir, state, trace, i + 1,
+                        fingerprint=fingerprint,
+                    )
                 saved_it = i + 1
+                run.drain_timers(timers)
             if preempting:
-                print(
+                run.console(
                     f"preempted: checkpointed iteration {i + 1}, "
-                    "exiting cleanly"
+                    "exiting cleanly",
+                    tier="always",
                 )
                 break
             if d_diff < cfg.tol and z_diff < cfg.tol:
@@ -656,9 +818,14 @@ def learn(
             i += 1
 
     if checkpoint_dir is not None and saved_it != it_done:
-        ckpt.save(
-            checkpoint_dir, state, trace, it_done, fingerprint=fingerprint
-        )
-    _, d_sup, Dz = eval_fn(state, b_blocks)
-    Dz = Dz.reshape(n, *Dz.shape[2:])
+        with timers.section("checkpoint"):
+            ckpt.save(
+                checkpoint_dir, state, trace, it_done,
+                fingerprint=fingerprint,
+            )
+    with timers.section("final_eval"):
+        _, d_sup, Dz = eval_fn(state, b_blocks)
+        Dz = Dz.reshape(n, *Dz.shape[2:])
+    run.drain_timers(timers)
+    run.close(status="ok", iterations=it_done, wall_s=round(t_total, 4))
     return learn_mod.LearnResult(d_sup, state.z, Dz, trace)

@@ -190,7 +190,42 @@ def learn_streaming(
     successful flush, restores it on divergence, backs off rho by
     ``cfg.rho_backoff`` and replays the chunk — events recorded in
     trace['recoveries']. SIGTERM/SIGINT checkpoint-and-exit cleanly at
-    the next flush boundary."""
+    the next flush boundary.
+
+    Telemetry (utils.obs): ``cfg.metrics_dir`` enables the structured
+    event stream — run metadata, per-flush step metrics, compile
+    events, per-chunk roofline (the streamed math IS the consensus
+    outer step, so the analytic perfmodel bounds apply), heartbeats,
+    checkpoint/recovery events. All obs emission happens at the
+    existing flush fences from already-read-back floats — zero extra
+    readbacks."""
+    from ..utils import obs, resilience
+
+    run = obs.start_run(
+        cfg.metrics_dir,
+        algorithm="consensus_streaming",
+        verbose=cfg.verbose,
+        geom=geom,
+        cfg=cfg,
+        fingerprint=resilience.config_fingerprint(
+            geom, cfg, "consensus_streaming"
+        ),
+        data_shape=list(b.shape),
+        stream_mode=stream_mode,
+    )
+    try:
+        return _learn_streaming_impl(
+            b, geom, cfg, key, stream_mode, checkpoint_dir,
+            checkpoint_every, run,
+        )
+    finally:
+        # idempotent backstop for escaping exceptions
+        run.close(status="error")
+
+
+def _learn_streaming_impl(
+    b, geom, cfg, key, stream_mode, checkpoint_dir, checkpoint_every, run,
+):
     from ..utils import checkpoint as ckpt
     from ..utils import faults, resilience
 
@@ -250,7 +285,10 @@ def learn_streaming(
                 )
             dbar = jnp.asarray(resumed_fields["dbar"])
             udbar = jnp.asarray(resumed_fields["udbar"])
-            print(f"resumed from {checkpoint_dir} at iteration {start_it}")
+            run.console(
+                f"resumed from {checkpoint_dir} at iteration {start_it}",
+                tier="always",
+            )
 
     if resumed_trace is not None:
         trace = resumed_trace
@@ -272,6 +310,28 @@ def learn_streaming(
     # rho-backoff recovery: re-applies recoveries a resumed trace
     # recorded, so the jitted pieces below bake the backed-off rho
     recov = resilience.RecoveryManager(cfg, trace)
+
+    step_cost = None
+    if run.active:
+        from ..utils import perfmodel
+
+        # the streamed math is the consensus outer step, so the same
+        # analytic roofline applies (host<->device traffic of the
+        # paged tiers is NOT in the model — the hbm_frac of a paged
+        # run reads as compute-side headroom, not PCIe)
+        step_cost = perfmodel.analytic_outer_step_cost(
+            num_blocks=N,
+            ni=ni,
+            k=geom.num_filters,
+            spatial=fg.spatial_shape,
+            num_freq=fg.num_freq,
+            max_it_d=cfg.max_it_d,
+            max_it_z=cfg.max_it_z,
+            reduce_size=geom.reduce_size,
+            state_dtype_bytes=jnp.dtype(cfg.storage_dtype).itemsize,
+            d_state_dtype_bytes=jnp.dtype(cfg.d_storage_dtype).itemsize,
+            fft_impl=cfg.fft_impl,
+        )
 
     (
         f_bhat, f_dkern, f_prox, f_d_block, f_z_block, f_full_dhat,
@@ -414,11 +474,15 @@ def learn_streaming(
         trace["tim_vals"].append(t_total)
         trace["d_diff"].append(dd)
         trace["z_diff"].append(zd)
-        if cfg.verbose in ("brief", "all"):
-            print(
-                f"Iter {it + 1}, Obj_z {o_z:.4g}, Diff_d {dd:.3g}, "
-                f"Diff_z {zd:.3g}, t {t_total:.2f}s"
-            )
+        run.step(
+            it=it + 1, obj_d=o_d, obj_z=o_z, d_diff=dd, z_diff=zd,
+            t_total=round(t_total, 4),
+        )
+        run.console(
+            f"Iter {it + 1}, Obj_z {o_z:.4g}, Diff_d {dd:.3g}, "
+            f"Diff_z {zd:.3g}, t {t_total:.2f}s",
+            tier="brief",
+        )
         return dd < cfg.tol and zd < cfg.tol
 
     # divergence-recovery snapshot: the block lists only ever REBIND
@@ -574,10 +638,11 @@ def learn_streaming(
                 # unlike the in-memory drivers there is no last-good
                 # carry here — the block state advanced in place — so
                 # the message must not claim one was kept
-                print(
+                run.console(
                     f"Iter {it_b + 1}: non-finite metrics "
                     f"(obj_d={o_d}, obj_z={o_z}, d_diff={dd}, "
-                    f"z_diff={zd})"
+                    f"z_diff={zd})",
+                    tier="always",
                 )
                 ev = recov.on_divergence(it_b + 1)
                 if ev is not None:
@@ -585,6 +650,7 @@ def learn_streaming(
                     # flush, back off rho, replay the chunk with the
                     # rebuilt (softer) jitted pieces
                     trace.setdefault("recoveries", []).append(ev)
+                    run.event("recovery", **ev)
                     (d_snap, du_snap, z_snap, dz_snap, dbar, udbar,
                      i_snap) = rec_snap
                     d_local = list(d_snap)
@@ -605,10 +671,11 @@ def learn_streaming(
                 for it, o_d, o_z, dd, zd in vals[:bad]:
                     _append_entry(it, o_d, o_z, dd, zd, dt / len(vals))
                 trace["diverged_at"] = it_b + 1
-                print(
+                run.console(
                     "stopping: the streamed state advanced through the "
                     "diverged chunk — resume from the last checkpoint "
-                    "or enable max_recoveries"
+                    "or enable max_recoveries",
+                    tier="always",
                 )
                 diverged_stop = True
                 stop = True
@@ -618,6 +685,8 @@ def learn_streaming(
                     stop = True
             it_end = vals[-1][0] + 1
             it_done = it_end
+            run.chunk(chunk_start, len(vals), len(vals), dt, cost=step_cost)
+            run.heartbeat(it_end, dt)
             if recov.enabled:
                 rec_snap = (
                     list(d_local), list(dual_d), list(z), list(dual_z),
@@ -629,6 +698,7 @@ def learn_streaming(
             preempting = gs.requested and not stop and it_end < cfg.max_it
             if preempting:
                 trace.setdefault("preemptions", []).append(it_end)
+                run.event("preemption", iteration=it_end, signum=gs.signum)
             crossed = (
                 it_end // checkpoint_every > chunk_start // checkpoint_every
             )
@@ -638,9 +708,10 @@ def learn_streaming(
                 _save_ckpt(it_end)
                 saved_it = it_end
             if preempting:
-                print(
+                run.console(
                     f"preempted: checkpointed iteration {it_end}, "
-                    "exiting cleanly"
+                    "exiting cleanly",
+                    tier="always",
                 )
                 stop = True
             i += 1
@@ -665,6 +736,7 @@ def learn_streaming(
     for nn in range(N):
         Dz[nn] = np.asarray(f_dz_block(jnp.asarray(z[nn])))
     z_out = np.stack([np.asarray(zz) for zz in z])
+    run.close(status="ok", iterations=it_done, wall_s=round(t_total, 4))
     return learn_mod.LearnResult(
         np.asarray(d_sup), z_out, Dz.reshape(n, *Dz.shape[2:]), trace
     )

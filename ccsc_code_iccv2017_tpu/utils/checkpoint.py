@@ -135,6 +135,20 @@ def save(
     os.replace(tmp, final)
     _atomic_write_bytes(path_dir, _STATE + _SHA_SUFFIX, sha.encode())
     _atomic_write_bytes(path_dir, _TRACE, trace_blob)
+    # telemetry: one checkpoint_save record per committed generation
+    # (no-op without an active utils.obs run); also a durability point
+    # for the event stream itself
+    from . import obs
+
+    obs.record(
+        "checkpoint_save",
+        iteration=int(it),
+        path=final,
+        bytes=os.path.getsize(final),
+    )
+    run = obs.current_run()
+    if run is not None and run.active:
+        run.writer.sync()
     return final
 
 
@@ -251,6 +265,14 @@ def load(path_dir: str, expect_fingerprint: Optional[str] = None):
                     "paired trace (crash mid-save?) — resuming its "
                     "state with a fresh trace"
                 )
+            from . import obs
+
+            obs.record(
+                "checkpoint_load",
+                iteration=int(got[2]),
+                path=os.path.join(path_dir, state_name),
+                generation="prev" if idx > 0 else "newest",
+            )
             return got
     if had_newest or os.path.exists(os.path.join(path_dir, _STATE_PREV)):
         raise RuntimeError(

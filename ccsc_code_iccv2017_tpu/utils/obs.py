@@ -1,0 +1,656 @@
+"""Run telemetry: structured event stream, compile tracking, heartbeats.
+
+The reference's only run record is the ``iterations`` tic/toc struct
+(2D/admm_learn_conv2D_large_dParallel.m:62-71) printed to the MATLAB
+console; every performance claim in PERF.md so far was reconstructed
+after the fact from one-off probe scripts and hand-lifted annotations.
+This module makes run measurement first-class (the observability stance
+of multi-worker ADMM systems, PAPERS.md arXiv:1312.3040, and of JAX
+solver libraries like MPAX, arXiv:2412.09734): every learner and
+reconstruction run can emit a machine-readable telemetry stream by
+setting ONE knob (``LearnConfig.metrics_dir`` / ``--metrics-dir``).
+
+Pieces:
+
+- ``EventWriter`` / ``read_events`` — an append-only JSONL event
+  stream, one file per host process, crash-safe: each record is one
+  flushed line, and the reader tolerates a torn trailing line (a
+  preempted run's telemetry survives up to its last whole record).
+- ``Run`` — the per-run handle the drivers hold: typed records
+  (``run_meta``, ``step``, ``chunk``/``roofline``, ``heartbeat``,
+  ``checkpoint_save``/``checkpoint_load``, ``recovery``,
+  ``preemption``, ``phase``, ``log``, ``compile``, ``summary``), plus
+  the console tier — ``Run.console`` replaces the drivers' bare
+  ``print`` so the terminal and the event stream are formatted from
+  the SAME values and cannot drift.
+- ``CompileMonitor`` — ``jax.monitoring`` event-duration listeners for
+  the jit trace / lower / backend-compile events, with best-effort
+  function names and abstract shapes harvested from the dispatch/pxla
+  debug logs. The end-of-run summary counts compiles per function and
+  flags anything compiled more than once — the silent recompile that
+  is THE classic JAX perf killer.
+- heartbeats — in a ``distributed.initialize`` run every host appends
+  periodic ``heartbeat`` records (host id, step, timestamp, last fence
+  latency) to its own file in the shared metrics dir, so stragglers
+  and dead hosts are diagnosable post-mortem from the stream alone.
+- roofline — ``Run.chunk`` scores each chunk's achieved iteration rate
+  against the analytic utils.perfmodel bounds (MFU + HBM fraction) and
+  emits the live roofline line to the stream and, at the 'all' verbose
+  tier, to the console.
+
+``scripts/obs_report.py`` renders a metrics dir into the text
+dashboard PERF.md sections are written from.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import re
+import socket
+import subprocess
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+# console tiers, most to least important; a Run configured at verbose
+# level v prints every message whose tier is at or above v ('always'
+# prints even at verbose='none' — failure/recovery/preemption messages
+# were unconditional prints before this module existed)
+_TIERS = {"always": 0, "brief": 1, "all": 2}
+_VERBOSE_ADMITS = {"none": 0, "brief": 1, "all": 2}
+
+
+def git_sha() -> Optional[str]:
+    """Best-effort git revision of the running tree (provenance field
+    of run_meta and bench records). Env override CCSC_GIT_SHA first so
+    deployed copies without a .git can still stamp records."""
+    env = os.environ.get("CCSC_GIT_SHA")
+    if env:
+        return env
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=10,
+        )
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except Exception:
+        return None
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class EventWriter:
+    """Append-only JSONL writer; one flushed line per record.
+
+    Append mode means a resumed run keeps extending the same file —
+    the stream is the union of all attempts, each starting with its
+    own run_meta record. fsync is reserved for ``sync()`` (called on
+    checkpoint events and close); per-record flush already survives a
+    process crash, and fsync-per-step would throttle the driver."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        # a previous attempt killed mid-write leaves a torn final line
+        # with no newline; appending straight onto it would destroy
+        # THIS attempt's first record too — terminate it first
+        try:
+            with open(path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b"\n"
+        except (OSError, ValueError):
+            torn = False
+        self._f = open(path, "a", encoding="utf-8")
+        if torn:
+            self._f.write("\n")
+        # REENTRANT: a SIGTERM handler (utils.resilience) may emit a
+        # record while the main thread is mid-write under this lock
+        self._lock = threading.RLock()
+
+    def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record, default=_json_default)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def sync(self) -> None:
+        with self._lock:
+            self._f.flush()
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:  # pragma: no cover - exotic filesystems
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+
+def _json_default(o):
+    """Arrays and numpy scalars appear in knob dicts and metrics."""
+    try:
+        import numpy as np
+
+        if isinstance(o, np.generic):
+            return o.item()
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except Exception:  # pragma: no cover
+        pass
+    return str(o)
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse one events file or every ``events-*.jsonl`` in a dir.
+
+    Crash tolerance: a torn trailing line (the crash window of the
+    line-granular writer) is silently dropped; a malformed line
+    ANYWHERE else is dropped too rather than failing the whole stream
+    (append-only files can interleave a partial record from a killed
+    writer with later appends from its resume). Multi-file dirs are
+    merged in timestamp order so per-host streams read as one run."""
+    if os.path.isdir(path):
+        recs: List[Dict[str, Any]] = []
+        for name in sorted(os.listdir(path)):
+            if name.startswith("events") and name.endswith(".jsonl"):
+                recs.extend(read_events(os.path.join(path, name)))
+        recs.sort(key=lambda r: r.get("t", 0.0))
+        return recs
+    out = []
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn write
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+# --------------------------------------------------------------------
+# compile / recompile tracking
+# --------------------------------------------------------------------
+
+_EVENT_KIND = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "compile",
+}
+
+_RE_COMPILE = re.compile(r"Finished XLA compilation of (.+) in [0-9.eE+-]+ sec")
+_RE_TRACE = re.compile(
+    r"Finished tracing \+ transforming (.+) for (?:pjit|pmap) in"
+)
+_RE_SHAPES = re.compile(
+    r"Compiling (\S+) with global shapes and types (\[[^\n]*\])"
+)
+
+
+class CompileMonitor:
+    """jax.monitoring listeners for trace/lower/compile events.
+
+    The public monitoring API reports event KEY + duration only; the
+    function names and abstract input shapes live in the dispatch/pxla
+    debug logs, which fire immediately before the matching duration
+    event. A handler on those loggers stashes the latest name/shapes
+    and the duration listener claims them — best-effort (a miss just
+    records an unnamed event), zero-cost when uninstalled."""
+
+    def __init__(self):
+        self.events: List[Dict[str, Any]] = []
+        self._pending: Dict[str, str] = {}
+        self._shapes: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._installed = False
+        self._handler: Optional[logging.Handler] = None
+        self._loggers: List[tuple] = []
+        self._sink = None  # Optional[EventWriter-backed callback]
+
+    # -- log harvesting ------------------------------------------------
+    def _on_log(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:  # pragma: no cover
+            return
+        m = _RE_COMPILE.search(msg)
+        if m:
+            with self._lock:
+                self._pending["compile"] = m.group(1)
+            return
+        m = _RE_TRACE.search(msg)
+        if m:
+            with self._lock:
+                self._pending["trace"] = m.group(1)
+            return
+        m = _RE_SHAPES.search(msg)
+        if m:
+            with self._lock:
+                self._shapes[m.group(1)] = m.group(2)
+
+    def _on_duration(self, event: str, duration_secs: float, **kw) -> None:
+        kind = _EVENT_KIND.get(event)
+        if kind is None:
+            return
+        with self._lock:
+            name = self._pending.pop(kind, None)
+            shapes = None
+            if name:
+                # the shapes log keys the bare name; the compile log
+                # wraps it as 'jit(name)'
+                inner = name[4:-1] if name.startswith("jit(") else name
+                shapes = self._shapes.get(name) or self._shapes.get(inner)
+        rec = {
+            "kind": kind,
+            "fun_name": name,
+            "duration_s": float(duration_secs),
+            "shapes": shapes,
+            "t": time.time(),
+        }
+        self.events.append(rec)
+        if self._sink is not None:
+            try:
+                self._sink(rec)
+            except Exception:  # pragma: no cover - never break a compile
+                pass
+
+    # -- lifecycle -----------------------------------------------------
+    def install(self, sink=None) -> "CompileMonitor":
+        if self._installed:
+            return self
+        self._sink = sink
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(self._on_duration)
+
+        class _H(logging.Handler):
+            def __init__(h, cb):
+                super().__init__(logging.DEBUG)
+                h._cb = cb
+
+            def emit(h, record):
+                h._cb(record)
+
+        self._handler = _H(self._on_log)
+        for name in ("jax._src.dispatch", "jax._src.interpreters.pxla"):
+            lg = logging.getLogger(name)
+            self._loggers.append((lg, lg.level, lg.propagate))
+            lg.addHandler(self._handler)
+            if lg.getEffectiveLevel() > logging.DEBUG:
+                lg.setLevel(logging.DEBUG)
+                # the DEBUG records exist only for this harvester; do
+                # not let them flood the root handler / console
+                lg.propagate = False
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        try:
+            from jax._src import monitoring as _mon
+
+            _mon._unregister_event_duration_listener_by_callback(
+                self._on_duration
+            )
+        except Exception:  # pragma: no cover - private API drift
+            pass
+        for lg, level, propagate in self._loggers:
+            lg.removeHandler(self._handler)
+            lg.setLevel(level)
+            lg.propagate = propagate
+        self._loggers = []
+        self._handler = None
+        self._sink = None
+        self._installed = False
+
+    # -- reporting -----------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        """Per-function backend-compile counts; a function compiled
+        more than once recompiled — expected for a partial final chunk
+        or a post-recovery rho rebuild, a silent perf bug otherwise
+        (the summary flags it either way; the events carry the shapes
+        to tell which)."""
+        by_fun: Dict[str, int] = {}
+        compile_s = 0.0
+        trace_s = 0.0
+        n_compiles = 0
+        for ev in self.events:
+            if ev["kind"] == "compile":
+                n_compiles += 1
+                compile_s += ev["duration_s"]
+                key = ev["fun_name"] or "<unknown>"
+                by_fun[key] = by_fun.get(key, 0) + 1
+            elif ev["kind"] == "trace":
+                trace_s += ev["duration_s"]
+        return {
+            "n_compiles": n_compiles,
+            "compile_time_s": round(compile_s, 4),
+            "trace_time_s": round(trace_s, 4),
+            "compiles_by_fun": by_fun,
+            "recompiled_funs": sorted(
+                f for f, c in by_fun.items() if c > 1
+            ),
+        }
+
+
+# --------------------------------------------------------------------
+# the Run handle
+# --------------------------------------------------------------------
+
+_CURRENT: List["Run"] = []
+
+
+def current_run() -> Optional["Run"]:
+    """Innermost active Run, if any — the hook utils.checkpoint and
+    utils.resilience use to emit events without threading a handle
+    through every call site."""
+    return _CURRENT[-1] if _CURRENT else None
+
+
+def record(type_: str, **fields) -> None:
+    """Append a record to the current run's stream (no-op without an
+    active run or with telemetry off)."""
+    run = current_run()
+    if run is not None:
+        run.event(type_, **fields)
+
+
+def console(msg: str, tier: str = "brief") -> None:
+    """Route a console line through the current run's verbose tier (so
+    library code never calls bare print); plain print fallback when no
+    run is active and the tier is important enough."""
+    run = current_run()
+    if run is not None:
+        run.console(msg, tier=tier)
+    elif _TIERS.get(tier, 1) <= _TIERS["brief"]:
+        print(msg)
+
+
+class Run:
+    """One run's telemetry handle.
+
+    ``writer`` None = telemetry off: the console tier still works (the
+    drivers hold exactly one code path), every event method is a cheap
+    no-op. All record fields must already be host values — the drivers
+    call these methods strictly AFTER their existing readback fences,
+    so instrumentation adds zero dispatches and zero fences.
+    """
+
+    def __init__(
+        self,
+        writer: Optional[EventWriter],
+        verbose: str = "brief",
+        heartbeat_every_s: Optional[float] = None,
+    ):
+        self.writer = writer
+        self.verbose = verbose if verbose in _VERBOSE_ADMITS else "brief"
+        self.closed = False
+        self.compile_monitor: Optional[CompileMonitor] = None
+        self.chip: Optional[str] = None
+        self._host = _process_index()
+        if heartbeat_every_s is None:
+            heartbeat_every_s = float(
+                os.environ.get("CCSC_OBS_HEARTBEAT_S", "30")
+            )
+        self._hb_every = heartbeat_every_s
+        self._hb_last = 0.0
+        self._n_events = 0
+
+    @property
+    def active(self) -> bool:
+        return self.writer is not None and not self.closed
+
+    # -- primitives ----------------------------------------------------
+    def event(self, type_: str, **fields) -> None:
+        if not self.active:
+            return
+        rec = {"t": time.time(), "type": type_, "host": self._host}
+        rec.update(fields)
+        self.writer.write(rec)
+        self._n_events += 1
+
+    def console(self, msg: str, tier: str = "brief") -> None:
+        """Print ``msg`` when the run's verbose level admits ``tier``,
+        mirroring every printed line into the stream — terminal and
+        telemetry are the same emission, so they cannot drift. (Lines
+        suppressed by the tier are not recorded either: the metric
+        records carry the data; ``log`` records are the console.)"""
+        if _TIERS.get(tier, 1) <= _VERBOSE_ADMITS[self.verbose]:
+            print(msg)
+            self.event("log", tier=tier, msg=msg)
+
+    # -- typed records -------------------------------------------------
+    def step(self, it: int, **metrics) -> None:
+        self.event("step", it=int(it), **metrics)
+
+    def chunk(
+        self,
+        start_it: int,
+        length: int,
+        n_adopted: int,
+        dt_s: float,
+        cost: Optional[Dict[str, float]] = None,
+    ) -> None:
+        """Per-chunk throughput record; with a perfmodel ``cost`` the
+        live roofline (MFU + HBM fraction vs the chip's bounds) rides
+        the same record and the 'all' console tier."""
+        ips = (n_adopted / dt_s) if dt_s > 0 and n_adopted else 0.0
+        fields: Dict[str, Any] = {
+            "start_it": int(start_it),
+            "length": int(length),
+            "n_adopted": int(n_adopted),
+            "dt_s": round(float(dt_s), 6),
+            "it_per_sec": round(ips, 5),
+        }
+        line = (
+            f"chunk {start_it + 1}..{start_it + n_adopted}: "
+            f"{ips:.3g} it/s"
+        )
+        if cost is not None and ips > 0:
+            from . import perfmodel
+
+            util = perfmodel.utilization(cost, ips, chip=self.chip)
+            self.chip = util["chip"]
+            bound = perfmodel.bound_iters_per_sec(cost, chip=util["chip"])
+            fields.update(
+                chip=util["chip"],
+                mfu=round(util["mfu_vs_bf16_peak"], 6),
+                hbm_frac=round(util["hbm_frac"], 5),
+                achieved_tflops=round(util["achieved_tflops"], 4),
+                achieved_gbps=round(util["achieved_gbps"], 3),
+                bound_it_per_sec=round(bound, 4),
+            )
+            line += (
+                f", MFU {100 * util['mfu_vs_bf16_peak']:.2f}%, "
+                f"HBM {100 * util['hbm_frac']:.1f}%, "
+                f"{100 * ips / bound:.0f}% of the {util['chip']} "
+                f"roofline bound ({bound:.3g} it/s)"
+            )
+        self.event("roofline", **fields)
+        if _VERBOSE_ADMITS[self.verbose] >= _TIERS["all"]:
+            print(line)
+
+    def heartbeat(self, step: int, fence_latency_s: float) -> None:
+        """Periodic per-host liveness record (cadence
+        CCSC_OBS_HEARTBEAT_S seconds, default 30; 0 = every fence).
+        ``fence_latency_s`` is the wall time of the last readback fence
+        — a straggler shows up as one host's latency drifting."""
+        if not self.active:
+            return
+        now = time.time()
+        if self._hb_last and now - self._hb_last < self._hb_every:
+            return
+        self._hb_last = now
+        self.event(
+            "heartbeat",
+            step=int(step),
+            fence_latency_s=round(float(fence_latency_s), 6),
+        )
+
+    def drain_timers(self, timers, phase: str = "run") -> None:
+        """Flush a utils.profiling.SectionTimers into one ``phase``
+        record (totals since the previous drain) and reset it."""
+        if timers is None:
+            return
+        drained = timers.drain()
+        if drained:
+            self.event("phase", phase=phase, sections=drained)
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self, status: str = "ok", **fields) -> None:
+        """Emit the compile summary + final summary record and release
+        listeners/file. Idempotent — drivers call it from a finally
+        with status='error' as the backstop; the first close wins."""
+        if self.closed:
+            return
+        self.closed = True
+        if _CURRENT and _CURRENT[-1] is self:
+            _CURRENT.pop()
+        elif self in _CURRENT:  # pragma: no cover - defensive
+            _CURRENT.remove(self)
+        if self.compile_monitor is not None:
+            summary = self.compile_monitor.summary()
+            self.compile_monitor.uninstall()
+        else:
+            summary = None
+        if self.writer is not None:
+            rec = {
+                "t": time.time(),
+                "type": "summary",
+                "host": self._host,
+                "status": status,
+                "n_events": self._n_events + 1,
+            }
+            if summary is not None:
+                rec["compile"] = summary
+                if summary["recompiled_funs"]:
+                    self.console(
+                        "obs: recompiles detected for "
+                        + ", ".join(summary["recompiled_funs"])
+                        + " — expected only for partial chunks or "
+                        "post-recovery rebuilds (see the compile "
+                        "events' shapes)",
+                        tier="all",
+                    )
+            rec.update(fields)
+            self.writer.write(rec)
+            self.writer.sync()
+            self.writer.close()
+
+
+class _NullWriterRun(Run):
+    """Telemetry-off Run (console tier only)."""
+
+    def __init__(self, verbose: str = "brief"):
+        super().__init__(None, verbose=verbose)
+
+
+def start_run(
+    metrics_dir: Optional[str],
+    algorithm: str,
+    verbose: str = "brief",
+    geom=None,
+    cfg=None,
+    fingerprint: Optional[str] = None,
+    mesh=None,
+    **extra_meta,
+) -> Run:
+    """Open a telemetry run (or a console-only null run when
+    ``metrics_dir`` is None) and push it as the current run.
+
+    Writes the run_meta record — git sha, host identity, platform /
+    chip, device + process counts, mesh shape, the full knob dict of
+    ``cfg``, geometry, and the checkpoint config fingerprint — then
+    installs the compile monitor so every later jit trace/compile
+    lands in the stream."""
+    if metrics_dir is None:
+        run = _NullWriterRun(verbose=verbose)
+        _CURRENT.append(run)
+        return run
+    pid = _process_index()
+    writer = EventWriter(
+        os.path.join(metrics_dir, f"events-p{pid:05d}.jsonl")
+    )
+    run = Run(writer, verbose=verbose)
+    meta: Dict[str, Any] = {
+        "schema_version": SCHEMA_VERSION,
+        "algorithm": algorithm,
+        "git_sha": git_sha(),
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+        "fingerprint": fingerprint,
+    }
+    try:
+        import jax
+
+        from . import perfmodel
+
+        meta.update(
+            jax_version=jax.__version__,
+            platform=jax.devices()[0].platform,
+            device_count=jax.device_count(),
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+        run.chip = perfmodel.detect_chip()
+        meta["chip"] = run.chip
+    except Exception:  # pragma: no cover - pre-backend telemetry
+        pass
+    if mesh is not None:
+        meta["mesh_shape"] = {
+            str(k): int(v) for k, v in dict(mesh.shape).items()
+        }
+    if geom is not None:
+        meta["geom"] = {
+            "spatial_support": list(geom.spatial_support),
+            "num_filters": geom.num_filters,
+            "reduce_shape": list(geom.reduce_shape),
+        }
+    if cfg is not None:
+        try:
+            meta["config"] = dataclasses.asdict(cfg)
+        except TypeError:  # pragma: no cover - non-dataclass cfg
+            meta["config"] = str(cfg)
+    meta.update(extra_meta)
+    run.event("run_meta", **meta)
+    # only backend compiles land in the stream as records (every tiny
+    # eager op traces through pjit too — the trace/lower durations are
+    # still aggregated into the close() summary); each record carries
+    # the name + input avals harvested from the debug logs
+    run.compile_monitor = CompileMonitor().install(
+        sink=lambda ev: run.event(
+            "compile",
+            kind=ev["kind"],
+            fun_name=ev["fun_name"],
+            duration_s=round(ev["duration_s"], 6),
+            shapes=ev["shapes"],
+        )
+        if ev["kind"] == "compile"
+        else None
+    )
+    _CURRENT.append(run)
+    return run

@@ -206,6 +206,24 @@ def analytic_outer_step_cost(
     return {"flops": flops, "bytes": bytes_}
 
 
+def bound_iters_per_sec(
+    cost: Dict[str, float], chip: Optional[str] = None
+) -> float:
+    """Roofline upper bound on outer iterations/sec for this cost on
+    this chip: the tighter of the HBM-traffic bound (bytes / peak
+    bandwidth — the ~8.9 it/s ceiling PERF.md quotes for the
+    north-star shape) and the compute bound (flops / peak MXU rate).
+    The live telemetry (utils.obs roofline records) reports each
+    chunk's achieved rate next to this number so the remaining gap is
+    recorded, not re-derived every round."""
+    chip = chip or detect_chip()
+    peaks = CHIP_PEAKS.get(chip.split("->")[-1], CHIP_PEAKS["v5e"])
+    t_flops = cost["flops"] / peaks["flops_bf16"]
+    t_bytes = cost["bytes"] / peaks["hbm_gbps"]
+    t = max(t_flops, t_bytes)
+    return 1.0 / t if t > 0 else float("inf")
+
+
 def utilization(
     cost: Dict[str, float], steps_per_sec: float, chip: Optional[str] = None
 ) -> Dict[str, float]:

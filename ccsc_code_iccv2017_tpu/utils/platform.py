@@ -8,7 +8,7 @@ would still dial the TPU tunnel. Calling
 re-asserts the environment's choice via jax.config.
 """
 import os
-import sys
+import warnings
 
 
 def honor_jax_platforms_env() -> None:
@@ -25,9 +25,8 @@ def honor_jax_platforms_env() -> None:
     try:
         jax.config.update("jax_platforms", plat)
     except Exception as e:  # pragma: no cover - defensive
-        print(
-            f"[ccsc] warning: could not re-assert JAX_PLATFORMS={plat!r}"
-            f" ({type(e).__name__}: {e}); the run may use the default"
-            " platform instead",
-            file=sys.stderr,
+        warnings.warn(
+            f"could not re-assert JAX_PLATFORMS={plat!r} "
+            f"({type(e).__name__}: {e}); the run may use the default "
+            "platform instead"
         )

@@ -68,12 +68,30 @@ class SectionTimers:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, dt: float) -> None:
+        """Charge ``dt`` seconds to a section directly — for drivers
+        that already hold a measured duration (chunk fences) and
+        cannot wrap the region in a context manager."""
+        self.totals[name] = self.totals.get(name, 0.0) + dt
+        self.counts[name] = self.counts.get(name, 0) + 1
 
     def report(self) -> Dict[str, float]:
         return dict(self.totals)
+
+    def drain(self) -> Dict[str, Dict[str, float]]:
+        """Return {name: {'s': total, 'n': count}} accumulated since
+        the last drain and reset — the event-stream protocol of
+        utils.obs.Run.drain_timers (each ``phase`` record carries the
+        delta, so consecutive records sum to the run total)."""
+        out = {
+            k: {"s": round(v, 6), "n": self.counts.get(k, 0)}
+            for k, v in self.totals.items()
+        }
+        self.totals = {}
+        self.counts = {}
+        return out
 
     def __str__(self) -> str:
         return "  ".join(

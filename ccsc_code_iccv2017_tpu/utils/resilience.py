@@ -136,11 +136,14 @@ class RecoveryManager:
             "rho_d": float(self._base.rho_d * self.scale),
             "rho_z": float(self._base.rho_z * self.scale),
         }
-        print(
+        from . import obs
+
+        obs.console(
             f"Iter {failed_it}: divergence recovery {self.used}/"
             f"{self._base.max_recoveries} — restoring last good state, "
             f"backing off rho to scale {self.scale:g} "
-            f"(rho_d={ev['rho_d']:g}, rho_z={ev['rho_z']:g})"
+            f"(rho_d={ev['rho_d']:g}, rho_z={ev['rho_z']:g})",
+            tier="always",
         )
         return ev
 
@@ -175,9 +178,12 @@ class GracefulShutdown:
             return
         self.requested = True
         self.signum = signum
-        print(
+        from . import obs
+
+        obs.console(
             f"received signal {signum}: will checkpoint and exit at "
-            "the next iteration boundary (signal again to force)"
+            "the next iteration boundary (signal again to force)",
+            tier="always",
         )
 
     def _restore(self):

@@ -29,7 +29,8 @@ from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
 honor_jax_platforms_env()
 
 from family_banks import (  # noqa: E402
-    SHIPPED, central_slice, heldout_psnr_3d, synth_video,
+    SHIPPED, central_slice, heldout_psnr_3d, inmem_learn_estimate,
+    synth_video,
 )
 
 
@@ -71,6 +72,24 @@ def main():
         max_it=args.more, tol=1e-2, rho_d=5000.0, rho_z=1.0,
         num_blocks=8, verbose="brief", track_objective=True, **knobs,
     )
+    # pre-flight: the in-memory n=64 learn materializes full-batch
+    # code spectra; on a chip whose HBM the estimate exceeds, the
+    # compile-then-OOM attempt costs ~5 min before failing (the r5
+    # full-scale 3D train did exactly that). Warm-start requires
+    # init_d, which the streaming learner does not take — so this is
+    # an explicit error, not a silent fallback (ADVICE open item).
+    est, budget = inmem_learn_estimate(b.shape, geom, cfg)
+    if plat in ("tpu", "axon") and est > budget:
+        raise SystemExit(
+            f"continue_3d pre-flight: the in-memory n={args.n} learn "
+            f"needs ~{est / 1e9:.1f} GB of full-batch temps, over the "
+            f"~{budget / 1e9:.0f} GB device budget (CCSC_INMEM_HBM_GB) "
+            "— it would compile for minutes and then OOM. Run with "
+            "JAX_PLATFORMS=cpu (host RAM), shrink --n, or train from "
+            "scratch with the streaming learner "
+            "(scripts/family_banks.py, which falls back to it; "
+            "streaming cannot warm-start from --bank)."
+        )
     t0 = time.time()
     res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0),
                 init_d=jnp.asarray(init))

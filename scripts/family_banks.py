@@ -49,6 +49,36 @@ SHIPPED = {
 }
 
 
+def inmem_learn_estimate(b_shape, geom, cfg):
+    """Pre-flight byte estimate of the in-memory consensus learner's
+    peak working set, and the HBM budget to compare it against.
+
+    ~5 live full-batch complex code spectra inside the z iteration +
+    the f32/bf16 z/dual state — the measured driver of the r5
+    full-scale 3D OOM. Returns (est_bytes, budget_bytes); budget from
+    CCSC_INMEM_HBM_GB (default 14 — the 16 GB v5e minus runtime
+    reserves). Shared by the memory-bounded learn below and
+    scripts/continue_3d.py's pre-flight (ADVICE open item)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.models.common import FreqGeom
+
+    fg_est = FreqGeom.create(
+        geom, tuple(b_shape[-geom.ndim_spatial:]),
+        fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl,
+    )
+    est = (
+        5 * b_shape[0] * geom.num_filters * fg_est.num_freq * 8
+        + 2 * b_shape[0] * geom.num_filters
+        * int(np.prod(fg_est.spatial_shape))
+        * jnp.dtype(cfg.storage_dtype).itemsize
+    )
+    budget = float(os.environ.get("CCSC_INMEM_HBM_GB", "14")) * 1e9
+    return est, budget
+
+
 def _imgs(contrast="local_cn"):
     import numpy as np
 
@@ -237,27 +267,14 @@ def main():
         doomed ~5-minute compile-then-OOM attempt outright."""
         import numpy as np
 
-        from ccsc_code_iccv2017_tpu.models.common import FreqGeom
         from ccsc_code_iccv2017_tpu.parallel.streaming import (
             learn_streaming,
         )
 
-        fg_est = FreqGeom.create(
-            geom, b.shape[-geom.ndim_spatial:],
-            fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl,
-        )
-        # ~5 live full-batch complex code spectra inside the z
-        # iteration + f32 z/dual state — the measured OOM driver
-        est = (
-            5 * b.shape[0] * geom.num_filters * fg_est.num_freq * 8
-            + 2 * b.shape[0] * geom.num_filters
-            * int(np.prod(fg_est.spatial_shape))
-            * jnp.dtype(cfg.storage_dtype).itemsize
-        )
-        hbm_gb = float(os.environ.get("CCSC_INMEM_HBM_GB", "14"))
-        if plat in ("tpu", "axon") and est > hbm_gb * 1e9:
+        est, budget = inmem_learn_estimate(b.shape, geom, cfg)
+        if plat in ("tpu", "axon") and est > budget:
             print(f"in-memory learn pre-flight: ~{est/1e9:.1f} GB "
-                  f"full-batch temps > {hbm_gb:.0f} GB budget; going "
+                  f"full-batch temps > {budget/1e9:.0f} GB budget; going "
                   "straight to the streaming learner", flush=True)
             return learn_streaming(
                 np.asarray(b, np.float32), geom, cfg,

@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Render a run's telemetry stream (utils.obs JSONL) as a text dashboard.
+
+Usage:
+    python scripts/obs_report.py METRICS_DIR_OR_FILE [--json]
+
+Sections: run header (identity/provenance), phase breakdown
+(SectionTimers drains), step trajectory, roofline trajectory (per-chunk
+it/s, MFU, HBM fraction), compile/recompile table, per-host heartbeat
+timeline, checkpoint/recovery/preemption events, final summary. This
+is the dashboard PERF.md sections are written from — and what bench.py
+points at via its ``event_stream`` provenance field.
+
+Works on a live (still-growing) stream: the reader drops a torn
+trailing line, so the report is always renderable mid-run.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils import obs  # noqa: E402
+
+
+def _fmt_ts(t):
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t))
+
+
+def _by_type(events):
+    out = {}
+    for e in events:
+        out.setdefault(e.get("type", "?"), []).append(e)
+    return out
+
+
+def _section(title):
+    return f"\n== {title} " + "=" * max(1, 64 - len(title))
+
+
+def render(events):
+    """-> the dashboard string (pure function of the parsed records)."""
+    by = _by_type(events)
+    lines = []
+
+    metas = by.get("run_meta", [])
+    lines.append("CCSC run telemetry report")
+    if not events:
+        lines.append("  (no records)")
+        return "\n".join(lines)
+    lines.append(
+        f"  {len(events)} records, {_fmt_ts(events[0]['t'])} .. "
+        f"{_fmt_ts(events[-1]['t'])}"
+    )
+
+    lines.append(_section("RUN"))
+    if metas:
+        m = metas[-1]  # newest attempt; earlier metas = resumes
+        cfgknobs = m.get("config") or {}
+        lines.append(f"  algorithm     {m.get('algorithm')}")
+        lines.append(f"  git sha       {m.get('git_sha')}")
+        lines.append(
+            f"  platform      {m.get('platform')} ({m.get('chip')}), "
+            f"{m.get('device_count')} device(s), "
+            f"{m.get('process_count', 1)} process(es)"
+        )
+        if m.get("mesh_shape"):
+            lines.append(f"  mesh          {m['mesh_shape']}")
+        if m.get("geom"):
+            lines.append(f"  geom          {m['geom']}")
+        if m.get("data_shape"):
+            lines.append(f"  data          {m['data_shape']}")
+        fp = m.get("fingerprint")
+        lines.append(f"  fingerprint   {fp[:16] + '…' if fp else None}")
+        if len(metas) > 1:
+            lines.append(f"  attempts      {len(metas)} (resumed run)")
+        knob_keys = (
+            "outer_chunk", "donate_state", "fft_impl", "fft_pad",
+            "fused_z", "storage_dtype", "d_storage_dtype", "num_blocks",
+            "max_it", "max_it_d", "max_it_z",
+        )
+        knobs = {k: cfgknobs[k] for k in knob_keys if k in cfgknobs}
+        if knobs:
+            lines.append(f"  knobs         {json.dumps(knobs)}")
+
+    phases = by.get("phase", [])
+    lines.append(_section("PHASES"))
+    if phases:
+        totals = {}
+        for p in phases:
+            for name, v in (p.get("sections") or {}).items():
+                agg = totals.setdefault(name, {"s": 0.0, "n": 0})
+                agg["s"] += v.get("s", 0.0)
+                agg["n"] += v.get("n", 0)
+        width = max(len(n) for n in totals)
+        for name, agg in sorted(
+            totals.items(), key=lambda kv: -kv[1]["s"]
+        ):
+            lines.append(
+                f"  {name:<{width}}  {agg['s']:9.2f}s  x{agg['n']}"
+            )
+    else:
+        lines.append("  (no phase records)")
+
+    steps = by.get("step", [])
+    lines.append(_section("STEPS"))
+    if steps:
+        first, last = steps[0], steps[-1]
+        lines.append(f"  recorded      {len(steps)} step records")
+        for label, s in (("first", first), ("last", last)):
+            fields = ", ".join(
+                f"{k}={s[k]:.4g}" if isinstance(s[k], float) else
+                f"{k}={s[k]}"
+                for k in ("it", "obj_d", "obj_z", "d_diff", "z_diff",
+                          "obj", "diff", "consensus_dis", "nonfinite_z")
+                if k in s
+            )
+            lines.append(f"  {label:<6} {fields}")
+        bad = [s for s in steps if s.get("nonfinite_z")]
+        if bad:
+            lines.append(
+                f"  NON-FINITE    {len(bad)} step(s) with nonfinite_z > 0, "
+                f"first at it={bad[0]['it']}"
+            )
+    else:
+        lines.append("  (no step records)")
+
+    roofs = by.get("roofline", [])
+    lines.append(_section("ROOFLINE"))
+    if roofs:
+        lines.append(
+            "  iters      it/s        MFU    HBM frac   dt"
+        )
+        for r in roofs:
+            span = (
+                f"{r.get('start_it', 0) + 1}"
+                f"..{r.get('start_it', 0) + r.get('n_adopted', 0)}"
+            )
+            mfu = r.get("mfu")
+            hbm = r.get("hbm_frac")
+            lines.append(
+                f"  {span:<9}  {r.get('it_per_sec', 0.0):8.3f}  "
+                + (f"{100 * mfu:7.2f}%" if mfu is not None else "      —")
+                + "  "
+                + (f"{100 * hbm:8.2f}%" if hbm is not None else "       —")
+                + f"  {r.get('dt_s', 0.0):6.2f}s"
+            )
+        chip = next((r["chip"] for r in roofs if r.get("chip")), None)
+        if chip:
+            lines.append(f"  (scored against the {chip} roofline, "
+                         "utils.perfmodel)")
+    else:
+        lines.append("  (no roofline records)")
+
+    compiles = [
+        c for c in by.get("compile", []) if c.get("kind") == "compile"
+    ]
+    lines.append(_section("COMPILES"))
+    summary = next(
+        (s.get("compile") for s in reversed(by.get("summary", []))
+         if s.get("compile")),
+        None,
+    )
+    if compiles or summary:
+        by_fun = {}
+        for c in compiles:
+            key = c.get("fun_name") or "<unknown>"
+            agg = by_fun.setdefault(key, {"n": 0, "s": 0.0, "shapes": None})
+            agg["n"] += 1
+            agg["s"] += c.get("duration_s", 0.0)
+            agg["shapes"] = agg["shapes"] or c.get("shapes")
+        if not by_fun and summary:
+            by_fun = {
+                f: {"n": n, "s": 0.0, "shapes": None}
+                for f, n in summary.get("compiles_by_fun", {}).items()
+            }
+        width = min(44, max((len(f) for f in by_fun), default=8))
+        for fun, agg in sorted(by_fun.items(), key=lambda kv: -kv[1]["n"]):
+            flag = "  <-- RECOMPILED" if agg["n"] > 1 else ""
+            lines.append(
+                f"  {fun[:width]:<{width}}  x{agg['n']:<3} "
+                f"{agg['s']:8.3f}s{flag}"
+            )
+        if summary:
+            lines.append(
+                f"  total: {summary.get('n_compiles')} backend compiles, "
+                f"{summary.get('compile_time_s')}s compiling, "
+                f"{summary.get('trace_time_s')}s tracing"
+            )
+            if summary.get("recompiled_funs"):
+                lines.append(
+                    "  recompiled: "
+                    + ", ".join(summary["recompiled_funs"])
+                    + "  (expected only for partial chunks / "
+                    "post-recovery rho rebuilds)"
+                )
+    else:
+        lines.append("  (no compile records)")
+
+    hbs = by.get("heartbeat", [])
+    lines.append(_section("HOSTS"))
+    if hbs:
+        hosts = {}
+        for h in hbs:
+            hosts.setdefault(h.get("host", 0), []).append(h)
+        for host in sorted(hosts):
+            hs = hosts[host]
+            gaps = [
+                b["t"] - a["t"] for a, b in zip(hs, hs[1:])
+            ]
+            lat = max(h.get("fence_latency_s", 0.0) for h in hs)
+            lines.append(
+                f"  host {host}: {len(hs)} heartbeats, steps "
+                f"{hs[0].get('step')}..{hs[-1].get('step')}, last "
+                f"{_fmt_ts(hs[-1]['t'])}, max gap "
+                f"{max(gaps):.1f}s, max fence {lat:.3f}s"
+                if gaps else
+                f"  host {host}: {len(hs)} heartbeat, step "
+                f"{hs[0].get('step')}, at {_fmt_ts(hs[0]['t'])}, "
+                f"fence {lat:.3f}s"
+            )
+    else:
+        lines.append("  (no heartbeat records)")
+
+    lines.append(_section("EVENTS"))
+    n_ev = 0
+    for kind in ("checkpoint_save", "checkpoint_load", "recovery",
+                 "preemption"):
+        for e in by.get(kind, []):
+            n_ev += 1
+            detail = {
+                k: v for k, v in e.items()
+                if k not in ("t", "type", "host")
+            }
+            lines.append(
+                f"  {_fmt_ts(e['t'])}  {kind:<16} {json.dumps(detail)}"
+            )
+    if not n_ev:
+        lines.append("  (no checkpoint/recovery/preemption events)")
+
+    lines.append(_section("SUMMARY"))
+    summaries = by.get("summary", [])
+    if summaries:
+        s = summaries[-1]
+        detail = {
+            k: v for k, v in s.items()
+            if k not in ("t", "type", "host", "compile")
+        }
+        lines.append(f"  {json.dumps(detail)}")
+        if s.get("status") != "ok":
+            lines.append("  NOTE: run did not close cleanly")
+    else:
+        lines.append(
+            "  (no summary record — run still live or killed hard; "
+            "everything above survived)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="metrics dir or one events-*.jsonl")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the parsed record list as JSON instead of the "
+        "text dashboard",
+    )
+    args = ap.parse_args(argv)
+    events = obs.read_events(args.path)
+    if args.json:
+        print(json.dumps(events))
+        return events
+    print(render(events))
+    return events
+
+
+if __name__ == "__main__":
+    main()

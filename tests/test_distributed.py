@@ -112,9 +112,11 @@ def test_two_process_learn_matches_single(tmp_path):
         garr = distributed.global_block_array(local_blocks, mesh)
         assert garr.shape == (N, 2, 12, 12)
         geom = ProblemGeom((3, 3), 4)
+        os.environ["CCSC_OBS_HEARTBEAT_S"] = "0"
         cfg = LearnConfig(
             max_it=2, max_it_d=2, max_it_z=2, num_blocks=N,
             rho_d=50.0, rho_z=2.0, verbose="none", track_objective=True,
+            metrics_dir=outdir + "/metrics",
         )
         res = learn_mod.learn(
             jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0),
@@ -162,3 +164,13 @@ def test_two_process_learn_matches_single(tmp_path):
     np.testing.assert_allclose(
         obj2, np.asarray(ref.trace["obj_vals_z"]), rtol=1e-4
     )
+
+    # multi-host telemetry (utils.obs): EACH host wrote its own event
+    # file into the shared metrics dir, with heartbeat records carrying
+    # its process index — the post-mortem straggler/dead-host signal
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    events = obs.read_events(str(tmp_path / "metrics"))
+    beats = [e for e in events if e["type"] == "heartbeat"]
+    assert {e["host"] for e in beats} == {0, 1}
+    assert all(e["step"] >= 1 for e in beats)

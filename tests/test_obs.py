@@ -1,0 +1,615 @@
+"""Run telemetry subsystem (utils.obs + LearnConfig.metrics_dir):
+
+- event-stream schema round-trip and crash-truncation tolerance;
+- the acceptance contract: a consensus learn under outer_chunk=4 +
+  donate_state=True emits a complete stream (run_meta, >=1 step record
+  per chunk, compile events, summary) while executing the SAME number
+  of dispatches and readback fences as an uninstrumented run;
+- on-device extra scalars (ObsExtras) present in step records;
+- the compile listener fires on a forced shape change and the summary
+  flags the recompile;
+- per-host heartbeats, including a real 2-process run writing into a
+  shared metrics dir;
+- masked / streaming / reconstruction streams;
+- scripts/obs_report.py renders a real stream without error;
+- bench.py records carry git_sha + degraded + event_stream provenance;
+- the no-bare-print lint over the package (console output must route
+  through the obs tier so terminal and stream cannot drift);
+- the use_pallas no-op warning (VERDICT weak #6).
+"""
+import json
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom, SolveConfig
+from ccsc_code_iccv2017_tpu.parallel import consensus
+from ccsc_code_iccv2017_tpu.utils import obs
+
+PKG_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "ccsc_code_iccv2017_tpu",
+)
+
+
+def _b2d(n=8, size=16, seed=0):
+    r = np.random.default_rng(seed)
+    return jnp.asarray(r.normal(size=(n, size, size)).astype(np.float32))
+
+
+CFG = dict(
+    max_it=6, max_it_d=2, max_it_z=2, num_blocks=2, rho_d=500.0,
+    rho_z=10.0, lambda_prior=0.1, verbose="none", track_objective=True,
+    tol=0.0,
+)
+
+
+# ------------------------------------------------------------------
+# event stream primitives
+# ------------------------------------------------------------------
+
+def test_event_stream_schema_roundtrip(tmp_path):
+    d = str(tmp_path / "metrics")
+    run = obs.start_run(
+        d, algorithm="unit", verbose="none", workload="roundtrip"
+    )
+    try:
+        run.step(it=1, obj_d=1.5, obj_z=2.5, d_diff=0.1, z_diff=0.2)
+        run.event("heartbeat", step=1, fence_latency_s=0.01)
+        run.chunk(0, 4, 4, 2.0)
+    finally:
+        run.close(status="ok", iterations=1)
+    events = obs.read_events(d)
+    types = [e["type"] for e in events]
+    assert types[0] == "run_meta" and types[-1] == "summary"
+    meta = events[0]
+    assert meta["algorithm"] == "unit"
+    assert meta["workload"] == "roundtrip"
+    assert meta["platform"] == "cpu"
+    assert "jax_version" in meta and "hostname" in meta
+    step = next(e for e in events if e["type"] == "step")
+    assert step["it"] == 1 and step["obj_z"] == 2.5
+    assert all("t" in e and "host" in e for e in events)
+    roof = next(e for e in events if e["type"] == "roofline")
+    assert roof["it_per_sec"] == pytest.approx(2.0)
+    summary = events[-1]
+    assert summary["status"] == "ok" and "compile" in summary
+
+
+def test_crash_truncation_drops_partial_line(tmp_path):
+    d = str(tmp_path / "metrics")
+    run = obs.start_run(d, algorithm="unit", verbose="none")
+    run.step(it=1, obj_z=1.0)
+    run.step(it=2, obj_z=2.0)
+    run.close()
+    path = os.path.join(d, os.listdir(d)[0])
+    with open(path, "a") as f:
+        f.write('{"type": "step", "it": 3, "obj')  # torn mid-record
+    events = obs.read_events(d)
+    assert [e["it"] for e in events if e["type"] == "step"] == [1, 2]
+    # a resumed writer appending after the torn line keeps working —
+    # including its FIRST record (the writer newline-terminates a torn
+    # tail on open instead of appending run_meta onto it)
+    run2 = obs.start_run(d, algorithm="unit", verbose="none")
+    run2.step(it=4, obj_z=4.0)
+    run2.close()
+    events = obs.read_events(d)
+    assert [e["it"] for e in events if e["type"] == "step"] == [1, 2, 4]
+    assert len([e for e in events if e["type"] == "run_meta"]) == 2
+
+
+def test_null_run_is_inert(tmp_path, capsys):
+    run = obs.start_run(None, algorithm="unit", verbose="brief")
+    try:
+        assert not run.active
+        run.step(it=1, obj_z=1.0)
+        run.console("hello", tier="brief")
+        run.console("hidden", tier="all")
+    finally:
+        run.close()
+    out = capsys.readouterr().out
+    assert "hello" in out and "hidden" not in out
+
+
+# ------------------------------------------------------------------
+# acceptance: complete stream + dispatch/fence parity under
+# outer_chunk=4 + donate_state
+# ------------------------------------------------------------------
+
+def _instrument(counts):
+    """Wrap the consensus step/eval builders and the readback fence
+    with call counters; returns the originals for restore."""
+    orig_chunk = consensus.make_outer_chunk_step
+    orig_step = consensus.make_outer_step
+    orig_eval = consensus.make_eval_fn
+    orig_rb = consensus._readback
+
+    def counting(builder, key):
+        def build(*a, **k):
+            fn = builder(*a, **k)
+
+            def call(*aa, **kk):
+                counts[key] += 1
+                return fn(*aa, **kk)
+
+            return call
+
+        return build
+
+    consensus.make_outer_chunk_step = counting(orig_chunk, "chunk")
+    consensus.make_outer_step = counting(orig_step, "step")
+    consensus.make_eval_fn = counting(orig_eval, "eval")
+
+    def rb(tree):
+        counts["fence"] += 1
+        return orig_rb(tree)
+
+    consensus._readback = rb
+    return orig_chunk, orig_step, orig_eval, orig_rb
+
+
+def _restore(origs):
+    (
+        consensus.make_outer_chunk_step,
+        consensus.make_outer_step,
+        consensus.make_eval_fn,
+        consensus._readback,
+    ) = origs
+
+
+def _counted_learn(cfg):
+    counts = {"chunk": 0, "step": 0, "eval": 0, "fence": 0}
+    origs = _instrument(counts)
+    try:
+        res = consensus.learn(
+            _b2d(), ProblemGeom((3, 3), 4), cfg,
+            key=jax.random.PRNGKey(0),
+        )
+    finally:
+        _restore(origs)
+    return res, counts
+
+
+def test_chunked_stream_complete_and_dispatch_parity(tmp_path):
+    """THE acceptance criterion: with --metrics-dir set, the chunked+
+    donated consensus learn emits run metadata, >=1 step record per
+    chunk, compile events and a final summary, while executing exactly
+    as many dispatches and readback fences as the uninstrumented run."""
+    base = dict(CFG, outer_chunk=4, donate_state=True)
+    ref, plain = _counted_learn(LearnConfig(**base))
+    d = str(tmp_path / "metrics")
+    os.environ["CCSC_OBS_HEARTBEAT_S"] = "0"
+    try:
+        res, instr = _counted_learn(LearnConfig(**base, metrics_dir=d))
+    finally:
+        os.environ.pop("CCSC_OBS_HEARTBEAT_S", None)
+
+    # same trajectory...
+    np.testing.assert_allclose(
+        np.asarray(ref.d), np.asarray(res.d), atol=1e-6
+    )
+    # ...and exactly the same dispatch/fence counts: telemetry rides
+    # the existing chunk fence, it never adds one
+    assert instr == plain
+    assert plain["chunk"] == 2  # 6 iters as chunks of 4 + 2
+    assert plain["fence"] == 2
+
+    events = obs.read_events(d)
+    by = {}
+    for e in events:
+        by.setdefault(e["type"], []).append(e)
+    # complete stream: metadata, steps, compiles, summary
+    assert len(by["run_meta"]) == 1
+    meta = by["run_meta"][0]
+    assert meta["algorithm"] == "consensus"
+    assert meta["config"]["outer_chunk"] == 4
+    assert meta["config"]["donate_state"] is True
+    assert meta["fingerprint"]
+    steps = by["step"]
+    assert [s["it"] for s in steps] == [1, 2, 3, 4, 5, 6]
+    # >= 1 step record per chunk and a roofline record per chunk
+    assert len(by["roofline"]) == 2
+    roof = by["roofline"][0]
+    assert roof["n_adopted"] == 4 and roof["it_per_sec"] > 0
+    assert "mfu" in roof and "hbm_frac" in roof  # scored vs perfmodel
+    assert roof["bound_it_per_sec"] > 0  # the roofline ceiling itself
+    assert len(by["compile"]) >= 1
+    assert by["heartbeat"], "chunk fences emit heartbeats"
+    summary = by["summary"][-1]
+    assert summary["status"] == "ok"
+    assert summary["iterations"] == 6
+    assert summary["compile"]["n_compiles"] >= 1
+
+
+def test_step_records_carry_on_device_extras(tmp_path):
+    """ObsExtras (objective split, consensus disagreement, non-finite
+    count) accumulate inside the jitted scan and land in every step
+    record."""
+    d = str(tmp_path / "metrics")
+    consensus.learn(
+        _b2d(), ProblemGeom((3, 3), 4),
+        LearnConfig(**dict(CFG, outer_chunk=3), metrics_dir=d),
+        key=jax.random.PRNGKey(0),
+    )
+    steps = [e for e in obs.read_events(d) if e["type"] == "step"]
+    assert len(steps) == 6
+    for s in steps:
+        assert s["nonfinite_z"] == 0
+        assert s["consensus_dis"] >= 0.0
+        # the split must reassemble the recorded objective
+        assert s["obj_fid"] + s["obj_l1"] == pytest.approx(
+            s["obj_z"], rel=1e-5
+        )
+
+
+def test_per_step_driver_also_emits(tmp_path):
+    """The un-chunked (outer_chunk=1, no donation) driver emits the
+    same record family."""
+    d = str(tmp_path / "metrics")
+    consensus.learn(
+        _b2d(), ProblemGeom((3, 3), 4),
+        LearnConfig(**dict(CFG, max_it=2), metrics_dir=d),
+        key=jax.random.PRNGKey(0),
+    )
+    events = obs.read_events(d)
+    types = {e["type"] for e in events}
+    assert {"run_meta", "step", "roofline", "compile", "summary"} <= types
+    steps = [e for e in events if e["type"] == "step"]
+    assert len(steps) == 2 and "consensus_dis" in steps[0]
+
+
+# ------------------------------------------------------------------
+# compile / recompile tracking
+# ------------------------------------------------------------------
+
+def test_recompile_listener_fires_on_shape_change(tmp_path):
+    d = str(tmp_path / "metrics")
+    run = obs.start_run(d, algorithm="unit", verbose="none")
+    try:
+        @jax.jit
+        def poly_fn(x):
+            return (x * 2.0).sum()
+
+        float(poly_fn(jnp.ones((4,))))
+        float(poly_fn(jnp.ones((8,))))  # forced shape change -> recompile
+    finally:
+        run.close()
+    events = obs.read_events(d)
+    compiles = [
+        e for e in events
+        if e["type"] == "compile"
+        and e.get("fun_name") and "poly_fn" in e["fun_name"]
+    ]
+    assert len(compiles) >= 2, compiles
+    assert all(c["duration_s"] > 0 for c in compiles)
+    # names + abstract shapes harvested from the debug logs
+    shapes = [c["shapes"] for c in compiles if c.get("shapes")]
+    assert any("float32[4]" in s for s in shapes)
+    assert any("float32[8]" in s for s in shapes)
+    summary = [e for e in events if e["type"] == "summary"][-1]
+    assert any(
+        "poly_fn" in f for f in summary["compile"]["recompiled_funs"]
+    )
+
+
+def test_compile_monitor_uninstalls_cleanly(tmp_path):
+    from jax._src import monitoring as _mon
+
+    before = len(_mon._event_duration_secs_listeners)
+    run = obs.start_run(str(tmp_path / "m"), algorithm="u", verbose="none")
+    assert len(_mon._event_duration_secs_listeners) == before + 1
+    run.close()
+    assert len(_mon._event_duration_secs_listeners) == before
+
+
+# ------------------------------------------------------------------
+# heartbeats
+# ------------------------------------------------------------------
+
+def test_heartbeat_cadence(tmp_path):
+    d = str(tmp_path / "m")
+    w = obs.EventWriter(os.path.join(d, "events-p00000.jsonl"))
+    run = obs.Run(w, verbose="none", heartbeat_every_s=0.0)
+    for i in range(3):
+        run.heartbeat(i + 1, 0.5)
+    run.close()
+    beats = [
+        e for e in obs.read_events(d) if e["type"] == "heartbeat"
+    ]
+    assert [b["step"] for b in beats] == [1, 2, 3]
+    assert beats[0]["fence_latency_s"] == pytest.approx(0.5)
+
+    d2 = str(tmp_path / "m2")
+    w2 = obs.EventWriter(os.path.join(d2, "events-p00000.jsonl"))
+    run2 = obs.Run(w2, verbose="none", heartbeat_every_s=3600.0)
+    for i in range(5):
+        run2.heartbeat(i + 1, 0.1)
+    run2.close()
+    beats2 = [
+        e for e in obs.read_events(d2) if e["type"] == "heartbeat"
+    ]
+    assert len(beats2) == 1  # cadence suppresses the rest
+
+
+def test_two_process_heartbeats_shared_dir(tmp_path):
+    """Two REAL processes bootstrap via distributed.initialize and
+    write heartbeats into ONE shared metrics dir — each host its own
+    events file, each record carrying its process index. (Runs the
+    learner locally per process: this jaxlib's CPU backend has no
+    multi-process collectives, but per-host telemetry needs none.)"""
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent("""
+        import os, sys
+        pid = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+        os.environ["CCSC_OBS_HEARTBEAT_S"] = "0"
+        os.environ.pop("JAX_PLATFORMS", None)
+        sys.path.insert(0, %r)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from ccsc_code_iccv2017_tpu.parallel import distributed
+        distributed.initialize(
+            f"127.0.0.1:{port}", num_processes=2, process_id=pid
+        )
+        assert jax.process_count() == 2
+        import numpy as np, jax.numpy as jnp
+        from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+        from ccsc_code_iccv2017_tpu.models import learn as learn_mod
+        b = np.random.default_rng(7).normal(
+            size=(4, 12, 12)).astype(np.float32)
+        cfg = LearnConfig(
+            max_it=2, max_it_d=1, max_it_z=1, num_blocks=2,
+            rho_d=50.0, rho_z=2.0, verbose="none",
+            track_objective=True, metrics_dir=outdir + "/metrics",
+        )
+        learn_mod.learn(jnp.asarray(b), geom=ProblemGeom((3, 3), 4),
+                        cfg=cfg, key=jax.random.PRNGKey(0))
+    """ % "/root/repo"))
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), str(port),
+             str(tmp_path)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o[-3000:]
+
+    files = sorted(os.listdir(tmp_path / "metrics"))
+    assert files == ["events-p00000.jsonl", "events-p00001.jsonl"]
+    events = obs.read_events(str(tmp_path / "metrics"))
+    beats = [e for e in events if e["type"] == "heartbeat"]
+    assert {b["host"] for b in beats} == {0, 1}
+    metas = [e for e in events if e["type"] == "run_meta"]
+    assert {m["process_index"] for m in metas} == {0, 1}
+    assert all(m["process_count"] == 2 for m in metas)
+
+
+# ------------------------------------------------------------------
+# masked / streaming / reconstruction streams
+# ------------------------------------------------------------------
+
+def test_masked_learner_emits_stream(tmp_path):
+    from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
+
+    d = str(tmp_path / "metrics")
+    b = _b2d(n=2, size=12)
+    learn_masked(
+        b, ProblemGeom((3, 3), 3),
+        LearnConfig(
+            max_it=3, max_it_d=1, max_it_z=1, verbose="none",
+            track_objective=True, tol=0.0, outer_chunk=2,
+            donate_state=True, metrics_dir=d,
+        ),
+        key=jax.random.PRNGKey(0),
+    )
+    events = obs.read_events(d)
+    by = {}
+    for e in events:
+        by.setdefault(e["type"], []).append(e)
+    assert by["run_meta"][0]["algorithm"] == "masked_admm"
+    assert len(by["step"]) == 3
+    assert by["roofline"]  # it/s only (no masked cost model)
+    assert by["summary"][-1]["status"] == "ok"
+
+
+def test_streaming_learner_emits_stream(tmp_path):
+    from ccsc_code_iccv2017_tpu.parallel.streaming import learn_streaming
+
+    d = str(tmp_path / "metrics")
+    b = np.asarray(_b2d(n=4, size=12))
+    learn_streaming(
+        b, ProblemGeom((3, 3), 3),
+        LearnConfig(
+            max_it=4, max_it_d=1, max_it_z=1, num_blocks=2,
+            rho_d=50.0, rho_z=2.0, verbose="none",
+            track_objective=True, tol=0.0, outer_chunk=2,
+            metrics_dir=d,
+        ),
+        key=jax.random.PRNGKey(0),
+    )
+    events = obs.read_events(d)
+    by = {}
+    for e in events:
+        by.setdefault(e["type"], []).append(e)
+    assert by["run_meta"][0]["algorithm"] == "consensus_streaming"
+    assert len(by["step"]) == 4
+    roof = by["roofline"][0]
+    assert roof["length"] == 2 and "mfu" in roof  # consensus cost model
+    assert by["summary"][-1]["iterations"] == 4
+
+
+def test_reconstruction_emits_stream(tmp_path):
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem, reconstruct,
+    )
+
+    d = str(tmp_path / "metrics")
+    geom = ProblemGeom((3, 3), 2)
+    r = np.random.default_rng(0)
+    b = jnp.asarray(r.normal(size=(1, 10, 10)).astype(np.float32))
+    filt = jnp.asarray(r.normal(size=(2, 3, 3)).astype(np.float32))
+    res = reconstruct(
+        b, filt, ReconstructionProblem(geom),
+        SolveConfig(max_it=4, verbose="none", metrics_dir=d),
+    )
+    events = obs.read_events(d)
+    by = {}
+    for e in events:
+        by.setdefault(e["type"], []).append(e)
+    assert by["run_meta"][0]["algorithm"] == "reconstruct"
+    n_it = int(res.trace.num_iters)
+    # step records are 1-based per iteration, like the learners'
+    assert [s["it"] for s in by["step"]] == list(
+        range(1, min(n_it + 1, 5))
+    )
+    assert by["summary"][-1]["iterations"] == n_it
+
+
+# ------------------------------------------------------------------
+# obs_report rendering
+# ------------------------------------------------------------------
+
+def test_obs_report_renders_real_stream(tmp_path, capsys):
+    import importlib.util
+
+    d = str(tmp_path / "metrics")
+    os.environ["CCSC_OBS_HEARTBEAT_S"] = "0"
+    try:
+        consensus.learn(
+            _b2d(), ProblemGeom((3, 3), 4),
+            LearnConfig(
+                **dict(CFG, outer_chunk=4, donate_state=True),
+                metrics_dir=d,
+            ),
+            key=jax.random.PRNGKey(0),
+        )
+    finally:
+        os.environ.pop("CCSC_OBS_HEARTBEAT_S", None)
+    spec = importlib.util.spec_from_file_location(
+        "obs_report",
+        os.path.join(os.path.dirname(PKG_ROOT), "scripts",
+                     "obs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main([d])
+    out = capsys.readouterr().out
+    for section in ("RUN", "PHASES", "STEPS", "ROOFLINE", "COMPILES",
+                    "HOSTS", "SUMMARY"):
+        assert section in out, section
+    assert "algorithm     consensus" in out
+    assert "it/s" in out
+    # renders mid-run streams too (no summary yet, torn tail)
+    with open(os.path.join(d, "events-p00000.jsonl"), "a") as f:
+        f.write('{"type": "step"')
+    mod.main([d])
+    assert "SUMMARY" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------------
+# bench provenance
+# ------------------------------------------------------------------
+
+def test_bench_emit_carries_provenance(capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_prov", os.path.join(os.path.dirname(PKG_ROOT), "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    r = {
+        "iters_per_sec": 1.0, "n": 8, "size": 24, "k": 8, "blocks": 2,
+        "platform": "cpu", "event_stream": "/tmp/x/events-p00000.jsonl",
+    }
+    bench.emit(r, degraded=True)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["degraded"] is True
+    assert "git_sha" in out  # may be None outside a git checkout
+    assert out["event_stream"] == "/tmp/x/events-p00000.jsonl"
+    bench.emit(dict(r, event_stream=None), degraded=False)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["degraded"] is False and out["event_stream"] is None
+
+
+# ------------------------------------------------------------------
+# lint: no bare prints in the library (apps/ CLI surface exempt)
+# ------------------------------------------------------------------
+
+_PRINT_RE = re.compile(r"(?<![\w.])print\(")
+# the one sanctioned emitter; everything else must route through it
+_PRINT_ALLOWLIST = {os.path.join("utils", "obs.py")}
+
+
+def test_no_bare_prints_in_package():
+    """Console output from library code must go through the utils.obs
+    tier (Run.console / obs.console) so the terminal and the event
+    stream cannot drift. apps/ is the CLI surface and may print."""
+    offenders = []
+    for dirpath, _, files in os.walk(PKG_ROOT):
+        rel_dir = os.path.relpath(dirpath, PKG_ROOT)
+        if rel_dir.split(os.sep)[0] == "apps":
+            continue
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            rel = os.path.normpath(os.path.join(rel_dir, name))
+            if rel in _PRINT_ALLOWLIST:
+                continue
+            with open(os.path.join(dirpath, name)) as f:
+                for lineno, line in enumerate(f, 1):
+                    code = line.split("#", 1)[0]
+                    if _PRINT_RE.search(code):
+                        offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "bare print() in library code — use utils.obs console tiers "
+        "instead:\n" + "\n".join(offenders)
+    )
+
+
+# ------------------------------------------------------------------
+# use_pallas no-op warning (VERDICT weak #6)
+# ------------------------------------------------------------------
+
+def test_use_pallas_noop_warns_once():
+    from ccsc_code_iccv2017_tpu.ops import freq_solvers
+
+    dhat = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 1, 5))
+        + 1j * np.random.default_rng(1).normal(size=(2, 1, 5))
+    ).astype(jnp.complex64)
+    kern = freq_solvers.precompute_z_kernel(dhat, 1.0)
+    xi1 = jnp.zeros((1, 1, 5), jnp.complex64)
+    xi2 = jnp.zeros((1, 2, 5), jnp.complex64)
+    freq_solvers._use_pallas_warned = False
+    with pytest.warns(UserWarning, match="no-op since the r5 demotion"):
+        freq_solvers.solve_z(kern, xi1, xi2, 1.0, use_pallas=True)
+    # one-time: a second call stays silent
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        freq_solvers.solve_z(kern, xi1, xi2, 1.0, use_pallas=True)
+    freq_solvers._use_pallas_warned = False
