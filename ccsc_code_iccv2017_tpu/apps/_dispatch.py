@@ -1,10 +1,18 @@
 """Shared CLI dispatch: device-resident learner vs host-streaming learner.
 
 One place for the --streaming arm ALL learning drivers share (2D, 3D,
-4D, hyperspectral), so the guard logic cannot drift between apps."""
+4D, hyperspectral), so the guard logic cannot drift between apps — and
+for the ``--auto-degrade`` ladder: on a pre-flight HBM overflow
+(utils.perfmodel.inmem_learn_estimate, the same check
+scripts/continue_3d.py runs) or a RESOURCE_EXHAUSTED at compile/first
+dispatch, the dispatch steps the run down donate → smaller
+``outer_chunk`` → streaming mode before erroring, recording every
+downgrade as a ``degrade`` event in the obs stream and in the result
+trace (``trace['degrades']``)."""
 from __future__ import annotations
 
-from typing import Dict, Optional
+import time
+from typing import Dict, List, Optional
 
 
 def add_perf_args(
@@ -97,6 +105,28 @@ def add_resilience_args(parser, checkpoint: bool = False) -> None:
         help="multiplicative rho backoff per recovery "
         "(LearnConfig.rho_backoff)",
     )
+    parser.add_argument(
+        "--watchdog", action="store_true",
+        help="arm the dispatch-fence watchdog: a jitted step/chunk "
+        "readback exceeding its roofline-derived deadline emits a "
+        "`stall` obs event and (CCSC_WATCHDOG_ACTION=abort, the "
+        "default) hard-exits so a supervisor can restart from the "
+        "last checkpoint (LearnConfig.watchdog; utils.watchdog)",
+    )
+    parser.add_argument(
+        "--watchdog-slack", type=float, default=20.0,
+        help="slack multiplier on the roofline-derived per-iteration "
+        "time before a fence is declared hung "
+        "(LearnConfig.watchdog_slack)",
+    )
+    parser.add_argument(
+        "--auto-degrade", action="store_true",
+        help="on pre-flight HBM overflow or RESOURCE_EXHAUSTED at "
+        "compile/first dispatch, step down donate -> smaller "
+        "--outer-chunk -> --streaming instead of erroring; every "
+        "downgrade is recorded as a `degrade` obs event and in "
+        "trace['degrades'] (apps._dispatch)",
+    )
     if checkpoint:
         parser.add_argument("--checkpoint-dir", default=None)
         parser.add_argument("--checkpoint-every", type=int, default=5)
@@ -115,6 +145,153 @@ def add_mat_layout_arg(parser) -> None:
     )
 
 
+def _retry_discards_progress(metrics_dir, checkpoint_dir, t_start):
+    """Whether re-running the solver after a runtime OOM would discard
+    completed work: with a checkpoint dir the retry RESUMES (loss
+    bounded by the cadence), and an attempt that recorded no step
+    events died in compile/first dispatch — the ladder's documented
+    target. Only a checkpoint-less attempt with recorded iterations
+    (a late OOM from fragmentation) must surface the error instead of
+    silently starting the learn over."""
+    if checkpoint_dir:
+        return False
+    if metrics_dir is None:
+        return False  # no evidence either way; compile-OOM is the norm
+    from ..utils import obs
+
+    return any(
+        e.get("type") == "step" and e.get("t", 0.0) >= t_start
+        for e in obs.read_events(metrics_dir)
+    )
+
+
+def _looks_oom(e: BaseException) -> bool:
+    """Recognize an XLA device-memory failure at compile or dispatch
+    without importing jaxlib exception types (they move between
+    releases): the status string is the stable surface."""
+    s = f"{type(e).__name__}: {e}"
+    return (
+        "RESOURCE_EXHAUSTED" in s
+        or "Out of memory" in s
+        or "out of memory" in s
+        or "OOM" in s
+    )
+
+
+def _can_stream(mesh, solver, forbidden, kwargs) -> bool:
+    """Whether the streaming rung is available from this call: the
+    streaming arm is single-device consensus and takes only the
+    checkpoint options — and an EXISTING checkpoint must not be from
+    the in-memory algorithm (the fingerprints differ by design, so
+    learn_streaming would refuse to resume it; the ladder stopping
+    here keeps the original OOM as the error instead of a confusing
+    fingerprint crash)."""
+    if mesh is not None or solver is not None:
+        return False
+    if any(v for v in (forbidden or {}).values()):
+        return False
+    # `is not None`, not truthiness: option values here can be numpy
+    # arrays (init_d, smooth offsets), whose bool() raises
+    extra = [
+        k for k, v in kwargs.items()
+        if k not in ("checkpoint_dir", "checkpoint_every")
+        and v is not None
+    ]
+    if extra:
+        return False
+    ckdir = kwargs.get("checkpoint_dir")
+    if ckdir:
+        import os
+
+        if any(
+            os.path.exists(os.path.join(ckdir, f))
+            for f in ("ccsc_state.npz", "ccsc_state.prev.npz")
+        ):
+            return False
+    return True
+
+
+def _next_rung(cfg, streaming, mesh, solver, forbidden, kwargs,
+               runtime=False):
+    """The next downgrade: (new_cfg, new_streaming, rung_name) or None
+    when the ladder is exhausted. Order: donate (drops the output-state
+    copies XLA otherwise materializes per step) -> outer_chunk=1
+    (runtime only: a shorter scan shrinks XLA's scheduling temps,
+    which the byte estimate cannot see — at pre-flight the rung would
+    be a no-op under the model that triggered it) -> streaming (host-
+    resident state, bounded HBM by construction)."""
+    import dataclasses
+
+    if streaming:
+        return None
+    if not cfg.donate_state:
+        return (
+            dataclasses.replace(cfg, donate_state=True), False, "donate"
+        )
+    if runtime and cfg.outer_chunk > 1:
+        return dataclasses.replace(cfg, outer_chunk=1), False, "chunk"
+    if _can_stream(mesh, solver, forbidden, kwargs):
+        # streaming rejects donate_state (no whole-state jitted step)
+        return (
+            dataclasses.replace(cfg, donate_state=False),
+            True,
+            "streaming",
+        )
+    return None
+
+
+class _DegradeLog:
+    """Collects the ladder's downgrade events and mirrors them into
+    the obs stream. The learner's Run isn't open yet at pre-flight
+    time, so the events go into their own ``events-*-dispatch.jsonl``
+    file in the same metrics dir — utils.obs.read_events merges the
+    per-file streams, so obs_report and the supervisor see one run."""
+
+    def __init__(self, metrics_dir: Optional[str]):
+        self.events: List[Dict] = []
+        self._writer = None
+        self._host = 0
+        if metrics_dir is not None:
+            import os
+
+            from ..utils import obs
+
+            try:
+                import jax
+
+                self._host = jax.process_index()
+            except Exception:
+                pass
+            self._writer = obs.EventWriter(
+                os.path.join(
+                    metrics_dir,
+                    f"events-p{self._host:05d}-dispatch.jsonl",
+                )
+            )
+
+    def record(self, rung: str, stage: str, **fields) -> None:
+        ev = {"rung": rung, "stage": stage, **fields}
+        self.events.append(ev)
+        print(
+            f"auto-degrade [{stage}]: stepping down to '{rung}' "
+            + ", ".join(f"{k}={v}" for k, v in fields.items())
+        )
+        if self._writer is not None:
+            self._writer.write(
+                {
+                    "t": time.time(),
+                    "type": "degrade",
+                    "host": self._host,
+                    **ev,
+                }
+            )
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
 def dispatch_learn(
     b,
     geom,
@@ -126,6 +303,7 @@ def dispatch_learn(
     streaming_blocks: Optional[int] = None,
     streaming_offset=None,
     forbidden: Optional[Dict[str, object]] = None,
+    auto_degrade: bool = False,
     **kwargs,
 ):
     """Run the device-resident learner, or the host-streaming variant
@@ -143,7 +321,15 @@ def dispatch_learn(
     subtracted from the data (the smooth_init the masked objective
     would model, learn_hyperspectral.m:16-17) and ``streaming_blocks``
     shrinks to the nearest divisor of n before replacing
-    cfg.num_blocks."""
+    cfg.num_blocks.
+
+    ``auto_degrade`` arms the downgrade ladder (--auto-degrade): when
+    the pre-flight estimate (utils.perfmodel.inmem_learn_estimate)
+    exceeds the device budget, or the solver dies with
+    RESOURCE_EXHAUSTED at compile/first dispatch, the run steps down
+    donate -> outer_chunk=1 -> streaming and retries; each downgrade
+    is a ``degrade`` obs event and lands in ``trace['degrades']``.
+    Default off: an explicit OOM beats a silent strategy change."""
     # --stream-mode is passed straight into learn_streaming as an
     # argument (no process-global env mutation that would leak into
     # later learns in the same process); without --streaming it is an
@@ -151,6 +337,84 @@ def dispatch_learn(
     stream_mode = kwargs.pop("stream_mode", None)
     if stream_mode and not streaming:
         raise SystemExit("--stream-mode requires --streaming")
+    if not auto_degrade:
+        return _dispatch_once(
+            b, geom, cfg, key, mesh, streaming, solver,
+            streaming_blocks, streaming_offset, forbidden, stream_mode,
+            kwargs,
+        )
+
+    log = _DegradeLog(cfg.metrics_dir)
+    try:
+        if not streaming and solver is None:
+            # the pre-flight estimate models the CONSENSUS learner's
+            # working set; a custom solver (the hyperspectral CLI's
+            # masked learner) holds different state, so only the
+            # runtime RESOURCE_EXHAUSTED ladder below applies to it
+            from ..utils import perfmodel
+
+            est, budget = perfmodel.inmem_learn_estimate(
+                b.shape, geom, cfg
+            )
+            while est > budget:
+                rung = _next_rung(
+                    cfg, streaming, mesh, solver, forbidden, kwargs
+                )
+                if rung is None:
+                    break  # ladder exhausted; run as configured
+                cfg, streaming, name = rung
+                log.record(
+                    name, "preflight",
+                    est_gb=round(est / 1e9, 2),
+                    budget_gb=round(budget / 1e9, 2),
+                )
+                if streaming:
+                    break  # host-resident state: bounded by design
+                est, budget = perfmodel.inmem_learn_estimate(
+                    b.shape, geom, cfg
+                )
+        while True:
+            t_attempt = time.time()
+            try:
+                res = _dispatch_once(
+                    b, geom, cfg, key, mesh, streaming, solver,
+                    streaming_blocks, streaming_offset, forbidden,
+                    stream_mode, dict(kwargs),
+                )
+                break
+            except Exception as e:
+                if not _looks_oom(e):
+                    raise
+                if _retry_discards_progress(
+                    cfg.metrics_dir, kwargs.get("checkpoint_dir"),
+                    t_attempt,
+                ):
+                    print(
+                        "auto-degrade: a late OOM interrupted completed "
+                        "iterations and no --checkpoint-dir is set — "
+                        "surfacing the error instead of silently "
+                        "restarting the learn from scratch"
+                    )
+                    raise
+                rung = _next_rung(
+                    cfg, streaming, mesh, solver, forbidden, kwargs,
+                    runtime=True,
+                )
+                if rung is None:
+                    raise
+                cfg, streaming, name = rung
+                log.record(name, "dispatch", error=str(e)[:300])
+    finally:
+        log.close()
+    if log.events and isinstance(res.trace, dict):
+        res.trace["degrades"] = log.events
+    return res
+
+
+def _dispatch_once(
+    b, geom, cfg, key, mesh, streaming, solver, streaming_blocks,
+    streaming_offset, forbidden, stream_mode, kwargs,
+):
     if streaming:
         if mesh is not None:
             raise SystemExit(
@@ -164,10 +428,15 @@ def dispatch_learn(
             raise SystemExit(
                 "--streaming does not combine with " + "/".join(set_flags)
             )
-        if kwargs:
+        # a None-valued option (an unset CLI flag riding the shared
+        # call, or the auto-degrade ladder stepping a non-streaming
+        # call down to streaming) is not a request; `is not None`
+        # rather than truthiness because values can be numpy arrays
+        extra = [k for k, v in kwargs.items() if v is not None]
+        if extra:
             raise SystemExit(
                 "--streaming does not combine with "
-                + "/".join(sorted(kwargs))
+                + "/".join(sorted(extra))
             )
         import numpy as np
 

@@ -95,6 +95,12 @@ def main(argv=None):
     bn = (blurred - mu) / sd
 
     geom = ProblemGeom(d.shape[1:], d.shape[0])
+    from ..utils import validate
+
+    # fail on garbage inputs HERE, with the file/flag named, not as a
+    # deferred XLA error mid-solve (utils.validate)
+    validate.check_solve_data(bn[None], d, geom)
+    validate.check_finite("psf", psf)
     prob = ReconstructionProblem(geom, dirac="prepend")
     cfg = SolveConfig(
         metrics_dir=args.metrics_dir,

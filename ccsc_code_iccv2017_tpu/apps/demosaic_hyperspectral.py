@@ -99,6 +99,14 @@ def main(argv=None):
     sm = nn_fill_smooth_init(cube * mask, mask)
 
     geom = ProblemGeom(d.shape[2:], k, (bands,))
+    from ..utils import validate
+
+    # fail on garbage inputs HERE, with the file/flag named, not as a
+    # deferred XLA error mid-solve (utils.validate)
+    validate.check_solve_data(
+        (cube * mask)[None], d, geom, mask=mask[None],
+        smooth_init=sm[None],
+    )
     prob = ReconstructionProblem(geom, pad=False)
     cfg = SolveConfig(
         metrics_dir=args.metrics_dir,

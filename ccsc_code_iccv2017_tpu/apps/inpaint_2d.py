@@ -68,6 +68,11 @@ def main(argv=None):
     sm = smooth_fill(b, mask)
 
     geom = ProblemGeom(d.shape[1:], d.shape[0])
+    from ..utils import validate
+
+    # fail on garbage inputs HERE, with the file/flag named, not as a
+    # deferred XLA error mid-solve (utils.validate)
+    validate.check_solve_data(b, d, geom, mask=mask, smooth_init=sm)
     cfg = SolveConfig(
         metrics_dir=args.metrics_dir,
         lambda_residual=args.lambda_residual,

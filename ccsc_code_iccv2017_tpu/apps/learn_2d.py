@@ -101,6 +101,11 @@ def main(argv=None):
     print(f"loaded {b.shape[0]} images {b.shape[1:]} in {time.time()-t0:.1f}s")
 
     geom = ProblemGeom((args.support, args.support), args.filters)
+    from ..utils import validate
+
+    # fail on garbage inputs HERE, with the file/flag named, not as a
+    # deferred XLA error mid-learn (utils.validate)
+    validate.check_learn_data(b, geom, num_blocks=args.blocks)
     cfg = LearnConfig(
         lambda_residual=args.lambda_residual,
         lambda_prior=args.lambda_prior,
@@ -121,6 +126,8 @@ def main(argv=None):
         donate_state=args.donate_state,
         max_recoveries=args.max_recoveries,
         rho_backoff=args.rho_backoff,
+        watchdog=args.watchdog,
+        watchdog_slack=args.watchdog_slack,
         metrics_dir=args.metrics_dir,
     )
     mesh = block_mesh(args.mesh) if args.mesh else None
@@ -138,6 +145,7 @@ def main(argv=None):
             mesh,
             streaming=True,
             stream_mode=args.stream_mode,
+            auto_degrade=args.auto_degrade,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             forbidden={
@@ -153,6 +161,7 @@ def main(argv=None):
             jax.random.PRNGKey(args.seed),
             mesh,
             streaming=False,
+            auto_degrade=args.auto_degrade,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             init_d=init_d,

@@ -96,6 +96,11 @@ def main(argv=None):
         args.filters,
         (b.shape[1], b.shape[2]),
     )
+    from ..utils import validate
+
+    # fail on garbage inputs HERE, with the file/flag named, not as a
+    # deferred XLA error mid-learn (utils.validate)
+    validate.check_learn_data(b, geom, num_blocks=args.blocks)
     cfg = LearnConfig(
         max_it=args.max_it,
         max_it_d=5,
@@ -113,6 +118,8 @@ def main(argv=None):
         donate_state=args.donate_state,
         max_recoveries=args.max_recoveries,
         rho_backoff=args.rho_backoff,
+        watchdog=args.watchdog,
+        watchdog_slack=args.watchdog_slack,
         metrics_dir=args.metrics_dir,
     )
     from ._dispatch import dispatch_learn
@@ -121,6 +128,7 @@ def main(argv=None):
     res = dispatch_learn(
         b, geom, cfg, jax.random.PRNGKey(args.seed), mesh, args.streaming,
         stream_mode=args.stream_mode,
+        auto_degrade=args.auto_degrade,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )

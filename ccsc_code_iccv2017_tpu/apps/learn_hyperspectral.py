@@ -100,6 +100,11 @@ def main(argv=None):
     geom = ProblemGeom(
         (args.support, args.support), args.filters, (b.shape[1],)
     )
+    from ..utils import validate
+
+    # fail on garbage inputs HERE, with the file/flag named, not as a
+    # deferred XLA error mid-learn (utils.validate)
+    validate.check_learn_data(b, geom)
     cfg = LearnConfig(
         lambda_residual=1.0,
         lambda_prior=1.0,
@@ -115,6 +120,8 @@ def main(argv=None):
         donate_state=args.donate_state,
         max_recoveries=args.max_recoveries,
         rho_backoff=args.rho_backoff,
+        watchdog=args.watchdog,
+        watchdog_slack=args.watchdog_slack,
         metrics_dir=args.metrics_dir,
     )
     init_d = (
@@ -133,6 +140,7 @@ def main(argv=None):
             mesh=None,
             streaming=True,
             stream_mode=args.stream_mode,
+            auto_degrade=args.auto_degrade,
             streaming_blocks=args.streaming_blocks,
             streaming_offset=sm,
             checkpoint_dir=args.checkpoint_dir,
@@ -152,6 +160,7 @@ def main(argv=None):
         mesh=None,
         streaming=False,
         solver=learn_masked,
+        auto_degrade=args.auto_degrade,
         smooth_init=jnp.asarray(sm),
         init_d=init_d,
         checkpoint_dir=args.checkpoint_dir,

@@ -55,6 +55,13 @@ def main(argv=None):
     rng = np.random.default_rng(args.seed)
 
     geom = ProblemGeom(d.shape[1:], d.shape[0])
+    from ..utils import validate
+
+    # fail on garbage inputs HERE, with the file/flag named, not as a
+    # deferred XLA error mid-solve (utils.validate)
+    validate.check_filters(d, geom)
+    for i, x in enumerate(imgs):
+        validate.check_finite(f"data image {i}", x)
     prob = ReconstructionProblem(
         geom,
         data_term="poisson",

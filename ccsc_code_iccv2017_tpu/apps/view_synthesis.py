@@ -99,6 +99,13 @@ def main(argv=None):
     sm = interp_fill(lf * mask, mask)
 
     geom = ProblemGeom(d.shape[3:], k, (a1, a2))
+    from ..utils import validate
+
+    # fail on garbage inputs HERE, with the file/flag named, not as a
+    # deferred XLA error mid-solve (utils.validate)
+    validate.check_solve_data(
+        (lf * mask)[None], d, geom, mask=mask[None], smooth_init=sm[None]
+    )
     prob = ReconstructionProblem(geom, pad=False)
     cfg = SolveConfig(
         metrics_dir=args.metrics_dir,

@@ -222,6 +222,24 @@ class LearnConfig:
     # append-only and crash-safe (a preempted run's telemetry
     # survives). Render with scripts/obs_report.py.
     metrics_dir: Optional[str] = None
+    # Dispatch-fence watchdog (utils.watchdog): a host-side thread
+    # armed around every jitted step/chunk readback. If a fence
+    # exceeds its deadline — derived from the analytic roofline bound
+    # (utils.perfmodel.bound_iters_per_sec) times watchdog_slack,
+    # floored at CCSC_WATCHDOG_MIN_S and with a first-fence compile
+    # allowance (CCSC_WATCHDOG_COMPILE_S) — the run is declared hung:
+    # a `stall` event lands in the obs stream and, in the default
+    # 'abort' mode (CCSC_WATCHDOG_ACTION), the process hard-exits with
+    # watchdog.EXIT_STALL so a supervisor (scripts/supervise.py) can
+    # restart from the last checkpoint. In multi-host runs the same
+    # thread flags dead peers via heartbeat staleness in the shared
+    # metrics dir. Off by default: supervision is opt-in.
+    watchdog: bool = False
+    # Slack multiplier on the roofline-derived per-iteration time
+    # before a fence is declared hung. Generous by design: the bound
+    # is the FASTEST possible iteration, and a false stall abort costs
+    # a restart.
+    watchdog_slack: float = 20.0
     # Carry the frequency-domain iterate across the masked learner's
     # inner scans instead of re-transforming the spatial iterate each
     # iteration. The spatial iterate is ALWAYS produced by an inverse
@@ -262,6 +280,10 @@ class LearnConfig:
         if not (0.0 < self.rho_backoff <= 1.0):
             raise ValueError(
                 f"rho_backoff must be in (0, 1], got {self.rho_backoff}"
+            )
+        if self.watchdog_slack <= 0:
+            raise ValueError(
+                f"watchdog_slack must be > 0, got {self.watchdog_slack}"
             )
 
     @property

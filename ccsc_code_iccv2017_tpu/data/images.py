@@ -208,6 +208,7 @@ def _mat_image_stack(
     [H, W, C, n] stack with n in (1,3) images) raises rather than
     guesses — pass ``mat_layout`` or name the variable."""
     from ..utils.io_mat import _loadmat
+    from ..utils.validate import CCSCInputError
 
     d = {
         k: np.asarray(v)
@@ -215,7 +216,7 @@ def _mat_image_stack(
         if not k.startswith("__") and np.asarray(v).ndim >= 2
     }
     if not d:
-        raise ValueError(f"no image array found in {path}")
+        raise CCSCInputError(f"no image array found in {path}")
     named = None
     for name in ("images", "original_images", "I", "b"):
         if name in d:
@@ -241,6 +242,17 @@ def _mat_image_stack(
                 "'images' (MATLAB) / 'b' (framework)."
             )
         layout = "matlab"
+    # PNG/JPG files cannot hold NaN, but a .mat stack can — reject it
+    # at the loader so the failure names the FILE, not an iterate
+    # thirty minutes into a learn (utils.validate)
+    if np.issubdtype(arr.dtype, np.floating):
+        bad = int(np.count_nonzero(~np.isfinite(arr)))
+        if bad:
+            raise CCSCInputError(
+                f".mat image stack {path} contains {bad} non-finite "
+                "value(s) (NaN/Inf) — clean the export; non-finite "
+                "data silently diverges the solvers"
+            )
     return array_image_stack(arr, layout=layout)
 
 

@@ -32,10 +32,19 @@ def load_hyperspectral_dir(
     (CreateImages_Robin.m:182-191 grouping)."""
     from PIL import Image
 
+    from ..utils.validate import CCSCInputError
+
     files = _list_image_files(path)
+    if not files:
+        raise CCSCInputError(
+            f"no band images found in {path} — expected a folder of "
+            f"grayscale files, every {bands} consecutive files one cube"
+        )
     if len(files) % bands:
-        raise ValueError(
-            f"{len(files)} files not divisible by bands={bands}"
+        raise CCSCInputError(
+            f"{len(files)} files in {path} not divisible by "
+            f"bands={bands} — each cube needs exactly {bands} "
+            "consecutive band images"
         )
     cubes = []
     for i in range(0, len(files), bands):

@@ -545,7 +545,20 @@ def learn_masked(
     Telemetry (utils.obs): ``cfg.metrics_dir`` enables the structured
     event stream — run metadata, per-step metrics, compile events,
     per-chunk throughput, heartbeats, checkpoint/recovery events."""
-    from ..utils import obs, resilience
+    from ..utils import obs, resilience, validate, watchdog
+
+    # strict entry validation (utils.validate): layout vs geometry,
+    # non-finite data/offsets, kernel vs signal size, positivity —
+    # fail actionably before anything compiles
+    # blocks=False: this solver never consensus-splits the batch, so
+    # cfg.num_blocks (a consensus knob) must not constrain its inputs
+    validate.check_learn_inputs(
+        b, geom, cfg, init_d=init_d, smooth_init=smooth_init,
+        blocks=False,
+    )
+    validate.check_positive(
+        "learn_masked", gamma_div_d=gamma_div_d, gamma_div_z=gamma_div_z
+    )
 
     run = obs.start_run(
         cfg.metrics_dir,
@@ -557,12 +570,19 @@ def learn_masked(
         mesh=mesh,
         data_shape=list(b.shape),
     )
+    # hang/stall watchdog (utils.watchdog): no analytic cost model for
+    # the masked objective, so the CCSC_WATCHDOG_MIN_S floor (plus the
+    # first-fence compile allowance) governs its fence deadlines
+    wd = watchdog.maybe_start(cfg, algorithm="masked_admm")
     try:
         return _learn_masked_impl(
             b, geom, cfg, smooth_init, init_d, key, gamma_div_d,
             gamma_div_z, mesh, checkpoint_dir, checkpoint_every, run,
+            wd,
         )
     finally:
+        if wd is not None:
+            wd.stop()
         # idempotent backstop: only an escaping exception lands here
         # with the run still open
         run.close(status="error")
@@ -570,7 +590,7 @@ def learn_masked(
 
 def _learn_masked_impl(
     b, geom, cfg, smooth_init, init_d, key, gamma_div_d, gamma_div_z,
-    mesh, checkpoint_dir, checkpoint_every, run,
+    mesh, checkpoint_dir, checkpoint_every, run, wd=None,
 ):
     from ..utils import checkpoint as ckpt
     from ..utils import faults, resilience
@@ -756,6 +776,14 @@ def _learn_masked_impl(
                     poison_at=na - (i + 1) if poisoned else None,
                 )
                 t0 = time.perf_counter()
+                if wd is not None:
+                    # _chunk_step builds a fresh jit wrapper every
+                    # round, so any fence may trace/compile — the
+                    # deadline always carries the compile allowance
+                    wd.arm(
+                        clen, f"masked_outer_{i}_{i + clen}",
+                        may_compile=True,
+                    )
                 # state and prev are DONATED when cfg.donate_state —
                 # rebind both, never touch the old arrays
                 state, prev, best, ys = stepc(
@@ -767,6 +795,11 @@ def _learn_masked_impl(
                     np.asarray(a, np.float64) if k < 4 else np.asarray(a)
                     for k, a in enumerate(ys_h)
                 )
+                # injected hang fires INSIDE the armed fence
+                # (utils.faults.hang_tick)
+                faults.hang_tick(i + clen)
+                if wd is not None:
+                    wd.disarm()
                 if poisoned:
                     faults.consume_nan()
                 dt = time.perf_counter() - t0
@@ -884,9 +917,15 @@ def _learn_masked_impl(
     prev = state
     with resilience.GracefulShutdown() as gs:
         i = start_it
+        fresh_step = True  # the first fence traces + compiles
         while i < cfg.max_it:
             t0 = time.perf_counter()
             na = faults.nan_iteration()
+            if wd is not None:
+                wd.arm(
+                    1, f"masked_outer_{i}",
+                    may_compile=fresh_step or na == i + 1,
+                )
             stepf = _make_poisoned_step() if na == i + 1 else step
             new_state, obj_d, obj_z, d_diff, z_diff = stepf(
                 state,
@@ -898,6 +937,11 @@ def _learn_masked_impl(
                 faults.consume_nan()
             obj_d, obj_z = float(obj_d), float(obj_z)  # also the fence
             d_diff, z_diff = float(d_diff), float(z_diff)
+            # injected hang fires INSIDE the armed fence (utils.faults)
+            faults.hang_tick(i + 1)
+            if wd is not None:
+                wd.disarm()
+            fresh_step = False
             dt_step = time.perf_counter() - t0
             t_total += dt_step
             # non-finite guard (mirrors the consensus driver): NaN
@@ -920,6 +964,7 @@ def _learn_masked_impl(
                 trace.setdefault("recoveries", []).append(ev)
                 run.event("recovery", **ev)
                 step = _make_step()
+                fresh_step = True  # the gamma rebuild recompiles
                 continue  # retry iteration i with backed-off gammas
             # rollback (admm_learn.m:204-213): no pass improved the best.
             # Requires tracking: with with_objective off the step returns

@@ -140,6 +140,15 @@ def reconstruct(
     sharded run matches the unsharded one (same stopping iteration,
     same objective values) up to float reduction order.
     """
+    # strict entry validation (utils.validate): layout vs geometry,
+    # non-finite observations, mask shape/support, kernel vs signal
+    # size, gamma/lambda positivity — fail actionably before compile
+    from ..utils import validate
+
+    validate.check_solve_inputs(
+        b, d, prob.geom, cfg, mask=mask, smooth_init=smooth_init,
+        x_orig=x_orig,
+    )
     if cfg.metrics_dir is not None:
         return _reconstruct_observed(
             b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig, mesh

@@ -367,7 +367,13 @@ def learn(
     (or chunk) boundary; checkpoints carry a config fingerprint and
     resume refuses a mismatched run.
     """
-    from ..utils import obs, resilience
+    from ..utils import obs, resilience, validate, watchdog
+
+    # strict entry validation (utils.validate): layout vs geometry,
+    # non-finite data, kernel vs signal size, block divisibility,
+    # positivity of lambda/rho — a CCSCInputError here beats a
+    # deferred XLA failure thirty minutes in
+    validate.check_learn_inputs(b, geom, cfg, init_d=init_d)
 
     ndim_s = geom.ndim_spatial
     n = b.shape[0]
@@ -396,15 +402,17 @@ def learn(
         mesh=mesh,
         data_shape=list(b.shape),
     )
+    wd = None
     try:
         step_cost = None
-        if run.active:
+        if run.active or cfg.watchdog:
             from ..utils import perfmodel
 
             # analytic per-outer-step cost of THIS problem, priced
             # once — each chunk's achieved rate is scored against it
             # live (the roofline records obs_report renders as the
-            # trajectory)
+            # trajectory), and the watchdog derives its fence deadline
+            # from the same bound
             step_cost = perfmodel.analytic_outer_step_cost(
                 num_blocks=N,
                 ni=ni,
@@ -422,12 +430,19 @@ def learn(
                 fused_z=cfg.fused_z,
                 donate_state=cfg.donate_state,
             )
+        # hang/stall watchdog (utils.watchdog): armed around every
+        # fenced dispatch below; deadline = roofline bound x slack
+        wd = watchdog.maybe_start(
+            cfg, cost=step_cost, algorithm="consensus"
+        )
         return _learn_impl(
             b, geom, cfg, key, mesh, checkpoint_dir, checkpoint_every,
             init_d, profile_dir, figures_dir, run, step_cost, fg,
-            b_blocks, n, N, ni,
+            b_blocks, n, N, ni, wd,
         )
     finally:
+        if wd is not None:
+            wd.stop()
         # idempotent: the normal path closed with status='ok' already;
         # this only fires on an exception escaping the driver
         run.close(status="error")
@@ -436,6 +451,7 @@ def learn(
 def _learn_impl(
     b, geom, cfg, key, mesh, checkpoint_dir, checkpoint_every, init_d,
     profile_dir, figures_dir, run, step_cost, fg, b_blocks, n, N, ni,
+    wd=None,
 ):
     from ..utils import checkpoint as ckpt
     from ..utils import faults, profiling, resilience
@@ -562,6 +578,11 @@ def _learn_impl(
                 clen = min(cfg.outer_chunk, cfg.max_it - i)
                 na = faults.nan_iteration()
                 poisoned = na is not None and i + 1 <= na <= i + clen
+                # a step callable built fresh this round (new scan
+                # length, post-recovery rho rebuild, one-off poison)
+                # traces + compiles INSIDE the armed fence — tell the
+                # watchdog so its deadline carries the allowance
+                fresh_step = poisoned or clen not in chunk_steps
                 stepc = (
                     make_outer_chunk_step(
                         geom, recov.cfg, fg, clen, mesh=mesh,
@@ -571,6 +592,11 @@ def _learn_impl(
                     else _chunk_step(clen)
                 )
                 t0 = time.perf_counter()
+                if wd is not None:
+                    wd.arm(
+                        clen, f"ccsc_outer_{i}_{i + clen}",
+                        may_compile=fresh_step,
+                    )
                 with profiling.annotate(f"ccsc_outer_{i}_{i + clen}"):
                     # state is DONATED when cfg.donate_state: the old
                     # binding's buffers die inside this call; rebind
@@ -586,6 +612,12 @@ def _learn_impl(
                     active = np.asarray(tr_h.active)
                     adopted = np.asarray(tr_h.adopted)
                     extras = tr_h.metrics.extras  # [chunk] leaves, host
+                # injected hang fires INSIDE the armed fence — to the
+                # watchdog it is indistinguishable from a wedged
+                # dispatch (utils.faults.hang_tick)
+                faults.hang_tick(i + clen)
+                if wd is not None:
+                    wd.disarm()
                 if poisoned:
                     faults.consume_nan()
                 dt = time.perf_counter() - t0
@@ -712,10 +744,16 @@ def _learn_impl(
     with resilience.GracefulShutdown() as gs, \
             profiling.xla_trace(profile_dir):
         i = start_it
+        fresh_step = True  # the first fence traces + compiles
         while i < cfg.max_it:
             t0 = time.perf_counter()
+            na = faults.nan_iteration()
+            if wd is not None:
+                wd.arm(
+                    1, f"ccsc_outer_{i}",
+                    may_compile=fresh_step or na == i + 1,
+                )
             with profiling.annotate(f"ccsc_outer_{i}"):
-                na = faults.nan_iteration()
                 if na == i + 1:
                     # chaos injection: a one-off step compiled with
                     # the NaN poison baked in (utils.faults)
@@ -730,6 +768,11 @@ def _learn_impl(
                 m_h = _readback(m)
                 obj_d, obj_z = float(m_h.obj_d), float(m_h.obj_z)
                 d_diff, z_diff = float(m_h.d_diff), float(m_h.z_diff)
+            # injected hang fires INSIDE the armed fence (utils.faults)
+            faults.hang_tick(i + 1)
+            if wd is not None:
+                wd.disarm()
+            fresh_step = False
             # failure detection: a non-finite metric means the iterate
             # diverged (bad rho for the data scale, or a numeric fault);
             # keep the last good state instead of propagating NaNs into
@@ -754,6 +797,7 @@ def _learn_impl(
                 trace.setdefault("recoveries", []).append(ev)
                 run.event("recovery", **ev)
                 step = make_outer_step(geom, recov.cfg, fg, mesh)
+                fresh_step = True  # the rho rebuild recompiles
                 continue  # retry iteration i with the backed-off rho
             state = new_state
             dt = time.perf_counter() - t0

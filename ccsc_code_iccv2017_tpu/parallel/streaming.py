@@ -199,7 +199,12 @@ def learn_streaming(
     checkpoint/recovery events. All obs emission happens at the
     existing flush fences from already-read-back floats — zero extra
     readbacks."""
-    from ..utils import obs, resilience
+    from ..utils import obs, resilience, validate, watchdog
+
+    # strict entry validation (utils.validate): layout vs geometry,
+    # non-finite data, kernel vs signal size, block divisibility —
+    # fail actionably before anything compiles
+    validate.check_learn_inputs(b, geom, cfg)
 
     run = obs.start_run(
         cfg.metrics_dir,
@@ -213,18 +218,51 @@ def learn_streaming(
         data_shape=list(b.shape),
         stream_mode=stream_mode,
     )
+    # hang/stall watchdog (utils.watchdog): seeded with the analytic
+    # consensus-step cost (the streamed math IS the consensus outer
+    # step) so the deadline scales with problem size; the host<->device
+    # paging the roofline does not model is covered by the watchdog's
+    # self-calibration against observed fence times plus the
+    # CCSC_WATCHDOG_MIN_S floor
+    wd_cost = None
+    if cfg.watchdog:  # block divisibility already validated above
+        from ..utils import perfmodel
+
+        fg_wd = common.FreqGeom.create(
+            geom, b.shape[-geom.ndim_spatial:],
+            fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl,
+        )
+        wd_cost = perfmodel.analytic_outer_step_cost(
+            num_blocks=cfg.num_blocks,
+            ni=b.shape[0] // cfg.num_blocks,
+            k=geom.num_filters,
+            spatial=fg_wd.spatial_shape,
+            num_freq=fg_wd.num_freq,
+            max_it_d=cfg.max_it_d,
+            max_it_z=cfg.max_it_z,
+            reduce_size=geom.reduce_size,
+            state_dtype_bytes=jnp.dtype(cfg.storage_dtype).itemsize,
+            d_state_dtype_bytes=jnp.dtype(cfg.d_storage_dtype).itemsize,
+            fft_impl=cfg.fft_impl,
+        )
+    wd = watchdog.maybe_start(
+        cfg, cost=wd_cost, algorithm="consensus_streaming"
+    )
     try:
         return _learn_streaming_impl(
             b, geom, cfg, key, stream_mode, checkpoint_dir,
-            checkpoint_every, run,
+            checkpoint_every, run, wd,
         )
     finally:
+        if wd is not None:
+            wd.stop()
         # idempotent backstop for escaping exceptions
         run.close(status="error")
 
 
 def _learn_streaming_impl(
     b, geom, cfg, key, stream_mode, checkpoint_dir, checkpoint_every, run,
+    wd=None,
 ):
     from ..utils import checkpoint as ckpt
     from ..utils import faults, resilience
@@ -506,9 +544,18 @@ def _learn_streaming_impl(
         i = start_it
         stop = False
         diverged_stop = False
+        fresh_pieces = True  # the first chunk compiles the jit pieces
         while i < cfg.max_it and not stop:
             if not pending:
                 t_chunk0 = time.perf_counter()
+                if wd is not None:
+                    # one armed window per flush chunk: the streamed
+                    # chunk is many small dispatches, but a hang in any
+                    # of them stalls the same fence
+                    wd.arm(
+                        cfg.outer_chunk, f"stream_outer_{i}",
+                        may_compile=fresh_pieces,
+                    )
             na = faults.nan_iteration()
             dbar_prev = dbar
 
@@ -624,6 +671,11 @@ def _learn_streaming_impl(
                 for it, o_d, o_z, dd, num_, den_ in pending
             ]
             dt = time.perf_counter() - t_chunk0  # fenced by the floats
+            # injected hang fires INSIDE the armed fence (utils.faults)
+            faults.hang_tick(vals[-1][0] + 1)
+            if wd is not None:
+                wd.disarm()
+            fresh_pieces = False
             pending = []
             bad = next(
                 (
@@ -662,6 +714,7 @@ def _learn_streaming_impl(
                         f_bhat, f_dkern, f_prox, f_d_block, f_z_block,
                         f_full_dhat, f_obj_block,
                     ) = _jit_pieces(geom, recov.cfg, fg)
+                    fresh_pieces = True  # the rho rebuild recompiles
                     continue
                 # stop-and-keep: the block state advanced in place, so
                 # only the finite prefix of the chunk enters the trace,

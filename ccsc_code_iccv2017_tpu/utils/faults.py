@@ -5,7 +5,8 @@ exercised end-to-end — on CPU, in CI, every run (the MPAX-style
 "solver-level safeguard" discipline, PAPERS.md arXiv:2412.09734).
 This module is the single switchboard of injectable faults; the
 learner drivers and ``utils.checkpoint`` query it at well-defined
-points, so a test (or ``scripts/chaos_smoke.py``) can prove:
+points, so a test (or ``scripts/chaos_smoke.py`` /
+``tests/test_supervised.py``) can prove:
 
 - divergence recovery: ``CCSC_FAULT_NAN_IT=k`` poisons the code
   iterate INSIDE the jitted step that computes outer iteration ``k``
@@ -19,17 +20,34 @@ points, so a test (or ``scripts/chaos_smoke.py``) can prove:
 - preemption: ``CCSC_FAULT_SIGTERM_IT=k`` raises SIGTERM in the
   driver thread at the boundary after outer iteration ``k``
   completes — the graceful-shutdown path must checkpoint and exit
-  cleanly.
+  cleanly;
+- hangs: ``CCSC_FAULT_HANG_IT=k`` sleeps ``CCSC_FAULT_HANG_S``
+  seconds (default 3600) inside the host-side fence at the boundary
+  after iteration ``k`` — indistinguishable from a wedged dispatch,
+  so the watchdog (utils.watchdog) and the external supervisor
+  (scripts/supervise.py) are provable on CPU.
 
-Every fault fires AT MOST ONCE per process (else a recovered/resumed
-run would re-fail forever); ``reset()`` re-arms them for the next
-test. Reads go through the environment on every query so tests can
+Every fault fires AT MOST ONCE per run. Within a process that is a
+set in memory; ACROSS supervisor restarts the consumption must
+survive the process — otherwise a restarted run re-trips the same
+injected fault forever and the supervisor can never make progress.
+So firing also (a) drops a ``fault-fired-<name>.json`` marker into
+the fault state dir — ``CCSC_FAULT_STATE_DIR`` if set, else the
+active obs run's metrics dir — and (b) records a ``fault_fired``
+event in the obs stream, so every restart sees WHAT fired and WHEN in
+the same telemetry that carries the restarts themselves. With neither
+a state dir nor an active stream the fire-once contract is
+process-local, as before. ``reset()`` re-arms the in-process state
+for the next test (on-disk markers belong to the test's tmp dir).
+Reads go through the environment on every query so tests can
 arm/disarm with monkeypatch.setenv.
 """
 from __future__ import annotations
 
+import json
 import os
 import signal
+import time
 from typing import Optional
 
 __all__ = [
@@ -38,6 +56,7 @@ __all__ = [
     "consume_nan",
     "ckpt_save_hook",
     "sigterm_tick",
+    "hang_tick",
     "reset",
 ]
 
@@ -50,6 +69,63 @@ class InjectedFault(RuntimeError):
 # contract keeps a recovered or resumed run from re-failing on the
 # same injection)
 _fired: set = set()
+
+
+def _state_dir() -> Optional[str]:
+    """Where cross-restart fire-once markers live: the explicit
+    CCSC_FAULT_STATE_DIR (scripts/supervise.py sets it to the metrics
+    dir), else the active obs run's stream directory."""
+    d = os.environ.get("CCSC_FAULT_STATE_DIR", "").strip()
+    if d:
+        return d
+    try:
+        from . import obs
+
+        run = obs.current_run()
+        if run is not None and run.writer is not None:
+            return os.path.dirname(run.writer.path)
+    except Exception:  # pragma: no cover - obs import cycle guard
+        pass
+    return None
+
+
+def _marker_path(name: str) -> Optional[str]:
+    d = _state_dir()
+    if d is None:
+        return None
+    return os.path.join(d, f"fault-fired-{name}.json")
+
+
+def _fired_before(name: str) -> bool:
+    if name in _fired:
+        return True
+    p = _marker_path(name)
+    if p is not None and os.path.exists(p):
+        # a previous attempt of this supervised run already delivered
+        # the fault — cache so the marker is stat'ed once per process
+        _fired.add(name)
+        return True
+    return False
+
+
+def _mark_fired(name: str, **info) -> None:
+    _fired.add(name)
+    p = _marker_path(name)
+    if p is not None:
+        try:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "w", encoding="utf-8") as f:
+                json.dump(
+                    {"fault": name, "t": time.time(), **info}, f
+                )
+        except OSError:  # pragma: no cover - marker is best-effort
+            pass
+    try:
+        from . import obs
+
+        obs.record("fault_fired", fault=name, **info)
+    except Exception:  # pragma: no cover - never fail the driver
+        pass
 
 
 def _env_int(name: str) -> Optional[int]:
@@ -77,26 +153,31 @@ def nan_iteration() -> Optional[int]:
     """1-based outer iteration whose step should poison the iterate
     with NaN, or None. Stays armed until ``consume_nan()`` — the
     driver consumes it when the poisoned step has actually run, so a
-    rho-backoff retry of the same iteration runs clean."""
-    if "nan" in _fired:
+    rho-backoff retry of the same iteration runs clean.
+
+    (Every fault point checks its env var FIRST: an unarmed production
+    run must pay one dict lookup per query, not a marker-file stat.)"""
+    k = _env_int("CCSC_FAULT_NAN_IT")
+    if k is None or _fired_before("nan"):
         return None
-    return _env_int("CCSC_FAULT_NAN_IT")
+    return k
 
 
 def consume_nan() -> None:
     """Mark the NaN injection as delivered (the poisoned step ran)."""
-    _fired.add("nan")
+    _mark_fired("nan")
 
 
 def ckpt_save_hook() -> None:
     """Called by ``utils.checkpoint.save`` between writing the payload
     and the atomic commit; raises ``InjectedFault`` once when armed
     (CCSC_FAULT_CKPT_SAVE truthy) — simulating a crash mid-save."""
-    if "ckpt" in _fired:
+    if os.environ.get("CCSC_FAULT_CKPT_SAVE", "").strip() in ("", "0"):
         return
-    if os.environ.get("CCSC_FAULT_CKPT_SAVE", "").strip() not in ("", "0"):
-        _fired.add("ckpt")
-        raise InjectedFault("injected checkpoint-save crash")
+    if _fired_before("ckpt"):
+        return
+    _mark_fired("ckpt")
+    raise InjectedFault("injected checkpoint-save crash")
 
 
 def sigterm_tick(completed_it: int) -> None:
@@ -107,14 +188,35 @@ def sigterm_tick(completed_it: int) -> None:
     ``signal.raise_signal`` (not ``os.kill``) so delivery is
     synchronous in the driver thread — the graceful-shutdown flag is
     deterministically set before the driver's next boundary check."""
-    if "sigterm" in _fired:
-        return
     k = _env_int("CCSC_FAULT_SIGTERM_IT")
-    if k is not None and completed_it >= k:
-        _fired.add("sigterm")
-        signal.raise_signal(signal.SIGTERM)
+    if k is None or completed_it < k or _fired_before("sigterm"):
+        return
+    # marked (and persisted) BEFORE delivery: the process may not
+    # get another chance, and a supervisor restart must see it
+    _mark_fired("sigterm", iteration=int(completed_it))
+    signal.raise_signal(signal.SIGTERM)
+
+
+def hang_tick(completed_it: int) -> None:
+    """Called by the drivers INSIDE the armed watchdog fence, right
+    after the readback of the chunk that completed outer iteration
+    ``completed_it``; sleeps CCSC_FAULT_HANG_S seconds (default 3600)
+    once when armed (CCSC_FAULT_HANG_IT <= completed_it) — to the
+    watchdog and the supervisor this is exactly a hung dispatch.
+
+    Marked (and persisted) BEFORE the sleep: a watchdog abort or a
+    supervisor kill never returns control here, and the restarted
+    process must not re-hang."""
+    k = _env_int("CCSC_FAULT_HANG_IT")
+    if k is None or completed_it < k or _fired_before("hang"):
+        return
+    dur = float(os.environ.get("CCSC_FAULT_HANG_S", "3600"))
+    _mark_fired("hang", iteration=int(completed_it), sleep_s=dur)
+    time.sleep(dur)
 
 
 def reset() -> None:
-    """Re-arm all fault points (test isolation)."""
+    """Re-arm all in-process fault points (test isolation). On-disk
+    fire-once markers are per fault state dir and belong to the test's
+    tmp directory lifecycle."""
     _fired.clear()

@@ -13,34 +13,75 @@ layout is [k, *reduce, *spatial] (config.ProblemGeom).
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
+
+from .validate import CCSCInputError
 
 
 def _loadmat(path: str) -> dict:
+    """scipy.io.loadmat with hardened failure modes: a missing,
+    truncated or corrupt .mat raises an actionable
+    :class:`~ccsc_code_iccv2017_tpu.utils.validate.CCSCInputError`
+    naming the file instead of whatever internal exception the parser
+    tripped over (every .mat read in the package — filter banks, data
+    stacks, Dz round-trips — routes through here)."""
     import scipy.io
 
+    if not os.path.exists(path):
+        raise CCSCInputError(f"no such .mat file: {path}")
     try:
         return scipy.io.loadmat(path)
     except NotImplementedError:  # v7.3 (HDF5) files
-        import h5py
+        try:
+            import h5py
 
-        out = {}
-        with h5py.File(path, "r") as f:
-            for k in f.keys():
-                if isinstance(f[k], h5py.Dataset):
-                    out[k] = np.array(f[k]).T  # h5py is C-order transpose
-        return out
+            out = {}
+            with h5py.File(path, "r") as f:
+                for k in f.keys():
+                    if isinstance(f[k], h5py.Dataset):
+                        # h5py is C-order transpose
+                        out[k] = np.array(f[k]).T
+            return out
+        except CCSCInputError:
+            raise
+        except Exception as e:
+            raise CCSCInputError(
+                f"cannot read {path} as a v7.3 (HDF5) .mat file — the "
+                f"file is truncated or corrupt ({type(e).__name__}: "
+                f"{e}). Re-export or re-download it."
+            ) from e
+    except Exception as e:
+        size = os.path.getsize(path)
+        raise CCSCInputError(
+            f"cannot read {path} as a .mat file ({size} bytes) — the "
+            f"file is truncated, corrupt, or not a .mat at all "
+            f"({type(e).__name__}: {e}). Re-export or re-download it."
+        ) from e
+
+
+def _mat_var(path: str, name: str) -> np.ndarray:
+    data = _loadmat(path)
+    if name not in data:
+        have = sorted(k for k in data if not k.startswith("__"))
+        raise CCSCInputError(
+            f"{path} holds no variable {name!r} (found: {have}) — "
+            "this loader expects the reference's filter-bank layout "
+            "(utils.io_mat docstring)"
+        )
+    return data[name]
 
 
 def load_filters_2d(path: str) -> np.ndarray:
     """[s, s, k] -> [k, s, s] float32."""
-    d = _loadmat(path)["d"]
+    d = _mat_var(path, "d")
     return np.ascontiguousarray(np.transpose(d, (2, 0, 1))).astype(np.float32)
 
 
 def load_filters_hyperspectral(path: str) -> np.ndarray:
     """[s, s, w, k] -> [k, w, s, s] float32."""
-    d = _loadmat(path)["d"]
+    d = _mat_var(path, "d")
     return np.ascontiguousarray(np.transpose(d, (3, 2, 0, 1))).astype(
         np.float32
     )
@@ -48,7 +89,7 @@ def load_filters_hyperspectral(path: str) -> np.ndarray:
 
 def load_filters_3d(path: str) -> np.ndarray:
     """[s, s, t, k] -> [k, s, s, t] float32 (all three dims spatial)."""
-    d = _loadmat(path)["d"]
+    d = _mat_var(path, "d")
     return np.ascontiguousarray(np.transpose(d, (3, 0, 1, 2))).astype(
         np.float32
     )
@@ -56,7 +97,7 @@ def load_filters_3d(path: str) -> np.ndarray:
 
 def load_filters_lightfield(path: str) -> np.ndarray:
     """[s, s, a1, a2, k] -> [k, a1, a2, s, s] float32."""
-    d = _loadmat(path)["d"]
+    d = _mat_var(path, "d")
     return np.ascontiguousarray(np.transpose(d, (4, 2, 3, 0, 1))).astype(
         np.float32
     )
@@ -120,7 +161,7 @@ def save_filters(
 
 def load_dz(path: str, layout: str = "2d") -> np.ndarray:
     """Load the Dz reconstructions back into [n, *reduce, *spatial]."""
-    Dz = _loadmat(path)["Dz"]
+    Dz = _mat_var(path, "Dz")
     perm = _TO_MATLAB[layout]
     inv = np.argsort(perm)
     return np.ascontiguousarray(np.transpose(Dz, inv)).astype(np.float32)

@@ -206,6 +206,54 @@ def analytic_outer_step_cost(
     return {"flops": flops, "bytes": bytes_}
 
 
+def inmem_learn_estimate(b_shape, geom, cfg):
+    """Pre-flight byte estimate of the in-memory consensus learner's
+    peak working set, and the HBM budget to compare it against.
+
+    ~5 live full-batch complex code spectra inside the z iteration +
+    the f32/bf16 z/dual state — the measured driver of the r5
+    full-scale 3D OOM. Moved here from scripts/family_banks.py (r7) so
+    the auto-degrade ladder (apps._dispatch) shares the exact check
+    scripts/continue_3d.py already ran; extended with the output-state
+    term donation removes: without ``cfg.donate_state`` XLA
+    materializes every step's output state into fresh buffers, so the
+    non-donated peak carries one extra full ADMM state — which is why
+    'donate' is the first rung of the ladder. Returns
+    (est_bytes, budget_bytes); budget from CCSC_INMEM_HBM_GB (default
+    14 — the 16 GB v5e minus runtime reserves)."""
+    import os
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..models.common import FreqGeom
+
+    fg_est = FreqGeom.create(
+        geom, tuple(b_shape[-geom.ndim_spatial:]),
+        fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl,
+    )
+    n = b_shape[0]
+    k = geom.num_filters
+    S = int(np.prod(fg_est.spatial_shape))
+    zb = jnp.dtype(cfg.storage_dtype).itemsize
+    est = (
+        5 * n * k * fg_est.num_freq * 8
+        + 2 * n * k * S * zb
+    )
+    if not cfg.donate_state:
+        db = jnp.dtype(cfg.d_storage_dtype).itemsize
+        W = geom.reduce_size
+        N = cfg.num_blocks
+        est += (
+            2 * n * k * S * zb  # z + dual_z output copies
+            + 2 * N * k * W * S * db  # d_local + dual_d
+            + 2 * k * W * S * 4  # dbar + udbar (f32)
+        )
+    budget = float(os.environ.get("CCSC_INMEM_HBM_GB", "14")) * 1e9
+    return est, budget
+
+
 def bound_iters_per_sec(
     cost: Dict[str, float], chip: Optional[str] = None
 ) -> float:

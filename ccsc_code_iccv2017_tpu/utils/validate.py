@@ -1,0 +1,327 @@
+"""Strict input validation at every public entry point.
+
+A malformed input to a jitted JAX program fails as a deferred XLA
+shape/dtype error — often minutes in, after compilation, with a
+traceback pointing at the lowering machinery instead of the operator's
+mistake — and non-finite DATA doesn't fail at all: it silently poisons
+the iterate until the divergence guard stops a run that was never
+going to work. Production solver stacks treat input validation as part
+of the solver, not the caller (the MPAX stance, PAPERS.md
+arXiv:2412.09734). This module is the single vocabulary of input
+checks; the three learners (models.learn / models.learn_masked /
+parallel.streaming), models.reconstruct, the data loaders, and every
+app CLI route their inputs through it BEFORE anything is dispatched
+(tests/test_validate.py lints the CLI wiring).
+
+Every failure raises :class:`CCSCInputError` — a ``ValueError``
+subclass so callers that matched the historical errors keep working —
+whose message states what was wrong AND what to change.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CCSCInputError",
+    "check_finite",
+    "check_learn_data",
+    "check_solve_data",
+    "check_filters",
+    "check_mask",
+    "check_positive",
+    "check_learn_config",
+    "check_solve_config",
+    "check_learn_inputs",
+    "check_solve_inputs",
+]
+
+
+class CCSCInputError(ValueError):
+    """An input failed validation at a public entry point (never raised
+    mid-solve: by the time a step is dispatched, inputs are known
+    good)."""
+
+
+def _shape(x) -> Tuple[int, ...]:
+    try:
+        return tuple(int(s) for s in x.shape)
+    except AttributeError:
+        raise CCSCInputError(
+            f"expected an array, got {type(x).__name__} — load data "
+            "through data.images / data.volumes or pass a numpy/jax array"
+        )
+
+
+def _host(x) -> np.ndarray:
+    # one host copy for the finite scan; inputs at the entry points are
+    # host-side (loaders return numpy, CLIs convert after validation)
+    return np.asarray(x)
+
+
+def check_finite(name: str, arr) -> None:
+    """Reject NaN/Inf DATA up front: non-finite inputs don't error in
+    the solver — they silently diverge it. A jax array is scanned ON
+    DEVICE (one scalar readback) so validating at the learner entry
+    never pulls a multi-GB batch back to host."""
+    dtype = getattr(arr, "dtype", None)
+    if dtype is None:
+        arr = _host(arr)
+        dtype = arr.dtype
+    dtype = np.dtype(dtype)
+    if np.issubdtype(dtype, np.integer) or np.issubdtype(
+        dtype, np.bool_
+    ):
+        return  # integral data is trivially finite
+    if dtype.kind in ("O", "U", "S"):
+        raise CCSCInputError(
+            f"{name} has non-numeric dtype {dtype} — convert to "
+            "float32 before solving"
+        )
+    # float / complex / extension float dtypes (bfloat16): scan
+    try:
+        import jax
+
+        is_jax = isinstance(arr, jax.Array)
+    except Exception:  # pragma: no cover - jax always present here
+        is_jax = False
+    if is_jax:
+        import jax.numpy as jnp
+
+        if not bool(jnp.isfinite(arr).all()):
+            raise CCSCInputError(
+                f"{name} contains non-finite values (NaN/Inf) — clean "
+                "or mask the input before solving; non-finite data "
+                "silently diverges the ADMM iterate instead of erroring"
+            )
+        return
+    a = _host(arr)
+    bad = np.count_nonzero(~np.isfinite(a))
+    if bad:
+        raise CCSCInputError(
+            f"{name} contains {bad} non-finite value(s) "
+            f"(NaN/Inf) out of {a.size} — clean or mask the input "
+            "before solving; non-finite data silently diverges the "
+            "ADMM iterate instead of erroring"
+        )
+
+
+def _check_geometry(name: str, shape, geom, what: str) -> None:
+    """Batch-leading data layout [n, *reduce, *spatial] vs a
+    ProblemGeom, with actionable messages for the classic mistakes
+    (missing batch axis, wrong family layout, kernel > signal)."""
+    want_ndim = 1 + geom.ndim_reduce + geom.ndim_spatial
+    if len(shape) != want_ndim:
+        layout = (
+            "[n"
+            + "".join(f", {r}" for r in geom.reduce_shape)
+            + ", *spatial]"
+        )
+        raise CCSCInputError(
+            f"{name} has shape {shape} ({len(shape)} axes) but this "
+            f"{what} expects {layout} with {geom.ndim_spatial} spatial "
+            f"axes ({want_ndim} axes total) — check the data layout "
+            "(batch leading, FFT axes trailing; config.ProblemGeom "
+            "docstring)"
+        )
+    if shape[0] < 1:
+        raise CCSCInputError(f"{name} is empty (shape {shape})")
+    reduce_got = shape[1 : 1 + geom.ndim_reduce]
+    if tuple(reduce_got) != tuple(geom.reduce_shape):
+        raise CCSCInputError(
+            f"{name} reduce axes {tuple(reduce_got)} do not match the "
+            f"problem's reduce_shape {tuple(geom.reduce_shape)} "
+            "(wavelengths/views axes right after the batch axis)"
+        )
+    spatial = shape[1 + geom.ndim_reduce :]
+    too_small = [
+        (s, k)
+        for s, k in zip(spatial, geom.spatial_support)
+        if s < k
+    ]
+    if too_small:
+        raise CCSCInputError(
+            f"kernel support {tuple(geom.spatial_support)} exceeds the "
+            f"{name} signal size {tuple(spatial)} — a filter cannot be "
+            "larger than the signal it codes; reduce the support or "
+            "use larger inputs"
+        )
+
+
+def check_learn_data(
+    b, geom, *, num_blocks: Optional[int] = None, name: str = "data"
+) -> None:
+    """Learner data [n, *reduce, *spatial]: layout vs geometry,
+    finiteness, and (when given) consensus-block divisibility."""
+    shape = _shape(b)
+    _check_geometry(name, shape, geom, "learner")
+    if num_blocks is not None:
+        if num_blocks < 1:
+            raise CCSCInputError(
+                f"num_blocks must be >= 1, got {num_blocks}"
+            )
+        if shape[0] % num_blocks:
+            raise CCSCInputError(
+                f"n={shape[0]} not divisible by num_blocks={num_blocks}"
+                " — pick a block count that divides the batch (or trim "
+                "the batch)"
+            )
+    check_finite(name, b)
+
+
+def check_filters(d, geom=None, *, name: str = "filters") -> None:
+    """Dictionary [k, *reduce, *support]; with a geometry, the shape
+    must match it exactly."""
+    shape = _shape(d)
+    if len(shape) < 3:
+        raise CCSCInputError(
+            f"{name} has shape {shape} — expected "
+            "[k, *reduce, *support] with at least 2 spatial axes "
+            "(load through utils.io_mat.load_filters_*)"
+        )
+    if geom is not None and tuple(shape) != tuple(geom.filter_shape):
+        raise CCSCInputError(
+            f"{name} shape {shape} does not match the problem's "
+            f"filter shape {tuple(geom.filter_shape)}"
+        )
+    check_finite(name, d)
+
+
+def check_mask(mask, b, *, name: str = "mask") -> None:
+    """Observation mask: same shape as the data, finite, and with a
+    non-empty support (an all-zero mask observes nothing). Like
+    check_finite, a jax array is reduced ON DEVICE — a data-sized
+    device mask is never pulled to host just to be validated."""
+    mshape, bshape = _shape(mask), _shape(b)
+    if mshape != bshape:
+        raise CCSCInputError(
+            f"{name} shape {mshape} does not match data shape {bshape}"
+            " — the mask must weight every data entry"
+        )
+    check_finite(name, mask)
+    try:
+        import jax
+
+        is_jax = isinstance(mask, jax.Array)
+    except Exception:  # pragma: no cover - jax always present here
+        is_jax = False
+    if is_jax:
+        import jax.numpy as jnp
+
+        all_zero = mask.size > 0 and float(jnp.max(jnp.abs(mask))) == 0.0
+    else:
+        m = _host(mask)
+        all_zero = m.size > 0 and float(np.max(np.abs(m))) == 0.0
+    if all_zero:
+        raise CCSCInputError(
+            f"{name} is identically zero — it observes no pixels, so "
+            "the reconstruction is unconstrained"
+        )
+
+
+def check_same_shape(name: str, arr, b) -> None:
+    ashape, bshape = _shape(arr), _shape(b)
+    if ashape != bshape:
+        raise CCSCInputError(
+            f"{name} shape {ashape} does not match data shape {bshape}"
+        )
+
+
+def check_positive(what: str, **vals) -> None:
+    for k, v in vals.items():
+        if v is None:
+            continue
+        if not np.isfinite(v) or v <= 0:
+            raise CCSCInputError(
+                f"{what}.{k} must be a finite positive number, got "
+                f"{v!r}"
+            )
+
+
+def check_learn_config(cfg) -> None:
+    """Positivity / sanity of the LearnConfig fields that the solver
+    would otherwise divide by or diverge on."""
+    check_positive(
+        "LearnConfig",
+        lambda_residual=cfg.lambda_residual,
+        lambda_prior=cfg.lambda_prior,
+        rho_d=cfg.rho_d,
+        rho_z=cfg.rho_z,
+    )
+    # max_it=0 is legitimate (a zero-iteration run returns the seeded
+    # dictionary — the warm-start contract, tests/test_learn.py)
+    if cfg.max_it < 0 or cfg.max_it_d < 1 or cfg.max_it_z < 1:
+        raise CCSCInputError(
+            "LearnConfig.max_it must be >= 0 and max_it_d/max_it_z "
+            f">= 1, got {cfg.max_it}/{cfg.max_it_d}/{cfg.max_it_z}"
+        )
+    if not np.isfinite(cfg.tol) or cfg.tol < 0:
+        raise CCSCInputError(
+            f"LearnConfig.tol must be a finite value >= 0, got {cfg.tol}"
+        )
+
+
+def check_solve_config(cfg) -> None:
+    """Positivity / sanity of the SolveConfig fields."""
+    check_positive(
+        "SolveConfig",
+        lambda_residual=cfg.lambda_residual,
+        lambda_prior=cfg.lambda_prior,
+        gamma_factor=cfg.gamma_factor,
+        gamma_ratio=cfg.gamma_ratio,
+    )
+    if cfg.max_it < 1:
+        raise CCSCInputError(
+            f"SolveConfig.max_it must be >= 1, got {cfg.max_it}"
+        )
+    if not np.isfinite(cfg.tol) or cfg.tol < 0:
+        raise CCSCInputError(
+            f"SolveConfig.tol must be a finite value >= 0, got {cfg.tol}"
+        )
+
+
+def check_learn_inputs(
+    b, geom, cfg, *, init_d=None, smooth_init=None, blocks=True
+) -> None:
+    """Everything a learner entry point needs checked before its first
+    dispatch (the learners call this; CLIs additionally call
+    check_learn_data right after loading so a bad file fails before
+    JAX initializes a backend). ``blocks=False`` for solvers that do
+    not consensus-split the batch (the masked learner) — they must not
+    reject inputs over a constraint they never read."""
+    check_learn_config(cfg)
+    check_learn_data(
+        b, geom, num_blocks=cfg.num_blocks if blocks else None
+    )
+    if init_d is not None:
+        check_filters(init_d, geom, name="init_d")
+    if smooth_init is not None:
+        check_same_shape("smooth_init", smooth_init, b)
+        check_finite("smooth_init", smooth_init)
+
+
+def check_solve_data(
+    b, d, geom, *, mask=None, smooth_init=None, name: str = "data"
+) -> None:
+    """Reconstruction inputs (no config): observations vs geometry,
+    dictionary vs geometry, mask/offset shapes — what a CLI can check
+    right after loading, before a backend even initializes."""
+    _check_geometry(name, _shape(b), geom, "reconstruction")
+    check_finite(name, b)
+    check_filters(d, geom)
+    if mask is not None:
+        check_mask(mask, b)
+    if smooth_init is not None:
+        check_same_shape("smooth_init", smooth_init, b)
+        check_finite("smooth_init", smooth_init)
+
+
+def check_solve_inputs(
+    b, d, geom, cfg, *, mask=None, smooth_init=None, x_orig=None
+) -> None:
+    """Everything models.reconstruct needs checked before dispatch."""
+    check_solve_config(cfg)
+    check_solve_data(b, d, geom, mask=mask, smooth_init=smooth_init)
+    if x_orig is not None:
+        check_same_shape("x_orig", x_orig, b)

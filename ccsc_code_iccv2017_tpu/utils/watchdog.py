@@ -1,0 +1,425 @@
+"""Dispatch-fence watchdog: a hung XLA dispatch must not hang the run.
+
+A wedged TPU tunnel, a deadlocked collective, or a runaway host
+callback all present the same way: the driver blocks forever inside a
+jitted step or its readback fence, the event stream goes quiet, and
+nothing in-process will ever notice — the failure modes PR 2's
+in-process recovery cannot see by construction. The watchdog is a
+host-side thread armed around every fenced dispatch in the three
+learner drivers; the deadline is derived from the analytic roofline
+bound (``utils.perfmodel.bound_iters_per_sec``) times a configurable
+slack, so it scales with the problem instead of being one more magic
+timeout (the supervision stance of production JAX solver stacks,
+PAPERS.md arXiv:2412.09734).
+
+On expiry it emits a ``stall`` record into the obs stream (utils.obs)
+and, in ``abort`` mode, syncs the stream and hard-exits with
+``EXIT_STALL`` — the driver thread is wedged inside the runtime, so a
+soft unwind is not available; the last on-disk checkpoint is the
+resume point and ``scripts/supervise.py`` restarts from it. In
+``event`` mode it only records the stall (monitoring without
+authority).
+
+In a multi-host run the same thread watches the shared metrics dir for
+peer-host heartbeat staleness (``check_peers``): a host whose newest
+heartbeat lags the stream by more than the stale threshold is flagged
+with a ``peer_stale`` record — the post-mortem "which host died"
+signal, live. ``scripts/obs_report.py`` renders the same staleness
+rule as a per-host liveness column.
+
+Enabled per run via ``LearnConfig.watchdog`` (CLI ``--watchdog``);
+knobs:
+
+==============================  =====================================
+CCSC_WATCHDOG_ACTION            'abort' (default) | 'event'
+CCSC_WATCHDOG_MIN_S             deadline floor per fence (default 30)
+CCSC_WATCHDOG_COMPILE_S         extra allowance on the FIRST fence,
+                                which includes trace+compile
+                                (default 300)
+CCSC_WATCHDOG_PEER_STALE_S      peer heartbeat staleness threshold
+                                (default 120)
+==============================  =====================================
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "DispatchWatchdog",
+    "maybe_start",
+    "check_peers",
+    "EXIT_STALL",
+    "DEFAULT_PEER_STALE_S",
+]
+
+# distinctive exit code for a stall abort, recognized by
+# scripts/supervise.py (a crash, but one whose diagnosis is already in
+# the event stream)
+EXIT_STALL = 87
+
+DEFAULT_MIN_S = 30.0
+DEFAULT_COMPILE_S = 300.0
+DEFAULT_PEER_STALE_S = 120.0
+
+
+def _env_f(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+class DispatchWatchdog:
+    """Deadline monitor for the drivers' fenced dispatches.
+
+    The driver arms a deadline before each jitted step/chunk +
+    readback (``arm``) and disarms it when the fence returns
+    (``disarm``); the daemon thread fires when an armed deadline
+    expires. One watchdog per run; ``stop()`` in the driver's finally.
+    All methods are cheap and thread-safe — the armed window is two
+    lock-protected float writes per fence.
+    """
+
+    def __init__(
+        self,
+        per_iter_s: float,
+        *,
+        action: Optional[str] = None,
+        metrics_dir: Optional[str] = None,
+        algorithm: str = "",
+    ):
+        self.per_iter_s = float(per_iter_s)
+        self.min_s = _env_f("CCSC_WATCHDOG_MIN_S", DEFAULT_MIN_S)
+        self.compile_s = _env_f(
+            "CCSC_WATCHDOG_COMPILE_S", DEFAULT_COMPILE_S
+        )
+        self.action = action or os.environ.get(
+            "CCSC_WATCHDOG_ACTION", "abort"
+        )
+        if self.action not in ("abort", "event"):
+            self.action = "abort"
+        self.peer_stale_s = _env_f(
+            "CCSC_WATCHDOG_PEER_STALE_S", DEFAULT_PEER_STALE_S
+        )
+        self.metrics_dir = metrics_dir
+        self.algorithm = algorithm
+        self.stalls = 0
+        self._deadline: Optional[float] = None
+        self._label = ""
+        self._fences = 0
+        self._fired_this_fence = False
+        self._armed_at: Optional[float] = None
+        self._armed_iters = 1
+        self._armed_compile = False
+        self._obs_per_iter = 0.0
+        self._stale_flagged: set = set()
+        self._peer_checked = 0.0
+        self._tail: Optional["_HeartbeatTail"] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="ccsc-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    # -- driver API ----------------------------------------------------
+    def timeout_for(
+        self, expected_iters: int, may_compile: bool = False
+    ) -> float:
+        """Deadline budget for a fence covering ``expected_iters``
+        outer iterations: the roofline-derived expectation times the
+        slack (already folded into per_iter_s), floored at MIN_S, plus
+        the compile allowance when a jit trace/compile may land inside
+        this fence — always true for the first fence, and signaled by
+        the driver (``may_compile``) when it just built a new step
+        callable (a partial tail chunk's new scan length, a
+        post-recovery rho rebuild, a one-off poisoned step).
+
+        Without a cost model (per_iter_s == 0: the masked and
+        streaming learners) the MIN_S floor scales with the number of
+        iterations the fence covers — a 16-iteration chunk legitimately
+        takes 16x longer than a single step.
+
+        The deadline is additionally SELF-CALIBRATING: every clean
+        fence (no compile, no stall) updates the slowest observed
+        per-iteration time, and later deadlines are at least 4x that —
+        so a run whose real pace the static model under-predicts (the
+        streaming learner's host paging, a slow tunnel) teaches the
+        watchdog its own baseline instead of being aborted for it."""
+        n = max(1, expected_iters)
+        per = self.per_iter_s if self.per_iter_s > 0 else self.min_s
+        t = max(self.min_s, per * n, 4.0 * self._obs_per_iter * n)
+        if self._fences == 0 or may_compile:
+            t += self.compile_s
+        return t
+
+    def arm(
+        self,
+        expected_iters: int = 1,
+        label: str = "",
+        may_compile: bool = False,
+    ) -> None:
+        t = self.timeout_for(expected_iters, may_compile=may_compile)
+        with self._lock:
+            self._deadline = time.monotonic() + t
+            self._label = label
+            self._fired_this_fence = False
+            self._armed_at = time.monotonic()
+            self._armed_iters = max(1, expected_iters)
+            self._armed_compile = may_compile or self._fences == 0
+
+    def disarm(self) -> None:
+        with self._lock:
+            # calibrate on clean fences only (a compile-bearing or
+            # stalled fence is not representative of steady state)
+            if (
+                self._armed_at is not None
+                and not self._armed_compile
+                and not self._fired_this_fence
+            ):
+                per = (
+                    time.monotonic() - self._armed_at
+                ) / self._armed_iters
+                self._obs_per_iter = max(self._obs_per_iter, per)
+            self._armed_at = None
+            self._deadline = None
+            self._fences += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        # the thread is daemon; join briefly so tests see a quiet exit
+        self._thread.join(timeout=2.0)
+
+    # -- the monitor thread --------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(0.25):
+            now = time.monotonic()
+            with self._lock:
+                expired = (
+                    self._deadline is not None
+                    and not self._fired_this_fence
+                    and now > self._deadline
+                )
+                if expired:
+                    # fire once per armed fence; the driver may still
+                    # return late (a slow fence, not a hang) and the
+                    # next arm() re-enables firing
+                    self._fired_this_fence = True
+                label = self._label
+            if expired:
+                self._on_stall(label)
+            self._maybe_check_peers()
+
+    def _on_stall(self, label: str) -> None:
+        from . import obs
+
+        self.stalls += 1
+        obs.record(
+            "stall",
+            label=label,
+            algorithm=self.algorithm,
+            per_iter_budget_s=round(self.per_iter_s, 4),
+            action=self.action,
+        )
+        obs.console(
+            f"WATCHDOG: dispatch fence '{label}' exceeded its deadline "
+            f"— the device/runtime looks hung ({self.action} mode)",
+            tier="always",
+        )
+        if self.action == "abort":
+            run = obs.current_run()
+            if run is not None and run.writer is not None:
+                try:
+                    run.writer.sync()
+                except Exception:  # pragma: no cover - dying anyway
+                    pass
+            # the driver thread is wedged inside the runtime: no soft
+            # unwind exists. Hard-exit with the stall code; the last
+            # on-disk checkpoint is the resume point and supervise.py
+            # restarts from it.
+            os._exit(EXIT_STALL)
+
+    def _maybe_check_peers(self) -> None:
+        if self.metrics_dir is None or self.peer_stale_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._peer_checked < max(1.0, self.peer_stale_s / 4):
+            return
+        self._peer_checked = now
+        try:
+            import jax
+
+            if jax.process_count() < 2:
+                return
+            me = jax.process_index()
+        except Exception:
+            return
+        from . import obs
+
+        if self._tail is None:
+            self._tail = _HeartbeatTail(self.metrics_dir)
+        for peer in self._tail.stale_peers(self.peer_stale_s):
+            if peer["host"] == me or peer["host"] in self._stale_flagged:
+                continue
+            self._stale_flagged.add(peer["host"])
+            obs.record("peer_stale", **peer)
+            obs.console(
+                f"WATCHDOG: host {peer['host']} heartbeat is "
+                f"{peer['behind_s']:.0f}s behind the stream — peer "
+                "looks dead",
+                tier="always",
+            )
+
+
+class _HeartbeatTail:
+    """Incremental reader of the shared metrics dir for the watchdog's
+    periodic peer check: remembers a byte offset per events file and
+    parses only APPENDED lines for heartbeats, so the per-check cost is
+    O(new records) instead of re-parsing the whole stream (which grows
+    to hundreds of MB over a long run) every interval. The one-shot
+    ``check_peers`` below stays a full read — obs_report and tests
+    call it once, not every 30 s."""
+
+    def __init__(self, metrics_dir: str):
+        self.dir = metrics_dir
+        self._offsets: Dict[str, int] = {}
+        self.last_hb: Dict[int, Dict] = {}
+        self.newest_t = 0.0
+
+    def poll(self) -> None:
+        import json
+
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("events") and name.endswith(".jsonl")):
+                continue
+            path = os.path.join(self.dir, name)
+            off = self._offsets.get(name, 0)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            # consume only whole lines; a torn trailing line is left
+            # for the next poll (same crash tolerance as read_events)
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            self._offsets[name] = off + last_nl + 1
+            for line in chunk[: last_nl + 1].splitlines():
+                try:
+                    rec = json.loads(line)
+                except Exception:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                t = rec.get("t", 0.0)
+                if isinstance(t, (int, float)):
+                    self.newest_t = max(self.newest_t, t)
+                if rec.get("type") != "heartbeat":
+                    continue
+                h = rec.get("host", 0)
+                if h not in self.last_hb or t > self.last_hb[h]["t"]:
+                    self.last_hb[h] = rec
+
+    def stale_peers(self, stale_s: float) -> List[Dict]:
+        self.poll()
+        out = []
+        for h, e in sorted(self.last_hb.items()):
+            behind = self.newest_t - e.get("t", 0.0)
+            if behind > stale_s:
+                out.append(
+                    {
+                        "host": h,
+                        "last_t": e.get("t"),
+                        "last_step": e.get("step"),
+                        "behind_s": round(behind, 1),
+                    }
+                )
+        return out
+
+
+def check_peers(
+    metrics_dir: str,
+    stale_s: Optional[float] = None,
+    now: Optional[float] = None,
+) -> List[Dict]:
+    """Hosts whose newest heartbeat lags the stream.
+
+    ``now`` defaults to the newest record timestamp ANYWHERE in the
+    stream — staleness is judged against the run's own clock line, so
+    a finished run's report is stable (a host is stale because OTHERS
+    kept going after it stopped, not because the run ended). Returns
+    one dict per stale host: {host, last_t, last_step, behind_s}.
+    """
+    from . import obs
+
+    stale_s = (
+        _env_f("CCSC_WATCHDOG_PEER_STALE_S", DEFAULT_PEER_STALE_S)
+        if stale_s is None
+        else stale_s
+    )
+    events = obs.read_events(metrics_dir)
+    if not events:
+        return []
+    if now is None:
+        now = max(e.get("t", 0.0) for e in events)
+    last: Dict[int, Dict] = {}
+    for e in events:
+        if e.get("type") != "heartbeat":
+            continue
+        h = e.get("host", 0)
+        if h not in last or e.get("t", 0.0) > last[h]["t"]:
+            last[h] = e
+    out = []
+    for h, e in sorted(last.items()):
+        behind = now - e.get("t", 0.0)
+        if behind > stale_s:
+            out.append(
+                {
+                    "host": h,
+                    "last_t": e.get("t"),
+                    "last_step": e.get("step"),
+                    "behind_s": round(behind, 1),
+                }
+            )
+    return out
+
+
+def maybe_start(
+    cfg, cost=None, algorithm: str = ""
+) -> Optional[DispatchWatchdog]:
+    """Build and start the run's watchdog when ``cfg.watchdog`` is on,
+    else None (the drivers guard every arm/disarm on that).
+
+    With an analytic per-step ``cost`` (utils.perfmodel) the per-
+    iteration budget is ``watchdog_slack / bound_iters_per_sec`` — the
+    roofline-derived fastest possible iteration times the slack. With
+    no cost model (the masked learner) the MIN_S floor alone governs.
+    """
+    if not getattr(cfg, "watchdog", False):
+        return None
+    per_iter = 0.0
+    if cost is not None:
+        from . import perfmodel
+
+        bound = perfmodel.bound_iters_per_sec(cost)
+        if bound > 0 and bound != float("inf"):
+            per_iter = cfg.watchdog_slack / bound
+    return DispatchWatchdog(
+        per_iter,
+        metrics_dir=getattr(cfg, "metrics_dir", None),
+        algorithm=algorithm,
+    )

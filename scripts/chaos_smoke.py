@@ -18,8 +18,16 @@ restores them):
                       rotation
   sigterm_checkpoint  SIGTERM at iteration 1 -> clean checkpoint-and-
                       exit at the boundary, checkpoint resumable
+  hang_watchdog       injected hang at iteration 2 (CCSC_FAULT_HANG_IT,
+                      sleeping inside the fence) -> the dispatch
+                      watchdog (utils.watchdog, event mode) records a
+                      `stall` event and the run still completes
   sigterm_subprocess  (script mode only) the same against a real child
                       process: exit code 0 + valid checkpoint
+  supervise_restart   (script mode only) scripts/supervise.py restarts
+                      a SIGTERM'd child from its checkpoint and the
+                      supervised run completes (trace: preempted ->
+                      completed, fault fire-once across restarts)
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
@@ -204,6 +212,89 @@ def scenario_sigterm_checkpoint():
     return ok, f"preemptions={res.trace.get('preemptions')}"
 
 
+def scenario_hang_watchdog():
+    import jax
+
+    from ccsc_code_iccv2017_tpu.models.learn import learn
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    b, geom, cfg = _tiny_problem()
+    with tempfile.TemporaryDirectory() as mdir:
+        with _fault(
+            CCSC_FAULT_HANG_IT=2,
+            CCSC_FAULT_HANG_S="1.5",
+            CCSC_WATCHDOG_ACTION="event",
+            CCSC_WATCHDOG_MIN_S="0.5",
+            CCSC_WATCHDOG_COMPILE_S="120",
+        ):
+            res = learn(
+                b, geom, cfg(watchdog=True, metrics_dir=mdir),
+                key=jax.random.PRNGKey(0),
+            )
+        events = obs.read_events(mdir)
+        stalls = [e for e in events if e["type"] == "stall"]
+        fired = [e for e in events if e["type"] == "fault_fired"]
+        ok = (
+            len(stalls) >= 1
+            and any(f.get("fault") == "hang" for f in fired)
+            and len(res.trace["obj_vals_z"]) == 4  # run completed
+        )
+    return ok, f"stalls={len(stalls)}, trace_len={len(res.trace['obj_vals_z'])}"
+
+
+def scenario_supervise_restart():
+    import json
+
+    from ccsc_code_iccv2017_tpu.utils import checkpoint as ckpt
+
+    with tempfile.TemporaryDirectory() as d:
+        ck = os.path.join(d, "ck")
+        mdir = os.path.join(d, "metrics")
+        code = f"""
+import jax, jax.numpy as jnp, numpy as np
+from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+from ccsc_code_iccv2017_tpu.models.learn import learn
+b = jnp.asarray(np.asarray(
+    jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12)), np.float32))
+cfg = LearnConfig(max_it=3, max_it_d=2, max_it_z=2, num_blocks=2,
+                  rho_d=50.0, rho_z=2.0, tol=0.0, verbose="none",
+                  metrics_dir={mdir!r})
+learn(b, ProblemGeom((3, 3), 4), cfg, key=jax.random.PRNGKey(0),
+      checkpoint_dir={ck!r}, checkpoint_every=1)
+"""
+        env = dict(
+            os.environ, CCSC_FAULT_SIGTERM_IT="1", JAX_PLATFORMS="cpu"
+        )
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        p = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "supervise.py"),
+                "--checkpoint-dir", ck,
+                "--metrics-dir", mdir,
+                "--max-restarts", "3",
+                "--backoff", "0",
+                "--",
+                sys.executable, "-c", code,
+            ],
+            capture_output=True, text=True, env=env, timeout=480,
+        )
+        trace = {}
+        tp = os.path.join(mdir, "supervisor_trace.json")
+        if os.path.exists(tp):
+            with open(tp) as f:
+                trace = json.load(f)
+        reasons = [a.get("reason") for a in trace.get("attempts", [])]
+        snap = ckpt.load(ck) if p.returncode == 0 else None
+        ok = (
+            p.returncode == 0
+            and reasons == ["preempted", "completed"]
+            and snap is not None
+            and snap[2] == 3
+        )
+    return ok, f"rc={p.returncode}, reasons={reasons}"
+
+
 def scenario_sigterm_subprocess():
     from ccsc_code_iccv2017_tpu.utils import checkpoint as ckpt
 
@@ -243,9 +334,11 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
         "ckpt_save_crash": scenario_ckpt_save_crash,
         "corrupt_fallback": scenario_corrupt_fallback,
         "sigterm_checkpoint": scenario_sigterm_checkpoint,
+        "hang_watchdog": scenario_hang_watchdog,
     }
     if subprocess_scenarios:
         scenarios["sigterm_subprocess"] = scenario_sigterm_subprocess
+        scenarios["supervise_restart"] = scenario_supervise_restart
     if only is not None:
         scenarios = {k: v for k, v in scenarios.items() if k in set(only)}
     results = {}

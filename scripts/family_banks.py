@@ -49,34 +49,13 @@ SHIPPED = {
 }
 
 
-def inmem_learn_estimate(b_shape, geom, cfg):
-    """Pre-flight byte estimate of the in-memory consensus learner's
-    peak working set, and the HBM budget to compare it against.
-
-    ~5 live full-batch complex code spectra inside the z iteration +
-    the f32/bf16 z/dual state — the measured driver of the r5
-    full-scale 3D OOM. Returns (est_bytes, budget_bytes); budget from
-    CCSC_INMEM_HBM_GB (default 14 — the 16 GB v5e minus runtime
-    reserves). Shared by the memory-bounded learn below and
-    scripts/continue_3d.py's pre-flight (ADVICE open item)."""
-    import numpy as np
-
-    import jax.numpy as jnp
-
-    from ccsc_code_iccv2017_tpu.models.common import FreqGeom
-
-    fg_est = FreqGeom.create(
-        geom, tuple(b_shape[-geom.ndim_spatial:]),
-        fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl,
-    )
-    est = (
-        5 * b_shape[0] * geom.num_filters * fg_est.num_freq * 8
-        + 2 * b_shape[0] * geom.num_filters
-        * int(np.prod(fg_est.spatial_shape))
-        * jnp.dtype(cfg.storage_dtype).itemsize
-    )
-    budget = float(os.environ.get("CCSC_INMEM_HBM_GB", "14")) * 1e9
-    return est, budget
+# moved to utils.perfmodel (r7: the auto-degrade ladder in
+# apps._dispatch shares the exact same pre-flight); re-exported here so
+# scripts/continue_3d.py and older callers keep importing it from this
+# script
+from ccsc_code_iccv2017_tpu.utils.perfmodel import (  # noqa: E402
+    inmem_learn_estimate,
+)
 
 
 def _imgs(contrast="local_cn"):

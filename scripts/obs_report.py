@@ -41,8 +41,17 @@ def _section(title):
     return f"\n== {title} " + "=" * max(1, 64 - len(title))
 
 
-def render(events):
-    """-> the dashboard string (pure function of the parsed records)."""
+def render(events, stale_after=None):
+    """-> the dashboard string (pure function of the parsed records).
+    ``stale_after``: per-host liveness threshold in seconds (default:
+    the watchdog's peer-staleness default, CCSC_WATCHDOG_PEER_STALE_S).
+    """
+    if stale_after is None:
+        from ccsc_code_iccv2017_tpu.utils import watchdog as _wd
+
+        stale_after = _wd._env_f(
+            "CCSC_WATCHDOG_PEER_STALE_S", _wd.DEFAULT_PEER_STALE_S
+        )
     by = _by_type(events)
     lines = []
 
@@ -203,6 +212,12 @@ def render(events):
     hbs = by.get("heartbeat", [])
     lines.append(_section("HOSTS"))
     if hbs:
+        # liveness is judged against the run's own clock line (the
+        # newest record anywhere in the stream): a host is STALE
+        # because the OTHERS kept going after it went quiet — the same
+        # staleness rule the watchdog applies live
+        # (utils.watchdog.check_peers)
+        now = max(e.get("t", 0.0) for e in events)
         hosts = {}
         for h in hbs:
             hosts.setdefault(h.get("host", 0), []).append(h)
@@ -212,23 +227,39 @@ def render(events):
                 b["t"] - a["t"] for a, b in zip(hs, hs[1:])
             ]
             lat = max(h.get("fence_latency_s", 0.0) for h in hs)
+            behind = now - hs[-1]["t"]
+            # staleness is a RELATIVE signal — one host quiet while
+            # others kept going. With a single host there are no
+            # others: post-loop finalization (final eval, summary)
+            # legitimately outlasts the threshold, and the live
+            # watchdog skips the check below 2 processes too.
+            live = (
+                f"STALE ({behind:.0f}s behind — the watchdog would "
+                "declare this host dead)"
+                if behind > stale_after and len(hosts) > 1
+                else "live"
+            )
             lines.append(
-                f"  host {host}: {len(hs)} heartbeats, steps "
+                f"  host {host}: {live:<7} {len(hs)} heartbeats, steps "
                 f"{hs[0].get('step')}..{hs[-1].get('step')}, last "
                 f"{_fmt_ts(hs[-1]['t'])}, max gap "
                 f"{max(gaps):.1f}s, max fence {lat:.3f}s"
                 if gaps else
-                f"  host {host}: {len(hs)} heartbeat, step "
+                f"  host {host}: {live:<7} {len(hs)} heartbeat, step "
                 f"{hs[0].get('step')}, at {_fmt_ts(hs[0]['t'])}, "
                 f"fence {lat:.3f}s"
             )
+        lines.append(
+            f"  (stale threshold {stale_after:g}s; --stale-after)"
+        )
     else:
         lines.append("  (no heartbeat records)")
 
     lines.append(_section("EVENTS"))
     n_ev = 0
     for kind in ("checkpoint_save", "checkpoint_load", "recovery",
-                 "preemption"):
+                 "preemption", "stall", "peer_stale", "degrade",
+                 "fault_fired"):
         for e in by.get(kind, []):
             n_ev += 1
             detail = {
@@ -268,12 +299,19 @@ def main(argv=None):
         help="emit the parsed record list as JSON instead of the "
         "text dashboard",
     )
+    ap.add_argument(
+        "--stale-after", type=float, default=None,
+        help="per-host liveness threshold in seconds for the HOSTS "
+        "column: a host whose newest heartbeat lags the stream by "
+        "more than this is flagged STALE (default: the watchdog's "
+        "CCSC_WATCHDOG_PEER_STALE_S, 120)",
+    )
     args = ap.parse_args(argv)
     events = obs.read_events(args.path)
     if args.json:
         print(json.dumps(events))
         return events
-    print(render(events))
+    print(render(events, stale_after=args.stale_after))
     return events
 
 

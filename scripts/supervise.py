@@ -1,0 +1,393 @@
+#!/usr/bin/env python
+"""Run supervisor: launch a learner CLI, watch it, restart from its
+checkpoint until the run actually completes.
+
+The in-process resilience layer (utils.resilience) survives what a
+process can survive: divergence, preemption signals, torn snapshots.
+It cannot survive the process itself dying — a segfaulting runtime, an
+OOM kill, a watchdog stall abort (utils.watchdog), a wedged dispatch.
+Multi-block consensus ADMM tolerates restart from any block boundary
+(PAPERS.md arXiv:1312.3040), and every learner here checkpoints at
+those boundaries — so the missing piece is purely supervisory, and ad
+hoc ``while true; do python learn_2d.py; done`` loops get none of the
+judgment below. This script is that piece:
+
+- launches the given command as a child process (everything after
+  ``--``), teeing its output to a per-attempt log file;
+- tails the run's telemetry (``--metrics-dir``, utils.obs) and the
+  checkpoint dir for PROGRESS — a child that is alive but has written
+  nothing for ``--stall-timeout`` seconds is declared hung, killed
+  (SIGTERM, then SIGKILL) and restarted; the in-process watchdog's
+  stall abort (exit code 87) is recognized the same way;
+- on any crash, restarts from ``--checkpoint-dir`` with exponential
+  backoff (``--backoff`` * 2^k, capped) up to ``--max-restarts``;
+- on a CLEAN exit, decides completed-vs-preempted from the event
+  stream: an attempt whose records include a ``preemption`` was asked
+  to stop early and is resumed; one that ran to its summary without
+  preemption is done;
+- poison-run detection: two consecutive deaths before the FIRST
+  checkpoint ever lands mean restarts cannot help (the run dies
+  deterministically in setup/compile) — abort with a diagnosis and
+  the tail of the last attempt's log instead of burning the restart
+  budget;
+- writes a parity-checkable trace of every attempt (reason, exit
+  code, timestamps, checkpoint presence) to ``--trace`` (default
+  ``<metrics-dir>/supervisor_trace.json``), re-written after every
+  attempt so the trace survives the supervisor itself being killed.
+
+The supervisor also exports ``CCSC_FAULT_STATE_DIR`` to the child (set
+to the metrics dir) so injected chaos faults (utils.faults) stay
+fire-once ACROSS restarts — the property tests/test_supervised.py
+leans on.
+
+Usage:
+    python scripts/supervise.py --checkpoint-dir CK --metrics-dir M \\
+        [--max-restarts 5] [--backoff 5] [--stall-timeout 0] \\
+        -- python -m ccsc_code_iccv2017_tpu.apps.learn_2d --data ... \\
+           --checkpoint-dir CK --metrics-dir M
+
+Exit codes: 0 completed; 2 poison run; 3 restart budget exhausted;
+4 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils import obs  # noqa: E402
+from ccsc_code_iccv2017_tpu.utils.watchdog import EXIT_STALL  # noqa: E402
+
+EXIT_OK = 0
+EXIT_POISON = 2
+EXIT_EXHAUSTED = 3
+EXIT_USAGE = 4
+
+_CKPT_FILES = ("ccsc_state.npz", "ccsc_state.prev.npz")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument(
+        "--checkpoint-dir", default=None,
+        help="the child's checkpoint dir — the restart point, and the "
+        "poison-run detector's evidence of first progress",
+    )
+    p.add_argument(
+        "--metrics-dir", default=None,
+        help="the child's utils.obs metrics dir: progress signal for "
+        "hang detection, preempted-vs-completed on clean exits, and "
+        "the fault-marker state dir (CCSC_FAULT_STATE_DIR)",
+    )
+    p.add_argument(
+        "--max-restarts", type=int, default=5,
+        help="crash-restart budget (crashes, stall aborts, hang "
+        "kills). Orderly preemptions — clean exits that checkpointed "
+        "and asked to be resumed — have their own budget "
+        "(--max-preemptions): a healthy run on preemptible capacity "
+        "must not be abandoned for being preempted often",
+    )
+    p.add_argument("--max-preemptions", type=int, default=100)
+    p.add_argument(
+        "--backoff", type=float, default=5.0,
+        help="base restart delay; attempt k sleeps backoff * 2^(k-1), "
+        "capped at --backoff-cap",
+    )
+    p.add_argument("--backoff-cap", type=float, default=300.0)
+    p.add_argument(
+        "--stall-timeout", type=float, default=0.0,
+        help="kill the child when its metrics/checkpoint dirs show no "
+        "progress for this many seconds (0 = rely on the in-process "
+        "watchdog's stall abort only)",
+    )
+    p.add_argument(
+        "--trace", default=None,
+        help="where to write the supervisor trace JSON (default "
+        "<metrics-dir>/supervisor_trace.json)",
+    )
+    p.add_argument(
+        "--log-dir", default=None,
+        help="per-attempt child logs (default <metrics-dir>, else cwd)",
+    )
+    p.add_argument(
+        "cmd", nargs=argparse.REMAINDER,
+        help="the learner command, after a literal --",
+    )
+    return p
+
+
+def _progress_stamp(paths):
+    """A monotone token of on-disk progress: newest (mtime, size) over
+    every file under the watched dirs. Changes whenever the child
+    writes an event, a heartbeat, or a checkpoint."""
+    stamp = (0.0, 0)
+    for root in paths:
+        if not root or not os.path.isdir(root):
+            continue
+        try:
+            names = os.listdir(root)
+        except OSError:
+            continue
+        for name in names:
+            fp = os.path.join(root, name)
+            try:
+                st = os.stat(fp)
+            except OSError:
+                continue
+            stamp = max(stamp, (st.st_mtime, st.st_size))
+    return stamp
+
+
+def _checkpoint_exists(checkpoint_dir) -> bool:
+    if not checkpoint_dir:
+        return False
+    return any(
+        os.path.exists(os.path.join(checkpoint_dir, f))
+        for f in _CKPT_FILES
+    )
+
+
+def _attempt_preempted(metrics_dir) -> bool:
+    """Whether the NEWEST attempt in the event stream was preempted
+    (asked to checkpoint-and-exit early) — a clean exit that still
+    wants a resume. Records after the last run_meta are that attempt's."""
+    if not metrics_dir:
+        return False
+    events = obs.read_events(metrics_dir)
+    last_meta = max(
+        (i for i, e in enumerate(events) if e.get("type") == "run_meta"),
+        default=-1,
+    )
+    return any(
+        e.get("type") == "preemption" for e in events[last_meta + 1 :]
+    )
+
+
+def _tail(path, nbytes=2000) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - nbytes))
+            return f.read().decode("utf-8", "replace")
+    except OSError:
+        return "(no log)"
+
+
+class Supervisor:
+    def __init__(self, args):
+        self.args = args
+        self.attempts = []
+        self.restarts = 0  # crash restarts (charged to --max-restarts)
+        self.resumes = 0  # preemption resumes (--max-preemptions)
+        self.outcome = None
+        base = args.metrics_dir or "."
+        self.trace_path = args.trace or os.path.join(
+            base, "supervisor_trace.json"
+        )
+        self.log_dir = args.log_dir or base
+        os.makedirs(self.log_dir, exist_ok=True)
+        if args.metrics_dir:
+            os.makedirs(args.metrics_dir, exist_ok=True)
+
+    # -- trace ---------------------------------------------------------
+    def _write_trace(self):
+        os.makedirs(
+            os.path.dirname(os.path.abspath(self.trace_path)),
+            exist_ok=True,
+        )
+        tmp = self.trace_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "cmd": self.args.cmd,
+                    "checkpoint_dir": self.args.checkpoint_dir,
+                    "metrics_dir": self.args.metrics_dir,
+                    "max_restarts": self.args.max_restarts,
+                    "restarts": self.restarts,
+                    "resumes": self.resumes,
+                    "outcome": self.outcome,
+                    "attempts": self.attempts,
+                },
+                f,
+                indent=2,
+            )
+        os.replace(tmp, self.trace_path)
+
+    # -- one attempt ---------------------------------------------------
+    def _run_attempt(self, n: int):
+        a = self.args
+        log_path = os.path.join(self.log_dir, f"supervise-attempt-{n}.log")
+        env = dict(os.environ)
+        if a.metrics_dir:
+            # fault fire-once markers survive restarts (utils.faults)
+            env.setdefault("CCSC_FAULT_STATE_DIR", a.metrics_dir)
+        watched = (a.metrics_dir, a.checkpoint_dir)
+        rec = {
+            "attempt": n,
+            "start_t": time.time(),
+            "log": log_path,
+            "checkpoint_at_start": _checkpoint_exists(a.checkpoint_dir),
+        }
+        with open(log_path, "wb") as logf:
+            proc = subprocess.Popen(
+                a.cmd, stdout=logf, stderr=subprocess.STDOUT, env=env
+            )
+            stamp = _progress_stamp(watched)
+            quiet_since = time.monotonic()
+            killed_for_hang = False
+            while True:
+                try:
+                    proc.wait(timeout=1.0)
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+                if a.stall_timeout <= 0:
+                    continue
+                new_stamp = _progress_stamp(watched)
+                now = time.monotonic()
+                if new_stamp != stamp:
+                    stamp = new_stamp
+                    quiet_since = now
+                elif now - quiet_since > a.stall_timeout:
+                    print(
+                        f"supervise: no progress for {a.stall_timeout:g}s"
+                        " — declaring the child hung, killing it",
+                        flush=True,
+                    )
+                    killed_for_hang = True
+                    proc.send_signal(signal.SIGTERM)
+                    try:
+                        proc.wait(timeout=10.0)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+                    break
+        rc = proc.returncode
+        rec.update(
+            end_t=time.time(),
+            rc=rc,
+            checkpoint_present=_checkpoint_exists(a.checkpoint_dir),
+        )
+        if killed_for_hang:
+            rec["reason"] = "hang"
+        elif rc == EXIT_STALL:
+            rec["reason"] = "stall_abort"
+        elif rc != 0:
+            rec["reason"] = "crash"
+        elif _attempt_preempted(a.metrics_dir):
+            rec["reason"] = "preempted"
+        else:
+            rec["reason"] = "completed"
+        return rec
+
+    # -- the loop ------------------------------------------------------
+    def run(self) -> int:
+        a = self.args
+        pre_ckpt_deaths = 0
+        attempt = 0
+        while True:
+            attempt += 1
+            rec = self._run_attempt(attempt)
+            self.attempts.append(rec)
+            self._write_trace()
+            reason = rec["reason"]
+            print(
+                f"supervise: attempt {attempt} -> {reason} "
+                f"(rc={rec['rc']})",
+                flush=True,
+            )
+            if reason == "completed":
+                self.outcome = "completed"
+                self._write_trace()
+                return EXIT_OK
+            # every other reason wants a relaunch — judge it first
+            died = reason in ("crash", "stall_abort", "hang")
+            if died and not rec["checkpoint_present"]:
+                pre_ckpt_deaths += 1
+                if pre_ckpt_deaths >= 2:
+                    self.outcome = "poison"
+                    self._write_trace()
+                    print(
+                        "supervise: POISON RUN — two consecutive deaths "
+                        "before the first checkpoint ever landed; a "
+                        "restart cannot help (the run dies "
+                        "deterministically in setup/compile). Last "
+                        "output:\n" + _tail(rec["log"]),
+                        flush=True,
+                    )
+                    return EXIT_POISON
+            else:
+                pre_ckpt_deaths = 0
+            if not died:
+                # an orderly preemption checkpointed and asked to be
+                # resumed: it consumes its OWN (generous) budget, not
+                # the crash-restart budget — a healthy run on
+                # preemptible capacity is resumed, not abandoned. No
+                # backoff either: nothing is broken.
+                if self.resumes >= a.max_preemptions:
+                    self.outcome = "exhausted"
+                    self._write_trace()
+                    print(
+                        "supervise: preemption-resume budget "
+                        f"({a.max_preemptions}) exhausted.",
+                        flush=True,
+                    )
+                    return EXIT_EXHAUSTED
+                self.resumes += 1
+                print(
+                    f"supervise: resuming preempted run (resume "
+                    f"{self.resumes}/{a.max_preemptions})",
+                    flush=True,
+                )
+                continue
+            if self.restarts >= a.max_restarts:
+                self.outcome = "exhausted"
+                self._write_trace()
+                print(
+                    f"supervise: restart budget ({a.max_restarts}) "
+                    "exhausted. Last output:\n" + _tail(rec["log"]),
+                    flush=True,
+                )
+                return EXIT_EXHAUSTED
+            self.restarts += 1
+            delay = min(
+                a.backoff * (2 ** (self.restarts - 1)), a.backoff_cap
+            )
+            if delay > 0:
+                print(
+                    f"supervise: restart {self.restarts}/"
+                    f"{a.max_restarts} in {delay:g}s",
+                    flush=True,
+                )
+                time.sleep(delay)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print(
+            "supervise: no command given — pass the learner CLI after "
+            "`--`",
+            file=sys.stderr,
+        )
+        return EXIT_USAGE
+    args.cmd = cmd
+    return Supervisor(args).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

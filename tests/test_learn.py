@@ -159,19 +159,30 @@ def test_warm_start_init_d():
         learn(b, geom, cfg, init_d=jnp.zeros((3, 5, 5)))
 
 
-def test_nan_guard_keeps_last_good_state():
+def test_nan_guard_keeps_last_good_state(monkeypatch):
     """Failure detection: a diverging run (non-finite metrics) stops and
-    returns the last finite state instead of NaNs."""
+    returns the last finite state instead of NaNs.
+
+    Poisoned via the sanctioned chaos point (CCSC_FAULT_NAN_IT inside
+    the jitted step) — non-finite INPUT data is now rejected at the
+    entry boundary by utils.validate, so it can no longer be used as a
+    divergence trigger."""
+    from ccsc_code_iccv2017_tpu.utils import faults
+
     geom = ProblemGeom((3, 3), 4)
     b = np.array(
         jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12)), np.float32
     )
-    b[0, 0, 0] = np.inf  # poison the data -> metrics go non-finite
     cfg = LearnConfig(
         max_it=3, max_it_d=1, max_it_z=1, num_blocks=2,
         rho_d=50.0, rho_z=2.0, verbose="none", track_objective=True,
     )
-    res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0))
+    monkeypatch.setenv("CCSC_FAULT_NAN_IT", "2")
+    faults.reset()
+    try:
+        res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0))
+    finally:
+        faults.reset()
     # result is the pre-divergence state: everything finite
     assert np.isfinite(np.asarray(res.d)).all()
     assert np.isfinite(np.asarray(res.z)).all()

@@ -226,26 +226,37 @@ def test_chunk_tol_early_stop_lands_on_same_iterate():
     _assert_same_traj(ref, res)
 
 
-def test_chunk_nan_guard_keeps_last_good_state():
+def test_chunk_nan_guard_keeps_last_good_state(monkeypatch):
     """Divergence mid-chunk: the scan's last-finite-state carry must
     return the pre-divergence iterate (the per-step driver's contract
-    at tests/test_learn.py::test_nan_guard_keeps_last_good_state)."""
+    at tests/test_learn.py::test_nan_guard_keeps_last_good_state).
+
+    Poisoned via the sanctioned chaos point (CCSC_FAULT_NAN_IT inside
+    the jitted step) — non-finite INPUT data is now rejected at the
+    entry boundary by utils.validate, so it can no longer be used as a
+    divergence trigger."""
+    from ccsc_code_iccv2017_tpu.utils import faults
+
     geom = ProblemGeom((3, 3), 4)
     b = np.array(
         jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12)), np.float32
     )
-    b[0, 0, 0] = np.inf  # poison the data -> metrics go non-finite
     cfg = LearnConfig(
         max_it=4, max_it_d=1, max_it_z=1, num_blocks=2,
         rho_d=50.0, rho_z=2.0, verbose="none", track_objective=True,
         outer_chunk=2, donate_state=True,
     )
-    res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0))
+    monkeypatch.setenv("CCSC_FAULT_NAN_IT", "2")  # mid-first-chunk
+    faults.reset()
+    try:
+        res = learn(jnp.asarray(b), geom, cfg, key=jax.random.PRNGKey(0))
+    finally:
+        faults.reset()
     assert np.isfinite(np.asarray(res.d)).all()
     assert np.isfinite(np.asarray(res.z)).all()
-    # no diverged iteration was adopted into the trace (entry 0 is the
-    # pre-loop obj0, inf for this poisoned data in BOTH drivers)
-    assert all(np.isfinite(res.trace["obj_vals_z"][1:]))
+    # the diverged iteration 2 was not adopted: obj0 + iteration 1 only
+    assert len(res.trace["obj_vals_z"]) == 2
+    assert all(np.isfinite(res.trace["obj_vals_z"]))
 
 
 def test_masked_chunk_rollback_returns_prev_state():

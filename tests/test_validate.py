@@ -1,0 +1,339 @@
+"""Hardened input boundaries (utils.validate, hardened utils.io_mat).
+
+Every public entry point — the three learners, reconstruct, the data
+loaders, and the app CLIs — must reject malformed inputs with an
+actionable CCSCInputError BEFORE anything is dispatched, instead of a
+deferred XLA shape error or (worse) a silent NaN divergence. Plus the
+lint asserting every app CLI actually routes its inputs through
+utils.validate.
+"""
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.io import savemat
+
+from ccsc_code_iccv2017_tpu.config import (
+    LearnConfig,
+    ProblemGeom,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.utils import io_mat, validate
+from ccsc_code_iccv2017_tpu.utils.validate import CCSCInputError
+
+GEOM = ProblemGeom((3, 3), 4)
+
+
+def _data(n=4, side=12):
+    return np.array(
+        jax.random.normal(jax.random.PRNGKey(1), (n, side, side)),
+        np.float32,
+    )
+
+
+# ------------------------------------------------------------- unit checks
+
+
+def test_check_finite_rejects_nan_and_inf():
+    with pytest.raises(CCSCInputError, match="non-finite"):
+        validate.check_finite("data", np.array([1.0, np.nan]))
+    with pytest.raises(CCSCInputError, match="non-finite"):
+        validate.check_finite("data", np.array([np.inf, 1.0]))
+    validate.check_finite("data", np.array([1.0, 2.0]))
+    validate.check_finite("ints", np.array([1, 2]))  # trivially finite
+
+
+def test_check_learn_data_geometry():
+    # wrong rank: missing batch axis
+    with pytest.raises(CCSCInputError, match="axes"):
+        validate.check_learn_data(_data()[0], GEOM)
+    # kernel larger than signal
+    with pytest.raises(CCSCInputError, match="exceeds"):
+        validate.check_learn_data(
+            _data(side=8), ProblemGeom((11, 11), 4)
+        )
+    # block divisibility, with the historical message preserved
+    with pytest.raises(CCSCInputError, match="not divisible"):
+        validate.check_learn_data(_data(n=4), GEOM, num_blocks=3)
+    # reduce mismatch
+    with pytest.raises(CCSCInputError, match="reduce"):
+        validate.check_learn_data(
+            np.zeros((2, 3, 10, 10), np.float32),
+            ProblemGeom((3, 3), 4, reduce_shape=(2,)),
+        )
+    validate.check_learn_data(_data(), GEOM, num_blocks=2)
+
+
+def test_check_mask():
+    b = _data()
+    with pytest.raises(CCSCInputError, match="does not match data"):
+        validate.check_mask(np.ones((4, 6, 6), np.float32), b)
+    with pytest.raises(CCSCInputError, match="identically zero"):
+        validate.check_mask(np.zeros_like(b), b)
+    validate.check_mask(np.ones_like(b), b)
+
+
+def test_check_filters():
+    d = np.zeros((4, 3, 3), np.float32)
+    validate.check_filters(d, GEOM)
+    with pytest.raises(CCSCInputError, match="does not match"):
+        validate.check_filters(np.zeros((5, 3, 3), np.float32), GEOM)
+    with pytest.raises(CCSCInputError, match="non-finite"):
+        validate.check_filters(np.full((4, 3, 3), np.nan), GEOM)
+
+
+def test_check_config_positivity():
+    with pytest.raises(CCSCInputError, match="rho_d"):
+        validate.check_learn_config(LearnConfig(rho_d=0.0))
+    with pytest.raises(CCSCInputError, match="lambda_prior"):
+        validate.check_learn_config(LearnConfig(lambda_prior=-1.0))
+    with pytest.raises(CCSCInputError, match="gamma_factor"):
+        validate.check_solve_config(SolveConfig(gamma_factor=0.0))
+    validate.check_learn_config(LearnConfig())
+    validate.check_solve_config(SolveConfig())
+
+
+# ------------------------------------------------- learner / solver entry
+
+
+def test_learn_rejects_nan_data():
+    from ccsc_code_iccv2017_tpu.models.learn import learn
+
+    b = _data()
+    b[1, 3, 3] = np.nan
+    with pytest.raises(CCSCInputError, match="non-finite"):
+        learn(jnp.asarray(b), GEOM, LearnConfig(num_blocks=2))
+
+
+def test_learn_masked_rejects_bad_gamma_and_nan():
+    from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
+
+    geom = ProblemGeom((3, 3), 3, reduce_shape=(2,))
+    b = np.random.default_rng(0).uniform(
+        0.1, 1.0, (2, 2, 10, 10)
+    ).astype(np.float32)
+    with pytest.raises(CCSCInputError, match="gamma_div_d"):
+        learn_masked(jnp.asarray(b), geom, LearnConfig(), gamma_div_d=0.0)
+    b[0, 0, 0, 0] = np.inf
+    with pytest.raises(CCSCInputError, match="non-finite"):
+        learn_masked(jnp.asarray(b), geom, LearnConfig())
+
+
+def test_learn_masked_ignores_consensus_num_blocks():
+    """The masked learner never consensus-splits the batch, so a
+    consensus-tuned num_blocks that doesn't divide n must not reject
+    its inputs."""
+    from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
+
+    geom = ProblemGeom((3, 3), 3, reduce_shape=(2,))
+    b = np.random.default_rng(0).uniform(
+        0.1, 1.0, (2, 2, 10, 10)
+    ).astype(np.float32)
+    res = learn_masked(
+        jnp.asarray(b), geom,
+        LearnConfig(max_it=1, max_it_d=1, max_it_z=1, num_blocks=3,
+                    verbose="none"),
+        gamma_div_d=50.0, gamma_div_z=10.0, key=jax.random.PRNGKey(0),
+    )
+    assert np.isfinite(np.asarray(res.d)).all()
+
+
+def test_learn_streaming_rejects_kernel_too_big():
+    from ccsc_code_iccv2017_tpu.parallel.streaming import learn_streaming
+
+    with pytest.raises(CCSCInputError, match="exceeds"):
+        learn_streaming(
+            _data(side=8), ProblemGeom((11, 11), 4), LearnConfig()
+        )
+
+
+def test_reconstruct_rejects_mask_mismatch():
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+        reconstruct,
+    )
+
+    b = _data(n=1)
+    d = np.zeros((4, 3, 3), np.float32)
+    d[:, 1, 1] = 1.0
+    with pytest.raises(CCSCInputError, match="does not match data"):
+        reconstruct(
+            jnp.asarray(b),
+            jnp.asarray(d),
+            ReconstructionProblem(GEOM),
+            SolveConfig(max_it=1),
+            mask=jnp.ones((1, 6, 6), jnp.float32),
+        )
+
+
+# ----------------------------------------------------------- .mat loading
+
+
+def test_corrupt_mat_raises_input_error(tmp_path):
+    p = tmp_path / "bank.mat"
+    p.write_bytes(b"MATLAB 5.0 MAT-file, truncated garbage")
+    with pytest.raises(CCSCInputError, match="truncated|corrupt"):
+        io_mat.load_filters_2d(str(p))
+    with pytest.raises(CCSCInputError, match="no such"):
+        io_mat.load_filters_2d(str(tmp_path / "missing.mat"))
+
+
+def test_truncated_mat_raises_input_error(tmp_path):
+    p = tmp_path / "bank.mat"
+    savemat(p, {"d": np.zeros((3, 3, 4), np.float32)})
+    blob = p.read_bytes()
+    p.write_bytes(blob[: len(blob) // 3])  # tear the file
+    with pytest.raises(CCSCInputError, match="truncated|corrupt"):
+        io_mat.load_filters_2d(str(p))
+
+
+def test_mat_missing_variable_raises_input_error(tmp_path):
+    p = tmp_path / "bank.mat"
+    savemat(p, {"not_d": np.zeros((3, 3, 4), np.float32)})
+    with pytest.raises(CCSCInputError, match="no variable 'd'"):
+        io_mat.load_filters_2d(str(p))
+
+
+def test_mat_stack_with_nan_raises_input_error(tmp_path):
+    from ccsc_code_iccv2017_tpu.data.images import load_images
+
+    stack = np.moveaxis(_data(), 0, -1)
+    stack[0, 0, 0] = np.nan
+    p = tmp_path / "stack.mat"
+    savemat(p, {"images": stack})
+    with pytest.raises(CCSCInputError, match="non-finite"):
+        load_images(str(p))
+
+
+# ------------------------------------------------------------ CLI surface
+
+
+def _mat_stack(tmp_path, n=4, side=12, nan_at=None):
+    b = _data(n=n, side=side)
+    if nan_at is not None:
+        b[nan_at] = np.nan
+    p = tmp_path / "stack.mat"
+    savemat(p, {"b": b})  # framework layout [n, H, W]
+    return str(p)
+
+
+def test_learn_2d_cli_nan_data(tmp_path):
+    from ccsc_code_iccv2017_tpu.apps import learn_2d
+
+    data = _mat_stack(tmp_path, nan_at=(1, 2, 2))
+    with pytest.raises(CCSCInputError, match="non-finite"):
+        learn_2d.main(
+            ["--data", data, "--filters", "4", "--support", "3",
+             "--blocks", "2", "--contrast", "none", "--max-it", "1"]
+        )
+
+
+def test_learn_2d_cli_kernel_exceeds_signal(tmp_path):
+    from ccsc_code_iccv2017_tpu.apps import learn_2d
+
+    data = _mat_stack(tmp_path)
+    with pytest.raises(CCSCInputError, match="exceeds"):
+        learn_2d.main(
+            ["--data", data, "--filters", "4", "--support", "21",
+             "--blocks", "2", "--contrast", "none", "--max-it", "1"]
+        )
+
+
+def test_learn_2d_cli_bad_blocks(tmp_path):
+    from ccsc_code_iccv2017_tpu.apps import learn_2d
+
+    data = _mat_stack(tmp_path)
+    with pytest.raises(CCSCInputError, match="not divisible"):
+        learn_2d.main(
+            ["--data", data, "--filters", "4", "--support", "3",
+             "--blocks", "3", "--contrast", "none", "--max-it", "1"]
+        )
+
+
+def test_learn_2d_cli_corrupt_mat(tmp_path):
+    from ccsc_code_iccv2017_tpu.apps import learn_2d
+
+    p = tmp_path / "stack.mat"
+    p.write_bytes(b"not a mat file at all")
+    with pytest.raises(CCSCInputError, match="truncated|corrupt"):
+        learn_2d.main(
+            ["--data", str(p), "--filters", "4", "--support", "3",
+             "--blocks", "2", "--contrast", "none", "--max-it", "1"]
+        )
+
+
+def test_learn_3d_cli_kernel_exceeds_signal():
+    from ccsc_code_iccv2017_tpu.apps import learn_3d
+
+    with pytest.raises(CCSCInputError, match="exceeds"):
+        learn_3d.main(
+            ["--synthetic", "--clips", "4", "--clip-size", "8",
+             "--support", "11", "--support-t", "11", "--filters", "4",
+             "--blocks", "2", "--max-it", "1"]
+        )
+
+
+def test_learn_hyperspectral_cli_nan_mat(tmp_path):
+    from ccsc_code_iccv2017_tpu.apps import learn_hyperspectral
+
+    cube = np.random.default_rng(0).uniform(
+        0.1, 1.0, (10, 10, 4, 2)
+    ).astype(np.float32)  # [x y w n]
+    cube[0, 0, 0, 0] = np.nan
+    p = tmp_path / "cubes.mat"
+    savemat(p, {"b": cube})
+    with pytest.raises(CCSCInputError, match="non-finite"):
+        learn_hyperspectral.main(
+            ["--mat", str(p), "--filters", "4", "--support", "3",
+             "--max-it", "1"]
+        )
+
+
+def test_inpaint_cli_corrupt_filters(tmp_path):
+    from ccsc_code_iccv2017_tpu.apps import inpaint_2d
+
+    bank = tmp_path / "bank.mat"
+    bank.write_bytes(b"garbage that is not a mat file")
+    data = _mat_stack(tmp_path)
+    with pytest.raises(CCSCInputError, match="truncated|corrupt"):
+        inpaint_2d.main(
+            ["--data", data, "--filters", str(bank), "--max-it", "1"]
+        )
+
+
+# ------------------------------------------------------------------- lint
+
+
+APPS_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "ccsc_code_iccv2017_tpu", "apps"
+)
+# not CLI entry points: the package hook and the shared dispatch layer
+_LINT_EXEMPT = {"__init__.py", "_dispatch.py"}
+_VALIDATE_IMPORT_RE = re.compile(
+    r"from \.\.utils import validate|from \.\.utils\.validate import"
+)
+_VALIDATE_CALL_RE = re.compile(r"validate\.check_\w+\(")
+
+
+def test_every_app_cli_routes_inputs_through_validate():
+    """Pattern lint (same discipline as the bare-print lint,
+    tests/test_obs.py): every app CLI must import utils.validate and
+    call at least one of its check_* functions before dispatch — a new
+    app that skips the input boundary fails CI, not a user's run."""
+    offenders = []
+    for name in sorted(os.listdir(APPS_DIR)):
+        if not name.endswith(".py") or name in _LINT_EXEMPT:
+            continue
+        with open(os.path.join(APPS_DIR, name)) as f:
+            src = f.read()
+        if not _VALIDATE_IMPORT_RE.search(src):
+            offenders.append(f"{name}: no utils.validate import")
+        elif not _VALIDATE_CALL_RE.search(src):
+            offenders.append(f"{name}: imports validate but never calls it")
+    assert not offenders, (
+        "app CLIs must route their inputs through utils.validate "
+        "before dispatching:\n" + "\n".join(offenders)
+    )
