@@ -14,6 +14,7 @@ from .config import (
     GEOM_LIGHTFIELD,
     LearnConfig,
     ProblemGeom,
+    ServeConfig,
     SolveConfig,
 )
 

@@ -18,6 +18,7 @@ from typing import Dict, List, Optional
 def add_perf_args(
     parser, fft_pad: bool = True, fused: bool = False,
     streaming: bool = False, chunk: bool = False,
+    masked_carry: bool = False,
 ) -> None:
     """The shared execution-strategy flags (one definition so the
     vocabulary and help text cannot drift across the 9 apps).
@@ -28,7 +29,11 @@ def add_perf_args(
     learners); ``streaming=True`` only on the learner CLIs that have
     a --streaming arm (a flag a coding app would silently ignore must
     not parse there); ``chunk=True`` only on the learner CLIs (the
-    chunked/donated outer driver is a LearnConfig knob)."""
+    chunked/donated outer driver is a LearnConfig knob);
+    ``masked_carry=True`` only on CLIs that can route through the
+    MASKED learner — carry_freq is that learner's lever (1.25x CPU,
+    float-tolerance-equal trajectory, PERF.md r5) and would be a
+    silent no-op anywhere else."""
     if fft_pad:
         parser.add_argument(
             "--fft-pad", default="none", choices=["none", "pow2", "fast"],
@@ -70,6 +75,16 @@ def add_perf_args(
             help="state placement tier for --streaming (default auto "
             "by byte budget, CCSC_STREAM_RESIDENT_GB; "
             "parallel.streaming). Requires --streaming.",
+        )
+    if masked_carry:
+        parser.add_argument(
+            "--carry-freq", action="store_true",
+            help="carry the frequency-domain iterate across the masked "
+            "learner's inner scans instead of re-transforming the "
+            "spatial iterate each iteration — drops 1 of 3 code-sized "
+            "FFT passes per z inner iteration; trajectory equal to "
+            "float tolerance (LearnConfig.carry_freq; 1.25x CPU step "
+            "win, PERF.md r5). Masked learner only.",
         )
 
 

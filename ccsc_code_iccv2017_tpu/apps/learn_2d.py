@@ -59,7 +59,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="host-streaming mode: one consensus block on device at a "
         "time (bounded HBM; parallel.streaming)",
     )
-    add_perf_args(p, fused=True, streaming=True, chunk=True)
+    p.add_argument(
+        "--masked",
+        action="store_true",
+        help="use the masked-boundary learner (models.learn_masked, "
+        "the 2-3D admm_learn.m variant run at reduce_shape=()): "
+        "masked border residual instead of the consensus zero-pad "
+        "objective, single dictionary, objective-regression rollback. "
+        "Unlocks --carry-freq. Does not combine with --streaming/"
+        "--mesh/--fused-z (consensus-only mechanisms).",
+    )
+    add_perf_args(p, fused=True, streaming=True, chunk=True,
+                  masked_carry=True)
     add_resilience_args(p)
     add_obs_args(p)
     p.add_argument(
@@ -103,9 +114,30 @@ def main(argv=None):
     geom = ProblemGeom((args.support, args.support), args.filters)
     from ..utils import validate
 
+    if args.carry_freq and not args.masked:
+        # explicit error beats a silent no-op: carry_freq is the
+        # MASKED learner's lever (the consensus learner has no
+        # redundant re-transform to skip, PERF.md r5)
+        raise SystemExit("--carry-freq requires --masked")
+    if args.masked:
+        for flag, val in (
+            ("--streaming", args.streaming),
+            ("--mesh", args.mesh),
+            ("--fused-z", args.fused_z),
+            ("--profile-dir", args.profile_dir),
+        ):
+            if val:
+                raise SystemExit(
+                    f"--masked does not combine with {flag} "
+                    "(consensus-learner mechanisms)"
+                )
     # fail on garbage inputs HERE, with the file/flag named, not as a
-    # deferred XLA error mid-learn (utils.validate)
-    validate.check_learn_data(b, geom, num_blocks=args.blocks)
+    # deferred XLA error mid-learn (utils.validate). The masked
+    # learner never consensus-splits the batch, so --blocks does not
+    # constrain it.
+    validate.check_learn_data(
+        b, geom, num_blocks=None if args.masked else args.blocks
+    )
     cfg = LearnConfig(
         lambda_residual=args.lambda_residual,
         lambda_prior=args.lambda_prior,
@@ -124,6 +156,7 @@ def main(argv=None):
         d_storage_dtype=args.d_storage_dtype,
         outer_chunk=args.outer_chunk,
         donate_state=args.donate_state,
+        carry_freq=args.carry_freq,
         max_recoveries=args.max_recoveries,
         rho_backoff=args.rho_backoff,
         watchdog=args.watchdog,
@@ -136,7 +169,25 @@ def main(argv=None):
     )
     from ._dispatch import dispatch_learn
 
-    if args.streaming:
+    if args.masked:
+        from ..models.learn_masked import learn_masked
+
+        res = dispatch_learn(
+            b,
+            geom,
+            cfg,
+            jax.random.PRNGKey(args.seed),
+            mesh=None,
+            streaming=False,
+            solver=learn_masked,
+            auto_degrade=args.auto_degrade,
+            init_d=(
+                jnp.asarray(init_d) if init_d is not None else None
+            ),
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+        )
+    elif args.streaming:
         res = dispatch_learn(
             b,
             geom,

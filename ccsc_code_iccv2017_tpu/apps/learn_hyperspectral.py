@@ -45,7 +45,7 @@ def build_parser() -> argparse.ArgumentParser:
         add_obs_args, add_perf_args, add_resilience_args,
     )
 
-    add_perf_args(p, streaming=True, chunk=True)
+    add_perf_args(p, streaming=True, chunk=True, masked_carry=True)
     add_resilience_args(p)
     add_obs_args(p)
     p.add_argument(
@@ -118,6 +118,7 @@ def main(argv=None):
         storage_dtype=args.storage_dtype,
         outer_chunk=args.outer_chunk,
         donate_state=args.donate_state,
+        carry_freq=args.carry_freq,
         max_recoveries=args.max_recoveries,
         rho_backoff=args.rho_backoff,
         watchdog=args.watchdog,
@@ -147,6 +148,10 @@ def main(argv=None):
             checkpoint_every=args.checkpoint_every,
             forbidden={
                 "--init": args.init,
+                # --streaming swaps in the CONSENSUS learner, which has
+                # no redundant re-transform to carry (PERF.md r5) — an
+                # explicit error beats silently ignoring the request
+                "--carry-freq": args.carry_freq,
             },
         )
         save_filters(args.out, res.d, res.trace, layout="hyperspectral", Dz=res.Dz)

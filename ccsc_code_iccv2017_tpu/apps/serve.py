@@ -1,0 +1,261 @@
+"""Reconstruction serving CLI — the production replacement for the
+reference's per-image driver loop (reconstruct_2D_subsampling.m:35-60,
+SURVEY.md section 2.4 #24).
+
+Loads a 2D filter bank once, builds a serve.CodecEngine (per-bank
+plans, shape-bucketed AOT-compiled programs, micro-batched dispatch),
+and serves a stream of inpainting observations: every image in
+--data, or file paths streamed one per line on stdin (--stdin) so an
+external producer can feed the queue live. Each request gets the
+reference protocol — random --keep mask, normalized-convolution
+smooth fill, masked coding against the pinned bank — and per-request
+PSNR + latency are reported, with p50/p99 and bucket occupancy at the
+end.
+
+Usage:
+    python -m ccsc_code_iccv2017_tpu.apps.serve --filters f.mat \
+        --data DIR [--bucket 64 --bucket 128:8] [--compile-cache DIR]
+    ls imgs/*.png | python -m ccsc_code_iccv2017_tpu.apps.serve \
+        --filters f.mat --stdin
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from ._dispatch import add_mat_layout_arg, add_obs_args, add_perf_args
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--filters", required=True, help=".mat/.npz filter bank")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--data", help="serve every image in this folder")
+    src.add_argument(
+        "--stdin", action="store_true",
+        help="serve image paths streamed one per line on stdin",
+    )
+    p.add_argument(
+        "--bucket", action="append", default=None, metavar="SIDE[:SLOTS]",
+        help="shape bucket: spatial side and optional concurrent "
+        "request slots (default slots 4; repeatable; default buckets "
+        "64 and 128). Requests are padded to the smallest bucket that "
+        "fits, mask-excluded so valid-region results are unchanged.",
+    )
+    p.add_argument(
+        "--max-wait-ms", type=float, default=5.0,
+        help="micro-batch flush deadline: a bucket dispatches when "
+        "full or when its oldest request has waited this long",
+    )
+    p.add_argument(
+        "--compile-cache", default=None,
+        help="persistent XLA compilation cache dir (CCSC_COMPILE_CACHE "
+        "env equivalent): warm engine restarts skip compilation",
+    )
+    p.add_argument(
+        "--no-aot", action="store_true",
+        help="skip the startup AOT warmup (buckets compile lazily on "
+        "first use)",
+    )
+    p.add_argument("--keep", type=float, default=0.5,
+                   help="observed fraction of each request")
+    p.add_argument("--lambda-residual", type=float, default=5.0)
+    p.add_argument("--lambda-prior", type=float, default=2.0)
+    p.add_argument("--max-it", type=int, default=100)
+    p.add_argument("--tol", type=float, default=1e-3)
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--out-dir", default=None, help="write 16-bit PNGs here")
+    p.add_argument("--seed", type=int, default=0)
+    add_perf_args(p)
+    add_obs_args(p)
+    add_mat_layout_arg(p)
+    return p
+
+
+def _parse_buckets(specs, default_slots=4):
+    if not specs:
+        specs = ["64", "128"]
+    out = []
+    for spec in specs:
+        side, _, slots = spec.partition(":")
+        out.append(
+            (int(slots) if slots else default_slots,
+             (int(side), int(side)))
+        )
+    return tuple(out)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import jax.numpy as jnp  # noqa: F401  (backend init before engine)
+
+    from .. import ProblemGeom, ServeConfig, SolveConfig
+    from ..data.images import load_image_list
+    from ..data.native import smooth_fill_batch
+    from ..models.reconstruct import ReconstructionProblem
+    from ..serve import CodecEngine
+    from ..utils.io_mat import load_filters_2d
+
+    d = load_filters_2d(args.filters)
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    from ..utils import validate
+
+    # fail on a garbage bank HERE, with the file named, before a
+    # backend initializes; per-request data is re-checked by the
+    # engine's cheap submit-time boundary (validate.check_serve_request)
+    validate.check_filters(d, geom)
+    cfg = SolveConfig(
+        lambda_residual=args.lambda_residual,
+        lambda_prior=args.lambda_prior,
+        max_it=args.max_it,
+        tol=args.tol,
+        fft_pad=args.fft_pad,
+        fft_impl=args.fft_impl,
+        verbose="none",
+        track_objective=True,
+        track_psnr=True,
+    )
+    scfg = ServeConfig(
+        buckets=_parse_buckets(args.bucket),
+        max_wait_ms=args.max_wait_ms,
+        compile_cache=args.compile_cache,
+        aot_warmup=not args.no_aot,
+        metrics_dir=args.metrics_dir,
+    )
+    t0 = time.perf_counter()
+    engine = CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
+    print(
+        f"engine ready in {time.perf_counter() - t0:.2f}s "
+        f"({len(scfg.buckets)} bucket(s))"
+    )
+
+    rng = np.random.default_rng(args.seed)
+    n_skipped = 0
+
+    def _submit(x, label):
+        nonlocal n_skipped
+        mask = (rng.random(x.shape) < args.keep).astype(np.float32)
+        sm = smooth_fill_batch(x[None], mask[None])[0]
+        try:
+            fut = engine.submit(
+                x * mask, mask=mask, smooth_init=sm, x_orig=x
+            )
+        except validate.CCSCInputError as e:
+            # one bad request (oversize for every bucket, NaN pixels)
+            # must not abort a live serving stream — report and move on
+            print(f"  {label}: SKIPPED ({e})")
+            n_skipped += 1
+            return None
+        return label, fut
+
+    outs = []  # (label, result) kept only when PNGs are written
+    n_done = 0
+
+    def _finish(label, res):
+        nonlocal n_done
+        n_done += 1
+        if args.out_dir:
+            outs.append((label, res))
+        psnr = f"{res.psnr:.2f} dB" if res.psnr is not None else "—"
+        print(
+            f"  {label}: bucket {res.bucket}, "
+            f"{int(res.trace.num_iters)} iters, PSNR {psnr}, "
+            f"latency {res.latency_s * 1e3:.1f} ms "
+            f"(queued {res.wait_s * 1e3:.1f} ms)"
+        )
+
+    pending = []
+
+    def _drain(block=False):
+        # print results AS THEY COMPLETE: a long-lived stdin producer
+        # must see live output, and holding every Future (+ recon)
+        # until EOF would grow without bound
+        while pending and (block or pending[0][1].done()):
+            label, fut = pending.pop(0)
+            _finish(label, fut.result(timeout=600))
+
+    MAX_IN_FLIGHT = 32
+    try:
+        if args.data:
+            # per-image list, not a stacked batch: a serving folder
+            # holds MIXED sizes (the reason shape buckets exist) and
+            # each image is its own request anyway
+            imgs = load_image_list(
+                args.data, limit=args.limit, mat_layout=args.mat_layout
+            )
+            for i, img in enumerate(imgs):
+                p = _submit(img.astype(np.float32), f"img{i}")
+                if p is not None:
+                    pending.append(p)
+                _drain()
+        else:
+            # stdin streaming: one path per line; requests enter the
+            # queue as they arrive so micro-batching works on live
+            # traffic
+            from PIL import Image
+
+            n = 0
+            for line in sys.stdin:
+                path = line.strip()
+                if not path:
+                    continue
+                try:
+                    img = np.asarray(
+                        Image.open(path).convert("L"), np.float32
+                    ) / 255.0
+                except Exception as e:
+                    # a deleted/corrupt file in a live stream is a bad
+                    # REQUEST, not a reason to kill the service — same
+                    # skip-and-continue contract as _submit's checks
+                    print(f"  {os.path.basename(path)}: SKIPPED ({e})")
+                    n_skipped += 1
+                    continue
+                p = _submit(img, os.path.basename(path))
+                if p is not None:
+                    pending.append(p)
+                _drain()
+                if len(pending) >= MAX_IN_FLIGHT:
+                    label, fut = pending.pop(0)
+                    _finish(label, fut.result(timeout=600))
+                n += 1
+                if args.limit and n >= args.limit:
+                    break
+        _drain(block=True)
+    finally:
+        # the engine must always close (flushes queued dispatches,
+        # writes the telemetry summary) — even when a mid-stream
+        # failure aborts the submit loop
+        engine.close()
+        try:
+            _drain(block=True)  # results the close-flush completed
+        except Exception:
+            pass
+    stats = engine.stats()
+    if stats["n_requests"]:
+        print(
+            f"{stats['n_requests']} requests, "
+            f"{stats['n_dispatches']} dispatch(es), mean occupancy "
+            f"{100 * stats['mean_occupancy']:.0f}%, p50 "
+            f"{stats['p50_latency_s'] * 1e3:.1f} ms, p99 "
+            f"{stats['p99_latency_s'] * 1e3:.1f} ms"
+        )
+
+    if args.out_dir and outs:
+        os.makedirs(args.out_dir, exist_ok=True)
+        from PIL import Image
+
+        for label, res in outs:
+            arr = np.clip(res.recon, 0.0, 1.0)
+            Image.fromarray((arr * 65535.0).astype(np.uint16)).save(
+                os.path.join(args.out_dir, f"recon_{label}.png")
+            )
+        print(f"wrote {len(outs)} PNGs to {args.out_dir}")
+    return n_done
+
+
+if __name__ == "__main__":
+    main()

@@ -357,3 +357,78 @@ class SolveConfig:
         if self.track_psnr is None:
             return self.verbose != "none"
         return self.track_psnr
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Configuration of the reconstruction serving engine
+    (serve.CodecEngine) — the layer that turns one pinned
+    (bank, problem, SolveConfig) into a many-request service.
+
+    ``buckets`` is the shape-bucket table: each entry is
+    ``(slots, spatial_shape)`` — requests are padded (mask-excluded,
+    so valid-region results are unchanged) up to the smallest bucket
+    that fits, and up to ``slots`` concurrent requests ride one
+    dispatch of that bucket's AOT-compiled program. A small bucket set
+    bounds the number of compiled programs regardless of the request
+    shape distribution — the serving answer to per-shape jit
+    recompiles (each measured at ~0.5-2 s CPU, PERF.md r7).
+    """
+
+    # ((slots, (h, w, ...)), ...): the configured bucket shapes
+    buckets: Tuple[Tuple[int, Tuple[int, ...]], ...]
+    # micro-batch flush: a bucket dispatches when it holds `slots`
+    # requests OR its oldest request has waited max_wait_ms
+    max_wait_ms: float = 5.0
+    # persistent XLA compilation cache directory
+    # (jax_compilation_cache_dir): warm engine restarts skip backend
+    # compilation entirely. None = CCSC_COMPILE_CACHE env, else off.
+    compile_cache: Optional[str] = None
+    # AOT-compile every bucket at engine startup
+    # (jax.jit(...).lower().compile()) so no request ever pays a
+    # compile. Off = compile lazily on first use of each bucket.
+    aot_warmup: bool = True
+    # return the code tensor z with each result (large: [K, *padded])
+    return_codes: bool = False
+    # run telemetry (utils.obs): serve_request / serve_dispatch events,
+    # compile tracking, queue depth + bucket occupancy
+    metrics_dir: Optional[str] = None
+    verbose: str = "brief"
+
+    def __post_init__(self):
+        if not self.buckets:
+            raise ValueError("ServeConfig.buckets must be non-empty")
+        norm = []
+        for entry in self.buckets:
+            try:
+                slots, spatial = entry
+                spatial = tuple(int(s) for s in spatial)
+                slots = int(slots)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"bucket {entry!r} is not (slots, spatial_shape)"
+                )
+            if slots < 1 or any(s < 1 for s in spatial):
+                raise ValueError(
+                    f"bucket {entry!r}: slots and spatial dims must be "
+                    ">= 1"
+                )
+            norm.append((slots, spatial))
+        ndims = {len(sp) for _, sp in norm}
+        if len(ndims) > 1:
+            raise ValueError(
+                f"buckets mix spatial ranks {sorted(ndims)} — one "
+                "engine serves one problem family"
+            )
+        # frozen dataclass: route around the immutability for the
+        # normalized copy (sorted by volume so bucket pick is "first
+        # that fits")
+        object.__setattr__(
+            self,
+            "buckets",
+            tuple(sorted(norm, key=lambda e: math.prod(e[1]))),
+        )
+        if self.max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
+            )

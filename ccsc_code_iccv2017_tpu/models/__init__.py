@@ -1,6 +1,12 @@
 from . import common, learn, reconstruct
 from .learn import LearnResult, learn as learn_dictionary
-from .reconstruct import ReconResult, ReconstructionProblem, reconstruct
+from .reconstruct import (
+    ReconPlan,
+    ReconResult,
+    ReconstructionProblem,
+    build_plan,
+    reconstruct,
+)
 
 __all__ = [
     "common",
@@ -8,6 +14,8 @@ __all__ = [
     "reconstruct",
     "LearnResult",
     "learn_dictionary",
+    "ReconPlan",
     "ReconResult",
     "ReconstructionProblem",
+    "build_plan",
 ]

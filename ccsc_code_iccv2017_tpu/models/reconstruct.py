@@ -78,6 +78,173 @@ class ReconResult(NamedTuple):
     trace: ReconTrace
 
 
+def _solve_rho(cfg: SolveConfig, fg: common.FreqGeom) -> float:
+    """The static quadratic-coupling constant of the z-solve (gamma
+    cancels in gamma2/gamma1, so rho is a python float — see the note
+    at its use site)."""
+    return cfg.gamma_ratio * (
+        fg.reduce_size if cfg.scale_rho_by_reduce else 1.0
+    )
+
+
+def _bank_digest(d) -> str:
+    """Content fingerprint of a dictionary bank (shape + dtype +
+    bytes). Banks are tiny ([K, *reduce, *support]), so hashing them at
+    plan build / plan-carrying reconstruct() calls is cheap — and it is
+    the only way a stale plan built from a DIFFERENT bank with the same
+    filter count can be refused instead of silently mis-solving."""
+    import hashlib
+
+    import numpy as np
+
+    a = np.asarray(d)
+    h = hashlib.sha256()
+    h.update(str((a.shape, str(a.dtype))).encode())
+    h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:16]
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("dhat_clean", "dhat_solve", "kern"),
+    meta_fields=(
+        "prob", "fg", "rho", "has_blur", "d_digest", "lambda_smooth",
+    ),
+)
+@dataclasses.dataclass(frozen=True)
+class ReconPlan:
+    """Everything a reconstruction solve derives from the DICTIONARY
+    alone, precomputed once and reused across requests.
+
+    Every ``reconstruct()`` call re-derives the padded filter spectra,
+    the per-frequency solve factors (the Sherman-Morrison/Woodbury
+    terms of ops.freq_solvers), the dirac-channel gradient diagonal,
+    and the blur-OTF composition INSIDE the jitted program — all of it
+    depends only on (bank, problem, config, FFT domain), none of it on
+    the request. A plan hoists that operator-dependent precompute out
+    of the per-request path (the solver-plan pattern of MPAX/JAX-AMG,
+    PAPERS.md): the serving engine (serve.CodecEngine) builds one plan
+    per shape bucket at startup; direct callers can build one with
+    :func:`build_plan` and pass it to ``reconstruct(plan=...)`` —
+    both run the SAME solve code path (value parity asserted by
+    tests/test_reconstruct.py).
+
+    Array fields are pytree data; ``prob``/``fg``/``rho``/``has_blur``
+    are static metadata (they key the jit cache and let
+    ``reconstruct`` refuse a plan built for a different problem,
+    domain, or coupling constant).
+    """
+
+    dhat_clean: jnp.ndarray  # [K, W, F] clean filter spectra
+    dhat_solve: jnp.ndarray  # [K, W, F] solve-side (blur-composed)
+    kern: freq_solvers.ZSolveKernel
+    prob: "ReconstructionProblem"
+    fg: common.FreqGeom
+    rho: float
+    has_blur: bool
+    d_digest: str  # content fingerprint of the source bank
+    # the dirac gradient-regularization weight baked into kern's
+    # diagonal (only meaningful when prob.grad_reg_dirac)
+    lambda_smooth: float
+
+    @property
+    def num_filters(self) -> int:
+        """K including any dirac channel."""
+        return self.dhat_clean.shape[0]
+
+
+def _plan_arrays(d, prob, cfg, fg, blur_psf, fslice=None):
+    """The operator-only precompute of one solve: dirac channel,
+    filter spectra, blur-OTF composition, dirac gradient diagonal,
+    and the per-frequency z-solve factors. Shared verbatim by the
+    in-jit path of ``_reconstruct_impl`` and by :func:`build_plan`
+    so plan and inline precompute cannot drift.
+
+    ``fslice``: optional frequency-shard slicer (the mesh path);
+    identity when None."""
+    if fslice is None:
+        fslice = lambda x: x
+    geom = prob.geom
+    if prob.dirac != "none":
+        d = _add_dirac(d, geom, prob.dirac)
+    K = d.shape[0]
+    dirac_idx = 0 if prob.dirac == "prepend" else K - 1
+    dhat_clean = common.filters_to_freq(d, fg)  # [K, W, F]
+    if blur_psf is not None:
+        blur_otf = fourier.psf2otf(
+            blur_psf, fg.spatial_shape, impl=fg.fft_impl
+        ).reshape(-1)
+        dhat_solve = dhat_clean * blur_otf[None, None, :]
+    else:
+        dhat_solve = dhat_clean
+    extra_diag = None
+    if prob.grad_reg_dirac:
+        tg = _grad_diag(fg, cfg.lambda_smooth)  # [F]
+        extra_diag = jnp.zeros((K, fg.num_freq)).at[dirac_idx].set(tg)
+    kern = freq_solvers.precompute_z_kernel(
+        fslice(dhat_solve),
+        _solve_rho(cfg, fg),
+        fslice(extra_diag) if extra_diag is not None else None,
+    )
+    return dhat_clean, dhat_solve, kern
+
+
+# module-level jitted builders (two entries: with/without blur) so
+# repeated build_plan calls — e.g. periodic bank refreshes at a fixed
+# shape — hit the jit cache instead of retracing per call
+@functools.partial(jax.jit, static_argnames=("prob", "cfg", "fg"))
+def _build_plan_jit(d, prob, cfg, fg):
+    return _plan_arrays(d, prob, cfg, fg, None)
+
+
+@functools.partial(jax.jit, static_argnames=("prob", "cfg", "fg"))
+def _build_plan_blur_jit(d, blur_psf, prob, cfg, fg):
+    return _plan_arrays(d, prob, cfg, fg, blur_psf)
+
+
+def build_plan(
+    d: jnp.ndarray,
+    prob: "ReconstructionProblem",
+    cfg: SolveConfig,
+    data_spatial: Tuple[int, ...],
+    blur_psf: Optional[jnp.ndarray] = None,
+) -> ReconPlan:
+    """Precompute a :class:`ReconPlan` for observations of spatial
+    shape ``data_spatial`` (the request shape BEFORE psf padding).
+
+    The plan pins (bank, problem, config, FFT domain, blur): pass it
+    to ``reconstruct(plan=...)`` for every request at that shape and
+    the per-request program starts at the data-side constants instead
+    of re-deriving the operator precompute. A plan built with
+    ``blur_psf`` already composes the OTF — callers then pass
+    ``blur_psf=None`` to ``reconstruct``."""
+    from ..utils import validate
+
+    validate.check_filters(d, prob.geom)
+    data_spatial = tuple(int(s) for s in data_spatial)
+    fg = common.FreqGeom.create(
+        prob.geom, data_spatial, pad=prob.pad, fft_pad=cfg.fft_pad,
+        fft_impl=cfg.fft_impl,
+    )
+    if blur_psf is None:
+        dhat_clean, dhat_solve, kern = _build_plan_jit(d, prob, cfg, fg)
+    else:
+        dhat_clean, dhat_solve, kern = _build_plan_blur_jit(
+            d, blur_psf, prob, cfg, fg
+        )
+    return ReconPlan(
+        dhat_clean=dhat_clean,
+        dhat_solve=dhat_solve,
+        kern=kern,
+        prob=prob,
+        fg=fg,
+        rho=_solve_rho(cfg, fg),
+        has_blur=blur_psf is not None,
+        d_digest=_bank_digest(d),
+        lambda_smooth=cfg.lambda_smooth,
+    )
+
+
 def _add_dirac(d: jnp.ndarray, geom: ProblemGeom, where: str) -> jnp.ndarray:
     """Append/prepend an identity (dirac) filter channel
     (admm_solve_conv_poisson.m:4-7, admm_solve_video_weighted_sampling.m:5-7).
@@ -118,6 +285,7 @@ def reconstruct(
     blur_psf: Optional[jnp.ndarray] = None,
     x_orig: Optional[jnp.ndarray] = None,
     mesh=None,
+    plan: Optional[ReconPlan] = None,
 ) -> ReconResult:
     """Solve the coding problem for a batch of observations.
 
@@ -139,6 +307,14 @@ def reconstruct(
     are computed GLOBALLY via collectives inside the solve, so the
     sharded run matches the unsharded one (same stopping iteration,
     same objective values) up to float reduction order.
+
+    plan: optional :class:`ReconPlan` (build_plan) pinning the
+    operator precompute — the per-request program then skips the
+    filter-spectra / solve-factor derivation. The plan must match
+    (prob, cfg, FFT domain) exactly or the call refuses; a plan built
+    with a blur PSF already composes it, so ``blur_psf`` must be None
+    then. Single-program path only (no mesh — the serving engine is
+    the batching layer above plans).
     """
     # strict entry validation (utils.validate): layout vs geometry,
     # non-finite observations, mask shape/support, kernel vs signal
@@ -149,13 +325,76 @@ def reconstruct(
         b, d, prob.geom, cfg, mask=mask, smooth_init=smooth_init,
         x_orig=x_orig,
     )
+    if plan is not None:
+        if mesh is not None:
+            raise ValueError(
+                "plan does not combine with mesh — plans pin one "
+                "unsharded program; shard by batching requests "
+                "through serve.CodecEngine instead"
+            )
+        if blur_psf is not None:
+            raise ValueError(
+                "the plan already composes its blur OTF — build the "
+                "plan with blur_psf and pass blur_psf=None here"
+            )
+        expect_fg = common.FreqGeom.create(
+            prob.geom, b.shape[-prob.geom.ndim_spatial:], pad=prob.pad,
+            fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl,
+        )
+        if (
+            plan.prob != prob
+            or plan.fg != expect_fg
+            or plan.rho != _solve_rho(cfg, expect_fg)
+            # every cfg field _plan_arrays consumed must match: rho
+            # covers gamma_ratio/scale_rho_by_reduce, fg covers
+            # fft_pad/fft_impl, and the dirac gradient weight is baked
+            # into kern's diagonal when grad_reg_dirac is on
+            or (
+                prob.grad_reg_dirac
+                and plan.lambda_smooth != cfg.lambda_smooth
+            )
+        ):
+            raise ValueError(
+                f"plan mismatch: built for prob={plan.prob}, "
+                f"fg={plan.fg}, rho={plan.rho} but this call needs "
+                f"prob={prob}, fg={expect_fg}, "
+                f"rho={_solve_rho(cfg, expect_fg)} — rebuild the plan "
+                "with build_plan(d, prob, cfg, data_spatial)"
+            )
+        expect_k = d.shape[0] + (0 if prob.dirac == "none" else 1)
+        if plan.num_filters != expect_k:
+            raise ValueError(
+                f"plan holds {plan.num_filters} filter spectra but the "
+                f"dictionary (plus dirac) has {expect_k}"
+            )
+        if plan.d_digest != _bank_digest(d):
+            # the solve runs entirely against the plan's spectra — a
+            # plan from a DIFFERENT bank with the same K would return
+            # plausible-looking but wrong codes with no other signal
+            raise ValueError(
+                "plan was built from a different dictionary bank "
+                f"(content fingerprint {plan.d_digest} != "
+                f"{_bank_digest(d)}) — rebuild it with build_plan "
+                "after any bank update"
+            )
+        # validation done. The digest (and, for non-grad-reg problems,
+        # lambda_smooth) is PRE-jit metadata only; it rides the pytree
+        # aux data, so leaving it in would miss the jit cache for every
+        # rebuilt bank at unchanged shapes — exactly the retrace cost
+        # plans exist to avoid. Canonicalize so all same-structure
+        # plans share one compiled program.
+        plan = dataclasses.replace(
+            plan, d_digest="", lambda_smooth=cfg.lambda_smooth
+        )
     if cfg.metrics_dir is not None:
         return _reconstruct_observed(
-            b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig, mesh
+            b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig, mesh,
+            plan=plan,
         )
     if mesh is None:
         return _reconstruct_jit(
-            b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig
+            b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig,
+            plan=plan,
         )
     axis = mesh.axis_names[0]
     ndev = mesh.shape[axis]
@@ -184,7 +423,8 @@ def reconstruct(
 
 
 def _reconstruct_observed(
-    b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig, mesh
+    b, d, prob, cfg, mask, smooth_init, blur_psf, x_orig, mesh,
+    plan=None,
 ):
     """Telemetry wrapper (utils.obs, SolveConfig.metrics_dir): the
     coding solve is ONE jitted while_loop, so the stream carries run
@@ -224,6 +464,7 @@ def _reconstruct_observed(
             blur_psf=blur_psf,
             x_orig=x_orig,
             mesh=mesh,
+            plan=plan,
         )
         tr = res.trace
         n_it = int(tr.num_iters)
@@ -298,12 +539,7 @@ def _sharded_reconstruct_fn(
     return jax.jit(fn)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("prob", "cfg", "axis_name", "freq_axis_name",
-                     "num_freq_shards"),
-)
-def _reconstruct_jit(
+def _reconstruct_impl(
     b,
     d,
     prob: ReconstructionProblem,
@@ -315,6 +551,7 @@ def _reconstruct_jit(
     axis_name=None,
     freq_axis_name=None,
     num_freq_shards=1,
+    plan=None,
 ):
     """axis_name: when set (called inside shard_map over a batch
     shard), every batch-wide scalar — gamma's max(b), the objective,
@@ -325,7 +562,12 @@ def _reconstruct_jit(
     freq_axis_name: optional second mesh axis sharding the
     per-frequency solves (each device solves F/num_freq_shards bins;
     one tiled all_gather per iteration reassembles the spectrum for
-    the replicated FFT boundary — the learner's TP scheme)."""
+    the replicated FFT boundary — the learner's TP scheme).
+
+    plan: optional ReconPlan replacing the in-jit operator precompute
+    (spectra + solve factors). Unjitted so the serving engine can vmap
+    per-request slots of this exact body; ``_reconstruct_jit`` is the
+    jitted entry."""
 
     def gsum(x):
         return jax.lax.psum(x, axis_name) if axis_name else x
@@ -342,21 +584,18 @@ def _reconstruct_jit(
         fft_impl=cfg.fft_impl,
     )
     n = b.shape[0]
+    if plan is not None and freq_axis_name is not None:
+        raise ValueError("plan does not combine with frequency sharding")
 
-    if prob.dirac != "none":
-        d = _add_dirac(d, geom, prob.dirac)
-    K = d.shape[0]
+    K = (
+        plan.num_filters
+        if plan is not None
+        else d.shape[0] + (0 if prob.dirac == "none" else 1)
+    )
     dirac_idx = 0 if prob.dirac == "prepend" else K - 1
-
-    # --- spectra ----------------------------------------------------
-    dhat_clean = common.filters_to_freq(d, fg)  # [K, W, F]
-    if blur_psf is not None:
-        blur_otf = fourier.psf2otf(
-            blur_psf, fg.spatial_shape, impl=fg.fft_impl
-        ).reshape(-1)
-        dhat_solve = dhat_clean * blur_otf[None, None, :]
-    else:
-        dhat_solve = dhat_clean
+    # static fact for the PSNR branch: with a plan the blur OTF is
+    # baked into dhat_solve and blur_psf is None at this call
+    has_blur = plan.has_blur if plan is not None else blur_psf is not None
 
     # --- data-side constants ---------------------------------------
     M = (
@@ -386,7 +625,7 @@ def _reconstruct_jit(
     g = cfg.gamma_factor * cfg.lambda_prior / jnp.maximum(b_max, 1e-30)
     gamma1 = g / cfg.gamma_ratio
     gamma2 = g
-    rho = cfg.gamma_ratio * (fg.reduce_size if cfg.scale_rho_by_reduce else 1.0)
+    rho = _solve_rho(cfg, fg)
     # rho = gamma2/gamma1 is a static python float only if gamma_ratio
     # static; gamma cancels in the ratio so rho is static. Weights of
     # the two prox terms stay dynamic (depend on max(b)).
@@ -413,16 +652,15 @@ def _reconstruct_jit(
             x, freq_axis_name, axis=x.ndim - 1, tiled=True
         )
 
-    extra_diag = None
-    if prob.grad_reg_dirac:
-        tg = _grad_diag(fg, cfg.lambda_smooth)  # [F]
-        extra_diag = jnp.zeros((K, fg.num_freq)).at[dirac_idx].set(tg)
-
-    kern = freq_solvers.precompute_z_kernel(
-        fslice(dhat_solve),
-        rho,
-        fslice(extra_diag) if extra_diag is not None else None,
-    )
+    # --- operator precompute: from the plan, or derived in-jit ------
+    if plan is not None:
+        dhat_clean, dhat_solve, kern = (
+            plan.dhat_clean, plan.dhat_solve, plan.kern,
+        )
+    else:
+        dhat_clean, dhat_solve, kern = _plan_arrays(
+            d, prob, cfg, fg, blur_psf, fslice
+        )
 
     channel_mask = None
     if not prob.sparsify_dirac and prob.dirac != "none":
@@ -458,9 +696,7 @@ def _reconstruct_jit(
         # without a blur operator the clean and solve spectra coincide:
         # reuse the carried reconstruction instead of a second Dz pass
         Dz = (
-            Dz_solve
-            if blur_psf is None
-            else Dz_real(zhat, dhat_clean)
+            Dz_real(zhat, dhat_clean) if has_blur else Dz_solve
         )
         rec = fourier.crop_spatial(Dz + smoothinit, radius, data_spatial)
         return common.psnr(rec, x_orig, geom.psf_radius, axis_name)
@@ -527,3 +763,10 @@ def _reconstruct_jit(
     if prob.clamp_nonneg:
         recon = jnp.maximum(recon, 0.0)
     return ReconResult(z, recon, ReconTrace(obj_t, psnr_t, diff_t, i))
+
+
+_reconstruct_jit = functools.partial(
+    jax.jit,
+    static_argnames=("prob", "cfg", "axis_name", "freq_axis_name",
+                     "num_freq_shards"),
+)(_reconstruct_impl)

@@ -1,0 +1,16 @@
+"""Reconstruction serving: many requests against one pinned bank.
+
+The reference's serving story is a MATLAB for-loop — one image, one
+full solver invocation, all operator precompute re-derived per call
+(reconstruct_2D_subsampling.m:35-60). This package is the
+production-shape replacement: :class:`CodecEngine` pins a dictionary
+bank + ReconstructionProblem + SolveConfig once and serves many
+requests fast — per-bank solve plans (models.reconstruct.ReconPlan),
+shape-bucketed AOT-compiled programs warmed at startup, and a
+micro-batching request queue.
+"""
+from .engine import (  # noqa: F401
+    CodecEngine,
+    ServedResult,
+    enable_compile_cache,
+)

@@ -64,6 +64,19 @@ _TIERS = {"always": 0, "brief": 1, "all": 2}
 _VERBOSE_ADMITS = {"none": 0, "brief": 1, "all": 2}
 
 
+def percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of an ASCENDING-sorted list (None when
+    empty). One definition for the serving latency stats — the engine's
+    ``stats()``, the serve bench record, and obs_report's SERVING
+    section must quote the same statistic."""
+    if not sorted_vals:
+        return None
+    import math
+
+    i = min(len(sorted_vals) - 1, int(math.ceil(q * len(sorted_vals))) - 1)
+    return sorted_vals[max(0, i)]
+
+
 def git_sha() -> Optional[str]:
     """Best-effort git revision of the running tree (provenance field
     of run_meta and bench records). Env override CCSC_GIT_SHA first so
@@ -229,6 +242,11 @@ class CompileMonitor:
         self._handler: Optional[logging.Handler] = None
         self._loggers: List[tuple] = []
         self._sink = None  # Optional[EventWriter-backed callback]
+        # persistent-compilation-cache hits (jax_compilation_cache_dir;
+        # the serving engine's warm-restart signal): jax fires a counter
+        # event per executable loaded from the cache instead of built
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     # -- log harvesting ------------------------------------------------
     def _on_log(self, record: logging.LogRecord) -> None:
@@ -277,6 +295,16 @@ class CompileMonitor:
             except Exception:  # pragma: no cover - never break a compile
                 pass
 
+    def _on_event(self, event: str, **kw) -> None:
+        """Counter-event listener: track persistent-cache traffic (the
+        '/jax/compilation_cache/...' events); everything else ignored."""
+        if "compilation_cache" not in event:
+            return
+        if "hit" in event:
+            self.cache_hits += 1
+        elif "miss" in event:
+            self.cache_misses += 1
+
     # -- lifecycle -----------------------------------------------------
     def install(self, sink=None) -> "CompileMonitor":
         if self._installed:
@@ -285,6 +313,10 @@ class CompileMonitor:
         from jax import monitoring
 
         monitoring.register_event_duration_secs_listener(self._on_duration)
+        try:
+            monitoring.register_event_listener(self._on_event)
+        except Exception:  # pragma: no cover - API drift
+            pass
 
         class _H(logging.Handler):
             def __init__(h, cb):
@@ -316,6 +348,12 @@ class CompileMonitor:
             _mon._unregister_event_duration_listener_by_callback(
                 self._on_duration
             )
+        except Exception:  # pragma: no cover - private API drift
+            pass
+        try:
+            from jax._src import monitoring as _mon
+
+            _mon._unregister_event_listener_by_callback(self._on_event)
         except Exception:  # pragma: no cover - private API drift
             pass
         for lg, level, propagate in self._loggers:
@@ -354,6 +392,8 @@ class CompileMonitor:
             "recompiled_funs": sorted(
                 f for f, c in by_fun.items() if c > 1
             ),
+            "persistent_cache_hits": self.cache_hits,
+            "persistent_cache_misses": self.cache_misses,
         }
 
 

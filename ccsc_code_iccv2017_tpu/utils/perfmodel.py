@@ -272,6 +272,43 @@ def bound_iters_per_sec(
     return 1.0 / t if t > 0 else float("inf")
 
 
+def serving_bound(
+    iters_per_sec: float,
+    iters_per_request: float,
+    slots: int,
+    occupancy: float = 1.0,
+) -> Dict[str, float]:
+    """Requests/sec bound of one serving bucket (serve.CodecEngine).
+
+    A bucket dispatch advances all its occupied slots together, so at
+    a measured per-iteration rate of the BATCHED bucket solve
+    (``iters_per_sec`` — e.g. the 260-380 ADMM it/s of the PERF.md
+    reconstruction families, or a dispatch's achieved iters/dt) the
+    ceiling is::
+
+        requests/sec = iters_per_sec * slots * occupancy
+                       / iters_per_request
+
+    ``occupancy`` is the mean filled-slot fraction (1.0 = every
+    dispatch full); ``iters_per_request`` the mean ADMM iterations a
+    request runs before its tol stop (the while_loop runs to the
+    slowest slot, so the honest divisor is the bucket MAX — pass that
+    for a hard bound, the mean for the expected rate). The engine
+    emits this next to each dispatch's achieved rate (obs
+    ``serve_dispatch`` records) so the gap is recorded, not
+    re-derived."""
+    if iters_per_request <= 0 or slots < 1:
+        return {"requests_per_sec": 0.0}
+    rps = iters_per_sec * slots * max(0.0, min(occupancy, 1.0))
+    return {
+        "requests_per_sec": rps / iters_per_request,
+        "iters_per_sec": iters_per_sec,
+        "slots": slots,
+        "occupancy": occupancy,
+        "iters_per_request": iters_per_request,
+    }
+
+
 def utilization(
     cost: Dict[str, float], steps_per_sec: float, chip: Optional[str] = None
 ) -> Dict[str, float]:

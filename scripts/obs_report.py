@@ -89,7 +89,7 @@ def render(events, stale_after=None):
         knob_keys = (
             "outer_chunk", "donate_state", "fft_impl", "fft_pad",
             "fused_z", "storage_dtype", "d_storage_dtype", "num_blocks",
-            "max_it", "max_it_d", "max_it_z",
+            "carry_freq", "max_it", "max_it_d", "max_it_z",
         )
         knobs = {k: cfgknobs[k] for k in knob_keys if k in cfgknobs}
         if knobs:
@@ -254,6 +254,60 @@ def render(events, stale_after=None):
         )
     else:
         lines.append("  (no heartbeat records)")
+
+    sreqs = by.get("serve_request", [])
+    sdisp = by.get("serve_dispatch", [])
+    if sreqs or sdisp:
+        lines.append(_section("SERVING"))
+        lat = sorted(r.get("latency_ms", 0.0) for r in sreqs)
+        # one percentile definition across engine stats(), the serve
+        # bench record, and this report (utils.obs.percentile)
+        pct = lambda q: obs.percentile(lat, q) or float("nan")
+
+        if sreqs:
+            waits = sorted(r.get("wait_ms", 0.0) for r in sreqs)
+            wait_p50 = obs.percentile(waits, 0.5) or float("nan")
+            lines.append(
+                f"  requests      {len(sreqs)} served, latency p50 "
+                f"{pct(0.5):.1f} ms / p99 {pct(0.99):.1f} ms, queue "
+                f"wait p50 {wait_p50:.1f} ms"
+            )
+        if sdisp:
+            occ = sum(d.get("occupancy", 0.0) for d in sdisp) / len(sdisp)
+            depth = max(d.get("queue_depth", 0) for d in sdisp)
+            lines.append(
+                f"  dispatches    {len(sdisp)}, mean bucket occupancy "
+                f"{100 * occ:.0f}%, max queue depth {depth}"
+            )
+            per = {}
+            for d_ in sdisp:
+                agg = per.setdefault(
+                    d_.get("bucket", "?"), {"n": 0, "req": 0, "occ": 0.0}
+                )
+                agg["n"] += 1
+                agg["req"] += d_.get("n", 0)
+                agg["occ"] += d_.get("occupancy", 0.0)
+            for bname in sorted(per):
+                agg = per[bname]
+                lines.append(
+                    f"    {bname:<14} {agg['n']:4d} dispatch(es), "
+                    f"{agg['req']:4d} request(s), occupancy "
+                    f"{100 * agg['occ'] / agg['n']:.0f}%"
+                )
+        warm = by.get("serve_ready", [])
+        if warm:
+            w = warm[-1]
+            lines.append(
+                f"  warmup        {w.get('n_buckets')} bucket(s) in "
+                f"{w.get('warmup_s')}s, persistent cache hits "
+                f"{w.get('persistent_cache_hits')}"
+            )
+        if summary and summary.get("persistent_cache_hits") is not None:
+            lines.append(
+                f"  compile cache {summary['persistent_cache_hits']} "
+                f"hit(s), {summary.get('persistent_cache_misses')} "
+                "miss(es) over the run"
+            )
 
     lines.append(_section("EVENTS"))
     n_ev = 0

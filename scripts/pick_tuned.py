@@ -91,6 +91,12 @@ def _valid_runs(path):
         if not rec.get("run") or "DEGRADED" in res.get("metric", "") \
                 or v <= 0:
             continue
+        # the serving arms (CCSC_BENCH_SERVE) measure requests/sec of
+        # a DIFFERENT workload with serve-specific knobs — they must
+        # never win the learner-knob pick (records without a unit
+        # field predate the serving arm and are all north-star runs)
+        if res.get("unit", "outer_iters/sec") != "outer_iters/sec":
+            continue
         yield rec["run"], v, res.get("knobs") or {}
 
 

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Serving benchmark: CodecEngine vs the per-request driver loop.
+
+Measures steady-state engine throughput (per-bank plans + shape
+buckets + AOT warmup + micro-batching, serve.CodecEngine) against the
+reference-shaped one-``reconstruct()``-call-per-request loop
+(reconstruct_2D_subsampling.m:35-60) on a stream of small inpainting
+requests, and records the request-latency histogram.
+
+Prints one JSON record (the serve.bench record format; bench.py emits
+the same workload as the CCSC_BENCH_SERVE on-chip arm) followed by a
+text latency histogram unless --json.
+
+Knobs are env vars shared with the bench arm: CCSC_SERVE_REQUESTS,
+CCSC_SERVE_SIZE_MIN/MAX, CCSC_SERVE_K, CCSC_SERVE_SUPPORT,
+CCSC_SERVE_SLOTS, CCSC_SERVE_MAXIT, CCSC_SERVE_WAIT_MS,
+CCSC_SERVE_HOMOG, CCSC_COMPILE_CACHE.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
+
+def _histogram(lat_ms, width=50):
+    """Text latency histogram (10 bins over the observed range)."""
+    if not lat_ms:
+        return "  (no latency records)"
+    lo, hi = min(lat_ms), max(lat_ms)
+    span = max(hi - lo, 1e-9)
+    bins = [0] * 10
+    for v in lat_ms:
+        bins[min(9, int((v - lo) / span * 10))] += 1
+    peak = max(bins)
+    lines = []
+    for i, n in enumerate(bins):
+        a = lo + span * i / 10
+        b = lo + span * (i + 1) / 10
+        bar = "#" * int(width * n / peak) if peak else ""
+        lines.append(f"  {a:9.1f}-{b:9.1f} ms  {n:4d}  {bar}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit only the JSON record (no histogram)",
+    )
+    ap.add_argument(
+        "--requests", type=int, default=None,
+        help="stream length (overrides CCSC_SERVE_REQUESTS)",
+    )
+    ap.add_argument(
+        "--homog", action="store_true",
+        help="homogeneous stream at the bucket shape "
+        "(CCSC_SERVE_HOMOG=1): isolates micro-batching from "
+        "shape bucketing; outputs bit-identical to the loop",
+    )
+    args = ap.parse_args(argv)
+    if args.requests is not None:
+        os.environ["CCSC_SERVE_REQUESTS"] = str(args.requests)
+    if args.homog:
+        os.environ["CCSC_SERVE_HOMOG"] = "1"
+
+    from ccsc_code_iccv2017_tpu.serve.bench import run_serve_workload
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    rec = run_serve_workload()
+    print(json.dumps(rec))
+    if args.json:
+        return rec
+    lat = sorted(
+        e["latency_ms"]
+        for e in obs.read_events(rec["event_stream"])
+        if e.get("type") == "serve_request"
+    )
+    print("\nrequest latency histogram (queue wait + solve):")
+    print(_histogram(lat))
+    print(
+        f"\nengine {rec['engine_requests_per_sec']} req/s vs loop "
+        f"{rec['loop_requests_per_sec']} req/s "
+        f"({rec['speedup_vs_loop']}x; warm loop "
+        f"{rec['loop_warm_requests_per_sec']} req/s), p50 "
+        f"{rec['p50_ms']} ms, p99 {rec['p99_ms']} ms, "
+        f"recompiles after warmup: {rec['recompiles_after_warmup']}"
+    )
+    return rec
+
+
+if __name__ == "__main__":
+    main()

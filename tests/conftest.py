@@ -21,3 +21,13 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 (ROADMAP.md) deselects these with -m 'not slow'; register
+    # the marker so plain pytest doesn't warn about it
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests (engine soak etc.) excluded from the "
+        "tier-1 -m 'not slow' run",
+    )

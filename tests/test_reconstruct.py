@@ -456,6 +456,114 @@ def test_fft_pad_fast_reconstruction():
         )
 
 
+def test_plan_matches_inline_precompute():
+    """A precomputed ReconPlan (build_plan) and the in-jit operator
+    precompute are the same code path (_plan_arrays): passing
+    plan= must reproduce the plan-less call bitwise — including the
+    dirac/poisson/gradient-regularization configuration."""
+    from ccsc_code_iccv2017_tpu.models.reconstruct import build_plan
+
+    x = _toy_image()
+    r = np.random.default_rng(41)
+    mask = (r.random(x.shape) < 0.5).astype(np.float32)
+    d = _toy_dictionary()
+    geom = ProblemGeom((5, 5), 8)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=15, tol=1e-4,
+        verbose="none", track_objective=True,
+    )
+    prob = ReconstructionProblem(geom)
+    args = (jnp.asarray((x * mask)[None]), d, prob, cfg)
+    kw = dict(mask=jnp.asarray(mask[None]))
+    ref = reconstruct(*args, **kw)
+    plan = build_plan(d, prob, cfg, x.shape)
+    got = reconstruct(*args, **kw, plan=plan)
+    np.testing.assert_array_equal(np.asarray(ref.z), np.asarray(got.z))
+    np.testing.assert_array_equal(
+        np.asarray(ref.recon), np.asarray(got.recon)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.trace.obj_vals), np.asarray(got.trace.obj_vals)
+    )
+    assert int(ref.trace.num_iters) == int(got.trace.num_iters)
+
+    # poisson + dirac + gradient-regularized channel through the plan
+    obs = np.abs(r.normal(size=x.shape)).astype(np.float32) * 50 + 1
+    prob2 = ReconstructionProblem(
+        geom, data_term="poisson", dirac="append", grad_reg_dirac=True,
+        sparsify_dirac=False, clamp_nonneg=True,
+    )
+    cfg2 = SolveConfig(
+        lambda_residual=20.0, lambda_prior=1.0, max_it=8, tol=1e-5,
+        gamma_factor=20.0, gamma_ratio=5.0, verbose="none",
+    )
+    ones = jnp.ones_like(jnp.asarray(obs[None]))
+    ref2 = reconstruct(jnp.asarray(obs[None]), d, prob2, cfg2, mask=ones)
+    plan2 = build_plan(d, prob2, cfg2, obs.shape)
+    got2 = reconstruct(
+        jnp.asarray(obs[None]), d, prob2, cfg2, mask=ones, plan=plan2
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref2.recon), np.asarray(got2.recon)
+    )
+
+
+def test_plan_mismatch_refused():
+    """A plan built for a different config/domain/blur must be
+    refused with an actionable error, never silently mis-solved."""
+    from ccsc_code_iccv2017_tpu.models.reconstruct import build_plan
+
+    x = _toy_image()
+    d = _toy_dictionary()
+    geom = ProblemGeom((5, 5), 8)
+    prob = ReconstructionProblem(geom)
+    cfg = SolveConfig(max_it=5, verbose="none")
+    plan = build_plan(d, prob, cfg, x.shape)
+    b = jnp.asarray(x[None])
+    # different gamma_ratio -> different rho baked into the solve factors
+    with pytest.raises(ValueError, match="plan mismatch"):
+        reconstruct(
+            b, d, prob,
+            SolveConfig(max_it=5, gamma_ratio=50.0, verbose="none"),
+            plan=plan,
+        )
+    # different spatial domain
+    with pytest.raises(ValueError, match="plan mismatch"):
+        reconstruct(
+            jnp.asarray(x[None, :24, :24], jnp.float32), d, prob, cfg,
+            plan=plan,
+        )
+    # a DIFFERENT bank with the same filter count: the solve would run
+    # entirely against the plan's stale spectra — refused by content
+    # fingerprint
+    d2 = _toy_dictionary(seed=99)
+    with pytest.raises(ValueError, match="different dictionary bank"):
+        reconstruct(b, d2, prob, cfg, plan=plan)
+    # lambda_smooth is baked into the grad-reg kern diagonal: a plan
+    # built at a different weight must be refused, not mis-solved
+    prob_g = ReconstructionProblem(
+        geom, dirac="append", grad_reg_dirac=True
+    )
+    cfg_g = SolveConfig(max_it=5, lambda_smooth=0.1, verbose="none")
+    plan_g = build_plan(d, prob_g, cfg_g, x.shape)
+    with pytest.raises(ValueError, match="plan mismatch"):
+        reconstruct(
+            b, d, prob_g,
+            SolveConfig(max_it=5, lambda_smooth=100.0, verbose="none"),
+            plan=plan_g,
+        )
+    # blur must be baked into the plan, not passed alongside it
+    with pytest.raises(ValueError, match="blur"):
+        reconstruct(
+            b, d, prob, cfg, blur_psf=jnp.ones((3, 3)) / 9.0, plan=plan
+        )
+    # plan + mesh is refused (the engine is the batching layer)
+    from ccsc_code_iccv2017_tpu.parallel.mesh import block_mesh
+
+    with pytest.raises(ValueError, match="mesh"):
+        reconstruct(b, d, prob, cfg, mesh=block_mesh(1), plan=plan)
+
+
 def test_unpadded_reconstruction_fft_impl_matmul():
     """fft_impl='matmul' on the unpadded W>1 (demosaic-style) solver
     matches the jnp.fft path to float tolerance."""

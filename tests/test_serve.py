@@ -1,0 +1,414 @@
+"""The reconstruction serving engine (serve.CodecEngine): per-bank
+plans, shape-bucketed AOT warmup, micro-batched solves.
+
+Contracts under test (ISSUE 5):
+- a served result at an exact bucket shape is BIT-IDENTICAL to a
+  direct reconstruct() call (each slot is an n=1 solve under vmap:
+  per-request gamma, traces, and tol termination);
+- a padded-bucket result equals the exact-shape solve on the valid
+  region to boundary tolerance (the zero-mask pad path);
+- second-and-later same-bucket requests trigger ZERO XLA compiles
+  (asserted from the obs event stream);
+- the micro-batch queue flushes on both max_batch (bucket slots) and
+  max_wait_ms;
+- per-request validation is the cheap subset (shape/non-finite), the
+  bank checks having run once at engine construction.
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import (
+    ProblemGeom,
+    ServeConfig,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+    reconstruct,
+)
+from ccsc_code_iccv2017_tpu.serve import CodecEngine
+from ccsc_code_iccv2017_tpu.utils import obs
+from ccsc_code_iccv2017_tpu.utils.validate import CCSCInputError
+
+
+def _bank(k=6, s=5, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, s, s)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return jnp.asarray(d)
+
+
+def _cfg(**kw):
+    base = dict(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=8, tol=1e-4,
+        verbose="none", track_objective=True, track_psnr=True,
+    )
+    base.update(kw)
+    return SolveConfig(**base)
+
+
+def _req(size, seed=1, keep=0.5):
+    r = np.random.default_rng(seed)
+    x = r.random((size, size)).astype(np.float32)
+    m = (r.random((size, size)) < keep).astype(np.float32)
+    return x, m
+
+
+def _engine(d, cfg, buckets, tmp_path=None, **kw):
+    scfg = ServeConfig(
+        buckets=buckets,
+        max_wait_ms=kw.pop("max_wait_ms", 10.0),
+        metrics_dir=str(tmp_path) if tmp_path is not None else None,
+        verbose="none",
+        **kw,
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    return CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
+
+
+def test_exact_bucket_bit_identical_to_direct_call():
+    """A request AT a bucket shape: served result == a standalone
+    reconstruct() call, bitwise — recon, codes trace values, and the
+    stopping iteration."""
+    d = _bank()
+    cfg = _cfg()
+    eng = _engine(d, cfg, ((2, (24, 24)),))
+    try:
+        x, m = _req(24)
+        res = eng.reconstruct(x * m, mask=m, x_orig=x)
+        geom = ProblemGeom(d.shape[1:], d.shape[0])
+        direct = reconstruct(
+            jnp.asarray((x * m)[None]), d, ReconstructionProblem(geom),
+            cfg, mask=jnp.asarray(m[None]), x_orig=jnp.asarray(x[None]),
+        )
+        np.testing.assert_array_equal(
+            res.recon, np.asarray(direct.recon[0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res.trace.obj_vals),
+            np.asarray(direct.trace.obj_vals),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res.trace.psnr_vals),
+            np.asarray(direct.trace.psnr_vals),
+        )
+        assert int(res.trace.num_iters) == int(direct.trace.num_iters)
+        # .psnr is recomputed host-side over the valid region; at an
+        # exact bucket shape that is the same region as the in-solve
+        # trace, up to f32-vs-f64 reduction
+        assert res.psnr == pytest.approx(
+            float(direct.trace.psnr_vals[int(direct.trace.num_iters)]),
+            abs=1e-3,
+        )
+    finally:
+        eng.close()
+
+
+def test_padded_bucket_matches_exact_shape_on_valid_region():
+    """A request SMALLER than its bucket: the pad region is excluded
+    through the mask path, so the valid-region result matches the
+    exact-shape solve to boundary tolerance (same class as the
+    fft_pad canvas-growth bound in test_reconstruct)."""
+    d = _bank()
+    cfg = _cfg(max_it=20)
+    eng = _engine(d, cfg, ((2, (32, 32)),))
+    try:
+        x, m = _req(26, seed=3)
+        res = eng.reconstruct(x * m, mask=m)
+        assert res.bucket == "2@32x32"
+        assert res.recon.shape == (26, 26)
+        geom = ProblemGeom(d.shape[1:], d.shape[0])
+        direct = reconstruct(
+            jnp.asarray((x * m)[None]), d, ReconstructionProblem(geom),
+            cfg, mask=jnp.asarray(m[None]),
+        )
+        ref = np.asarray(direct.recon[0])
+        rel = np.abs(res.recon - ref).max() / max(
+            np.abs(ref).max(), 1e-9
+        )
+        assert rel < 0.05, rel
+    finally:
+        eng.close()
+
+
+def test_second_request_zero_xla_compiles(tmp_path):
+    """The zero-recompile serving contract, asserted from the obs
+    event stream: every backend compile lands during engine warmup;
+    requests — including the FIRST — dispatch with none."""
+    d = _bank()
+    eng = _engine(d, _cfg(), ((2, (24, 24)),), tmp_path=tmp_path)
+    try:
+        t_ready = time.time()
+        x, m = _req(24)
+        eng.reconstruct(x * m, mask=m)
+        eng.reconstruct(x * m, mask=m)
+        x2, m2 = _req(20, seed=5)  # padded into the same bucket
+        eng.reconstruct(x2 * m2, mask=m2)
+    finally:
+        eng.close()
+    events = obs.read_events(str(tmp_path))
+    compiles = [e for e in events if e.get("type") == "compile"]
+    assert compiles, "warmup must have recorded compile events"
+    after = [e for e in compiles if e["t"] > t_ready]
+    assert after == [], (
+        f"requests triggered {len(after)} XLA compile(s): "
+        f"{[e.get('fun_name') for e in after]}"
+    )
+    # and the summary's recompile tracker agrees: nothing compiled twice
+    summary = next(
+        e for e in reversed(events) if e.get("type") == "summary"
+    )
+    assert summary["compile"]["recompiled_funs"] == []
+
+
+def test_queue_flushes_at_max_batch(tmp_path):
+    """Filling a bucket's slots dispatches immediately (no wait for
+    the deadline): one dispatch, occupancy 1.0."""
+    d = _bank()
+    eng = _engine(
+        d, _cfg(), ((2, (24, 24)),), tmp_path=tmp_path,
+        max_wait_ms=10_000.0,  # deadline can never be the trigger
+    )
+    try:
+        x, m = _req(24)
+        t0 = time.perf_counter()
+        f1 = eng.submit(x * m, mask=m)
+        f2 = eng.submit(x * m, mask=m)
+        f1.result(timeout=60)
+        f2.result(timeout=60)
+        assert time.perf_counter() - t0 < 10.0  # did not sit out 10 s
+    finally:
+        eng.close()
+    disp = [
+        e
+        for e in obs.read_events(str(tmp_path))
+        if e.get("type") == "serve_dispatch"
+    ]
+    assert [e["n"] for e in disp] == [2]
+    assert disp[0]["occupancy"] == 1.0
+
+
+def test_queue_flushes_at_max_wait(tmp_path):
+    """A lone request dispatches after max_wait_ms even though its
+    bucket never fills."""
+    d = _bank()
+    wait_ms = 150.0
+    eng = _engine(
+        d, _cfg(), ((4, (24, 24)),), tmp_path=tmp_path,
+        max_wait_ms=wait_ms,
+    )
+    try:
+        x, m = _req(24)
+        fut = eng.submit(x * m, mask=m)
+        res = fut.result(timeout=60)
+        # it waited (roughly) the deadline, not forever and not zero
+        assert res.wait_s >= 0.8 * wait_ms / 1e3
+    finally:
+        eng.close()
+    disp = [
+        e
+        for e in obs.read_events(str(tmp_path))
+        if e.get("type") == "serve_dispatch"
+    ]
+    assert [e["n"] for e in disp] == [1]
+    assert disp[0]["slots"] == 4
+
+
+def test_full_bucket_stream_does_not_starve_deadline(tmp_path):
+    """A steady stream keeping one bucket full must not starve another
+    bucket's lone request past its max_wait deadline: expired
+    deadlines flush before full buckets."""
+    d = _bank()
+    wait_ms = 100.0
+    eng = _engine(
+        d, _cfg(max_it=4), ((1, (20, 20)), (4, (32, 32))),
+        tmp_path=tmp_path, max_wait_ms=wait_ms,
+    )
+    try:
+        xs, ms = _req(20)
+        xb, mb = _req(30, seed=9)
+        lone = eng.submit(xb * mb, mask=mb)  # 32-bucket, never fills
+        # saturate the 1-slot small bucket: every submit makes it full
+        small = [eng.submit(xs * ms, mask=ms) for _ in range(8)]
+        res = lone.result(timeout=60)
+        # it must have been served close to its deadline, not behind
+        # the whole small-bucket stream
+        assert res.wait_s < 8 * wait_ms / 1e3, res.wait_s
+        for f in small:
+            f.result(timeout=60)
+    finally:
+        eng.close()
+
+
+def test_psnr_none_when_tracking_off():
+    """x_orig given but the pinned config does not track PSNR: the
+    result must say None, never a fake 0.0 dB."""
+    d = _bank()
+    cfg = _cfg(track_psnr=False)
+    eng = _engine(d, cfg, ((2, (24, 24)),))
+    try:
+        x, m = _req(24)
+        res = eng.reconstruct(x * m, mask=m, x_orig=x)
+        assert res.psnr is None
+        assert float(np.abs(np.asarray(res.trace.psnr_vals)).max()) == 0.0
+    finally:
+        eng.close()
+
+
+def test_cancelled_future_does_not_poison_batch(tmp_path):
+    """A client-cancelled pending request is dropped at dispatch; its
+    batch siblings still get their results."""
+    d = _bank()
+    eng = _engine(
+        d, _cfg(max_it=4), ((2, (24, 24)),), tmp_path=tmp_path,
+        max_wait_ms=300.0,
+    )
+    try:
+        x, m = _req(24)
+        f1 = eng.submit(x * m, mask=m)
+        assert f1.cancel()  # still queued: cancellable
+        f2 = eng.submit(x * m, mask=m)
+        f3 = eng.submit(x * m, mask=m)  # fills the 2-slot bucket
+        assert f2.result(timeout=60).recon.shape == (24, 24)
+        assert f3.result(timeout=60).recon.shape == (24, 24)
+        assert f1.cancelled()
+    finally:
+        eng.close()
+
+
+def test_bucket_selection_and_oversize_refusal():
+    d = _bank()
+    eng = _engine(d, _cfg(), ((2, (24, 24)), (2, (40, 40))))
+    try:
+        assert eng.bucket_for((20, 24)) == (2, (24, 24))
+        assert eng.bucket_for((25, 10)) == (2, (40, 40))
+        with pytest.raises(CCSCInputError, match="exceeds every"):
+            eng.bucket_for((64, 64))
+        # submit() routes through the same refusal
+        x, m = _req(64)
+        with pytest.raises(CCSCInputError, match="exceeds every"):
+            eng.submit(x * m, mask=m)
+    finally:
+        eng.close()
+
+
+def test_per_request_validation_is_the_cheap_subset():
+    """Bad per-request data fails fast with the named check; the bank
+    itself was validated once at construction (a bad bank never
+    constructs an engine)."""
+    d = _bank()
+    eng = _engine(d, _cfg(), ((2, (24, 24)),))
+    try:
+        x, m = _req(24)
+        bad = x.copy()
+        bad[3, 3] = np.nan
+        with pytest.raises(CCSCInputError, match="non-finite"):
+            eng.submit(bad)
+        with pytest.raises(CCSCInputError, match="no batch axis"):
+            eng.submit(x[None])
+        with pytest.raises(CCSCInputError, match="mask shape"):
+            eng.submit(x, mask=m[:12])
+        # same all-zero-mask refusal as the direct reconstruct() path
+        with pytest.raises(CCSCInputError, match="identically zero"):
+            eng.submit(x, mask=np.zeros_like(m))
+    finally:
+        eng.close()
+    # construction-time (hoisted) check: NaN bank refused before any
+    # compile
+    bad_bank = np.asarray(_bank()).copy()
+    bad_bank[0, 0, 0] = np.inf
+    with pytest.raises(CCSCInputError, match="non-finite"):
+        _engine(jnp.asarray(bad_bank), _cfg(), ((2, (24, 24)),))
+
+
+def test_requests_without_optional_fields_match_direct_none_path():
+    """mask=None / smooth_init=None / x_orig=None requests run the
+    same math as the direct call's None path (the engine feeds
+    neutral fills: ones mask, zero offset)."""
+    d = _bank()
+    cfg = _cfg()
+    eng = _engine(d, cfg, ((2, (24, 24)),))
+    try:
+        x, _ = _req(24, seed=7)
+        res = eng.reconstruct(x)  # fully observed, no extras
+        geom = ProblemGeom(d.shape[1:], d.shape[0])
+        direct = reconstruct(
+            jnp.asarray(x[None]), d, ReconstructionProblem(geom), cfg
+        )
+        np.testing.assert_array_equal(
+            res.recon, np.asarray(direct.recon[0])
+        )
+        assert res.psnr is None
+        assert float(np.abs(np.asarray(res.trace.psnr_vals)).max()) == 0.0
+    finally:
+        eng.close()
+
+
+def test_serving_bound_formula():
+    from ccsc_code_iccv2017_tpu.utils import perfmodel
+
+    b = perfmodel.serving_bound(
+        300.0, iters_per_request=30.0, slots=4, occupancy=0.5
+    )
+    assert b["requests_per_sec"] == pytest.approx(300.0 * 4 * 0.5 / 30.0)
+    assert perfmodel.serving_bound(300.0, 0, 4)["requests_per_sec"] == 0.0
+
+
+@pytest.mark.slow
+def test_engine_soak_mixed_stream(tmp_path):
+    """Soak: a mixed-size stream across two buckets, every result
+    spot-checked against the direct exact-shape call on the valid
+    region; the stream ends with zero compiles after warmup and a
+    clean latency summary."""
+    d = _bank(k=8)
+    cfg = _cfg(max_it=12)
+    eng = _engine(
+        d, cfg, ((3, (24, 24)), (3, (32, 32))), tmp_path=tmp_path,
+        max_wait_ms=5.0,
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    r = np.random.default_rng(0)
+    try:
+        t_ready = time.time()
+        reqs, futs = [], []
+        for i in range(24):
+            size = int(r.integers(18, 33))
+            x, m = _req(size, seed=100 + i)
+            reqs.append((x, m))
+            futs.append(eng.submit(x * m, mask=m, x_orig=x))
+        results = [f.result(timeout=300) for f in futs]
+    finally:
+        eng.close()
+    # reference spot-checks AFTER close: the engine's compile monitor
+    # is process-global while its run is open, and these direct calls
+    # legitimately compile per shape — they must not count against the
+    # engine's zero-recompile assertion below
+    for i in (0, 7, 15, 23):
+        x, m = reqs[i]
+        direct = reconstruct(
+            jnp.asarray((x * m)[None]), d,
+            ReconstructionProblem(geom), cfg,
+            mask=jnp.asarray(m[None]), x_orig=jnp.asarray(x[None]),
+        )
+        ref = np.asarray(direct.recon[0])
+        rel = np.abs(results[i].recon - ref).max() / max(
+            np.abs(ref).max(), 1e-9
+        )
+        assert rel < 0.06, (i, rel)
+    events = obs.read_events(str(tmp_path))
+    after = [
+        e for e in events
+        if e.get("type") == "compile" and e["t"] > t_ready
+    ]
+    assert after == []
+    summary = next(
+        e for e in reversed(events) if e.get("type") == "summary"
+    )
+    assert summary["n_requests"] == 24
+    assert summary["p99_latency_s"] is not None
+    st = eng.stats()
+    assert st["n_requests"] == 24
+    assert 0 < st["mean_occupancy"] <= 1.0
