@@ -68,24 +68,29 @@ def run_workload():
     blocks = int(os.environ.get("CCSC_BENCH_BLOCKS", 8))
     iters = int(os.environ.get("CCSC_BENCH_ITERS", 3))
 
-    # bench_tuned.json (written by scripts/onchip_queue.sh after its
-    # on-chip A/Bs) carries the winning knob settings; explicit env
-    # vars always override. Same problem, same math (equality-tested
-    # knobs) — only the execution strategy changes. TPU-only: the
-    # knobs were picked on chip, and applying e.g. use_pallas to the
-    # CPU-degrade fallback would run the kernel in interpret mode and
-    # defeat the "degraded-but-present number beats a hang" design.
+    # The tuned-knob store (tune.store, written by scripts/pick_tuned
+    # after the on-chip A/Bs and by scripts/autotune.py sweeps)
+    # carries the winning knob settings keyed by (chip, shape bucket);
+    # explicit env vars always override. Same problem, same math
+    # (equality-tested knobs) — only the execution strategy changes.
+    # TPU-only: the knobs were picked on chip, and applying e.g.
+    # bf16/fused arms to the CPU-degrade fallback would defeat the
+    # "degraded-but-present number beats a hang" design. The legacy
+    # bench_tuned.json is a read-compat migration shim consulted only
+    # when the store holds nothing for the key on ANY chip; a store
+    # with entries for a DIFFERENT chip refuses (cross-chip knobs are
+    # exactly the hazard the store closes).
     tuned = {}
-    tuned_path = os.path.join(REPO, "bench_tuned.json")
-    if os.path.exists(tuned_path) and jax.default_backend() in (
-        "tpu",
-        "axon",
-    ):
-        try:
-            with open(tuned_path) as f:
-                tuned = json.load(f)
-        except Exception:
-            tuned = {}
+    if jax.default_backend() in ("tpu", "axon"):
+        from ccsc_code_iccv2017_tpu.tune import store as tune_store
+        from ccsc_code_iccv2017_tpu.utils import perfmodel as _pm
+
+        tuned, tuned_src = tune_store.bench_lookup(
+            _pm.detect_chip(), k=k, support=(11, 11), n=n,
+            size=(size, size), blocks=blocks, repo=REPO,
+        )
+        if tuned_src.startswith("refused"):
+            print(f"bench: tuned store {tuned_src}", file=sys.stderr)
     use_pallas = os.environ.get(
         "CCSC_BENCH_PALLAS", "1" if tuned.get("use_pallas") else "0"
     ) == "1"

@@ -11,8 +11,22 @@ downgrade as a ``degrade`` event in the obs stream and in the result
 trace (``trace['degrades']``)."""
 from __future__ import annotations
 
+import argparse
 import time
 from typing import Dict, List, Optional
+
+
+class _TuneStoreAction(argparse.Action):
+    """--tune-store PATH == CCSC_TUNE_STORE=PATH for this process:
+    every store consumer (dispatch resolution, reconstruct's inline
+    resolution, bench tooling) reads the env, so the flag sets it at
+    parse time instead of threading a path through each config."""
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        import os
+
+        os.environ["CCSC_TUNE_STORE"] = values
+        setattr(namespace, self.dest, values)
 
 
 def add_perf_args(
@@ -86,6 +100,24 @@ def add_perf_args(
             "float tolerance (LearnConfig.carry_freq; 1.25x CPU step "
             "win, PERF.md r5). Masked learner only.",
         )
+    parser.add_argument(
+        "--tune", default="off", choices=["off", "auto", "sweep"],
+        help="knob autotuning (tune/): 'auto' applies the "
+        "measured-fastest arm for this chip + shape bucket from the "
+        "tuned store (behind a trajectory-parity numerics guard; a "
+        "failing arm is demoted and the next-best applied); 'sweep' "
+        "times the candidate arms on the actual chip first and "
+        "persists the ranking; 'off' (default) runs exactly the "
+        "flags given. Hand-set knob flags still apply first — tuning "
+        "starts from the configured values.",
+    )
+    parser.add_argument(
+        "--tune-store", default=None, action=_TuneStoreAction,
+        metavar="PATH",
+        help="tuned-knob store path (sets CCSC_TUNE_STORE; default: "
+        "CCSC_TUNE_STORE env > $CCSC_COMPILE_CACHE/"
+        "ccsc_tuned_knobs.json > repo tuned_knobs.json)",
+    )
 
 
 def add_obs_args(parser) -> None:
@@ -352,6 +384,8 @@ def dispatch_learn(
     stream_mode = kwargs.pop("stream_mode", None)
     if stream_mode and not streaming:
         raise SystemExit("--stream-mode requires --streaming")
+    if cfg.tune != "off":
+        cfg = _resolve_tune(cfg, b, geom, streaming, solver)
     if not auto_degrade:
         return _dispatch_once(
             b, geom, cfg, key, mesh, streaming, solver,
@@ -424,6 +458,57 @@ def dispatch_learn(
     if log.events and isinstance(res.trace, dict):
         res.trace["degrades"] = log.events
     return res
+
+
+def _resolve_tune(cfg, b, geom, streaming, solver):
+    """Startup knob resolution for the learner CLIs (--tune): ONE
+    choke point shared by all four apps, run before the auto-degrade
+    preflight so the ladder sees the knobs that will actually execute.
+    The workload token gates arm applicability (a consensus-measured
+    fused_z never configures the masked or streaming learner) and
+    scopes the store key. Events go into their own
+    ``events-*-tune.jsonl`` in the metrics dir (the learner's Run is
+    not open yet — same pattern as _DegradeLog); obs.read_events
+    merges the per-file streams."""
+    from ..tune import autotune, store as tune_store
+    from ..utils import obs
+
+    algo = (
+        "masked" if solver is not None
+        else ("streaming" if streaming else "consensus")
+    )
+    workload = tune_store.learn_workload(geom, algo)
+    writer = None
+    emit = None
+    if cfg.metrics_dir is not None:
+        import os
+
+        host = 0
+        try:
+            import jax
+
+            host = jax.process_index()
+        except Exception:
+            pass
+        writer = obs.EventWriter(
+            os.path.join(
+                cfg.metrics_dir, f"events-p{host:05d}-tune.jsonl"
+            )
+        )
+
+        def emit(type_, _w=writer, _h=host, **fields):
+            _w.write(
+                {"t": time.time(), "type": type_, "host": _h, **fields}
+            )
+
+    try:
+        cfg, _ = autotune.resolve_learn(
+            cfg, geom, tuple(b.shape), workload=workload, emit=emit
+        )
+    finally:
+        if writer is not None:
+            writer.close()
+    return cfg
 
 
 def _dispatch_once(

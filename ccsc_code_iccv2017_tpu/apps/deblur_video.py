@@ -110,6 +110,7 @@ def main(argv=None):
         tol=args.tol,
         fft_pad=args.fft_pad,
         fft_impl=args.fft_impl,
+        tune=args.tune,
         gamma_factor=500.0,
         gamma_ratio=1.0,
     )

@@ -81,6 +81,7 @@ def main(argv=None):
         tol=args.tol,
         fft_pad=args.fft_pad,
         fft_impl=args.fft_impl,
+        tune=args.tune,
     )
     res = reconstruct(
         jnp.asarray(b * mask),

@@ -151,6 +151,7 @@ def main(argv=None):
         verbose=args.verbose,
         fft_pad=args.fft_pad,
         fft_impl=args.fft_impl,
+        tune=args.tune,
         fused_z=args.fused_z,
         storage_dtype=args.storage_dtype,
         d_storage_dtype=args.d_storage_dtype,

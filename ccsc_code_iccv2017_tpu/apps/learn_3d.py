@@ -105,6 +105,7 @@ def main(argv=None):
         verbose=args.verbose,
         fft_pad=args.fft_pad,
         fft_impl=args.fft_impl,
+        tune=args.tune,
         storage_dtype=args.storage_dtype,
         d_storage_dtype=args.d_storage_dtype,
         outer_chunk=args.outer_chunk,

@@ -115,6 +115,7 @@ def main(argv=None):
         verbose=args.verbose,
         fft_pad=args.fft_pad,
         fft_impl=args.fft_impl,
+        tune=args.tune,
         storage_dtype=args.storage_dtype,
         outer_chunk=args.outer_chunk,
         donate_state=args.donate_state,

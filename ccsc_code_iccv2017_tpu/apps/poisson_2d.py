@@ -79,6 +79,7 @@ def main(argv=None):
         tol=args.tol,
         fft_pad=args.fft_pad,
         fft_impl=args.fft_impl,
+        tune=args.tune,
         gamma_factor=20.0,
         gamma_ratio=5.0,
     )

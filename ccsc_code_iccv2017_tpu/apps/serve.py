@@ -125,6 +125,11 @@ def main(argv=None):
         compile_cache=args.compile_cache,
         aot_warmup=not args.no_aot,
         metrics_dir=args.metrics_dir,
+        # engine-level resolution: the engine applies the tuned solve
+        # arm ONCE at startup (largest bucket's key) so every bucket
+        # program is built from the same resolved knobs
+        tune=args.tune,
+        tune_store=args.tune_store,
     )
     t0 = time.perf_counter()
     engine = CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)

@@ -110,6 +110,7 @@ def main(argv=None):
     cfg = SolveConfig(
         metrics_dir=args.metrics_dir,
         fft_impl=args.fft_impl,
+        tune=args.tune,
         lambda_residual=args.lambda_residual,
         lambda_prior=args.lambda_prior,
         max_it=args.max_it,

@@ -251,6 +251,16 @@ class LearnConfig:
     # the live spectra. Trajectory equal to float tolerance
     # (tests/test_learn_masked_carry.py). Masked learner only.
     carry_freq: bool = False
+    # Knob autotuning (tune/, --tune): 'off' (default — the config
+    # executes exactly as written; the only mode tests ever see),
+    # 'auto' (at startup, look up the measured-fastest arm for this
+    # chip + shape bucket in the tuned store and apply it behind the
+    # numerics guard — a failing arm is demoted and the next-best
+    # applied), 'sweep' (time the candidate arms on the actual chip
+    # first, persist the ranking, then resolve as 'auto'). Resolution
+    # happens ONCE at startup (apps._dispatch.dispatch_learn); the
+    # resolved config runs with tune='off'.
+    tune: str = "off"
 
     @property
     def with_objective(self) -> bool:
@@ -284,6 +294,11 @@ class LearnConfig:
         if self.watchdog_slack <= 0:
             raise ValueError(
                 f"watchdog_slack must be > 0, got {self.watchdog_slack}"
+            )
+        if self.tune not in ("off", "auto", "sweep"):
+            raise ValueError(
+                f"tune must be 'off' | 'auto' | 'sweep', got "
+                f"{self.tune!r}"
             )
 
     @property
@@ -338,13 +353,54 @@ class SolveConfig:
     # requires a padded problem (ReconstructionProblem.pad=True) — see
     # LearnConfig.fft_pad.
     fft_pad: str = "none"
-    # FFT implementation ('xla' | 'matmul') — see LearnConfig.fft_impl.
+    # FFT implementation ('xla' | 'matmul' | 'matmul_high' |
+    # 'matmul_bf16') — see LearnConfig.fft_impl. The matmul tiers are
+    # the measured on-chip learner wins (PERF.md r4/r5), now plumbed
+    # through the reconstruction/serving path too.
     fft_impl: str = "xla"
+    # Storage dtype of the ADMM code iterate inside the solve loop (z
+    # and its sparsity dual — the code-sized [n, K, *spatial] carry
+    # tensors). 'bfloat16' halves their HBM footprint and traffic;
+    # every computation still runs in float32 (cast-up at the loop
+    # boundary), the same stored-iterate rounding contract as
+    # LearnConfig.storage_dtype. 'float32' (default) keeps the
+    # historical program bit-exactly.
+    storage_dtype: str = "float32"
+    # Gram-inverse method of the W > 1 z-kernel precompute
+    # (ops.freq_solvers.hermitian_inverse: 'cholesky' | 'schur' |
+    # 'newton'; same math to float rounding). None (default) keeps the
+    # library's platform/size-aware resolution (CCSC_HERM_INV env >
+    # 'auto'); a config-level pin lets a serving engine carry the
+    # tuned method per-plan instead of per-process env. No effect on
+    # W == 1 problems (scalar inner system, no matrix inverse).
+    herm_inv: Optional[str] = None
     # Run telemetry (utils.obs) — see LearnConfig.metrics_dir. The
     # reconstruction solve is one jitted while_loop, so its stream
     # carries run metadata, compile events, the per-iteration trace
     # replayed from the returned arrays, and the final summary.
     metrics_dir: Optional[str] = None
+    # Knob autotuning — see LearnConfig.tune. Resolution happens once
+    # per reconstruct() entry (cheap store lookup; guard verdicts are
+    # cached in the store) or once per serving engine
+    # (ServeConfig.tune); the resolved config runs with tune='off'.
+    tune: str = "off"
+
+    def __post_init__(self):
+        if self.tune not in ("off", "auto", "sweep"):
+            raise ValueError(
+                f"tune must be 'off' | 'auto' | 'sweep', got "
+                f"{self.tune!r}"
+            )
+        if self.storage_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"storage_dtype must be 'float32' | 'bfloat16', got "
+                f"{self.storage_dtype!r}"
+            )
+        if self.herm_inv not in (None, "cholesky", "schur", "newton"):
+            raise ValueError(
+                f"herm_inv must be None | 'cholesky' | 'schur' | "
+                f"'newton', got {self.herm_inv!r}"
+            )
 
     @property
     def with_objective(self) -> bool:
@@ -394,8 +450,24 @@ class ServeConfig:
     # compile tracking, queue depth + bucket occupancy
     metrics_dir: Optional[str] = None
     verbose: str = "brief"
+    # Knob autotuning of the pinned SolveConfig (tune/): 'auto' looks
+    # up the measured-fastest solve arm for (this chip, the largest
+    # bucket's shape) in the tuned store at engine construction and
+    # applies it behind the numerics guard; 'sweep' times the arms on
+    # the actual chip first. The resolved knob dict is recorded in
+    # every serve_warmup event. 'off' (default) serves exactly the
+    # SolveConfig given — bit-identical to direct reconstruct() calls.
+    tune: str = "off"
+    # tuned-knob store path (None = CCSC_TUNE_STORE env > next to the
+    # compile cache > repo tuned_knobs.json; tune.store)
+    tune_store: Optional[str] = None
 
     def __post_init__(self):
+        if self.tune not in ("off", "auto", "sweep"):
+            raise ValueError(
+                f"tune must be 'off' | 'auto' | 'sweep', got "
+                f"{self.tune!r}"
+            )
         if not self.buckets:
             raise ValueError("ServeConfig.buckets must be non-empty")
         norm = []

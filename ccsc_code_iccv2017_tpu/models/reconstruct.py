@@ -109,6 +109,7 @@ def _bank_digest(d) -> str:
     data_fields=("dhat_clean", "dhat_solve", "kern"),
     meta_fields=(
         "prob", "fg", "rho", "has_blur", "d_digest", "lambda_smooth",
+        "herm_inv",
     ),
 )
 @dataclasses.dataclass(frozen=True)
@@ -146,6 +147,11 @@ class ReconPlan:
     # the dirac gradient-regularization weight baked into kern's
     # diagonal (only meaningful when prob.grad_reg_dirac)
     lambda_smooth: float
+    # the Gram-inverse method baked into kern's W > 1 inner inverse
+    # (SolveConfig.herm_inv; None = the env/platform default at build
+    # time) — part of the mismatch check so a plan never silently
+    # carries factors from a different method than the call's config
+    herm_inv: Optional[str] = None
 
     @property
     def num_filters(self) -> int:
@@ -185,6 +191,7 @@ def _plan_arrays(d, prob, cfg, fg, blur_psf, fslice=None):
         fslice(dhat_solve),
         _solve_rho(cfg, fg),
         fslice(extra_diag) if extra_diag is not None else None,
+        herm_inv=cfg.herm_inv,
     )
     return dhat_clean, dhat_solve, kern
 
@@ -221,6 +228,13 @@ def build_plan(
     from ..utils import validate
 
     validate.check_filters(d, prob.geom)
+    if cfg.tune != "off":
+        raise ValueError(
+            "build_plan requires a RESOLVED config (tune='off'): "
+            "resolve the knobs first (tune.autotune.resolve_solve, or "
+            "let serve.CodecEngine / reconstruct() do it) so the plan "
+            "is built from the knobs that will actually execute"
+        )
     data_spatial = tuple(int(s) for s in data_spatial)
     fg = common.FreqGeom.create(
         prob.geom, data_spatial, pad=prob.pad, fft_pad=cfg.fft_pad,
@@ -242,6 +256,7 @@ def build_plan(
         has_blur=blur_psf is not None,
         d_digest=_bank_digest(d),
         lambda_smooth=cfg.lambda_smooth,
+        herm_inv=cfg.herm_inv,
     )
 
 
@@ -325,6 +340,24 @@ def reconstruct(
         b, d, prob.geom, cfg, mask=mask, smooth_init=smooth_init,
         x_orig=x_orig,
     )
+    if cfg.tune != "off":
+        if plan is not None:
+            raise ValueError(
+                "plan does not combine with tune='auto'/'sweep': "
+                "resolve the knobs first (tune.autotune.resolve_solve) "
+                "and build the plan from the resolved config"
+            )
+        # startup-time knob resolution (tune/): cheap store lookup,
+        # guard verdicts cached in the store; the resolved config
+        # carries tune='off' so nothing below re-resolves
+        from ..tune import autotune, store as _tune_store
+
+        cfg, _ = autotune.resolve_solve(
+            cfg,
+            prob.geom,
+            b.shape[-prob.geom.ndim_spatial:],
+            workload=_tune_store.solve_workload(prob.geom),
+        )
     if plan is not None:
         if mesh is not None:
             raise ValueError(
@@ -347,8 +380,10 @@ def reconstruct(
             or plan.rho != _solve_rho(cfg, expect_fg)
             # every cfg field _plan_arrays consumed must match: rho
             # covers gamma_ratio/scale_rho_by_reduce, fg covers
-            # fft_pad/fft_impl, and the dirac gradient weight is baked
-            # into kern's diagonal when grad_reg_dirac is on
+            # fft_pad/fft_impl, the Gram-inverse method is baked into
+            # kern's W > 1 inner inverse, and the dirac gradient
+            # weight into kern's diagonal when grad_reg_dirac is on
+            or plan.herm_inv != cfg.herm_inv
             or (
                 prob.grad_reg_dirac
                 and plan.lambda_smooth != cfg.lambda_smooth
@@ -669,6 +704,22 @@ def _reconstruct_impl(
     theta1 = cfg.lambda_residual / gamma1
     theta2 = cfg.lambda_prior / gamma2
 
+    # storage dtype of the code-sized carry tensors (z and its
+    # sparsity dual — [n, K, *spatial] each): bf16 storage halves
+    # their HBM traffic per iteration; all math stays f32 (cast-up at
+    # the loop boundary, the learners' stored-iterate rounding
+    # contract — the compute target is float32, NOT b.dtype, so a
+    # reduced-precision observation never silently drags the loop
+    # math down with it). With the default f32 storage the casts are
+    # identity lambdas, so the compiled program is bit-exactly the
+    # historical one.
+    store_dt = jnp.dtype(cfg.storage_dtype)
+    if store_dt == jnp.float32:
+        to_store = to_compute = lambda x: x
+    else:
+        to_store = lambda x: x.astype(store_dt)
+        to_compute = lambda x: x.astype(jnp.float32)
+
     def data_prox(u):
         if prob.data_term == "gaussian":
             return proxes.masked_quadratic_prox(u, theta1, MtM, Mtb)
@@ -704,7 +755,9 @@ def _reconstruct_impl(
     z_shape = (n, K, *fg.spatial_shape)
 
     def body(state):
-        i, z, zhat, v1, d1, d2, obj_t, psnr_t, diff_t, _ = state
+        i, z_s, zhat, v1, d1, d2_s, obj_t, psnr_t, diff_t, _ = state
+        z = to_compute(z_s)
+        d2 = to_compute(d2_s)
         u1 = data_prox(v1 - d1)
         u2_raw = z - d2
         u2 = proxes.skip_channels(
@@ -728,8 +781,8 @@ def _reconstruct_impl(
         psnr_t = psnr_t.at[i + 1].set(psnr_of(zhat_new, v1_new))
         diff_t = diff_t.at[i + 1].set(diff)
         return (
-            i + 1, z_new, zhat_new, v1_new, d1, d2, obj_t, psnr_t,
-            diff_t, diff,
+            i + 1, to_store(z_new), zhat_new, v1_new, d1,
+            to_store(d2), obj_t, psnr_t, diff_t, diff,
         )
 
     def cond(state):
@@ -744,19 +797,20 @@ def _reconstruct_impl(
     diff_t = jnp.zeros(cfg.max_it + 1)
     state = (
         jnp.int32(0),
-        z0,
+        to_store(z0),
         zhat0,
         v10,
         jnp.zeros_like(v10),
-        jnp.zeros(z_shape, b.dtype),
+        to_store(jnp.zeros(z_shape, b.dtype)),
         obj_t,
         psnr_t,
         diff_t,
         jnp.float32(jnp.inf),
     )
-    i, z, zhat, *_ , obj_t, psnr_t, diff_t, _ = jax.lax.while_loop(
+    i, z_s, zhat, *_ , obj_t, psnr_t, diff_t, _ = jax.lax.while_loop(
         cond, body, state
     )
+    z = to_compute(z_s)
 
     Dz = Dz_real(zhat, dhat_clean) + smoothinit
     recon = fourier.crop_spatial(Dz, radius, data_spatial)

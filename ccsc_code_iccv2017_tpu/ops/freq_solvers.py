@@ -387,6 +387,7 @@ def precompute_z_kernel(
     rho: float,
     extra_diag: Optional[jnp.ndarray] = None,
     axis_name: Optional[str] = None,
+    herm_inv: Optional[str] = None,
 ) -> ZSolveKernel:
     """Build the per-frequency inverse factors for the z-solve.
 
@@ -397,6 +398,11 @@ def precompute_z_kernel(
     ``axis_name``: dhat holds only this device's K/nk filter shard;
     the k-reductions are psummed over that mesh axis, so the inner
     inverse factors come out replicated.
+
+    ``herm_inv``: explicit Gram-inverse method for the W > 1 inner
+    inverse (None keeps the CCSC_HERM_INV env / platform-aware
+    resolution) — the config-level pin SolveConfig.herm_inv plumbs
+    through so a serving plan carries the tuned method.
     """
     K, W, F = dhat.shape
     gamma = rho + (extra_diag if extra_diag is not None else 0.0)
@@ -415,7 +421,9 @@ def precompute_z_kernel(
         axis_name,
     )
     M = M + jnp.eye(W, dtype=M.dtype)
-    return ZSolveKernel(dhat, dinv, hermitian_inverse(M), None)
+    return ZSolveKernel(
+        dhat, dinv, hermitian_inverse(M, method=herm_inv), None
+    )
 
 
 def _pallas_interpret() -> bool:

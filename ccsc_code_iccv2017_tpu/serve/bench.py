@@ -24,7 +24,12 @@ CCSC_SERVE_SIZE_MAX (64), CCSC_SERVE_K (32), CCSC_SERVE_SUPPORT (7),
 CCSC_SERVE_SLOTS (4), CCSC_SERVE_MAXIT (20), CCSC_SERVE_WAIT_MS (5),
 CCSC_SERVE_HOMOG=1 (all requests at the bucket shape — bit-identical
 outputs, isolates batching from bucketing), CCSC_COMPILE_CACHE
-(persistent XLA cache for the engine warmup).
+(persistent XLA cache for the engine warmup), CCSC_SERVE_TUNE
+(off|auto|sweep — run a SECOND engine with tuned solve knobs
+[ServeConfig.tune] on the same stream and record
+tuned_requests_per_sec / speedup_tuned_vs_default / the resolved
+knob dict, the serving half of the autotune acceptance: tuned knobs
+must beat the f32/xla default at matching valid-region outputs).
 """
 from __future__ import annotations
 
@@ -112,32 +117,45 @@ def run_serve_workload() -> Dict:
     buckets = ((slots, (mid, mid)), (slots, (hi, hi)))
     if homog:
         buckets = ((slots, (hi, hi)),)
+
+    def run_engine(scfg):
+        """One engine over the whole stream: build (AOT warmup),
+        submit, drain, close. Shared by the default and tuned engines
+        so their timing/parity protocol cannot drift apart. Returns
+        (results, requests/sec, warmup_s, ready_wallclock, knob_dict).
+        """
+        t0 = time.perf_counter()
+        eng = CodecEngine(d, prob, cfg, scfg)
+        warmup_s = time.perf_counter() - t0
+        t_ready = time.time()
+        t0 = time.perf_counter()
+        futs = [eng.submit(**q) for q in reqs]
+        results = [f.result(timeout=600) for f in futs]
+        t_eng = time.perf_counter() - t0
+        knobs = dict(eng._knob_dict)
+        eng.close()
+        rate = len(reqs) / t_eng if t_eng > 0 else 0.0
+        return results, rate, warmup_s, t_ready, knobs
+
+    def max_rel_err(results):
+        # output parity on the valid region (engine pads to buckets;
+        # the loop solved exact shapes — boundary-tolerance equality)
+        worst = 0.0
+        for le, se in zip(loop_out, results):
+            scale = max(float(np.abs(le).max()), 1e-9)
+            worst = max(
+                worst, float(np.abs(se.recon - le).max()) / scale
+            )
+        return worst
+
     metrics_dir = tempfile.mkdtemp(prefix="ccsc_serve_bench_")
     scfg = ServeConfig(
         buckets=buckets, max_wait_ms=wait_ms, metrics_dir=metrics_dir,
         verbose="none",
         compile_cache=os.environ.get("CCSC_COMPILE_CACHE") or None,
     )
-    t0 = time.perf_counter()
-    eng = CodecEngine(d, prob, cfg, scfg)
-    t_warmup = time.perf_counter() - t0
-    t_ready = time.time()
-
-    # steady-state throughput: submit the whole stream, wait for all
-    t0 = time.perf_counter()
-    futs = [eng.submit(**q) for q in reqs]
-    eng_res = [f.result(timeout=600) for f in futs]
-    t_eng = time.perf_counter() - t0
-    eng.close()
-
-    # output parity on the valid region (engine pads to buckets; the
-    # loop solved exact shapes — boundary-tolerance equality)
-    max_rel = 0.0
-    for q, le, se in zip(reqs, loop_out, eng_res):
-        scale = max(float(np.abs(le).max()), 1e-9)
-        max_rel = max(
-            max_rel, float(np.abs(se.recon - le).max()) / scale
-        )
+    eng_res, eng_rps, t_warmup, t_ready, _ = run_engine(scfg)
+    max_rel = max_rel_err(eng_res)
 
     # zero-recompile assertion from the obs event stream: no backend
     # compile may land after the engine reported ready
@@ -161,13 +179,40 @@ def run_serve_workload() -> Dict:
         "persistent_cache_hits"
     )
 
-    eng_rps = n_req / t_eng if t_eng > 0 else 0.0
     loop_rps = n_req / t_loop if t_loop > 0 else 0.0
     occ = (
         sum(e["occupancy"] for e in dispatches) / len(dispatches)
         if dispatches
         else 0.0
     )
+
+    # ---- the TUNED engine on the same stream (CCSC_SERVE_TUNE):
+    # same buckets, same requests; only ServeConfig.tune differs —
+    # 'sweep' measures the solve arms on THIS chip first, 'auto'
+    # applies a pre-existing store entry. The record carries both
+    # rates so the default-vs-tuned gap is the measured number.
+    tune_mode = os.environ.get("CCSC_SERVE_TUNE", "off")
+    tuned_fields = {}
+    if tune_mode != "off":
+        metrics2 = tempfile.mkdtemp(prefix="ccsc_serve_tuned_")
+        scfg2 = ServeConfig(
+            buckets=buckets, max_wait_ms=wait_ms,
+            metrics_dir=metrics2, verbose="none",
+            compile_cache=os.environ.get("CCSC_COMPILE_CACHE") or None,
+            tune=tune_mode,
+        )
+        res2, rps2, t_warm2, _, knobs2 = run_engine(scfg2)
+        max_rel2 = max_rel_err(res2)
+        tuned_fields = {
+            "tuned_requests_per_sec": round(rps2, 4),
+            "speedup_tuned_vs_default": round(
+                rps2 / eng_rps if eng_rps else 0.0, 3
+            ),
+            "tuned_warmup_s": round(t_warm2, 3),
+            "tuned_knobs": knobs2,
+            "tuned_max_rel_err_vs_loop": round(max_rel2, 6),
+            "tuned_event_stream": metrics2,
+        }
     return {
         "serve": True,
         "platform": jax.devices()[0].platform,
@@ -205,5 +250,7 @@ def run_serve_workload() -> Dict:
             "max_wait_ms": wait_ms,
             "homog": homog,
             "compile_cache": scfg.compile_cache,
+            "tune": tune_mode,
         },
+        **tuned_fields,
     }

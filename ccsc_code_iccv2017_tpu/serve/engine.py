@@ -208,6 +208,42 @@ class CodecEngine:
         )
 
         try:
+            if serve_cfg.tune != "off":
+                # startup knob resolution (tune/): one pinned config
+                # serves every bucket, so the shape key is the LARGEST
+                # bucket (the engine's dominant program); the numerics
+                # guard runs before an arm first configures this chip,
+                # a failing arm is demoted and the next-best applied.
+                # tune='off' (default) keeps the given config verbatim
+                # — served results stay bit-identical to direct
+                # reconstruct() calls.
+                from ..tune import autotune, store as tune_store
+
+                cfg, self._tune_picked = autotune.resolve_solve(
+                    # the serving engine's tune switch lives on
+                    # ServeConfig; the pinned SolveConfig rides with
+                    # tune='off' so direct reconstruct() callers of the
+                    # same config never re-resolve
+                    dataclasses.replace(cfg, tune=serve_cfg.tune),
+                    geom,
+                    serve_cfg.buckets[-1][1],
+                    workload=tune_store.solve_workload(geom),
+                    store=tune_store.TunedStore(serve_cfg.tune_store),
+                    emit=self._run.event,
+                )
+                self.cfg = cfg
+            else:
+                self._tune_picked = None
+            # the resolved knob dict every request is served under —
+            # recorded per bucket warmup so the stream says which arm
+            # produced which program (obs_report SERVING section)
+            from ..tune.space import arm_knob_dict
+
+            self._knob_dict = dict(
+                arm_knob_dict(cfg, "solve"),
+                tune=serve_cfg.tune,
+                tuned=self._tune_picked is not None,
+            )
             self._build(d, prob, cfg, serve_cfg, blur_psf)
         except BaseException:
             # a failed construction (bad blur rank, OOM compiling an
@@ -269,6 +305,11 @@ class CodecEngine:
                 bucket=_bucket_name(slots, spatial),
                 aot=bool(serve_cfg.aot_warmup),
                 warmup_s=round(time.perf_counter() - t0, 4),
+                # the resolved knob dict, not just the bucket shape:
+                # the stream must say which arm this program serves
+                # under (a tuned engine and a default engine emit
+                # otherwise-identical warmup events)
+                knobs=self._knob_dict,
             )
         mon = self._run.compile_monitor
         self._run.event(
@@ -276,6 +317,7 @@ class CodecEngine:
             n_buckets=len(self._buckets),
             warmup_s=round(time.perf_counter() - t_warm0, 4),
             persistent_cache_hits=mon.cache_hits if mon else None,
+            knobs=self._knob_dict,
         )
         self._run.console(
             f"serve: {len(self._buckets)} bucket(s) ready in "

@@ -1,0 +1,30 @@
+"""On-chip knob autotuning: measure, persist, auto-apply.
+
+The measured record (BENCH_r05.json) shows the default f32/xla knobs
+leave ~46x on the table on the v5e while the fastest arm (bf16 storage
++ matmul-DFT + fused_z + Schur Hermitian inverse) is equality- or
+float-tolerance-tested — a pure execution choice. This package turns
+that bench-only artifact into the default fast path:
+
+- :mod:`.space` — the declared candidate knob space (every perf knob
+  of LearnConfig/SolveConfig, drift-guarded by test so new knobs
+  cannot silently escape tuning) and arm application.
+- :mod:`.store` — the tuned-knob store: winners persisted as JSON
+  keyed by (chip, workload shape-bucket, code-fingerprint), next to
+  the persistent XLA compile cache when one is configured. Cross-chip
+  application is refused — a record measured on a v5e (or a DEGRADED
+  CPU fallback) never configures a different chip.
+- :mod:`.autotune` — the resolver (``tune="auto"``: look up the
+  ranked arms for this chip+shape, numerics-guard the winner against
+  the f32 reference, demote a failing arm and take the next best) and
+  the sweep (``tune="sweep"`` / scripts/autotune.py: time the arms on
+  the actual chip and persist the ranking).
+
+Entry points: LearnConfig/SolveConfig/ServeConfig ``tune`` fields and
+the shared ``--tune off|auto|sweep`` CLI flag (apps._dispatch);
+``scripts/autotune.py`` for explicit sweeps, store seeding from
+on-chip bench records, and the chip-free ``--dry-run`` arm-space
+validation.
+"""
+from .autotune import resolve_learn, resolve_solve  # noqa: F401
+from .store import TunedStore, default_store_path  # noqa: F401

@@ -1,0 +1,670 @@
+"""The autotune resolver, numerics guard, and sweep.
+
+``tune="auto"`` (LearnConfig / SolveConfig / ServeConfig): at startup,
+look up the ranked measured arms for (this chip, this workload's
+shape bucket) in the tuned store and apply the fastest one — behind a
+**numerics guard**: before an arm first configures a run on this
+chip, a short trajectory-parity check against the all-defaults f32
+reference must pass within the float tolerance (the accuracy-gate
+bound of scripts/pick_tuned.py, CCSC_TUNE_GUARD_TOL). A failing arm
+is **demoted** in the store (persisted — it will not be retried) and
+the next-best arm is tried; guard verdicts are cached in the store so
+steady-state startups pay one store read, not one guard solve.
+
+``tune="sweep"``: time the candidate arms (space.default_arms) on the
+actual chip at the actual shape bucket, persist the ranking, then
+resolve as above. The timer is injectable for deterministic tests.
+
+``tune="off"`` (the default, and the only mode pytest ever sees):
+nothing here runs; configs execute exactly as written.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from . import space, store as store_mod
+
+
+def chip_now() -> str:
+    """The chip identity every store key uses: CCSC_TUNE_CHIP override
+    (tests / operators pinning a key) > perfmodel.detect_chip()."""
+    env = os.environ.get("CCSC_TUNE_CHIP")
+    if env:
+        return env
+    from ..utils import perfmodel
+
+    return perfmodel.detect_chip()
+
+
+def guard_tol() -> float:
+    """Numerics-guard tolerance: max relative objective-trajectory
+    deviation vs the f32 reference. Default matches the on-chip
+    accuracy gate (pick_tuned.ACC_BOUND): the tuned default must stay
+    in the documented 'small perturbation' accuracy class."""
+    env = os.environ.get("CCSC_TUNE_GUARD_TOL")
+    return float(env) if env else 0.01
+
+
+def _guard_enabled() -> bool:
+    return os.environ.get("CCSC_TUNE_GUARD", "").strip() != "0"
+
+
+def _default_emit(type_: str, **fields) -> None:
+    from ..utils import obs
+
+    run = obs.current_run()
+    if run is not None:
+        run.event(type_, **fields)
+
+
+# ---------------------------------------------------------------------
+# numerics guard: short trajectory parity vs the f32 reference
+# ---------------------------------------------------------------------
+
+def _trajectory_dev(ref, got) -> float:
+    import numpy as np
+
+    ref = np.asarray(ref, np.float64)
+    got = np.asarray(got, np.float64)
+    n = min(ref.shape[0], got.shape[0])
+    if n == 0:
+        return float("inf")
+    ref, got = ref[:n], got[:n]
+    if not (np.all(np.isfinite(ref)) and np.all(np.isfinite(got))):
+        return float("inf")
+    scale = np.maximum(np.abs(ref), 1e-12)
+    return float(np.max(np.abs(got - ref) / scale))
+
+
+def guard_learn(
+    arm: Dict[str, object], tol: Optional[float] = None,
+    workload: str = "consensus2d",
+) -> Tuple[bool, float]:
+    """Trajectory-parity check of a learner arm: a tiny synthetic
+    consensus (or masked) learn, arm knobs vs all-default knobs, same
+    data and seed; pass iff the objective trajectories agree to
+    ``tol`` max relative deviation and stay finite. The tiny problem
+    is a numerics proxy, not a speed probe — it exists to catch an
+    arm whose reduced-precision path diverges ON THIS CHIP before it
+    configures a day-long run."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import LearnConfig, ProblemGeom
+
+    tol = guard_tol() if tol is None else tol
+    masked = workload.startswith("masked")
+    geom = ProblemGeom((5, 5), 4)
+    base = LearnConfig(
+        max_it=3, max_it_d=2, max_it_z=3, num_blocks=1 if masked else 2,
+        tol=0.0, verbose="none", track_objective=True,
+        rho_d=50.0, rho_z=1.0,
+    )
+    armed, env_updates, _ = space.apply_arm(base, arm, "learn", workload)
+    b = jax.random.normal(
+        jax.random.PRNGKey(7), (4, 16, 16), jnp.float32
+    )
+    key = jax.random.PRNGKey(3)
+
+    def run(cfg, env):
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            if masked:
+                from ..models.learn_masked import learn_masked
+
+                res = learn_masked(b, geom, cfg, key=key)
+            else:
+                from ..models.learn import learn
+
+                res = learn(b, geom, cfg, key=key)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        # the learners' reference-protocol trace: both objective series
+        return list(res.trace["obj_vals_d"]) + list(
+            res.trace["obj_vals_z"]
+        )
+
+    try:
+        ref = run(base, {})
+        got = run(armed, env_updates)
+    except Exception:
+        return False, float("inf")  # an arm that crashes is demoted
+    dev = _trajectory_dev(ref, got)
+    return dev <= tol, dev
+
+
+def guard_solve(
+    arm: Dict[str, object], tol: Optional[float] = None,
+    workload: str = "solve2d",
+) -> Tuple[bool, float]:
+    """Trajectory-parity check of a reconstruction arm: a tiny masked
+    inpainting solve, arm vs defaults, compared on the objective
+    trajectory AND the reconstruction itself."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..config import ProblemGeom, SolveConfig
+    from ..models.reconstruct import ReconstructionProblem, reconstruct
+
+    tol = guard_tol() if tol is None else tol
+    r = np.random.default_rng(11)
+    d = r.normal(size=(4, 5, 5)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    geom = ProblemGeom((5, 5), 4)
+    prob = ReconstructionProblem(geom)
+    base = SolveConfig(
+        max_it=5, tol=0.0, verbose="none", track_objective=True,
+        lambda_prior=0.3,
+    )
+    armed, _, _ = space.apply_arm(base, arm, "solve", workload)
+    x = r.random((4, 16, 16)).astype(np.float32)
+    m = (r.random((4, 16, 16)) < 0.6).astype(np.float32)
+    try:
+        ref = reconstruct(
+            jnp.asarray(x * m), jnp.asarray(d), prob, base,
+            mask=jnp.asarray(m),
+        )
+        got = reconstruct(
+            jnp.asarray(x * m), jnp.asarray(d), prob, armed,
+            mask=jnp.asarray(m),
+        )
+    except Exception:
+        return False, float("inf")
+    dev = _trajectory_dev(ref.trace.obj_vals, got.trace.obj_vals)
+    rec_ref = np.asarray(ref.recon)
+    rec_got = np.asarray(got.recon)
+    if not np.all(np.isfinite(rec_got)):
+        return False, float("inf")
+    scale = max(float(np.abs(rec_ref).max()), 1e-9)
+    dev = max(dev, float(np.abs(rec_got - rec_ref).max()) / scale)
+    return dev <= tol, dev
+
+
+_GUARDS = {"learn": guard_learn, "solve": guard_solve}
+
+
+# ---------------------------------------------------------------------
+# resolution
+# ---------------------------------------------------------------------
+
+def _resolve(
+    kind: str,
+    cfg,
+    shape_key: str,
+    workload: str,
+    chip: Optional[str],
+    store: Optional[store_mod.TunedStore],
+    emit: Optional[Callable],
+    guard,
+    guard_tol_override: Optional[float] = None,
+):
+    """Core auto-resolution. ``guard``: None = the real numerics guard
+    (skipped for fully trajectory-exact arms and when CCSC_TUNE_GUARD=0);
+    False = skip; callable(kind, arm, tol) -> (ok, dev) = injected.
+    Returns (cfg, picked_entry_or_None, env_updates)."""
+    emit = emit or _default_emit
+    chip = chip or chip_now()
+    store = store or store_mod.TunedStore()
+    tol = guard_tol() if guard_tol_override is None else \
+        guard_tol_override
+    cands = store.candidates(chip, kind, shape_key)
+    if not cands:
+        others = store.chips_with_entries(kind, shape_key)
+        reason = (
+            f"cross-chip refusal: tuned entries exist for chip(s) "
+            f"{'/'.join(others)} but this run is on {chip}"
+            if others
+            else "no tuned entry for this chip/shape"
+        )
+        emit(
+            "tune_pick", kind=kind, chip=chip, shape_key=shape_key,
+            arm=None, reason=reason,
+        )
+        if others:
+            from ..utils import obs
+
+            obs.console(
+                f"tune: {reason} — running the untuned defaults "
+                "(measure this chip with scripts/autotune.py or "
+                "tune='sweep')",
+                tier="always",
+            )
+        return cfg, None, {}
+
+    knob_table = space.knobs(kind)
+    for entry in cands:
+        arm = entry["arm"]
+        new_cfg, env_updates, dropped = space.apply_arm(
+            cfg, arm, kind, workload
+        )
+        if arm and len(dropped) == len(arm):
+            # nothing of this arm applies to THIS workload (e.g. a
+            # consensus-measured fused_z-only arm resolved for a
+            # streaming run): applying a no-op would shadow an entry
+            # that actually transfers
+            continue
+        all_exact = all(
+            knob_table[n].exact for n in arm if n in knob_table
+        )
+        cached = entry.get("guard")
+        need_guard = (
+            guard is not False
+            and _guard_enabled()
+            and not all_exact
+            and not (
+                cached and cached.get("ok") and cached.get("tol", 0.0)
+                <= tol
+            )
+        )
+        if need_guard:
+            gfn = guard or (lambda k, a, t: _GUARDS[k](a, t, workload))
+            ok, dev = gfn(kind, arm, tol)
+            store.mark_guard(chip, kind, shape_key, arm, ok, dev, tol)
+            emit(
+                "tune_guard", kind=kind, chip=chip,
+                shape_key=shape_key, arm=arm, ok=bool(ok),
+                dev=None if dev != dev or dev == float("inf")
+                else round(dev, 8),
+                tol=tol,
+            )
+            if not ok:
+                store.demote(
+                    chip, kind, shape_key, arm,
+                    reason=f"numerics guard: dev {dev:.3g} > tol {tol:g}",
+                )
+                _safe_save(store)
+                from ..utils import obs
+
+                obs.console(
+                    f"tune: demoting arm [{space.arm_label(arm)}] — "
+                    f"trajectory deviation {dev:.3g} exceeds the "
+                    f"{tol:g} guard tolerance on {chip}; trying the "
+                    "next-best arm",
+                    tier="always",
+                )
+                continue
+            _safe_save(store)
+        emit(
+            "tune_pick", kind=kind, chip=chip, shape_key=shape_key,
+            arm=arm, value=entry.get("value"),
+            unit=entry.get("unit"), source=entry.get("source"),
+            dropped=[list(d) for d in dropped] or None,
+        )
+        from ..utils import obs
+
+        obs.console(
+            f"tune: applying [{space.arm_label(arm)}] "
+            f"({entry.get('value')} {entry.get('unit')}, "
+            f"{entry.get('source')}) for {chip} {shape_key}",
+            tier="brief",
+        )
+        return new_cfg, entry, env_updates
+    emit(
+        "tune_pick", kind=kind, chip=chip, shape_key=shape_key,
+        arm=None, reason="every candidate arm was demoted",
+    )
+    return cfg, None, {}
+
+
+def resolve_learn(
+    cfg,
+    geom,
+    data_shape,
+    workload: str = "consensus2d",
+    chip: Optional[str] = None,
+    store: Optional[store_mod.TunedStore] = None,
+    emit: Optional[Callable] = None,
+    guard=None,
+    apply_env: bool = True,
+):
+    """Resolve a LearnConfig under its ``tune`` mode (no-op for
+    'off'). ``data_shape`` is the full data batch shape [n, ...].
+    Returns (cfg_with_tune_consumed, picked_entry_or_None). When
+    ``apply_env``, the arm's env knobs (CCSC_HERM_INV) are set in
+    os.environ — startup-time resolution only, never mid-learn."""
+    if cfg.tune == "off":
+        return cfg, None
+    store = store or store_mod.TunedStore()
+    n = int(data_shape[0])
+    spatial = tuple(
+        int(s) for s in data_shape[1 + geom.ndim_reduce:]
+    )
+    key = store_mod.learn_shape_key(
+        workload,
+        k=geom.num_filters,
+        support=geom.spatial_support,
+        n=n,
+        size=spatial,
+        blocks=cfg.num_blocks,
+    )
+    if cfg.tune == "sweep":
+        sweep_learn(
+            cfg, geom, data_shape, workload=workload, chip=chip,
+            store=store, emit=emit,
+        )
+    new_cfg, picked, env_updates = _resolve(
+        "learn", cfg, key, workload, chip, store, emit, guard
+    )
+    if apply_env:
+        os.environ.update(env_updates)
+    # tune consumed: the resolved config must not re-resolve downstream
+    return dataclasses.replace(new_cfg, tune="off"), picked
+
+
+def resolve_solve(
+    cfg,
+    geom,
+    spatial,
+    workload: str = "solve2d",
+    chip: Optional[str] = None,
+    store: Optional[store_mod.TunedStore] = None,
+    emit: Optional[Callable] = None,
+    guard=None,
+):
+    """Resolve a SolveConfig under its ``tune`` mode (no-op for
+    'off'). ``spatial`` is the observation spatial shape (a serving
+    engine passes its largest bucket). Returns (cfg, picked)."""
+    if cfg.tune == "off":
+        return cfg, None
+    store = store or store_mod.TunedStore()
+    key = store_mod.solve_shape_key(
+        workload,
+        k=geom.num_filters,
+        support=geom.spatial_support,
+        spatial=tuple(int(s) for s in spatial),
+    )
+    if cfg.tune == "sweep":
+        sweep_solve(
+            cfg, geom, spatial, workload=workload, chip=chip,
+            store=store, emit=emit,
+        )
+    new_cfg, picked, _ = _resolve(
+        "solve", cfg, key, workload, chip, store, emit, guard
+    )
+    return dataclasses.replace(new_cfg, tune="off"), picked
+
+
+def _safe_save(store) -> None:
+    try:
+        store.save()
+    except OSError:  # read-only deploys still resolve, just uncached
+        pass
+
+
+# ---------------------------------------------------------------------
+# sweep: time the arms on the actual chip, persist the ranking
+# ---------------------------------------------------------------------
+
+def _time_learn_arm(cfg, geom, data_shape, iters: int = 2) -> float:
+    """iters/sec of one arm'd LearnConfig on synthetic data at the
+    run's shape (device-resident, fenced by a scalar readback — the
+    bench.py protocol at sweep scale). Routes through the SAME outer
+    step the real learner driver would pick for this config: an
+    outer_chunk/donate_state arm is timed on the chunked
+    (scan + donation) program, not the per-step one — otherwise those
+    knobs would be recorded with measurements that never exercised
+    them."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import common, learn as learn_mod
+    from ..parallel import consensus
+
+    n = int(data_shape[0])
+    spatial = tuple(int(s) for s in data_shape[1 + geom.ndim_reduce:])
+    blocks = cfg.num_blocks
+    ni = n // blocks
+    fg = common.FreqGeom.create(
+        geom, spatial, fft_pad=cfg.fft_pad, fft_impl=cfg.fft_impl
+    )
+    cfg = dataclasses.replace(
+        cfg, max_it=iters, tol=0.0, verbose="none",
+        track_objective=False, metrics_dir=None, watchdog=False,
+        tune="off",  # the timed workload must never re-resolve
+    )
+    b = jax.random.normal(
+        jax.random.PRNGKey(1), (blocks, ni, *geom.reduce_shape,
+                                *spatial), jnp.float32
+    )
+
+    def fresh_state():
+        return learn_mod.init_state(
+            key=jax.random.PRNGKey(0), geom=geom, fg=fg,
+            num_blocks=blocks, ni=ni,
+            z_dtype=jnp.dtype(cfg.storage_dtype),
+            d_dtype=jnp.dtype(cfg.d_storage_dtype),
+        )
+
+    if cfg.chunked_driver:
+        chunk = max(1, cfg.outer_chunk)
+        chunk_step = consensus.make_outer_chunk_step(
+            geom, cfg, fg, chunk, mesh=None, donate=cfg.donate_state
+        )
+
+        def step(state, data):
+            st, tr = chunk_step(state, data)
+            return st, tr.metrics.d_diff[-1]
+
+        iters_per_call = chunk
+    else:
+        per_step = consensus.make_outer_step(geom, cfg, fg, mesh=None)
+
+        def step(state, data):
+            st, m = per_step(state, data)
+            return st, m.d_diff
+
+        iters_per_call = 1
+
+    s1, fence0 = step(fresh_state(), b)
+    float(fence0)  # compile + warmup fence
+    # best-of-3: the minimum time is the least-noise estimate of the
+    # program's speed (standard bench practice — a noise-slow sample
+    # must not demote a genuinely faster arm, nor a noise-fast sample
+    # crown an identical program)
+    best = float("inf")
+    cur = s1
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            cur, fence = step(cur, b)
+        float(fence)
+        best = min(best, time.perf_counter() - t0)
+    return (iters * iters_per_call) / max(best, 1e-9)
+
+
+def _time_solve_arm(cfg, geom, spatial, d, reps: int = 2) -> float:
+    """Solves/sec of one arm'd SolveConfig on a synthetic masked
+    observation at the bucket shape."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.reconstruct import ReconstructionProblem, reconstruct
+
+    cfg = dataclasses.replace(
+        cfg, verbose="none", track_objective=False, track_psnr=False,
+        metrics_dir=None, tol=0.0,
+        tune="off",  # the timed workload must never re-resolve
+    )
+    prob = ReconstructionProblem(geom)
+    r = np.random.default_rng(5)
+    x = jnp.asarray(
+        r.random((1, *geom.reduce_shape, *spatial)).astype(np.float32)
+    )
+    m = jnp.asarray(
+        (r.random(x.shape) < 0.6).astype(np.float32)
+    )
+    res = reconstruct(x * m, d, prob, cfg, mask=m)
+    int(res.trace.num_iters)  # compile fence
+    best = float("inf")
+    for _ in range(3):  # best-of-3 (see _time_learn_arm)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            res = reconstruct(x * m, d, prob, cfg, mask=m)
+            int(res.trace.num_iters)
+        best = min(best, time.perf_counter() - t0)
+    return reps / max(best, 1e-9)
+
+
+def sweep_learn(
+    cfg,
+    geom,
+    data_shape,
+    workload: str = "consensus2d",
+    chip: Optional[str] = None,
+    store: Optional[store_mod.TunedStore] = None,
+    emit: Optional[Callable] = None,
+    arms=None,
+    timer: Optional[Callable] = None,
+    iters: int = 2,
+) -> store_mod.TunedStore:
+    """Time the candidate arms at this run's shape and persist the
+    ranking. ``timer(armed_cfg, arm)`` -> rate is injectable (the
+    deterministic-test hook); the default runs the real device
+    workload. Arms that fail to run record nothing (a knob the
+    backend cannot execute simply never wins)."""
+    emit = emit or _default_emit
+    chip = chip or chip_now()
+    store = store or store_mod.TunedStore()
+    n = int(data_shape[0])
+    spatial = tuple(int(s) for s in data_shape[1 + geom.ndim_reduce:])
+    key = store_mod.learn_shape_key(
+        workload, k=geom.num_filters, support=geom.spatial_support,
+        n=n, size=spatial, blocks=cfg.num_blocks,
+    )
+    timer = timer or (
+        lambda armed, arm: _time_learn_arm(
+            armed, geom, data_shape, iters=iters
+        )
+    )
+    for arm in (arms if arms is not None
+                else space.default_arms("learn", workload)):
+        armed, env_updates, dropped = space.apply_arm(
+            cfg, arm, "learn", workload
+        )
+        if dropped and len(dropped) == len(arm):
+            continue  # nothing of this arm applies here
+        old = {k: os.environ.get(k) for k in env_updates}
+        os.environ.update(env_updates)
+        try:
+            rate = float(timer(armed, arm))
+        except Exception as e:
+            emit(
+                "tune_arm", kind="learn", chip=chip, shape_key=key,
+                arm=arm, error=str(e)[:200],
+            )
+            continue
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        store.add(
+            chip, "learn", key, arm, rate, "outer_iters/sec",
+            source="sweep",
+        )
+        emit(
+            "tune_arm", kind="learn", chip=chip, shape_key=key,
+            arm=arm, value=round(rate, 5), unit="outer_iters/sec",
+        )
+    _drop_losers(store, chip, "learn", key)
+    _safe_save(store)
+    return store
+
+
+def sweep_solve(
+    cfg,
+    geom,
+    spatial,
+    workload: str = "solve2d",
+    chip: Optional[str] = None,
+    store: Optional[store_mod.TunedStore] = None,
+    emit: Optional[Callable] = None,
+    arms=None,
+    timer: Optional[Callable] = None,
+    d=None,
+    reps: int = 2,
+) -> store_mod.TunedStore:
+    """Solve-side sweep at one bucket shape (see sweep_learn)."""
+    emit = emit or _default_emit
+    chip = chip or chip_now()
+    store = store or store_mod.TunedStore()
+    spatial = tuple(int(s) for s in spatial)
+    key = store_mod.solve_shape_key(
+        workload, k=geom.num_filters, support=geom.spatial_support,
+        spatial=spatial,
+    )
+    if timer is None and d is None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        r = np.random.default_rng(2)
+        dd = r.normal(
+            size=(geom.num_filters, *geom.reduce_shape,
+                  *geom.spatial_support)
+        ).astype(np.float32)
+        dd /= np.sqrt(
+            (dd**2).sum(axis=tuple(range(1, dd.ndim)), keepdims=True)
+        )
+        d = jnp.asarray(dd)
+    timer = timer or (
+        lambda armed, arm: _time_solve_arm(
+            armed, geom, spatial, d, reps=reps
+        )
+    )
+    for arm in (arms if arms is not None
+                else space.default_arms("solve", workload)):
+        armed, _, dropped = space.apply_arm(cfg, arm, "solve", workload)
+        if dropped and len(dropped) == len(arm):
+            continue
+        try:
+            rate = float(timer(armed, arm))
+        except Exception as e:
+            emit(
+                "tune_arm", kind="solve", chip=chip, shape_key=key,
+                arm=arm, error=str(e)[:200],
+            )
+            continue
+        store.add(
+            chip, "solve", key, arm, rate, "solves/sec", source="sweep"
+        )
+        emit(
+            "tune_arm", kind="solve", chip=chip, shape_key=key,
+            arm=arm, value=round(rate, 5), unit="solves/sec",
+        )
+    _drop_losers(store, chip, "solve", key)
+    _safe_save(store)
+    return store
+
+
+def _drop_losers(store, chip, kind, shape_key) -> None:
+    """After a sweep, arms that do not beat the measured baseline by a
+    noise margin cannot win (the resolver takes the fastest candidate;
+    entries slower than — or statistically indistinguishable from —
+    'do nothing' would only add guard cost and noise-ranked winners;
+    falling back past the baseline should mean falling back to the
+    DEFAULTS, which need no entry). Margin: CCSC_TUNE_MIN_WIN
+    (default 2%)."""
+    margin = 1.0 + float(os.environ.get("CCSC_TUNE_MIN_WIN", "0.02"))
+    cands = store.candidates(chip, kind, shape_key)
+    base = next(
+        (e for e in cands if not e["arm"] and e.get("source") == "sweep"),
+        None,
+    )
+    if base is None:
+        return
+    for e in cands:
+        if e["arm"] and e["value"] <= base["value"] * margin:
+            store.demote(
+                chip, kind, shape_key, e["arm"],
+                reason="sweep: did not beat the baseline by the "
+                "noise margin",
+            )

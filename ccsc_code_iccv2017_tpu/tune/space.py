@@ -1,0 +1,322 @@
+"""The declared candidate knob space of the autotuner.
+
+One place answers three questions that previously lived in three
+ad-hoc spots (bench.py env vars, scripts/pick_tuned.py DEFAULTS,
+scripts/onchip_arms*.txt):
+
+1. WHICH config fields are performance knobs — execution-strategy
+   levers whose every value solves the same problem (to equality or
+   documented float tolerance) — versus algorithmic parameters that
+   change the problem (lambda, rho, max_it). Every LearnConfig /
+   SolveConfig field must be classified here; the drift-guard unit
+   test (tests/test_autotune.py) fails on an unclassified field, so a
+   new knob cannot silently escape tuning.
+2. WHAT candidate values each knob takes, and which workloads it
+   applies to (fused_z engages only in the 2D W==1 consensus
+   learners; carry_freq only in the masked learner).
+3. HOW an arm (a dict of non-default knob values) is applied to a
+   config — dataclasses.replace for config-field knobs, an env update
+   for the trace-time env knobs (the learners' Gram-inverse method,
+   CCSC_HERM_INV), with inapplicable knobs dropped by workload
+   instead of crashing the run.
+
+This module must stay importable WITHOUT jax (scripts/autotune.py
+--dry-run validates the space on chip-less CI hosts).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, List, Optional, Tuple
+
+# Bump when the meaning of a knob or the application mechanics change
+# incompatibly: the code fingerprint below keys every store entry, so
+# old entries stop matching instead of silently configuring new code.
+SPACE_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One tunable execution-strategy lever.
+
+    ``field``: True when the knob is a config dataclass field
+    (applied via dataclasses.replace); False for trace-time env knobs
+    (``env`` names the variable). ``workloads``: None = applies to
+    every workload of its kind; else workload-token PREFIXES it may be
+    applied to (see store.learn_shape_key — 'masked' matches
+    'masked2d' and 'masked2d+r1'). ``exact``: True when every value is
+    trajectory-exact (pure execution change — the numerics guard can
+    be skipped for arms that only move exact knobs)."""
+
+    values: Tuple
+    field: bool = True
+    env: Optional[str] = None
+    workloads: Optional[Tuple[str, ...]] = None
+    exact: bool = False
+
+    def applies_to(self, workload: str) -> bool:
+        if self.workloads is None or not workload:
+            return True
+        return any(workload.startswith(w) for w in self.workloads)
+
+
+# ---- LearnConfig ----------------------------------------------------
+LEARN_KNOBS: Dict[str, Knob] = {
+    "storage_dtype": Knob(("float32", "bfloat16")),
+    "d_storage_dtype": Knob(("float32", "bfloat16")),
+    "fft_impl": Knob(("xla", "matmul", "matmul_high", "matmul_bf16")),
+    "fused_z": Knob((False, True), workloads=("consensus2d",)),
+    "fused_z_precision": Knob(
+        ("highest", "high", "default"), workloads=("consensus2d",)
+    ),
+    "fft_pad": Knob(("none", "pow2", "fast")),
+    "outer_chunk": Knob((1, 4), exact=True),
+    # streaming rejects donation (no whole-state jitted step)
+    "donate_state": Knob((False, True), exact=True,
+                         workloads=("consensus", "masked")),
+    "carry_freq": Knob((False, True), workloads=("masked",)),
+    # the learners resolve the Gram-inverse method from CCSC_HERM_INV
+    # at trace time (ops.freq_solvers.resolve_herm_method) — an env
+    # knob, not a LearnConfig field
+    "herm_inv": Knob(("cholesky", "schur", "newton"), field=False,
+                     env="CCSC_HERM_INV"),
+}
+
+# Non-tuned LearnConfig fields, by reason. Algorithmic: changes the
+# optimization problem or its trajectory semantics. Operational:
+# telemetry/resilience switches orthogonal to execution speed.
+# Deprecated: kept for config compat, no longer routes anywhere.
+NON_TUNED_LEARN: Dict[str, str] = {
+    "lambda_residual": "algorithmic",
+    "lambda_prior": "algorithmic",
+    "max_it": "algorithmic",
+    "tol": "algorithmic",
+    "max_it_d": "algorithmic",
+    "max_it_z": "algorithmic",
+    "rho_d": "algorithmic",
+    "rho_z": "algorithmic",
+    "num_blocks": "algorithmic (consensus structure)",
+    "dtype": "algorithmic (compute precision contract)",
+    "verbose": "operational",
+    "track_objective": "operational",
+    "compat_coding": "algorithmic (reference-compat semantics)",
+    "use_pallas": "deprecated no-op (r5 demotion)",
+    "max_recoveries": "operational",
+    "rho_backoff": "operational",
+    "metrics_dir": "operational",
+    "watchdog": "operational",
+    "watchdog_slack": "operational",
+    "tune": "operational (the autotuner's own switch)",
+}
+
+# ---- SolveConfig ----------------------------------------------------
+SOLVE_KNOBS: Dict[str, Knob] = {
+    "storage_dtype": Knob(("float32", "bfloat16")),
+    "fft_impl": Knob(("xla", "matmul", "matmul_high", "matmul_bf16")),
+    "fft_pad": Knob(("none", "pow2", "fast")),
+    # SolveConfig carries the method explicitly (plumbed through
+    # ReconPlan/precompute_z_kernel) so a serving engine can pin it
+    # per-config instead of per-process env; None = the library's
+    # platform/size-aware default. Only W > 1 problems (a reduce
+    # axis: demosaic/view-synth) have a matrix inner inverse — at
+    # W == 1 the knob is a no-op and timing it only invites a
+    # noise-ranked 'winner'.
+    "herm_inv": Knob(
+        (None, "cholesky", "schur", "newton"),
+        workloads=("solve2d+r", "solve3d+r", "solve4d+r"),
+    ),
+}
+
+NON_TUNED_SOLVE: Dict[str, str] = {
+    "lambda_residual": "algorithmic",
+    "lambda_prior": "algorithmic",
+    "max_it": "algorithmic",
+    "tol": "algorithmic",
+    "gamma_factor": "algorithmic",
+    "gamma_ratio": "algorithmic",
+    "scale_rho_by_reduce": "algorithmic (reference-compat semantics)",
+    "lambda_smooth": "algorithmic",
+    "dtype": "algorithmic (compute precision contract)",
+    "verbose": "operational",
+    "track_objective": "operational",
+    "track_psnr": "operational",
+    "use_pallas": "deprecated no-op (r5 demotion)",
+    "metrics_dir": "operational",
+    "tune": "operational (the autotuner's own switch)",
+}
+
+_KNOBS = {"learn": LEARN_KNOBS, "solve": SOLVE_KNOBS}
+_NON_TUNED = {"learn": NON_TUNED_LEARN, "solve": NON_TUNED_SOLVE}
+
+
+def knobs(kind: str) -> Dict[str, Knob]:
+    return _KNOBS[kind]
+
+
+def classify_drift(kind: str, config_cls) -> Tuple[set, set]:
+    """(unclassified config fields, declared-but-missing field knobs)
+    — both must be empty; the drift-guard test asserts it."""
+    fields = {f.name for f in dataclasses.fields(config_cls)}
+    tuned = _KNOBS[kind]
+    classified = set(tuned) | set(_NON_TUNED[kind])
+    unclassified = fields - classified
+    missing = {
+        n for n, k in tuned.items() if k.field and n not in fields
+    }
+    return unclassified, missing
+
+
+def code_fingerprint() -> str:
+    """Content fingerprint of the knob space (names, values,
+    application mechanics version). Keys every store entry: when the
+    space changes incompatibly, persisted winners stop matching
+    instead of silently configuring code they were never measured
+    on. CCSC_TUNE_FP overrides (pinning across a compatible rename)."""
+    import os
+
+    env = os.environ.get("CCSC_TUNE_FP")
+    if env:
+        return env
+    basis = {
+        "version": SPACE_VERSION,
+        "knobs": {
+            kind: {
+                name: [str(v) for v in k.values]
+                for name, k in sorted(table.items())
+            }
+            for kind, table in _KNOBS.items()
+        },
+    }
+    return hashlib.sha256(
+        json.dumps(basis, sort_keys=True).encode()
+    ).hexdigest()[:12]
+
+
+def knob_defaults(kind: str, cfg=None) -> Dict[str, object]:
+    """Default value of every knob (from ``cfg``'s class when given,
+    else the shipped config defaults; env knobs default to their
+    first declared value resolved as 'library default')."""
+    from .. import config as _config
+
+    cls = type(cfg) if cfg is not None else (
+        _config.LearnConfig if kind == "learn" else _config.SolveConfig
+    )
+    out = {}
+    for name, k in _KNOBS[kind].items():
+        if k.field:
+            out[name] = next(
+                f.default for f in dataclasses.fields(cls)
+                if f.name == name
+            )
+        else:
+            out[name] = None  # env unset = library default
+    return out
+
+
+def apply_arm(
+    cfg, arm: Dict[str, object], kind: str, workload: str = ""
+):
+    """Apply an arm to ``cfg``.
+
+    Returns (new_cfg, env_updates, dropped): env_updates is the
+    {ENV_VAR: value} map for non-field knobs (the caller decides when
+    to set them — at startup resolution, never inside a library call);
+    dropped lists (knob, reason) pairs for knobs that do not apply to
+    this workload or are unknown to this kind — applying a consensus
+    arm to a masked learner must configure what transfers and say
+    what did not, not crash the run."""
+    table = _KNOBS[kind]
+    updates: Dict[str, object] = {}
+    env: Dict[str, str] = {}
+    dropped: List[Tuple[str, str]] = []
+    for name, value in arm.items():
+        k = table.get(name)
+        if k is None:
+            dropped.append((name, f"not a {kind} knob"))
+            continue
+        if not k.applies_to(workload):
+            defaults = knob_defaults(kind, cfg)
+            if value != defaults.get(name):
+                dropped.append(
+                    (name, f"not applicable to workload '{workload}'")
+                )
+            continue
+        if k.field:
+            updates[name] = value
+        elif value is not None:
+            env[k.env] = str(value)
+    new_cfg = dataclasses.replace(cfg, **updates) if updates else cfg
+    return new_cfg, env, dropped
+
+
+def arm_knob_dict(cfg, kind: str, env_applied=None) -> Dict[str, object]:
+    """The resolved knob dict of a config — what actually executes —
+    for telemetry records (serve_warmup, tune_pick)."""
+    out = {}
+    for name, k in _KNOBS[kind].items():
+        if k.field:
+            out[name] = getattr(cfg, name)
+        else:
+            import os
+
+            out[name] = (env_applied or {}).get(
+                k.env, os.environ.get(k.env)
+            )
+    return out
+
+
+def default_arms(kind: str, workload: str = "") -> List[Dict[str, object]]:
+    """The sweep's candidate arm list: the baseline, every applicable
+    single-knob move, and the measured-winner combos of the on-chip
+    record (PERF.md r5/r6). An arm is a dict of NON-default knobs."""
+    table = _KNOBS[kind]
+    defaults = knob_defaults(kind)
+
+    def applicable(name):
+        return table[name].applies_to(workload)
+
+    arms: List[Dict[str, object]] = [{}]
+    for name, k in sorted(table.items()):
+        if not applicable(name):
+            continue
+        for v in k.values:
+            if v == defaults.get(name) or v is None:
+                continue
+            arms.append({name: v})
+    combos = {
+        "learn": [
+            # the r5 measured ladder at the north star (onchip_r5.jsonl)
+            {"storage_dtype": "bfloat16", "d_storage_dtype": "bfloat16",
+             "fft_impl": "matmul", "herm_inv": "schur"},
+            {"storage_dtype": "bfloat16", "d_storage_dtype": "bfloat16",
+             "fft_impl": "matmul_bf16", "herm_inv": "schur"},
+            # best_onchip: fused_default_schur, 46.2x baseline
+            {"storage_dtype": "bfloat16", "d_storage_dtype": "bfloat16",
+             "fft_impl": "matmul_bf16", "fused_z": True,
+             "fused_z_precision": "default", "herm_inv": "schur"},
+            {"storage_dtype": "bfloat16", "d_storage_dtype": "bfloat16",
+             "fft_impl": "matmul_bf16", "fused_z": True,
+             "fused_z_precision": "high", "herm_inv": "schur",
+             "outer_chunk": 4, "donate_state": True},
+        ],
+        "solve": [
+            {"storage_dtype": "bfloat16", "fft_impl": "matmul"},
+            {"storage_dtype": "bfloat16", "fft_impl": "matmul_bf16",
+             "herm_inv": "schur"},
+        ],
+    }[kind]
+    for combo in combos:
+        kept = {
+            n: v for n, v in combo.items()
+            if n in table and applicable(n)
+        }
+        if kept and kept not in arms:
+            arms.append(kept)
+    return arms
+
+
+def arm_label(arm: Dict[str, object]) -> str:
+    if not arm:
+        return "baseline"
+    return ",".join(f"{k}={v}" for k, v in sorted(arm.items()))

@@ -1,0 +1,396 @@
+"""The tuned-knob store: measured winners, persisted and keyed.
+
+One JSON file holds ranked arm measurements keyed by
+``(chip, kind, shape-bucket)`` plus the knob-space code fingerprint.
+The key rules encode the two hard lessons of the bench record:
+
+- **Chip is part of the key, and cross-chip application is refused.**
+  ``BENCH_r05.json``'s top-level record is a ``DEGRADED: TPU
+  unreachable, ran on cpu`` row; a CPU-measured (or CPU-degraded) arm
+  must never configure a TPU run and vice versa — the whole point of
+  on-chip tuning is that the winner depends on the chip.
+- **The code fingerprint ages entries out.** An arm measured under an
+  older knob vocabulary (space.SPACE_VERSION bump) stops matching
+  instead of silently configuring code it was never measured on —
+  the same reasoning as pick_tuned's newest-round-only rule.
+
+Location: ``CCSC_TUNE_STORE`` env > next to the persistent XLA compile
+cache (``$CCSC_COMPILE_CACHE/ccsc_tuned_knobs.json``) > the repo-root
+``tuned_knobs.json`` (next to the legacy ``bench_tuned.json`` it
+replaces). Writes are atomic (tmp + rename) so a preempted sweep
+never leaves a torn store.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import space
+
+SCHEMA_VERSION = 1
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def default_store_path() -> str:
+    env = os.environ.get("CCSC_TUNE_STORE")
+    if env:
+        return env
+    cache = os.environ.get("CCSC_COMPILE_CACHE")
+    if cache:
+        return os.path.join(cache, "ccsc_tuned_knobs.json")
+    return os.path.join(_REPO_ROOT, "tuned_knobs.json")
+
+
+def _pow2_bucket(x: int) -> int:
+    """Shape-bucket rounding: nearby problem sizes share one tuned
+    entry (the knob ranking is shape-stable well beyond exact-match —
+    the same reason the serving engine buckets request shapes)."""
+    x = max(1, int(x))
+    return 1 << max(0, math.ceil(math.log2(x)))
+
+
+def learn_workload(geom, algo: str = "consensus") -> str:
+    """Workload token of a learner run: algorithm family + spatial
+    rank + reduce rank ('consensus2d', 'masked2d+r1', 'streaming3d').
+    Part of the shape key AND the arm-applicability gate
+    (space.Knob.workloads)."""
+    tok = f"{algo}{geom.ndim_spatial}d"
+    if geom.ndim_reduce:
+        tok += f"+r{geom.ndim_reduce}"
+    return tok
+
+
+def solve_workload(geom) -> str:
+    """Workload token of a reconstruction/serving problem
+    ('solve2d', 'solve2d+r1', 'solve3d')."""
+    tok = f"solve{geom.ndim_spatial}d"
+    if geom.ndim_reduce:
+        tok += f"+r{geom.ndim_reduce}"
+    return tok
+
+
+def learn_shape_key(
+    workload: str, *, k: int, support, n: int, size, blocks: int
+) -> str:
+    """Shape bucket of a learning problem. ``support``/``size`` may be
+    ints or per-dim tuples; n and size are pow2-bucketed, the
+    structural dims (k, support, blocks) stay exact."""
+    sup = "x".join(
+        str(s) for s in (
+            support if isinstance(support, (tuple, list)) else [support]
+        )
+    )
+    sz = "x".join(
+        str(_pow2_bucket(s)) for s in (
+            size if isinstance(size, (tuple, list)) else [size]
+        )
+    )
+    return (
+        f"{workload}:k{k}:s{sup}:n{_pow2_bucket(n)}:sz{sz}:b{blocks}"
+    )
+
+
+def solve_shape_key(workload: str, *, k: int, support, spatial) -> str:
+    """Shape bucket of a reconstruction/serving problem."""
+    sup = "x".join(
+        str(s) for s in (
+            support if isinstance(support, (tuple, list)) else [support]
+        )
+    )
+    sz = "x".join(str(_pow2_bucket(s)) for s in spatial)
+    return f"{workload}:k{k}:s{sup}:sz{sz}"
+
+
+def _key(chip: str, kind: str, shape_key: str) -> str:
+    return f"{chip}|{kind}|{shape_key}"
+
+
+class TunedStore:
+    """Ranked arm measurements per (chip, kind, shape-bucket) key.
+
+    Entries: {"arm": {...}, "value": float, "unit": str, "source": str,
+    "fp": str, "t": float, "demoted": bool, "guard": None | {...}}.
+    ``candidates`` returns the non-demoted, fingerprint-current
+    entries for ONE chip, fastest first — there is deliberately no
+    cross-chip lookup; ``chips_with_entries`` exists only so callers
+    can say WHY they refused."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_store_path()
+        self._data: Dict[str, List[Dict]] = {}
+        self.load()
+
+    # -- persistence ---------------------------------------------------
+    def load(self) -> "TunedStore":
+        self._data = {}
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                raw = json.load(f)
+            if (
+                isinstance(raw, dict)
+                and raw.get("schema") == SCHEMA_VERSION
+                and isinstance(raw.get("entries"), dict)
+            ):
+                self._data = {
+                    k: [e for e in v if isinstance(e, dict)]
+                    for k, v in raw["entries"].items()
+                    if isinstance(v, list)
+                }
+        except (OSError, ValueError):
+            pass  # missing/corrupt store reads as empty, never raises
+        return self
+
+    def save(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {"schema": SCHEMA_VERSION, "entries": self._data}, f,
+                indent=1, sort_keys=True,
+            )
+        os.replace(tmp, self.path)
+
+    @property
+    def empty(self) -> bool:
+        return not any(self._data.values())
+
+    # -- write ---------------------------------------------------------
+    def add(
+        self,
+        chip: str,
+        kind: str,
+        shape_key: str,
+        arm: Dict[str, object],
+        value: float,
+        unit: str,
+        source: str = "",
+    ) -> Dict:
+        """Record one measured arm. Re-measuring an existing arm
+        REPLACES its entry (newest measurement wins — same code, newer
+        chip session) and clears any demotion: a re-measured arm earns
+        a fresh guard verdict."""
+        key = _key(chip, kind, shape_key)
+        rows = self._data.setdefault(key, [])
+        entry = {
+            "arm": dict(arm),
+            "value": float(value),
+            "unit": unit,
+            "source": source,
+            "fp": space.code_fingerprint(),
+            "t": time.time(),
+            "demoted": False,
+            "guard": None,
+        }
+        rows[:] = [e for e in rows if e.get("arm") != entry["arm"]]
+        rows.append(entry)
+        rows.sort(key=lambda e: -float(e.get("value", 0.0)))
+        return entry
+
+    def demote(
+        self, chip: str, kind: str, shape_key: str, arm: Dict,
+        reason: str = "",
+    ) -> None:
+        for e in self._data.get(_key(chip, kind, shape_key), []):
+            if e.get("arm") == arm:
+                e["demoted"] = True
+                e["demote_reason"] = reason
+
+    def mark_guard(
+        self, chip: str, kind: str, shape_key: str, arm: Dict,
+        ok: bool, dev: float, tol: float,
+    ) -> None:
+        for e in self._data.get(_key(chip, kind, shape_key), []):
+            if e.get("arm") == arm:
+                e["guard"] = {
+                    "ok": bool(ok),
+                    "dev": float(dev),
+                    "tol": float(tol),
+                    "t": time.time(),
+                }
+
+    # -- read ----------------------------------------------------------
+    @staticmethod
+    def _eligible(e: Dict) -> bool:
+        return (
+            not e.get("demoted")
+            and e.get("fp") == space.code_fingerprint()
+            and float(e.get("value", 0.0)) > 0
+        )
+
+    def candidates(
+        self, chip: str, kind: str, shape_key: str
+    ) -> List[Dict]:
+        return [
+            e
+            for e in self._data.get(_key(chip, kind, shape_key), [])
+            if self._eligible(e)
+        ]
+
+    def chips_with_entries(self, kind: str, shape_key: str) -> List[str]:
+        """Chips holding an APPLICABLE entry for this (kind, shape) —
+        used ONLY to explain a cross-chip refusal, never to apply.
+        Applies the same eligibility filter as ``candidates``: a chip
+        whose entries are all demoted or fingerprint-stale has nothing
+        a run elsewhere is missing, and reporting it would misdiagnose
+        a same-chip empty lookup as a cross-chip refusal."""
+        out = []
+        for key, rows in self._data.items():
+            chip, k, sk = key.split("|", 2)
+            if k == kind and sk == shape_key and any(
+                self._eligible(e) for e in rows
+            ):
+                out.append(chip)
+        return sorted(set(out))
+
+
+# -- migration / seeding ----------------------------------------------
+
+_METRIC_RE = None
+
+
+def _parse_learn_metric(metric: str):
+    """(k, support, n, size, blocks) from a north-star bench metric
+    string like '2D consensus ADMM outer iters/sec (k=100 11x11
+    filters, n=128x100^2, 8 blocks, 1 chip)'; None when unparsable."""
+    global _METRIC_RE
+    if _METRIC_RE is None:
+        import re
+
+        _METRIC_RE = re.compile(
+            r"\(k=(\d+) (\d+)x\d+ filters, n=(\d+)x(\d+)\^2, "
+            r"(\d+) blocks"
+        )
+    m = _METRIC_RE.search(metric)
+    if not m:
+        return None
+    k, sup, n, size, blocks = (int(g) for g in m.groups())
+    return k, sup, n, size, blocks
+
+
+def seed_from_onchip(
+    store: TunedStore, jsonl_path: str, workload: str = "consensus2d"
+) -> int:
+    """Seed the store from an on-chip round file (onchip_r*.jsonl —
+    the records scripts/onchip_queue.sh appends). Only real-chip
+    learner records qualify: DEGRADED/FAILED rows, zero values,
+    non-learner units, and rows without a chip field are skipped —
+    the store key is the ACTUAL chip that measured the arm, so a CPU
+    fallback can never seed a TPU key. Returns the number of arms
+    recorded."""
+    n_added = 0
+    try:
+        lines = open(jsonl_path, encoding="utf-8").read().splitlines()
+    except OSError:
+        return 0
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        res = rec.get("result") or {}
+        metric = res.get("metric", "")
+        value = float(res.get("value", 0.0) or 0.0)
+        if (
+            not rec.get("run")
+            or value <= 0
+            or res.get("degraded")
+            or "DEGRADED" in metric
+            or "FAILED" in metric
+            or res.get("unit", "outer_iters/sec") != "outer_iters/sec"
+        ):
+            continue
+        # a chip-less row is unkeyable (nothing honest to key by); an
+        # intentional-CPU row seeds only a cpu key, which the chip
+        # match at lookup already fences off from TPU runs
+        chip = res.get("chip")
+        if not chip:
+            continue
+        shape = _parse_learn_metric(metric)
+        if shape is None:
+            continue
+        k, sup, n, size, blocks = shape
+        knobs = res.get("knobs") or {}
+        arm = {
+            name: v
+            for name, v in knobs.items()
+            if name in space.knobs("learn")
+            and v != space.knob_defaults("learn").get(name)
+            and v is not None
+        }
+        store.add(
+            chip,
+            "learn",
+            # the north-star metric names square 2D dims; the key uses
+            # full per-dim tuples so it matches resolve_learn's
+            # geometry-derived key exactly
+            learn_shape_key(
+                workload, k=k, support=(sup, sup), n=n,
+                size=(size, size), blocks=blocks,
+            ),
+            arm,
+            value,
+            res.get("unit", "outer_iters/sec"),
+            source=f"{os.path.basename(jsonl_path)}:{rec['run']}",
+        )
+        n_added += 1
+    return n_added
+
+
+def legacy_bench_tuned(repo: Optional[str] = None) -> Dict[str, object]:
+    """Read the legacy ``bench_tuned.json`` (the pre-store migration
+    shim): its flat knob dict, or {} when absent/corrupt."""
+    path = os.path.join(repo or _REPO_ROOT, "bench_tuned.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tuned = json.load(f)
+        return tuned if isinstance(tuned, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def bench_lookup(
+    chip: str,
+    *,
+    k: int,
+    support: int,
+    n: int,
+    size: int,
+    blocks: int,
+    repo: Optional[str] = None,
+    store_path: Optional[str] = None,
+    workload: str = "consensus2d",
+):
+    """bench.py's tuned-knob resolution: the store's best arm for this
+    (chip, north-star shape) — falling back to the legacy
+    bench_tuned.json ONLY when the store holds nothing at all for the
+    key on ANY chip (a not-yet-migrated checkout). A store that has
+    entries for OTHER chips refuses instead of falling back: the
+    legacy file carries the same cross-chip hazard the store exists to
+    close. Returns (knob_dict, source_string)."""
+    if store_path is None and repo is not None \
+            and not os.environ.get("CCSC_TUNE_STORE") \
+            and not os.environ.get("CCSC_COMPILE_CACHE"):
+        store_path = os.path.join(repo, "tuned_knobs.json")
+    store = TunedStore(store_path)
+    key = learn_shape_key(
+        workload, k=k, support=support, n=n, size=size, blocks=blocks
+    )
+    cands = store.candidates(chip, "learn", key)
+    if cands:
+        return dict(cands[0]["arm"]), f"store:{cands[0].get('source')}"
+    others = store.chips_with_entries("learn", key)
+    if others:
+        return {}, (
+            f"refused: tuned entries exist for chip(s) "
+            f"{'/'.join(others)} but this run is on {chip}"
+        )
+    legacy = legacy_bench_tuned(repo)
+    if legacy:
+        return dict(legacy), "legacy:bench_tuned.json"
+    return {}, "none"

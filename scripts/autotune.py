@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""On-chip knob autotuner CLI: sweep, seed, inspect, validate.
+
+Modes (one per invocation):
+
+--dry-run          Validate the candidate arm space WITHOUT a chip:
+                   enumerate every arm for both kinds, apply each to a
+                   default config (constructor validation), and print
+                   the table + store path + code fingerprint. No
+                   backend is initialized and no workload runs — safe
+                   on any CI host.
+--seed-from PATH   Seed the tuned store from an on-chip bench round
+                   file (onchip_r*.jsonl): every real-chip learner
+                   record becomes a ranked store entry keyed by its
+                   ACTUAL chip. DEGRADED/FAILED rows are refused.
+--list             Print the store's entries (chip/kind/shape ranked
+                   arms, guard verdicts, demotions).
+--sweep KIND       Time the candidate arms on the ACTUAL chip at the
+                   given shape (learn: --n/--size/--k/--support/
+                   --blocks; solve: --size/--k/--support) and persist
+                   the ranking. This is what LearnConfig/ServeConfig
+                   tune='sweep' runs at startup, as a standalone tool.
+
+After a sweep or seed, any learner/engine started with ``--tune auto``
+on the same chip + shape bucket picks the fastest recorded arm behind
+the numerics guard. Store path: --store > CCSC_TUNE_STORE >
+$CCSC_COMPILE_CACHE/ccsc_tuned_knobs.json > repo tuned_knobs.json.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--store", default=None, help="tuned store path")
+    mode = p.add_mutually_exclusive_group(required=True)
+    mode.add_argument(
+        "--dry-run", action="store_true",
+        help="validate the arm space without a chip (no jax import)",
+    )
+    mode.add_argument(
+        "--seed-from", default=None, metavar="JSONL",
+        help="seed the store from an onchip_r*.jsonl round file",
+    )
+    mode.add_argument(
+        "--list", action="store_true", help="print the store contents"
+    )
+    mode.add_argument(
+        "--sweep", default=None, choices=["learn", "solve"],
+        help="time the candidate arms on the actual chip",
+    )
+    p.add_argument("--workload", default=None,
+                   help="workload token (default consensus2d / solve2d)")
+    p.add_argument("--n", type=int, default=16, help="sweep: images")
+    p.add_argument("--size", type=int, default=32,
+                   help="sweep: spatial side")
+    p.add_argument("--k", type=int, default=16, help="sweep: filters")
+    p.add_argument("--support", type=int, default=7,
+                   help="sweep: filter support")
+    p.add_argument("--blocks", type=int, default=2,
+                   help="sweep(learn): consensus blocks")
+    p.add_argument("--iters", type=int, default=2,
+                   help="sweep: timed iterations/solves per arm")
+    return p
+
+
+def _dry_run():
+    # pure-python validation: no backend init, no device, no workload
+    import dataclasses
+
+    from ccsc_code_iccv2017_tpu import config
+    from ccsc_code_iccv2017_tpu.tune import space, store as ts
+
+    n_bad = 0
+    for kind, cls, workload in (
+        ("learn", config.LearnConfig, "consensus2d"),
+        ("solve", config.SolveConfig, "solve2d"),
+    ):
+        unclassified, missing = space.classify_drift(kind, cls)
+        if unclassified or missing:
+            print(
+                f"DRIFT in {kind}: unclassified fields "
+                f"{sorted(unclassified)}, declared-but-missing "
+                f"{sorted(missing)}"
+            )
+            n_bad += 1
+        arms = space.default_arms(kind, workload)
+        print(f"{kind} ({workload}): {len(arms)} candidate arms")
+        cfg = cls() if kind == "learn" else cls()
+        for arm in arms:
+            armed, env, dropped = space.apply_arm(
+                cfg, arm, kind, workload
+            )
+            dataclasses.asdict(armed)  # constructor already validated
+            note = f" env={env}" if env else ""
+            note += f" dropped={dropped}" if dropped else ""
+            print(f"  {space.arm_label(arm)}{note}")
+    print(f"code fingerprint: {space.code_fingerprint()}")
+    print(f"store path: {ts.default_store_path()}")
+    if n_bad:
+        print("DRY RUN FAILED: knob space drift detected")
+    return 1 if n_bad else 0
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.dry_run:
+        return _dry_run()
+
+    from ccsc_code_iccv2017_tpu.tune import store as ts
+
+    store = ts.TunedStore(args.store)
+    if args.seed_from:
+        n = ts.seed_from_onchip(
+            store, args.seed_from,
+            workload=args.workload or "consensus2d",
+        )
+        store.save()
+        print(f"seeded {n} arm(s) from {args.seed_from} -> {store.path}")
+        return 0
+    if args.list:
+        data = store._data
+        if not data:
+            print(f"(store empty: {store.path})")
+            return 0
+        for key in sorted(data):
+            print(key)
+            for e in data[key]:
+                flags = []
+                if e.get("demoted"):
+                    flags.append(
+                        f"DEMOTED({e.get('demote_reason', '')})"
+                    )
+                g = e.get("guard")
+                if g:
+                    flags.append(
+                        f"guard={'ok' if g.get('ok') else 'FAIL'}"
+                        f"@{g.get('dev'):.3g}"
+                    )
+                print(
+                    f"  {e.get('value'):>10.4g} {e.get('unit'):<16} "
+                    f"[{json.dumps(e.get('arm'))}] "
+                    f"{e.get('source', '')} {' '.join(flags)}"
+                )
+        return 0
+
+    # ---- sweep on the actual chip -----------------------------------
+    from ccsc_code_iccv2017_tpu.utils.platform import (
+        honor_jax_platforms_env,
+    )
+
+    honor_jax_platforms_env()
+    from ccsc_code_iccv2017_tpu.config import (
+        LearnConfig, ProblemGeom, SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.tune import autotune
+
+    def emit(type_, **fields):
+        print(json.dumps({"type": type_, **fields}))
+
+    if args.sweep == "learn":
+        geom = ProblemGeom((args.support, args.support), args.k)
+        cfg = LearnConfig(num_blocks=args.blocks, verbose="none")
+        autotune.sweep_learn(
+            cfg, geom, (args.n, args.size, args.size),
+            workload=args.workload or "consensus2d",
+            store=store, emit=emit, iters=args.iters,
+        )
+    else:
+        geom = ProblemGeom((args.support, args.support), args.k)
+        cfg = SolveConfig(
+            max_it=max(args.iters * 5, 10), verbose="none"
+        )
+        autotune.sweep_solve(
+            cfg, geom, (args.size, args.size),
+            workload=args.workload or "solve2d",
+            store=store, emit=emit, reps=args.iters,
+        )
+    print(f"sweep recorded -> {store.path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,7 +89,8 @@ def render(events, stale_after=None):
         knob_keys = (
             "outer_chunk", "donate_state", "fft_impl", "fft_pad",
             "fused_z", "storage_dtype", "d_storage_dtype", "num_blocks",
-            "carry_freq", "max_it", "max_it_d", "max_it_z",
+            "carry_freq", "herm_inv", "tune", "max_it", "max_it_d",
+            "max_it_z",
         )
         knobs = {k: cfgknobs[k] for k in knob_keys if k in cfgknobs}
         if knobs:
@@ -255,6 +256,51 @@ def render(events, stale_after=None):
     else:
         lines.append("  (no heartbeat records)")
 
+    tpicks = by.get("tune_pick", [])
+    tguards = by.get("tune_guard", [])
+    tarms = by.get("tune_arm", [])
+    if tpicks or tguards or tarms:
+        lines.append(_section("TUNING"))
+        if tarms:
+            lines.append(f"  sweep         {len(tarms)} arm(s) timed")
+            ok_arms = [a for a in tarms if "value" in a]
+            for a in sorted(
+                ok_arms, key=lambda a: -a.get("value", 0.0)
+            )[:8]:
+                lines.append(
+                    f"    {a.get('value', 0.0):>10.4g} "
+                    f"{a.get('unit', '')}  {json.dumps(a.get('arm'))}"
+                )
+            failed = [a for a in tarms if "error" in a]
+            if failed:
+                lines.append(
+                    f"    ({len(failed)} arm(s) failed to run)"
+                )
+        for g in tguards:
+            verdict = "pass" if g.get("ok") else "FAIL -> demoted"
+            lines.append(
+                f"  guard         {verdict}  dev={g.get('dev')} "
+                f"tol={g.get('tol')}  {json.dumps(g.get('arm'))}"
+            )
+        for p in tpicks:
+            if p.get("arm") is not None:
+                lines.append(
+                    f"  applied       {json.dumps(p.get('arm'))} "
+                    f"({p.get('value')} {p.get('unit')}, "
+                    f"{p.get('source')}) on {p.get('chip')} "
+                    f"{p.get('shape_key')}"
+                )
+                if p.get("dropped"):
+                    lines.append(
+                        f"    dropped for this workload: "
+                        f"{json.dumps(p['dropped'])}"
+                    )
+            else:
+                lines.append(
+                    f"  not applied   {p.get('reason')} "
+                    f"({p.get('chip')} {p.get('shape_key')})"
+                )
+
     sreqs = by.get("serve_request", [])
     sdisp = by.get("serve_dispatch", [])
     if sreqs or sdisp:
@@ -302,6 +348,12 @@ def render(events, stale_after=None):
                 f"{w.get('warmup_s')}s, persistent cache hits "
                 f"{w.get('persistent_cache_hits')}"
             )
+            if w.get("knobs"):
+                # the resolved arm every request was served under
+                # (serve_warmup/serve_ready knob dict)
+                lines.append(
+                    f"  served under  {json.dumps(w['knobs'])}"
+                )
         if summary and summary.get("persistent_cache_hits") is not None:
             lines.append(
                 f"  compile cache {summary['persistent_cache_hits']} "

@@ -8,6 +8,14 @@ mixed in — their arms ran older code on an older tunnel.
 
 Single source of truth for knob defaults — the queue phases append
 records, this script decides.
+
+The same run also seeds the tuned-knob STORE (tune.store): every
+valid record of the round lands as a ranked per-(chip, shape-bucket)
+entry in tuned_knobs.json, which is what learners/engines started
+with ``--tune auto`` and bench.py consult first — bench_tuned.json
+is kept as the read-compat migration shim for the flat-file flow.
+scripts/onchip_queue.sh re-picks after every measured arm, so both
+artifacts stay current through a tunnel window.
 """
 import json
 import os
@@ -15,6 +23,9 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TUNED = os.path.join(REPO, "bench_tuned.json")
+# tuned STORE path; None = derive from REPO at runtime (tests patch
+# REPO, and the store must follow it into the sandbox)
+STORE = None
 
 DEFAULTS = {
     "fft_pad": "none",
@@ -100,6 +111,29 @@ def _valid_runs(path):
         yield rec["run"], v, res.get("knobs") or {}
 
 
+def _seed_store(current_round):
+    """Mirror the round's valid arms into the tuned-knob store
+    (tune.store — the per-(chip, shape-bucket) ranking that --tune
+    auto and bench.py read). Best-effort: a record whose metric does
+    not name the north-star shape, or an unimportable package, must
+    not fail the flat-file pick this script has always done."""
+    try:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        from ccsc_code_iccv2017_tpu.tune import store as ts
+
+        store = ts.TunedStore(
+            STORE or os.path.join(REPO, "tuned_knobs.json")
+        )
+        n = ts.seed_from_onchip(store, current_round)
+        if n:
+            store.save()
+        print(f"tuned store: {n} arm(s) recorded -> {store.path}")
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"tuned store update skipped: {e}")
+
+
 def main():
     import glob
 
@@ -124,6 +158,7 @@ def main():
             os.remove(TUNED)
         print("tuned: defaults (no records)")
         return 0
+    _seed_store(current)
     devs = _accuracy_devs(current)
     best, best_v, best_k, base_v = None, -1.0, {}, None
     for run, v, knobs in _valid_runs(current):

@@ -64,11 +64,20 @@ def main(argv=None):
         "(CCSC_SERVE_HOMOG=1): isolates micro-batching from "
         "shape bucketing; outputs bit-identical to the loop",
     )
+    ap.add_argument(
+        "--tune", default=None, choices=["off", "auto", "sweep"],
+        help="also run a TUNED engine on the same stream "
+        "(CCSC_SERVE_TUNE; ServeConfig.tune — 'sweep' measures the "
+        "solve arms on this chip first, 'auto' applies the tuned "
+        "store entry) and record the default-vs-tuned gap",
+    )
     args = ap.parse_args(argv)
     if args.requests is not None:
         os.environ["CCSC_SERVE_REQUESTS"] = str(args.requests)
     if args.homog:
         os.environ["CCSC_SERVE_HOMOG"] = "1"
+    if args.tune is not None:
+        os.environ["CCSC_SERVE_TUNE"] = args.tune
 
     from ccsc_code_iccv2017_tpu.serve.bench import run_serve_workload
     from ccsc_code_iccv2017_tpu.utils import obs
@@ -92,6 +101,13 @@ def main(argv=None):
         f"{rec['p50_ms']} ms, p99 {rec['p99_ms']} ms, "
         f"recompiles after warmup: {rec['recompiles_after_warmup']}"
     )
+    if "tuned_requests_per_sec" in rec:
+        print(
+            f"tuned engine {rec['tuned_requests_per_sec']} req/s "
+            f"({rec['speedup_tuned_vs_default']}x the default engine; "
+            f"max rel err vs loop {rec['tuned_max_rel_err_vs_loop']}) "
+            f"under {rec['tuned_knobs']}"
+        )
     return rec
 
 

@@ -1,0 +1,694 @@
+"""Autotune subsystem (tune/): arm space, store, resolver, guard.
+
+Covers the ISSUE-6 acceptance set:
+- deterministic sweep with injected timers
+- store persistence round-trip + key (chip / fingerprint) mismatch
+  refusal — a DEGRADED/cross-chip record must never configure a run
+- the numerics guard demoting a deliberately-poisoned arm and
+  applying the next-best
+- the store pre-seeded from onchip_r5.jsonl resolving a --tune auto
+  learner to the best_onchip arm (bf16 + matmul-DFT + fused_z +
+  schur) with zero hand-set knob flags
+- the serving engine picking tuned knobs at startup and recording the
+  resolved knob dict in its warmup events
+- serving bit-identity preserved when tuning is off
+- the knob drift guard: every LearnConfig/SolveConfig field is
+  classified (tuned or explicitly non-tuned), so a new perf knob
+  cannot silently escape the tuner's candidate space
+- scripts/autotune.py --dry-run validating the arm space without a
+  chip
+
+Hermetic: tune='off' is the config default, every store lives in
+tmp_path, and chips are pinned explicitly — nothing here touches the
+repo-root tuned_knobs.json.
+"""
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from ccsc_code_iccv2017_tpu import config  # noqa: E402
+from ccsc_code_iccv2017_tpu.config import (  # noqa: E402
+    GEOM_2D, LearnConfig, ProblemGeom, ServeConfig, SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.tune import (  # noqa: E402
+    autotune, space, store as ts,
+)
+
+
+# ---------------------------------------------------------------------
+# arm space + drift guard
+# ---------------------------------------------------------------------
+
+def test_tune_off_is_the_default():
+    # tier-1 hermeticity: pytest must never see resolution unless a
+    # test opts in explicitly
+    assert LearnConfig().tune == "off"
+    assert SolveConfig().tune == "off"
+    with pytest.raises(ValueError):
+        LearnConfig(tune="fastest")
+    with pytest.raises(ValueError):
+        SolveConfig(tune="fastest")
+
+
+def test_every_config_field_is_classified():
+    """The drift guard: a knob added to LearnConfig/SolveConfig
+    without a tuner-space classification fails here — new perf knobs
+    cannot silently escape tuning."""
+    for kind, cls in (
+        ("learn", config.LearnConfig), ("solve", config.SolveConfig)
+    ):
+        unclassified, missing = space.classify_drift(kind, cls)
+        assert not unclassified, (
+            f"{cls.__name__} fields not classified in "
+            f"tune.space: {sorted(unclassified)} — add each to "
+            f"{kind.upper()}_KNOBS (tunable) or NON_TUNED_"
+            f"{kind.upper()} (with the reason)"
+        )
+        assert not missing, (
+            f"tune.space declares {kind} field knobs that "
+            f"{cls.__name__} does not have: {sorted(missing)}"
+        )
+
+
+def test_default_arms_apply_cleanly():
+    for kind, cfg, workload in (
+        ("learn", LearnConfig(), "consensus2d"),
+        ("solve", SolveConfig(), "solve2d"),
+    ):
+        arms = space.default_arms(kind, workload)
+        assert {} in arms and len(arms) > 5
+        for arm in arms:
+            armed, env, dropped = space.apply_arm(
+                cfg, arm, kind, workload
+            )
+            assert not dropped
+            for name, v in arm.items():
+                if space.knobs(kind)[name].field:
+                    assert getattr(armed, name) == v
+
+
+def test_apply_arm_drops_inapplicable_knobs():
+    arm = {"fused_z": True, "storage_dtype": "bfloat16"}
+    armed, _, dropped = space.apply_arm(
+        LearnConfig(), arm, "learn", "masked2d"
+    )
+    assert armed.storage_dtype == "bfloat16"
+    assert armed.fused_z is False  # consensus2d-only knob
+    assert dropped and dropped[0][0] == "fused_z"
+    # streaming drops donation too
+    armed, _, dropped = space.apply_arm(
+        LearnConfig(), {"donate_state": True}, "learn", "streaming2d"
+    )
+    assert armed.donate_state is False
+    assert dropped
+
+
+def test_dry_run_validates_without_a_chip():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "autotune.py"),
+         "--dry-run"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "candidate arms" in out.stdout
+    assert "code fingerprint" in out.stdout
+
+
+# ---------------------------------------------------------------------
+# store: round-trip, key mismatch refusal, seeding
+# ---------------------------------------------------------------------
+
+def test_store_round_trip_and_ranking(tmp_path):
+    path = str(tmp_path / "store.json")
+    st = ts.TunedStore(path)
+    assert st.empty
+    st.add("v5e", "learn", "key1", {"fft_impl": "matmul"}, 2.0,
+           "outer_iters/sec", source="a")
+    st.add("v5e", "learn", "key1", {"fused_z": True}, 3.0,
+           "outer_iters/sec", source="b")
+    st.add("v5e", "learn", "key1", {}, 1.0, "outer_iters/sec")
+    st.save()
+    st2 = ts.TunedStore(path)
+    cands = st2.candidates("v5e", "learn", "key1")
+    assert [c["value"] for c in cands] == [3.0, 2.0, 1.0]
+    # demotion round-trips
+    st2.demote("v5e", "learn", "key1", {"fused_z": True}, reason="x")
+    st2.save()
+    st3 = ts.TunedStore(path)
+    assert [c["value"] for c in st3.candidates("v5e", "learn", "key1")] \
+        == [2.0, 1.0]
+    # re-adding a demoted arm clears the demotion (fresh measurement)
+    st3.add("v5e", "learn", "key1", {"fused_z": True}, 4.0,
+            "outer_iters/sec")
+    assert st3.candidates("v5e", "learn", "key1")[0]["value"] == 4.0
+
+
+def test_store_refuses_cross_chip_and_stale_fingerprint(tmp_path):
+    path = str(tmp_path / "store.json")
+    st = ts.TunedStore(path)
+    st.add("v5e", "learn", "key1", {"fft_impl": "matmul"}, 2.0,
+           "outer_iters/sec")
+    # cross-chip: a v5e winner must never configure a cpu run
+    assert st.candidates("cpu", "learn", "key1") == []
+    assert st.chips_with_entries("learn", "key1") == ["v5e"]
+    # stale code fingerprint: entries from an older knob vocabulary
+    # stop matching
+    st._data["v5e|learn|key1"][0]["fp"] = "stale000000"
+    assert st.candidates("v5e", "learn", "key1") == []
+    # ...and a stale entry no longer counts as "tuned entries exist
+    # for chip v5e": the cross-chip refusal diagnosis applies the
+    # same eligibility filter as candidates(), so a fully stale (or
+    # demoted) store falls through to "no tuned entry" / the legacy
+    # bench shim instead of a self-contradictory refusal
+    assert st.chips_with_entries("learn", "key1") == []
+    st._data["v5e|learn|key1"][0]["fp"] = ts.space.code_fingerprint()
+    st.demote("v5e", "learn", "key1", {"fft_impl": "matmul"}, "test")
+    assert st.chips_with_entries("learn", "key1") == []
+
+
+def test_store_corrupt_file_reads_as_empty(tmp_path):
+    path = tmp_path / "store.json"
+    path.write_text("{not json")
+    st = ts.TunedStore(str(path))
+    assert st.empty
+    st.add("cpu", "solve", "k", {}, 1.0, "solves/sec")
+    st.save()
+    assert not ts.TunedStore(str(path)).empty
+
+
+def test_seed_skips_degraded_and_failed_records(tmp_path):
+    rows = [
+        {"run": "degraded", "result": {
+            "metric": "2D consensus ADMM outer iters/sec (k=8 11x11 "
+            "filters, n=16x32^2, 2 blocks, DEGRADED: TPU unreachable, "
+            "ran on cpu)",
+            "value": 9.9, "unit": "outer_iters/sec", "chip": "cpu",
+            "knobs": {"fft_impl": "matmul"}}},
+        {"run": "failed", "result": {
+            "metric": "2D consensus ADMM outer iters/sec (FAILED: "
+            "TPU attempt did not complete)", "value": 0.0}},
+        {"run": "serving", "result": {
+            "metric": "serving engine requests/sec (x, 1 chip)",
+            "value": 5.0, "unit": "requests/sec", "chip": "v5e"}},
+        {"run": "chipless", "result": {
+            "metric": "2D consensus ADMM outer iters/sec (k=8 11x11 "
+            "filters, n=16x32^2, 2 blocks, 1 chip)",
+            "value": 2.0, "unit": "outer_iters/sec"}},
+        {"run": "good", "result": {
+            "metric": "2D consensus ADMM outer iters/sec (k=8 11x11 "
+            "filters, n=16x32^2, 2 blocks, 1 chip)",
+            "value": 2.0, "unit": "outer_iters/sec", "chip": "v5e",
+            "knobs": {"fft_impl": "matmul", "fft_pad": "none"}}},
+    ]
+    p = tmp_path / "onchip_r9.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    st = ts.TunedStore(str(tmp_path / "store.json"))
+    assert ts.seed_from_onchip(st, str(p)) == 1
+    key = ts.learn_shape_key(
+        "consensus2d", k=8, support=(11, 11), n=16, size=(32, 32),
+        blocks=2,
+    )
+    cands = st.candidates("v5e", "learn", key)
+    assert len(cands) == 1
+    # default-valued knobs are stripped; only the real move remains
+    assert cands[0]["arm"] == {"fft_impl": "matmul"}
+    # the DEGRADED row seeded nothing anywhere
+    assert st.chips_with_entries("learn", key) == ["v5e"]
+
+
+# ---------------------------------------------------------------------
+# resolution: the acceptance path from the real on-chip record
+# ---------------------------------------------------------------------
+
+def test_seeded_store_resolves_learner_to_best_onchip(
+    tmp_path, monkeypatch
+):
+    """ISSUE-6 acceptance: with the store pre-seeded from
+    onchip_r5.jsonl, a learner config with tune='auto' (zero hand-set
+    knob flags) resolves to the best_onchip arm — bf16 storage,
+    matmul-DFT, fused_z, Schur inverse (46.2x baseline,
+    BENCH_r05.json)."""
+    # setenv (not delenv) so monkeypatch RECORDS the variable and
+    # restores its absence afterwards — resolve_learn writes
+    # CCSC_HERM_INV, and a leak would flip the Gram-inverse method of
+    # every later test in this process
+    monkeypatch.setenv("CCSC_HERM_INV", "")
+    st = ts.TunedStore(str(tmp_path / "store.json"))
+    n = ts.seed_from_onchip(
+        st, os.path.join(REPO, "onchip_r5.jsonl")
+    )
+    assert n >= 10  # the round measured a full arm ladder
+    cfg = LearnConfig(tune="auto", num_blocks=8)
+    # chip pinned to the record's chip; guard=False because the
+    # fused-kernel arm cannot run on the CI host — the guard's own
+    # demotion behavior is covered by test_guard_demotes_poisoned_arm
+    resolved, picked = autotune.resolve_learn(
+        cfg, GEOM_2D(100, 11), (128, 100, 100),
+        workload="consensus2d", chip="v5e", store=st, guard=False,
+    )
+    assert picked is not None
+    assert picked["source"].endswith("fused_default_schur")
+    assert resolved.storage_dtype == "bfloat16"
+    assert resolved.d_storage_dtype == "bfloat16"
+    assert resolved.fft_impl == "matmul_bf16"
+    assert resolved.fused_z is True
+    assert resolved.fused_z_precision == "default"
+    assert resolved.tune == "off"  # consumed — no re-resolution
+    # the env-level knob of the arm (learners read CCSC_HERM_INV at
+    # trace time) was applied at startup
+    assert os.environ.get("CCSC_HERM_INV") == "schur"
+    # and a CPU run must refuse the same v5e entries outright
+    events = []
+    cfg_cpu, picked_cpu = autotune.resolve_learn(
+        LearnConfig(tune="auto", num_blocks=8), GEOM_2D(100, 11),
+        (128, 100, 100), workload="consensus2d", chip="cpu", store=st,
+        guard=False, emit=lambda t, **f: events.append((t, f)),
+    )
+    assert picked_cpu is None
+    assert cfg_cpu.fft_impl == "xla"
+    assert any(
+        t == "tune_pick" and "cross-chip" in (f.get("reason") or "")
+        for t, f in events
+    )
+
+
+def test_resolve_no_entries_keeps_defaults(tmp_path):
+    st = ts.TunedStore(str(tmp_path / "store.json"))
+    events = []
+    cfg, picked = autotune.resolve_learn(
+        LearnConfig(tune="auto"), GEOM_2D(8, 5), (4, 16, 16),
+        chip="cpu", store=st, guard=False,
+        emit=lambda t, **f: events.append((t, f)),
+    )
+    assert picked is None and cfg.fft_impl == "xla"
+    assert events and events[0][0] == "tune_pick"
+
+
+# ---------------------------------------------------------------------
+# deterministic sweep with injected timers
+# ---------------------------------------------------------------------
+
+def test_sweep_with_injected_timer_ranks_and_persists(tmp_path):
+    st = ts.TunedStore(str(tmp_path / "store.json"))
+    speeds = {
+        "baseline": 1.0,
+        "fft_impl=matmul": 3.0,
+        "storage_dtype=bfloat16": 2.0,
+        "fft_pad=pow2": 0.5,  # a loser: must be demoted post-sweep
+    }
+    arms = [{}, {"fft_impl": "matmul"}, {"storage_dtype": "bfloat16"},
+            {"fft_pad": "pow2"}]
+    events = []
+    autotune.sweep_learn(
+        LearnConfig(num_blocks=2), GEOM_2D(8, 5), (8, 24, 24),
+        chip="cpu", store=st, arms=arms,
+        timer=lambda armed, arm: speeds[space.arm_label(arm)],
+        emit=lambda t, **f: events.append((t, f)),
+    )
+    key = ts.learn_shape_key(
+        "consensus2d", k=8, support=(5, 5), n=8, size=(24, 24),
+        blocks=2,
+    )
+    st2 = ts.TunedStore(str(tmp_path / "store.json"))  # round-trip
+    cands = st2.candidates("cpu", "learn", key)
+    assert [c["value"] for c in cands] == [3.0, 2.0, 1.0]
+    assert cands[0]["arm"] == {"fft_impl": "matmul"}
+    # the slower-than-baseline arm was demoted, not kept as a
+    # fallback candidate
+    assert all(c["arm"] != {"fft_pad": "pow2"} for c in cands)
+    assert sum(1 for t, _ in events if t == "tune_arm") == 4
+    # and resolution picks the injected winner
+    cfg, picked = autotune.resolve_learn(
+        LearnConfig(tune="auto", num_blocks=2), GEOM_2D(8, 5),
+        (8, 24, 24), chip="cpu", store=st2, guard=False,
+    )
+    assert cfg.fft_impl == "matmul"
+
+
+def test_sweep_timer_failure_records_no_entry(tmp_path):
+    st = ts.TunedStore(str(tmp_path / "store.json"))
+
+    def timer(armed, arm):
+        if arm:
+            raise RuntimeError("backend cannot run this knob")
+        return 1.0
+
+    autotune.sweep_learn(
+        LearnConfig(num_blocks=2), GEOM_2D(8, 5), (8, 24, 24),
+        chip="cpu", store=st, arms=[{}, {"fft_impl": "matmul"}],
+        timer=timer, emit=lambda t, **f: None,
+    )
+    key = ts.learn_shape_key(
+        "consensus2d", k=8, support=(5, 5), n=8, size=(24, 24),
+        blocks=2,
+    )
+    cands = st.candidates("cpu", "learn", key)
+    assert [c["arm"] for c in cands] == [{}]
+
+
+# ---------------------------------------------------------------------
+# numerics guard: demote a poisoned arm, apply the next best
+# ---------------------------------------------------------------------
+
+def test_guard_demotes_poisoned_arm_and_applies_next_best(
+    tmp_path, monkeypatch
+):
+    """The REAL guard on a REAL numerics difference: bf16 iterate
+    storage rounds the stored trajectory (~1e-4 relative on the tiny
+    guard problem), matmul-DFT matches to float rounding (~1e-7). A
+    guard tolerance between the two demotes the 'poisoned' bf16 arm
+    and applies the matmul arm — persisting the demotion so the next
+    startup skips straight to the survivor."""
+    monkeypatch.setenv("CCSC_TUNE_GUARD_TOL", "1e-5")
+    path = str(tmp_path / "store.json")
+    st = ts.TunedStore(path)
+    key_args = dict(k=8, support=(5, 5), n=4, size=(16, 16), blocks=2)
+    key = ts.learn_shape_key("consensus2d", **key_args)
+    st.add("cpu", "learn", key, {"storage_dtype": "bfloat16"}, 9.0,
+           "outer_iters/sec", source="poisoned")
+    st.add("cpu", "learn", key, {"fft_impl": "matmul"}, 5.0,
+           "outer_iters/sec", source="survivor")
+    st.save()
+    events = []
+    cfg, picked = autotune.resolve_learn(
+        LearnConfig(tune="auto", num_blocks=2), GEOM_2D(8, 5),
+        (4, 16, 16), chip="cpu", store=st,
+        emit=lambda t, **f: events.append((t, f)),
+    )
+    assert picked is not None and picked["source"] == "survivor"
+    assert cfg.fft_impl == "matmul"
+    assert cfg.storage_dtype == "float32"
+    guards = [f for t, f in events if t == "tune_guard"]
+    assert [g["ok"] for g in guards] == [False, True]
+    # the demotion persisted: a fresh load skips the poisoned arm
+    st2 = ts.TunedStore(path)
+    cands = st2.candidates("cpu", "learn", key)
+    assert [c["source"] for c in cands] == ["survivor"]
+    # and the survivor's guard verdict is cached — a second startup
+    # resolves without re-running any guard
+    events2 = []
+    cfg2, picked2 = autotune.resolve_learn(
+        LearnConfig(tune="auto", num_blocks=2), GEOM_2D(8, 5),
+        (4, 16, 16), chip="cpu", store=st2,
+        emit=lambda t, **f: events2.append((t, f)),
+        guard=lambda *a: (_ for _ in ()).throw(
+            AssertionError("guard must not re-run")
+        ),
+    )
+    assert picked2 is not None and cfg2.fft_impl == "matmul"
+
+
+def test_injected_guard_flow(tmp_path):
+    """Resolver mechanics with an injected guard: reject the top arm,
+    accept the next."""
+    st = ts.TunedStore(str(tmp_path / "store.json"))
+    st.add("cpu", "solve", "key", {"fft_impl": "matmul_bf16"}, 9.0,
+           "solves/sec")
+    st.add("cpu", "solve", "key", {"fft_impl": "matmul"}, 5.0,
+           "solves/sec")
+    calls = []
+
+    def guard(kind, arm, tol):
+        calls.append(arm)
+        return arm != {"fft_impl": "matmul_bf16"}, 1.0
+
+    cfg, picked, env = autotune._resolve(
+        "solve", SolveConfig(), "key", "solve2d", "cpu", st,
+        lambda t, **f: None, guard,
+    )
+    assert cfg.fft_impl == "matmul"
+    assert len(calls) == 2
+
+
+def test_all_arms_demoted_falls_back_to_defaults(tmp_path):
+    st = ts.TunedStore(str(tmp_path / "store.json"))
+    st.add("cpu", "solve", "key", {"fft_impl": "matmul"}, 5.0,
+           "solves/sec")
+    events = []
+    cfg, picked, _ = autotune._resolve(
+        "solve", SolveConfig(), "key", "solve2d", "cpu", st,
+        lambda t, **f: events.append((t, f)),
+        lambda kind, arm, tol: (False, float("inf")),
+    )
+    assert picked is None and cfg.fft_impl == "xla"
+    assert events[-1][0] == "tune_pick" and \
+        "demoted" in events[-1][1]["reason"]
+
+
+# ---------------------------------------------------------------------
+# engine startup + serving contracts
+# ---------------------------------------------------------------------
+
+def _unit_bank(k=4, sup=5, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, sup, sup)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return d
+
+
+def test_engine_startup_picks_tuned_knobs(tmp_path):
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import CodecEngine
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    d = _unit_bank()
+    geom = ProblemGeom((5, 5), 4)
+    spath = str(tmp_path / "store.json")
+    st = ts.TunedStore(spath)
+    st.add(
+        "cpu", "solve",
+        ts.solve_shape_key(
+            "solve2d", k=4, support=(5, 5), spatial=(24, 24)
+        ),
+        {"fft_impl": "matmul"}, 9.0, "solves/sec", source="seeded",
+    )
+    st.save()
+    mdir = str(tmp_path / "metrics")
+    cfg = SolveConfig(
+        max_it=4, tol=0.0, verbose="none", lambda_prior=0.3
+    )
+    scfg = ServeConfig(
+        buckets=((2, (24, 24)),), metrics_dir=mdir, verbose="none",
+        tune="auto", tune_store=spath,
+    )
+    with CodecEngine(
+        jnp.asarray(d), ReconstructionProblem(geom), cfg, scfg
+    ) as eng:
+        assert eng.cfg.fft_impl == "matmul"  # tuned arm applied
+        r = np.random.default_rng(1)
+        x = r.random((16, 16)).astype(np.float32)
+        m = (r.random((16, 16)) < 0.6).astype(np.float32)
+        res = eng.reconstruct(x * m, mask=m)
+        assert int(res.trace.num_iters) == 4
+    events = obs.read_events(mdir)
+    picks = [e for e in events if e.get("type") == "tune_pick"]
+    assert picks and picks[0]["arm"] == {"fft_impl": "matmul"}
+    # satellite: warmup events carry the RESOLVED knob dict, not just
+    # the bucket shape — the stream says which arm served
+    warmups = [e for e in events if e.get("type") == "serve_warmup"]
+    assert warmups
+    for w in warmups:
+        assert w["knobs"]["fft_impl"] == "matmul"
+        assert w["knobs"]["tuned"] is True
+        assert w["knobs"]["tune"] == "auto"
+
+
+def test_engine_tune_off_serving_bit_identity(tmp_path):
+    """With tuning off (the default), an exact-bucket served result
+    stays BIT-identical to a direct reconstruct() call — the
+    autotune layer must be invisible when not engaged."""
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem, reconstruct,
+    )
+    from ccsc_code_iccv2017_tpu.serve import CodecEngine
+
+    d = _unit_bank()
+    geom = ProblemGeom((5, 5), 4)
+    prob = ReconstructionProblem(geom)
+    cfg = SolveConfig(
+        max_it=5, tol=0.0, verbose="none", lambda_prior=0.3,
+        track_objective=True,
+    )
+    scfg = ServeConfig(buckets=((2, (16, 16)),), verbose="none")
+    assert scfg.tune == "off"
+    r = np.random.default_rng(2)
+    x = r.random((16, 16)).astype(np.float32)
+    m = (r.random((16, 16)) < 0.6).astype(np.float32)
+    with CodecEngine(jnp.asarray(d), prob, cfg, scfg) as eng:
+        assert eng._knob_dict["tuned"] is False
+        served = eng.reconstruct(x * m, mask=m)
+    direct = reconstruct(
+        jnp.asarray((x * m)[None]), jnp.asarray(d), prob, cfg,
+        mask=jnp.asarray(m[None]),
+    )
+    np.testing.assert_array_equal(
+        served.recon, np.asarray(direct.recon[0])
+    )
+
+
+def test_reconstruct_storage_dtype_stays_in_tolerance():
+    """SolveConfig.storage_dtype='bfloat16' (the serving analog of the
+    learners' bf16 code storage) perturbs the solve only in the
+    documented small class; f32 keeps the program byte-identical by
+    construction (identity casts)."""
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem, reconstruct,
+    )
+
+    d = _unit_bank()
+    geom = ProblemGeom((5, 5), 4)
+    prob = ReconstructionProblem(geom)
+    cfg = SolveConfig(
+        max_it=6, tol=0.0, verbose="none", lambda_prior=0.3
+    )
+    r = np.random.default_rng(3)
+    x = r.random((2, 16, 16)).astype(np.float32)
+    m = (r.random((2, 16, 16)) < 0.6).astype(np.float32)
+    ref = reconstruct(
+        jnp.asarray(x * m), jnp.asarray(d), prob, cfg,
+        mask=jnp.asarray(m),
+    )
+    got = reconstruct(
+        jnp.asarray(x * m), jnp.asarray(d), prob,
+        dataclasses.replace(cfg, storage_dtype="bfloat16"),
+        mask=jnp.asarray(m),
+    )
+    rec_ref = np.asarray(ref.recon)
+    rec_got = np.asarray(got.recon)
+    rel = np.abs(rec_got - rec_ref).max() / max(
+        np.abs(rec_ref).max(), 1e-9
+    )
+    assert 0 < rel < 0.02  # perturbed, but in the bf16 storage class
+
+
+def test_reconstruct_inline_tune_auto(tmp_path, monkeypatch):
+    """SolveConfig.tune='auto' resolves inside reconstruct() for the
+    coding-app path (no engine)."""
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem, reconstruct,
+    )
+
+    spath = str(tmp_path / "store.json")
+    monkeypatch.setenv("CCSC_TUNE_STORE", spath)
+    st = ts.TunedStore(spath)
+    st.add(
+        "cpu", "solve",
+        ts.solve_shape_key(
+            "solve2d", k=4, support=(5, 5), spatial=(16, 16)
+        ),
+        {"fft_impl": "matmul"}, 9.0, "solves/sec",
+    )
+    st.save()
+    d = _unit_bank()
+    geom = ProblemGeom((5, 5), 4)
+    prob = ReconstructionProblem(geom)
+    cfg = SolveConfig(
+        max_it=4, tol=0.0, verbose="none", lambda_prior=0.3,
+        tune="auto",
+    )
+    r = np.random.default_rng(4)
+    x = r.random((1, 16, 16)).astype(np.float32)
+    m = (r.random((1, 16, 16)) < 0.6).astype(np.float32)
+    res = reconstruct(
+        jnp.asarray(x * m), jnp.asarray(d), prob, cfg,
+        mask=jnp.asarray(m),
+    )
+    assert int(res.trace.num_iters) == 4
+    ref = reconstruct(
+        jnp.asarray(x * m), jnp.asarray(d), prob,
+        dataclasses.replace(cfg, tune="off", fft_impl="matmul"),
+        mask=jnp.asarray(m),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res.recon), np.asarray(ref.recon)
+    )
+
+
+# ---------------------------------------------------------------------
+# bench tooling unification
+# ---------------------------------------------------------------------
+
+def test_bench_lookup_prefers_store_then_shim_then_refuses(tmp_path):
+    repo = str(tmp_path)
+    spath = os.path.join(repo, "tuned_knobs.json")
+    shape = dict(k=100, support=(11, 11), n=128, size=(100, 100),
+                 blocks=8)
+    # 1) no store, no legacy file -> defaults
+    knobs, src = ts.bench_lookup("v5e", repo=repo, **shape)
+    assert knobs == {} and src == "none"
+    # 2) legacy bench_tuned.json only -> migration shim
+    with open(os.path.join(repo, "bench_tuned.json"), "w") as f:
+        json.dump({"fft_impl": "matmul", "herm_inv": "schur"}, f)
+    knobs, src = ts.bench_lookup("v5e", repo=repo, **shape)
+    assert knobs["fft_impl"] == "matmul"
+    assert src == "legacy:bench_tuned.json"
+    # 3) store entry wins over the shim
+    st = ts.TunedStore(spath)
+    key = ts.learn_shape_key("consensus2d", **shape)
+    st.add("v5e", "learn", key, {"fused_z": True}, 3.0,
+           "outer_iters/sec", source="r5")
+    st.save()
+    knobs, src = ts.bench_lookup("v5e", repo=repo, **shape)
+    assert knobs == {"fused_z": True} and src.startswith("store:")
+    # 4) wrong chip REFUSES (no silent legacy fallback: the shim
+    # carries the same cross-chip hazard)
+    knobs, src = ts.bench_lookup("cpu", repo=repo, **shape)
+    assert knobs == {} and src.startswith("refused")
+
+
+def test_pick_tuned_seeds_the_store(tmp_path, capsys):
+    import importlib.util
+    import time as _time
+
+    spec = importlib.util.spec_from_file_location(
+        "pick_tuned_for_autotune_test",
+        os.path.join(REPO, "scripts", "pick_tuned.py"),
+    )
+    pt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pt)
+    rows = [
+        {"run": "baseline", "result": {
+            "metric": "2D consensus ADMM outer iters/sec (k=100 11x11 "
+            "filters, n=128x100^2, 8 blocks, 1 chip)",
+            "value": 1.0, "unit": "outer_iters/sec", "chip": "v5e",
+            "knobs": {"fft_impl": "xla"}}},
+        {"run": "win", "result": {
+            "metric": "2D consensus ADMM outer iters/sec (k=100 11x11 "
+            "filters, n=128x100^2, 8 blocks, 1 chip)",
+            "value": 1.5, "unit": "outer_iters/sec", "chip": "v5e",
+            "knobs": {"fft_impl": "matmul"}}},
+    ]
+    (tmp_path / "onchip_r5.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\n"
+    )
+    pt.REPO = str(tmp_path)
+    pt.TUNED = str(tmp_path / "bench_tuned.json")
+    assert pt.main() == 0
+    # flat-file pick unchanged (read-compat shim)
+    assert json.load(open(pt.TUNED))["fft_impl"] == "matmul"
+    # AND the store now holds the ranked arms for the chip key
+    st = ts.TunedStore(str(tmp_path / "tuned_knobs.json"))
+    key = ts.learn_shape_key(
+        "consensus2d", k=100, support=(11, 11), n=128,
+        size=(100, 100), blocks=8,
+    )
+    cands = st.candidates("v5e", "learn", key)
+    assert [c["value"] for c in cands] == [1.5, 1.0]
