@@ -12,6 +12,12 @@ smooth fill, masked coding against the pinned bank — and per-request
 PSNR + latency are reported, with p50/p99 and bucket occupancy at the
 end.
 
+--replicas N (or --max-queue-depth) serves through the fault-tolerant
+fleet instead (serve.ServeFleet): N engine replicas behind one front
+queue, health-driven requeue of a crashed/stalled replica's requests,
+and admission control — an Overloaded refusal here backs off for the
+fleet's retry-after hint and resubmits.
+
 Usage:
     python -m ccsc_code_iccv2017_tpu.apps.serve --filters f.mat \
         --data DIR [--bucket 64 --bucket 128:8] [--compile-cache DIR]
@@ -57,6 +63,20 @@ def build_parser() -> argparse.ArgumentParser:
         "env equivalent): warm engine restarts skip compilation",
     )
     p.add_argument(
+        "--replicas", type=int, default=1,
+        help="serve through a fault-tolerant fleet of N engine "
+        "replicas (serve.ServeFleet): health-driven requeue on a "
+        "crashed or stalled replica, idempotent delivery, admission "
+        "control with a predictable overload ladder. 1 (default) = a "
+        "single bare engine",
+    )
+    p.add_argument(
+        "--max-queue-depth", type=int, default=None,
+        help="fleet admission ceiling on queued requests (implies the "
+        "fleet path even with --replicas 1); default: derived live "
+        "from perfmodel.serving_bound x live replicas",
+    )
+    p.add_argument(
         "--no-aot", action="store_true",
         help="skip the startup AOT warmup (buckets compile lazily on "
         "first use)",
@@ -93,11 +113,11 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
     import jax.numpy as jnp  # noqa: F401  (backend init before engine)
 
-    from .. import ProblemGeom, ServeConfig, SolveConfig
+    from .. import FleetConfig, ProblemGeom, ServeConfig, SolveConfig
     from ..data.images import load_image_list
     from ..data.native import smooth_fill_batch
     from ..models.reconstruct import ReconstructionProblem
-    from ..serve import CodecEngine
+    from ..serve import CodecEngine, Overloaded, ServeFleet
     from ..utils.io_mat import load_filters_2d
 
     d = load_filters_2d(args.filters)
@@ -131,31 +151,63 @@ def main(argv=None):
         tune=args.tune,
         tune_store=args.tune_store,
     )
+    if args.replicas < 1:
+        raise SystemExit("--replicas must be >= 1")
+    fleet_mode = args.replicas > 1 or args.max_queue_depth is not None
     t0 = time.perf_counter()
-    engine = CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
-    print(
-        f"engine ready in {time.perf_counter() - t0:.2f}s "
-        f"({len(scfg.buckets)} bucket(s))"
-    )
+    if fleet_mode:
+        engine = ServeFleet(
+            d, ReconstructionProblem(geom), cfg, scfg,
+            FleetConfig(
+                replicas=args.replicas,
+                max_queue_depth=args.max_queue_depth,
+                metrics_dir=args.metrics_dir,
+            ),
+        )
+        print(
+            f"fleet ready in {time.perf_counter() - t0:.2f}s "
+            f"({args.replicas} replica(s), {len(scfg.buckets)} "
+            f"bucket(s), queue ceiling {engine.queue_ceiling})"
+        )
+    else:
+        engine = CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
+        print(
+            f"engine ready in {time.perf_counter() - t0:.2f}s "
+            f"({len(scfg.buckets)} bucket(s))"
+        )
 
     rng = np.random.default_rng(args.seed)
     n_skipped = 0
+    n_overloaded = 0
 
     def _submit(x, label):
-        nonlocal n_skipped
+        nonlocal n_skipped, n_overloaded
         mask = (rng.random(x.shape) < args.keep).astype(np.float32)
         sm = smooth_fill_batch(x[None], mask[None])[0]
-        try:
-            fut = engine.submit(
-                x * mask, mask=mask, smooth_init=sm, x_orig=x
-            )
-        except validate.CCSCInputError as e:
-            # one bad request (oversize for every bucket, NaN pixels)
-            # must not abort a live serving stream — report and move on
-            print(f"  {label}: SKIPPED ({e})")
-            n_skipped += 1
-            return None
-        return label, fut
+        while True:
+            try:
+                fut = engine.submit(
+                    x * mask, mask=mask, smooth_init=sm, x_orig=x
+                )
+            except Overloaded as e:
+                # explicit backpressure: the fleet told us how long to
+                # back off — honor it instead of dropping the request
+                # (this producer has nowhere else to shed load to)
+                n_overloaded += 1
+                print(
+                    f"  {label}: overloaded, retrying in "
+                    f"{e.retry_after_s:.2f}s"
+                )
+                time.sleep(e.retry_after_s)
+                continue
+            except validate.CCSCInputError as e:
+                # one bad request (oversize for every bucket, NaN
+                # pixels) must not abort a live serving stream —
+                # report and move on
+                print(f"  {label}: SKIPPED ({e})")
+                n_skipped += 1
+                return None
+            return label, fut
 
     outs = []  # (label, result) kept only when PNGs are written
     n_done = 0
@@ -240,7 +292,16 @@ def main(argv=None):
         except Exception:
             pass
     stats = engine.stats()
-    if stats["n_requests"]:
+    if fleet_mode and stats["n_requests"]:
+        print(
+            f"{stats['n_requests']} requests over "
+            f"{args.replicas} replica(s), "
+            f"{stats['n_requeued']} requeued, "
+            f"{n_overloaded} overload backoff(s), p50 "
+            f"{stats['p50_latency_s'] * 1e3:.1f} ms, p99 "
+            f"{stats['p99_latency_s'] * 1e3:.1f} ms"
+        )
+    elif stats["n_requests"]:
         print(
             f"{stats['n_requests']} requests, "
             f"{stats['n_dispatches']} dispatch(es), mean occupancy "

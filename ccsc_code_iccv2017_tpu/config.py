@@ -461,12 +461,21 @@ class ServeConfig:
     # tuned-knob store path (None = CCSC_TUNE_STORE env > next to the
     # compile cache > repo tuned_knobs.json; tune.store)
     tune_store: Optional[str] = None
+    # Identity of this engine within a serving fleet
+    # (serve.ServeFleet): stamped onto every serve_* obs record so
+    # per-replica health/traffic is readable from the stream. None
+    # (a standalone engine) records replica 0.
+    replica_id: Optional[int] = None
 
     def __post_init__(self):
         if self.tune not in ("off", "auto", "sweep"):
             raise ValueError(
                 f"tune must be 'off' | 'auto' | 'sweep', got "
                 f"{self.tune!r}"
+            )
+        if self.replica_id is not None and int(self.replica_id) < 0:
+            raise ValueError(
+                f"replica_id must be >= 0, got {self.replica_id}"
             )
         if not self.buckets:
             raise ValueError("ServeConfig.buckets must be non-empty")
@@ -503,4 +512,150 @@ class ServeConfig:
         if self.max_wait_ms < 0:
             raise ValueError(
                 f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Configuration of the fault-tolerant serving fleet
+    (serve.ServeFleet) — N replicated :class:`~serve.CodecEngine`\\ s
+    behind one front queue, with health-driven requeue and admission
+    control.
+
+    The replicas share nothing but the queue (the MPAX fleet-of-
+    jit-cached-solver-instances shape, PAPERS.md arXiv:2412.09734):
+    each owns a private engine built from the same pinned
+    (bank, problem, SolveConfig, ServeConfig), so a request served by
+    any replica is bit-identical to a single-engine serve of the same
+    request. Admission is bounded by a queue-depth ceiling — explicit
+    (``max_queue_depth``) or derived from the measured
+    ``utils.perfmodel.serving_bound`` x live-replica count x
+    ``max_queue_s`` — and overload walks a three-rung ladder
+    (shed micro-batch waiting -> reject with retry-after -> degrade
+    the solve budget) so saturation produces predictable latency
+    instead of OOM.
+    """
+
+    # number of engine replicas
+    replicas: int = 2
+    # explicit admission ceiling on queued (not yet assigned) requests;
+    # None = derive from perfmodel.serving_bound: once a dispatch has
+    # measured an iteration rate, ceiling = bound requests/sec x live
+    # replicas x max_queue_s (floored at min_queue_depth). Before any
+    # measurement a static floor of
+    # max(min_queue_depth, 2 x total slots x replicas) applies.
+    max_queue_depth: Optional[int] = None
+    # target worst-case queueing delay used by the derived ceiling
+    max_queue_s: float = 2.0
+    # floor of the derived ceiling (admission must never starve a
+    # healthy fleet)
+    min_queue_depth: int = 8
+    # per-request delivery attempts before the future gets an error
+    # (the exactly-once-OR-ERROR half of the delivery contract): a
+    # request is requeued when its replica dies or stalls, at most
+    # max_attempts - 1 times
+    max_attempts: int = 3
+    # per-replica restart budget (crash or stall casualties; the
+    # scripts/supervise.py discipline, in-process)
+    max_restarts: int = 3
+    # base restart delay; restart k of a replica sleeps
+    # restart_backoff_s * 2^(k-1), capped at 30 s
+    restart_backoff_s: float = 0.25
+    # health monitor cadence (overload-ladder evaluation + ceiling
+    # refresh); per-replica stall detection runs on the watchdog's own
+    # thread at watchdog cadence
+    health_interval_s: float = 0.1
+    # fleet_heartbeat cadence per replica (obs stream; the liveness
+    # signal scripts/obs_report.py and watchdog.check_replicas read)
+    heartbeat_s: float = 5.0
+    # slack multiplier on the per-replica dispatch deadline (same role
+    # as LearnConfig.watchdog_slack; the floor is CCSC_WATCHDOG_MIN_S)
+    stall_slack: float = 20.0
+    # overload ladder thresholds, as fractions of the queue ceiling:
+    # rung 1 (shed max_wait_ms micro-batch waiting) enters at shed_at
+    # and exits below shed_exit; rung 2 (reject) enters at 1.0 and
+    # exits below reject_exit
+    shed_at: float = 0.5
+    shed_exit: float = 0.25
+    reject_exit: float = 0.75
+    # rung 3 (degrade): sustained rejection for this many seconds
+    # recycles replicas onto a degraded solve budget
+    # (max_it x degrade_max_it_factor) — bounded latency under
+    # saturation at reduced solve quality. 0 disables rung 3.
+    degrade_after_s: float = 30.0
+    degrade_max_it_factor: float = 0.5
+    # delivery bookkeeping is BOUNDED (a serving process lives for
+    # days; per-request state must not grow to OOM under the very
+    # admission control that exists to prevent it): the newest
+    # key_window served/failed idempotency keys are remembered for
+    # at-most-once suppression and resubmit refusal — a straggler
+    # delayed by more than key_window requests, or a resubmit of a
+    # key that old, is outside the protection window
+    key_window: int = 100_000
+    # latency percentiles (stats / summary) are computed over the
+    # newest latency_window deliveries
+    latency_window: int = 10_000
+    # fleet telemetry dir (utils.obs): the fleet stream lands here and
+    # each replica engine's stream in a replica-NN/ subdir
+    metrics_dir: Optional[str] = None
+    verbose: str = "brief"
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError(
+                f"replicas must be >= 1, got {self.replicas}"
+            )
+        if self.max_queue_depth is not None and self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got "
+                f"{self.max_queue_depth}"
+            )
+        if self.max_queue_s <= 0:
+            raise ValueError(
+                f"max_queue_s must be > 0, got {self.max_queue_s}"
+            )
+        if self.min_queue_depth < 1:
+            raise ValueError(
+                f"min_queue_depth must be >= 1, got "
+                f"{self.min_queue_depth}"
+            )
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}"
+            )
+        if self.max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {self.max_restarts}"
+            )
+        if self.key_window < 1:
+            raise ValueError(
+                f"key_window must be >= 1, got {self.key_window}"
+            )
+        if self.latency_window < 1:
+            raise ValueError(
+                f"latency_window must be >= 1, got "
+                f"{self.latency_window}"
+            )
+        if self.stall_slack <= 0:
+            raise ValueError(
+                f"stall_slack must be > 0, got {self.stall_slack}"
+            )
+        if not (0.0 < self.shed_exit <= self.shed_at <= 1.0):
+            raise ValueError(
+                "need 0 < shed_exit <= shed_at <= 1, got "
+                f"shed_exit={self.shed_exit}, shed_at={self.shed_at}"
+            )
+        if not (0.0 < self.reject_exit <= 1.0):
+            raise ValueError(
+                f"reject_exit must be in (0, 1], got {self.reject_exit}"
+            )
+        if self.degrade_after_s < 0:
+            raise ValueError(
+                f"degrade_after_s must be >= 0, got "
+                f"{self.degrade_after_s}"
+            )
+        if not (0.0 < self.degrade_max_it_factor <= 1.0):
+            raise ValueError(
+                f"degrade_max_it_factor must be in (0, 1], got "
+                f"{self.degrade_max_it_factor}"
             )

@@ -7,10 +7,15 @@ production-shape replacement: :class:`CodecEngine` pins a dictionary
 bank + ReconstructionProblem + SolveConfig once and serves many
 requests fast — per-bank solve plans (models.reconstruct.ReconPlan),
 shape-bucketed AOT-compiled programs warmed at startup, and a
-micro-batching request queue.
+micro-batching request queue. :class:`ServeFleet` (serve.fleet) is the
+fault-tolerance layer above it: N replicated engines behind one front
+queue with health-driven requeue, idempotent result delivery, and
+admission control with a predictable overload ladder.
 """
 from .engine import (  # noqa: F401
     CodecEngine,
     ServedResult,
     enable_compile_cache,
+    pick_bucket,
 )
+from .fleet import Overloaded, ServeFleet  # noqa: F401

@@ -117,6 +117,30 @@ def _bucket_name(slots: int, spatial: Tuple[int, ...]) -> str:
     return f"{slots}@" + "x".join(str(s) for s in spatial)
 
 
+def pick_bucket(
+    buckets: Sequence[Tuple[int, Tuple[int, ...]]],
+    spatial: Sequence[int],
+) -> Tuple[int, Tuple[int, ...]]:
+    """Smallest bucket (of a volume-sorted table) that fits
+    ``spatial`` — shared by the engine's ``bucket_for`` and the
+    fleet's admission boundary (serve.ServeFleet must refuse an
+    oversize request BEFORE queueing it, not after a replica takes
+    it)."""
+    from ..utils import validate
+
+    spatial = tuple(int(s) for s in spatial)
+    for slots, bsp in buckets:  # sorted by volume
+        if len(spatial) == len(bsp) and all(
+            s <= t for s, t in zip(spatial, bsp)
+        ):
+            return (slots, bsp)
+    raise validate.CCSCInputError(
+        f"request spatial {spatial} exceeds every configured "
+        f"bucket {[sp for _, sp in buckets]} — add a larger "
+        "bucket to ServeConfig.buckets"
+    )
+
+
 def _valid_region_psnr(
     rec: np.ndarray, ref: np.ndarray, radius: Tuple[int, ...]
 ) -> float:
@@ -162,9 +186,24 @@ class CodecEngine:
     ):
         from ..utils import obs, validate
 
+        # close/drain machinery FIRST, before anything can fail: a
+        # caller's `finally: engine.close()` must be a no-op on an
+        # engine whose constructor raised, and close itself must be
+        # re-entrant (a fleet drain racing a user close)
+        self._close_lock = threading.Lock()
+        self._close_started = False
+        self._close_done = threading.Event()
+
         self.prob = prob
         self.cfg = cfg
         self.serve_cfg = serve_cfg
+        # fleet identity: every serve_* record names its replica so
+        # per-replica health is readable from a merged stream; a
+        # standalone engine is replica 0
+        self._replica_id = (
+            0 if serve_cfg.replica_id is None
+            else int(serve_cfg.replica_id)
+        )
         geom: ProblemGeom = prob.geom
         self.geom = geom
         ndim_s = geom.ndim_spatial
@@ -195,6 +234,11 @@ class CodecEngine:
             verbose=serve_cfg.verbose,
             geom=geom,
             cfg=cfg,
+            # a fleet replica's run nests under the fleet's open run:
+            # compile events are process-wide, so only the fleet
+            # stream harvests them (once) — N replica monitors would
+            # each record every sibling's compiles and cache hits
+            compile_monitor=serve_cfg.replica_id is None,
             buckets=[
                 {"slots": s, "spatial": list(sp)}
                 for s, sp in serve_cfg.buckets
@@ -249,7 +293,11 @@ class CodecEngine:
             # a failed construction (bad blur rank, OOM compiling an
             # oversized bucket) must not leak the open telemetry run or
             # leave the process-global CompileMonitor installed — later
-            # runs would double-count compiles against it
+            # runs would double-count compiles against it. The close
+            # latch is consumed too: a later close() is a clean no-op.
+            with self._close_lock:
+                self._close_started = True
+            self._close_done.set()
             self._run.close(status="error")
             raise
 
@@ -300,7 +348,7 @@ class CodecEngine:
                 ).compile()
             else:
                 self._compiled[key] = fn
-            self._run.event(
+            self._emit(
                 "serve_warmup",
                 bucket=_bucket_name(slots, spatial),
                 aot=bool(serve_cfg.aot_warmup),
@@ -312,7 +360,7 @@ class CodecEngine:
                 knobs=self._knob_dict,
             )
         mon = self._run.compile_monitor
-        self._run.event(
+        self._emit(
             "serve_ready",
             n_buckets=len(self._buckets),
             warmup_s=round(time.perf_counter() - t_warm0, 4),
@@ -338,6 +386,10 @@ class CodecEngine:
         }
         self._n_pending = 0
         self._closed = False
+        # live flush deadline (set_max_wait_ms): the fleet's overload
+        # ladder sheds micro-batch waiting without rebuilding engines
+        self._max_wait_s = serve_cfg.max_wait_ms / 1e3
+        self._last_it_rate = 0.0  # newest dispatch's measured it/s
         self._latencies: List[float] = []
         self._n_dispatches = 0
         self._occupancy_sum = 0.0
@@ -347,34 +399,37 @@ class CodecEngine:
         self._worker.start()
 
     # ------------------------------------------------------------------
+    def _emit(self, type_: str, **fields) -> None:
+        """Every serve_* record rides through here so it carries the
+        replica identity — the per-replica health contract a lint test
+        enforces (bypassing this helper for a serve event is a
+        regression)."""
+        self._run.event(type_, replica_id=self._replica_id, **fields)
+
     def bucket_for(self, spatial: Sequence[int]) -> Tuple[int, Tuple[int, ...]]:
         """Smallest configured bucket that fits ``spatial``."""
-        from ..utils import validate
-
-        spatial = tuple(int(s) for s in spatial)
-        for slots, bsp in self._buckets:  # sorted by volume
-            if all(s <= t for s, t in zip(spatial, bsp)):
-                return (slots, bsp)
-        raise validate.CCSCInputError(
-            f"request spatial {spatial} exceeds every configured "
-            f"bucket {[sp for _, sp in self._buckets]} — add a larger "
-            "bucket to ServeConfig.buckets"
-        )
+        return pick_bucket(self._buckets, spatial)
 
     def submit(
-        self, b, mask=None, smooth_init=None, x_orig=None
+        self, b, mask=None, smooth_init=None, x_orig=None,
+        _validated: bool = False,
     ) -> "Future[ServedResult]":
         """Enqueue one observation [*reduce, *spatial] (no batch axis);
         returns a Future resolving to :class:`ServedResult`. Only the
         cheap per-request checks run here (utils.validate
         check_serve_request) — the operator was validated at
-        construction."""
+        construction. ``_validated`` is fleet-internal: the fleet runs
+        the identical checks (including the O(N) finiteness scans) at
+        admission and canonicalizes the arrays to float32, so its
+        dispatch — and every requeue retry — must not pay them again
+        per ownership."""
         from ..utils import validate
 
-        validate.check_serve_request(
-            b, self.geom, mask=mask, smooth_init=smooth_init,
-            x_orig=x_orig,
-        )
+        if not _validated:
+            validate.check_serve_request(
+                b, self.geom, mask=mask, smooth_init=smooth_init,
+                x_orig=x_orig,
+            )
         spatial = tuple(int(s) for s in b.shape[self.geom.ndim_reduce:])
         key = self.bucket_for(spatial)
         p = _Pending(
@@ -393,7 +448,7 @@ class CodecEngine:
             t_submit=time.perf_counter(),
         )
         with self._cv:
-            if self._closed:
+            if self._closed or self._close_started:
                 raise RuntimeError("engine is closed")
             self._pending[key].append(p)
             self._n_pending += 1
@@ -417,13 +472,16 @@ class CodecEngine:
 
     # ------------------------------------------------------------------
     def _work_loop(self):
-        max_wait = self.serve_cfg.max_wait_ms / 1e3
         while True:
             with self._cv:
                 while not self._closed and self._n_pending == 0:
                     self._cv.wait()
                 if self._closed and self._n_pending == 0:
                     return
+                # read under the lock, every pass: set_max_wait_ms
+                # (overload rung 1) retargets the deadline live, and
+                # its notify lands us back here with the fresh value
+                max_wait = self._max_wait_s
                 now = time.perf_counter()
                 # deadline-expired buckets flush FIRST: a steady stream
                 # keeping one bucket full must not starve another
@@ -463,7 +521,7 @@ class CodecEngine:
                 for p in batch:
                     if not p.future.done():
                         p.future.set_exception(e)
-                self._run.event("serve_error", error=str(e)[:300])
+                self._emit("serve_error", error=str(e)[:300])
 
     def _dispatch(self, key, batch: List[_Pending], depth_after: int):
         from ..models.reconstruct import ReconTrace
@@ -539,7 +597,7 @@ class CodecEngine:
                 z=z[i, 0] if z is not None else None,
             )
             p.future.set_result(res)
-            self._run.event(
+            self._emit(
                 "serve_request",
                 bucket=name,
                 spatial=list(p.spatial),
@@ -552,6 +610,11 @@ class CodecEngine:
         self._n_dispatches += 1
         self._occupancy_sum += occ
         it_rate = max_it / dt if dt > 0 and max_it else 0.0
+        if it_rate > 0:
+            # the fleet's ceiling derivation reads the newest measured
+            # rate (perfmodel.serving_bound input) without re-parsing
+            # the stream
+            self._last_it_rate = it_rate
         # the bound is the FULL-bucket ceiling at this dispatch's
         # measured iteration rate (occupancy=1.0) — the achieved
         # len(batch)/dt sits below it exactly by the unfilled slots,
@@ -559,7 +622,7 @@ class CodecEngine:
         bound = perfmodel.serving_bound(
             it_rate, max(max_it, 1), slots, occupancy=1.0
         )
-        self._run.event(
+        self._emit(
             "serve_dispatch",
             bucket=name,
             n=len(batch),
@@ -596,48 +659,127 @@ class CodecEngine:
             "p99_latency_s": pct(0.99),
         }
 
+    @property
+    def closed(self) -> bool:
+        """True once close() has been called (or construction failed)
+        — the liveness poll the fleet uses before handing an engine
+        more work. The engine may still be draining when this flips;
+        ``close()`` from any thread blocks until the drain finishes."""
+        return self._close_started
+
+    @property
+    def last_it_rate(self) -> float:
+        """Measured iteration rate of the newest dispatch (it/s; 0.0
+        before any dispatch) — the ``perfmodel.serving_bound`` input
+        the fleet's derived admission ceiling is computed from."""
+        return self._last_it_rate
+
+    def set_max_wait_ms(self, ms: float) -> None:
+        """Retarget the micro-batch flush deadline live (overload
+        ladder rung 1 sheds batching waits by setting 0; leaving the
+        rung restores the configured value). Assigned UNDER the queue
+        lock: the worker reads the deadline under the same lock on
+        every evaluation pass, so the notify can never be consumed by
+        a pass that still carries the stale value."""
+        with self._cv:
+            self._max_wait_s = max(0.0, float(ms)) / 1e3
+            self._cv.notify_all()
+
+    def drain_pending(self) -> List[Dict]:
+        """Handoff hook (serve.ServeFleet): atomically remove every
+        request still in the micro-batch queue — NOT yet in a dispatch
+        — and return its payload
+        (``{b, mask, smooth_init, x_orig, future}`` per entry) so the
+        caller can requeue it onto another replica. Each returned
+        engine Future is cancelled; requests already dispatching are
+        untouched and resolve normally. Safe at any lifecycle point,
+        including after (or racing) close()."""
+        out: List[Dict] = []
+        cv = getattr(self, "_cv", None)
+        if cv is None:  # construction never reached the queue
+            return out
+        taken: List[_Pending] = []
+        with cv:
+            for k in self._pending:
+                taken.extend(self._pending[k])
+                self._n_pending -= len(self._pending[k])
+                self._pending[k] = []
+        for p in taken:
+            p.future.cancel()
+            out.append(
+                {
+                    "b": p.b,
+                    "mask": p.mask,
+                    "smooth_init": p.smooth_init,
+                    "x_orig": p.x_orig,
+                    "future": p.future,
+                }
+            )
+        if taken:
+            self._emit("serve_drain", n=len(taken))
+        return out
+
     def close(self):
         """Flush every pending request, stop the worker, and close the
-        telemetry run with the latency summary. Idempotent."""
-        with self._cv:
-            if self._closed:
-                already = True
-            else:
-                already = False
-                self._closed = True
-                self._cv.notify_all()
-        if not already:
-            # wait for the worker to actually finish draining — closing
-            # the telemetry run while a final dispatch is in flight
-            # would drop its serve_request/serve_dispatch events and
-            # undercut the summary. Dispatches are finite, so this
-            # terminates; a long solve just gets a periodic notice.
-            while self._worker.is_alive():
-                self._worker.join(timeout=60)
-                if self._worker.is_alive():
-                    self._run.console(
-                        "serve: close() waiting on an in-flight "
-                        "dispatch to drain",
-                        tier="always",
-                    )
-        if not self._run.closed:
-            st = self.stats()
-            self._run.close(
-                status="ok",
-                n_requests=st["n_requests"],
-                n_dispatches=st["n_dispatches"],
-                mean_occupancy=round(st["mean_occupancy"], 4),
-                p50_latency_s=(
-                    round(st["p50_latency_s"], 5)
-                    if st["p50_latency_s"] is not None
-                    else None
-                ),
-                p99_latency_s=(
-                    round(st["p99_latency_s"], 5)
-                    if st["p99_latency_s"] is not None
-                    else None
-                ),
-            )
+        telemetry run with the latency summary.
+
+        Re-entrant AND race-safe: any number of callers (the user, a
+        fleet drain, ``__exit__``) may call concurrently — the first
+        performs the shutdown, the rest block until it has finished
+        and then return. A no-op on an engine whose constructor
+        raised."""
+        with self._close_lock:
+            owner = not self._close_started
+            self._close_started = True
+        if not owner:
+            self._close_done.wait()
+            return
+        try:
+            # a constructor that raised in the pre-telemetry
+            # validation block never assigned _run/_cv — the
+            # documented no-op contract must hold from the first
+            # statement of __init__ onward, so every late-constructed
+            # attribute is getattr-guarded here
+            run = getattr(self, "_run", None)
+            cv = getattr(self, "_cv", None)
+            if cv is not None:
+                with cv:
+                    self._closed = True
+                    cv.notify_all()
+                # wait for the worker to actually finish draining —
+                # closing the telemetry run while a final dispatch is
+                # in flight would drop its serve_request/serve_dispatch
+                # events and undercut the summary. Dispatches are
+                # finite, so this terminates; a long solve just gets a
+                # periodic notice.
+                while self._worker.is_alive():
+                    self._worker.join(timeout=60)
+                    if self._worker.is_alive():
+                        run.console(
+                            "serve: close() waiting on an in-flight "
+                            "dispatch to drain",
+                            tier="always",
+                        )
+            if run is not None and not run.closed:
+                st = self.stats()
+                run.close(
+                    status="ok",
+                    n_requests=st["n_requests"],
+                    n_dispatches=st["n_dispatches"],
+                    mean_occupancy=round(st["mean_occupancy"], 4),
+                    p50_latency_s=(
+                        round(st["p50_latency_s"], 5)
+                        if st["p50_latency_s"] is not None
+                        else None
+                    ),
+                    p99_latency_s=(
+                        round(st["p99_latency_s"], 5)
+                        if st["p99_latency_s"] is not None
+                        else None
+                    ),
+                )
+        finally:
+            self._close_done.set()
 
     def __enter__(self):
         return self

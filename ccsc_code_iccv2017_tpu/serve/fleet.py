@@ -1,0 +1,1300 @@
+"""Fault-tolerant serving fleet: replicated engines behind one queue.
+
+One :class:`~.engine.CodecEngine` on one device has no survival story:
+an engine stall or crash loses every queued request, and overload has
+no admission path short of OOM. :class:`ServeFleet` is the fleet
+layer — N engine replicas that share NOTHING but a front queue (the
+MPAX fleet of jit-cached solver instances over pinned problem
+structure, PAPERS.md arXiv:2412.09734; the ``vmap``-of-independent-
+n=1-solves batch shape means replicas need no coordination beyond
+request ownership):
+
+1. **Durable front queue + idempotency keys.** Durability is against
+   REPLICA failure: every request carries an idempotency key, a
+   replica owns the requests it has taken, and when a replica dies or
+   stalls its undelivered requests are requeued (at the front — they
+   already waited their turn) onto survivors. Delivery is
+   at-most-once (a recovered straggler's late result for an
+   already-delivered key is suppressed, counted as
+   ``fleet_duplicate_suppressed``) and each request resolves
+   exactly-once-or-error: after ``FleetConfig.max_attempts`` failed
+   ownerships the future gets an explicit error instead of silent
+   retry-forever.
+2. **Health-driven drain.** Each replica worker arms a per-replica
+   :class:`~..utils.watchdog.DispatchWatchdog` (event mode + the
+   ``on_stall`` authority hook) around its dispatch fence — the same
+   deadline rules as the learner drivers (MIN_S floor, first-fence
+   compile allowance, self-calibration against observed clean
+   fences). A stalled or dead replica is retired, its requests are
+   requeued, and a replacement engine is rebuilt from the warm
+   persistent compile cache (``ServeConfig.compile_cache``) under a
+   per-replica restart budget with exponential backoff — the
+   ``scripts/supervise.py`` discipline, in-process. Injected chaos
+   (``CCSC_FAULT_ENGINE_KILL_REQ`` / ``CCSC_FAULT_ENGINE_HANG_REQ``,
+   utils.faults, fire-once per replica) makes both paths provable on
+   CPU (tests/test_fleet.py, scripts/chaos_smoke.py ``fleet_kill``).
+3. **Admission control + predictable overload.** ``submit`` refuses
+   work beyond a queue-depth ceiling — explicit
+   (``FleetConfig.max_queue_depth``) or derived live from
+   ``utils.perfmodel.serving_bound`` x live replicas x
+   ``max_queue_s`` — raising :class:`Overloaded` with a retry-after
+   hint instead of growing the queue to OOM. Below the ceiling a
+   three-rung ladder keeps latency predictable: rung 1 sheds the
+   ``max_wait_ms`` micro-batch waiting (``set_max_wait_ms(0)``),
+   rung 2 rejects new requests, rung 3 (sustained rejection) recycles
+   replicas onto a degraded solve budget (``max_it`` x
+   ``degrade_max_it_factor`` — the serving face of the PR 4 degrade
+   ladder, each transition a ``degrade`` obs event).
+
+Telemetry: the fleet stream (``FleetConfig.metrics_dir``) carries
+``fleet_heartbeat`` (per replica: state/served/inflight — the
+liveness signal ``utils.watchdog.check_replicas`` and
+``scripts/obs_report.py`` FLEET read with the ``--stale-after``
+rule), ``fleet_request`` / ``fleet_requeue`` /
+``fleet_duplicate_suppressed``, replica lifecycle
+(``fleet_replica_dead`` / ``_restart`` / ``_ready`` /
+``_abandoned``), ``fleet_admission_reject``, ``fleet_ceiling`` and
+``fleet_overload`` rung transitions; every record carries a
+``replica_id`` field (None for fleet-scope records — lint-enforced).
+Each replica engine's own serve_* stream lands in a ``replica-NN/``
+subdir (``obs.read_events(recursive=True)`` merges them).
+
+Exactness: replicas are built from the same pinned
+(bank, problem, SolveConfig, ServeConfig), so a request served by ANY
+replica — including after a mid-stream handoff — is bit-identical to
+a single unfaulted engine's serve of the same request (the chaos
+parity contract of tests/test_fleet.py). Only rung 3 trades solve
+budget for latency, and it announces itself in the stream.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future, InvalidStateError
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from ..config import FleetConfig, ServeConfig, SolveConfig
+from .engine import CodecEngine, ServedResult, pick_bucket
+
+__all__ = ["ServeFleet", "Overloaded", "RUNGS"]
+
+# the overload ladder, least to most drastic
+RUNGS = ("normal", "shed_batching", "reject", "degrade")
+
+
+class Overloaded(RuntimeError):
+    """Admission refusal: the fleet's queue is at its ceiling. Carries
+    ``retry_after_s`` — the caller should back off that long before
+    resubmitting (explicit backpressure instead of silent queue growth
+    and eventual OOM)."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+@dataclasses.dataclass
+class _FleetRequest:
+    key: str
+    b: np.ndarray
+    mask: Optional[np.ndarray]
+    smooth_init: Optional[np.ndarray]
+    x_orig: Optional[np.ndarray]
+    future: Future
+    t_submit: float
+    attempts: int = 0  # ownerships so far (incremented at take)
+
+
+class _Replica:
+    """One engine replica: identity, worker thread, health state.
+
+    ``state``: 'live' -> ('dead' | 'stalled' | 'recycling') ->
+    replaced by a fresh _Replica of the same id (generation + 1).
+    ``retired`` flags the worker to stop taking work; a wedged worker
+    that later wakes finds it set and exits after its (suppressed)
+    deliveries."""
+
+    def __init__(self, rid: int, generation: int, engine: CodecEngine,
+                 watchdog, degraded: bool = False) -> None:
+        self.id = rid
+        self.generation = generation
+        self.engine = engine
+        self.watchdog = watchdog
+        self.degraded = degraded  # built on the reduced solve budget?
+        self.state = "live"
+        self.retired = False
+        # the casualty handoff (requeue + replacement scheduling) has
+        # run for this replica — exactly one of the stall handler, the
+        # death handler, or the worker's clean recycle exit performs
+        # it (a recycle marks `retired` without handing off, so the
+        # handoff is still owed if the worker then crashes or stalls)
+        self.reaped = False
+        self.req_seq = 0  # requests taken, lifetime of this generation
+        self.served = 0
+        self.assigned: List[_FleetRequest] = []
+        self.thread: Optional[threading.Thread] = None
+
+
+class ServeFleet:
+    """N replicated CodecEngines behind one durable front queue.
+
+    API mirrors :class:`~.engine.CodecEngine` — ``submit`` returns a
+    Future of :class:`~.engine.ServedResult`, plus ``reconstruct`` /
+    ``serve_many`` / ``stats`` / ``close`` / context manager — with
+    two additions: ``submit`` takes an optional idempotency ``key``
+    and may raise :class:`Overloaded`.
+    """
+
+    def __init__(self, d, prob, cfg: SolveConfig,
+                 serve_cfg: ServeConfig, fleet_cfg: FleetConfig,
+                 blur_psf=None):
+        from ..utils import obs, validate
+
+        self._close_lock = threading.Lock()
+        self._close_started = False
+        self._close_done = threading.Event()
+        # set by close(): wakes restart threads out of their backoff
+        # sleep so they can be joined instead of left running engine
+        # construction (XLA teardown from a live daemon thread at
+        # interpreter exit aborts the process)
+        self._closing = threading.Event()
+        self._restart_threads: List[threading.Thread] = []
+
+        # fail on a garbage bank/config ONCE, before N engines build
+        validate.check_solve_config(cfg)
+        validate.check_filters(d, prob.geom)
+        self.geom = prob.geom
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg
+        self.fleet_cfg = fleet_cfg
+        self._d = d
+        self._prob = prob
+        self._blur_psf = blur_psf
+        # already normalized + volume-sorted by ServeConfig.__post_init__
+        self.buckets = serve_cfg.buckets
+        self._total_slots = sum(s for s, _ in self.buckets)
+        self._take_cap = max(s for s, _ in self.buckets)
+
+        self._cv = threading.Condition()
+        self._queue: Deque[_FleetRequest] = deque()
+        self._index: Dict[str, _FleetRequest] = {}  # queued/assigned
+        # served / failed idempotency keys, BOUNDED to the newest
+        # FleetConfig.key_window each (insertion order = eviction
+        # order): a long-lived fleet must not grow per-request state
+        # forever — suppression and resubmit refusal hold within the
+        # window, which only a straggler delayed by key_window
+        # requests can outlive
+        self._delivered: "OrderedDict[str, None]" = OrderedDict()
+        # keys whose future got an error (max_attempts / no capacity):
+        # a late straggler result for one is suppressed, and the key is
+        # spent — exactly-once-OR-error, never both
+        self._failed_keys: "OrderedDict[str, None]" = OrderedDict()
+        # replica ids whose restart budget is exhausted — these never
+        # come back; every OTHER retired replica has a restart pending
+        self._abandoned: set = set()
+        # latency sample for the stats percentiles, newest
+        # latency_window deliveries (the delivered COUNT is
+        # _n_delivered, which never truncates)
+        self._latencies: Deque[float] = deque(
+            maxlen=fleet_cfg.latency_window
+        )
+        self._n_delivered = 0
+        self._seq = 0
+        self._n_requeued = 0
+        self._n_duplicates = 0
+        self._n_rejected = 0
+        self._n_failed = 0
+        self._restarts: Dict[int, int] = {}
+        self._replicas: List[Optional[_Replica]] = [None] * (
+            fleet_cfg.replicas
+        )
+        self._degraded = False
+        self._recycling = False
+        self._rung = 0
+        self._rung2_since: Optional[float] = None
+        self._bound_rps = 0.0
+        self._ceiling_derived = False
+        self._ceiling = fleet_cfg.max_queue_depth or max(
+            fleet_cfg.min_queue_depth,
+            2 * self._total_slots * fleet_cfg.replicas,
+        )
+
+        self._run = obs.start_run(
+            fleet_cfg.metrics_dir,
+            algorithm="serve_fleet",
+            verbose=fleet_cfg.verbose,
+            geom=prob.geom,
+            cfg=cfg,
+            replicas=fleet_cfg.replicas,
+            buckets=[
+                {"slots": s, "spatial": list(sp)}
+                for s, sp in self.buckets
+            ],
+            max_queue_depth=fleet_cfg.max_queue_depth,
+        )
+        try:
+            for rid in range(fleet_cfg.replicas):
+                self._replicas[rid] = self._spawn_replica(
+                    rid, generation=0, degraded=False
+                )
+            self._emit(
+                "fleet_start",
+                replica_id=None,
+                replicas=fleet_cfg.replicas,
+                queue_ceiling=self._ceiling,
+                ceiling_source=(
+                    "explicit" if fleet_cfg.max_queue_depth
+                    else "static_floor"
+                ),
+            )
+            self._stop_monitor = threading.Event()
+            self._hb_last = 0.0
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="ccsc-fleet-monitor",
+                daemon=True,
+            )
+            self._monitor.start()
+        except BaseException:
+            with self._close_lock:
+                self._close_started = True
+            self._closing.set()
+            self._close_done.set()
+            for rep in self._replicas:
+                if rep is not None:
+                    try:
+                        rep.watchdog.stop()
+                    except Exception:
+                        pass
+                    try:
+                        rep.engine.close()
+                    except Exception:
+                        pass
+            self._run.close(status="error")
+            raise
+        self._run.console(
+            f"fleet: {fleet_cfg.replicas} replica(s) live, queue "
+            f"ceiling {self._ceiling}",
+            tier="brief",
+        )
+
+    # -- telemetry -----------------------------------------------------
+    def _emit(self, type_: str, *, replica_id, **fields) -> None:
+        """Single emission point for fleet records: ``replica_id`` is
+        a REQUIRED argument (None only for fleet-scope records like
+        admission/ceiling) so per-replica attribution can never be
+        forgotten silently — the companion of the engine's ``_emit``,
+        both lint-enforced."""
+        self._run.event(type_, replica_id=replica_id, **fields)
+
+    # -- replica lifecycle ---------------------------------------------
+    def _engine_cfg(self, degraded: bool) -> SolveConfig:
+        if not degraded:
+            return self.cfg
+        f = self.fleet_cfg.degrade_max_it_factor
+        return dataclasses.replace(
+            self.cfg, max_it=max(1, int(self.cfg.max_it * f))
+        )
+
+    def _spawn_replica(
+        self, rid: int, generation: int, degraded: bool
+    ) -> _Replica:
+        from ..utils import watchdog as wd_mod
+
+        scfg = dataclasses.replace(
+            self.serve_cfg,
+            replica_id=rid,
+            metrics_dir=(
+                None if self.fleet_cfg.metrics_dir is None
+                else os.path.join(
+                    self.fleet_cfg.metrics_dir, f"replica-{rid:02d}"
+                )
+            ),
+        )
+        engine = CodecEngine(
+            self._d, self._prob, self._engine_cfg(degraded), scfg,
+            blur_psf=self._blur_psf,
+        )
+        if self._rung >= 1:
+            # a replica (re)built while the ladder is shedding must
+            # inherit the shed micro-batch deadline, not wait out the
+            # configured one under exactly the pressure rung 1 exists
+            # for
+            try:
+                engine.set_max_wait_ms(0.0)
+            except Exception:
+                pass
+        watchdog = wd_mod.DispatchWatchdog(
+            0.0,  # no analytic cost model: MIN_S floor + self-calibration
+            action="event",
+            algorithm="serve_fleet",
+            replica_id=rid,
+            run=self._run,  # stall records land in the FLEET stream,
+            # not whichever replica's run happens to be newest
+        )
+        rep = _Replica(rid, generation, engine, watchdog, degraded)
+        # the hook closes over the replica GENERATION: a stale
+        # watchdog can never retire its successor
+        watchdog.on_stall = (
+            lambda label, rep=rep: self._on_replica_stall(rep, label)
+        )
+        rep.thread = threading.Thread(
+            target=self._worker_loop, args=(rep,),
+            name=f"ccsc-fleet-r{rid}", daemon=True,
+        )
+        rep.thread.start()
+        return rep
+
+    def _on_replica_stall(self, rep: _Replica, label: str) -> None:
+        with self._cv:
+            if rep.reaped or (
+                rep.retired and rep.state != "recycling"
+            ):
+                # someone already handed this replica off (or a death
+                # handler is about to — reaped gates exactly one)
+                return
+            rep.reaped = True
+            rep.retired = True
+            rep.state = "stalled"
+        self._emit(
+            "fleet_replica_dead", replica_id=rep.id, reason="stall",
+            label=label,
+        )
+        self._run.console(
+            f"fleet: replica {rep.id} stalled ({label}) — draining "
+            "and restarting",
+            tier="always",
+        )
+        self._requeue_from(rep, reason="stall")
+        # cancel work still sitting in the stalled engine's micro-batch
+        # queue: the fleet just requeued its own copies, and a
+        # cancelled engine future unwedges the abandoned worker's
+        # result() wait if it ever wakes
+        try:
+            rep.engine.drain_pending()
+        except Exception:
+            pass
+        # the wedged worker thread is abandoned (daemon); if it ever
+        # wakes it finds `retired` set, its late deliveries are
+        # suppressed by the idempotency set, and it closes its engine
+        # on the way out
+        self._schedule_restart(rep)
+
+    def _on_replica_death(self, rep: _Replica, exc: BaseException) -> None:
+        with self._cv:
+            # `reaped` is the handoff gate, not `retired`: a replica
+            # retired for a rung-3 recycle still OWES its handoff — if
+            # its worker crashes mid-dispatch before the clean recycle
+            # exit, this handler must requeue its in-flight requests
+            # and respawn the slot, or they are lost and the slot
+            # stays a dead husk
+            already = rep.reaped
+            if not already:
+                rep.reaped = True
+                rep.retired = True
+                rep.state = "dead"
+        if already:
+            # stall handler already drained + restarted this replica;
+            # we are its abandoned worker waking up (often via the
+            # drain's cancelled engine futures) — release the old
+            # engine on the way out, nobody else holds it anymore
+            try:
+                rep.engine.close()
+            except Exception:
+                pass
+            return
+        self._emit(
+            "fleet_replica_dead", replica_id=rep.id, reason="crash",
+            error=f"{type(exc).__name__}: {exc}"[:300],
+        )
+        self._run.console(
+            f"fleet: replica {rep.id} died ({type(exc).__name__}) — "
+            "requeueing its requests and restarting",
+            tier="always",
+        )
+        self._requeue_from(rep, reason="crash")
+        try:
+            # the fleet just requeued its own copies of everything the
+            # engine still holds — drain them so close() below doesn't
+            # spend a dispatch serving results nobody will read
+            rep.engine.drain_pending()
+            rep.engine.close()
+        except Exception:
+            pass
+        self._schedule_restart(rep)
+
+    def _schedule_restart(self, rep: _Replica, charge: bool = True) -> None:
+        """``charge=False`` for ladder recycles: a rung transition is
+        maintenance, not a failure — it must neither consume the
+        crash-restart budget nor escalate the backoff."""
+        exhausted = False
+        with self._cv:
+            if self._close_started:
+                return
+            n = self._restarts.get(rep.id, 0)
+            if not charge:
+                attempt = 1
+            elif n >= self.fleet_cfg.max_restarts:
+                self._abandoned.add(rep.id)
+                exhausted = True
+            else:
+                self._restarts[rep.id] = n + 1
+                attempt = n + 1
+        if exhausted:
+            self._emit(
+                "fleet_replica_abandoned", replica_id=rep.id,
+                restarts=n,
+            )
+            self._run.console(
+                f"fleet: replica {rep.id} restart budget "
+                f"({self.fleet_cfg.max_restarts}) exhausted — "
+                "serving on survivors",
+                tier="always",
+            )
+            self._fail_if_no_capacity()
+            return
+        t = threading.Thread(
+            target=self._restart, args=(rep, attempt),
+            name=f"ccsc-fleet-restart-r{rep.id}", daemon=True,
+        )
+        with self._cv:
+            self._restart_threads = [
+                x for x in self._restart_threads if x.is_alive()
+            ]
+            self._restart_threads.append(t)
+        t.start()
+
+    def _restart(self, old: _Replica, attempt: int) -> None:
+        try:
+            old.watchdog.stop()
+        except Exception:
+            pass
+        delay = min(
+            self.fleet_cfg.restart_backoff_s * (2 ** (attempt - 1)),
+            30.0,
+        )
+        if delay > 0 and self._closing.wait(delay):
+            return
+        if self._close_started:
+            return
+        self._emit(
+            "fleet_replica_restart", replica_id=old.id,
+            attempt=attempt, degraded=self._degraded,
+        )
+        try:
+            rep = self._spawn_replica(
+                old.id, old.generation + 1, degraded=self._degraded
+            )
+        except Exception as e:
+            self._emit(
+                "fleet_replica_dead", replica_id=old.id,
+                reason="restart_failed",
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
+            self._schedule_restart(old)
+            return
+        with self._cv:
+            closing = self._close_started
+            if not closing:
+                self._replicas[old.id] = rep
+                self._cv.notify_all()
+        if closing:
+            # close() raced the rebuild and will never see this
+            # replica — release it here instead of leaking the engine
+            rep.retired = True
+            try:
+                rep.watchdog.stop()
+            except Exception:
+                pass
+            rep.engine.close()
+            return
+        self._emit(
+            "fleet_replica_ready", replica_id=old.id,
+            generation=rep.generation,
+            warm=bool(rep.engine.cache_dir),
+            degraded=self._degraded,
+        )
+
+    def _fail_if_no_capacity(self) -> None:
+        """Called (NOT under self._cv) when a replica is abandoned: if
+        NO replica is live or coming back, pending futures can never
+        resolve — fail them explicitly (exactly-once-or-error). A
+        replica that is merely retired (restart backoff / rebuild in
+        flight) counts as coming back — only budget exhaustion
+        (``_abandoned``) is terminal, so a transient all-retired
+        window must not error recoverable requests. The exceptions are
+        set AFTER the lock is released (same discipline as
+        ``_requeue_from`` / ``close``): ``Future.set_exception`` runs
+        done-callbacks synchronously, and a client callback that
+        re-enters the fleet — e.g. resubmitting under a fresh key —
+        would deadlock on the non-reentrant Condition."""
+        with self._cv:
+            alive = any(
+                rid not in self._abandoned
+                for rid in range(self.fleet_cfg.replicas)
+            )
+            if alive:
+                return
+            doomed = list(self._queue)
+            self._queue.clear()
+            for r in doomed:
+                self._index.pop(r.key, None)
+                self._remember(self._failed_keys, r.key)
+            self._n_failed += len(doomed)
+        for r in doomed:
+            try:
+                r.future.set_exception(
+                    RuntimeError(
+                        "fleet has no live replicas left (restart "
+                        "budgets exhausted)"
+                    )
+                )
+            except InvalidStateError:
+                pass
+
+    # -- requeue / delivery --------------------------------------------
+    def _remember(self, store: "OrderedDict[str, None]", key: str) -> None:
+        """Record a spent key (served or failed) under self._cv,
+        evicting the oldest beyond FleetConfig.key_window."""
+        store[key] = None
+        while len(store) > self.fleet_cfg.key_window:
+            store.popitem(last=False)
+
+    def _requeue_from(self, rep: _Replica, reason: str) -> None:
+        failed: List[_FleetRequest] = []
+        with self._cv:
+            lost = [
+                r for r in rep.assigned
+                if r.key not in self._delivered
+                and not r.future.cancelled()
+            ]
+            rep.assigned = []
+            requeued = []
+            for r in lost:
+                if r.attempts >= self.fleet_cfg.max_attempts:
+                    failed.append(r)
+                    self._index.pop(r.key, None)
+                    self._remember(self._failed_keys, r.key)
+                else:
+                    requeued.append(r)
+            # hand-offs go to the FRONT of the queue: they already
+            # waited their turn once
+            for r in reversed(requeued):
+                self._queue.appendleft(r)
+            self._n_requeued += len(requeued)
+            self._n_failed += len(failed)
+            self._cv.notify_all()
+        for r in failed:
+            try:
+                r.future.set_exception(
+                    RuntimeError(
+                        f"request {r.key!r} failed after "
+                        f"{r.attempts} delivery attempts "
+                        "(exactly-once-or-error: no result was "
+                        "delivered)"
+                    )
+                )
+            except InvalidStateError:
+                pass
+        if requeued or failed:
+            # a casualty that had already delivered everything it took
+            # is not a hand-off — emitting n=0 records here would
+            # inflate the FLEET report's drain count on every clean
+            # restart
+            self._emit(
+                "fleet_requeue", replica_id=rep.id, reason=reason,
+                n=len(requeued), n_failed=len(failed),
+                keys=[r.key for r in requeued][:16],
+            )
+
+    def _deliver(
+        self, rep: _Replica, req: _FleetRequest, res: ServedResult
+    ) -> None:
+        lat = time.perf_counter() - req.t_submit
+        with self._cv:
+            # a key whose future already carries an error (max_attempts
+            # exhausted) is as spent as a served one: recording a late
+            # straggler result for it would report a request the client
+            # saw FAIL as served in the stats and obs stream
+            dup = (
+                req.key in self._delivered
+                or req.key in self._failed_keys
+            )
+            if not dup:
+                self._remember(self._delivered, req.key)
+                self._index.pop(req.key, None)
+                self._latencies.append(lat)
+                self._n_delivered += 1
+                rep.served += 1
+            else:
+                self._n_duplicates += 1
+            try:
+                rep.assigned.remove(req)
+            except ValueError:
+                pass  # requeued from under us (stall handoff)
+        if dup:
+            # at-most-once delivery: a recovered straggler's late
+            # result for a key a survivor already served (or the fleet
+            # already failed) is dropped
+            self._emit(
+                "fleet_duplicate_suppressed", replica_id=rep.id,
+                key=req.key, failed_key=req.key in self._failed_keys,
+            )
+            return
+        try:
+            req.future.set_result(res)
+        except InvalidStateError:
+            pass  # client cancelled between checks
+        self._emit(
+            "fleet_request", replica_id=rep.id, key=req.key,
+            attempts=req.attempts, bucket=res.bucket,
+            latency_ms=round(lat * 1e3, 3),
+            requeued=req.attempts > 1,
+        )
+
+    # -- the replica worker --------------------------------------------
+    def _take(self, rep: _Replica) -> Optional[List[_FleetRequest]]:
+        with self._cv:
+            while True:
+                if rep.retired:
+                    return None
+                if self._queue:
+                    break
+                if self._close_started:
+                    return None
+                self._cv.wait(timeout=0.1)
+            batch: List[_FleetRequest] = []
+            while self._queue and len(batch) < self._take_cap:
+                req = self._queue.popleft()
+                if (
+                    req.key in self._delivered
+                    or req.key in self._failed_keys
+                ):
+                    # requeued copy of a key a straggler already
+                    # resolved — solving it again would only be
+                    # suppressed at delivery; drop it for free here
+                    self._index.pop(req.key, None)
+                    continue
+                if req.attempts == 0:
+                    if not req.future.set_running_or_notify_cancel():
+                        self._index.pop(req.key, None)
+                        continue  # client cancelled while queued
+                elif req.future.cancelled():
+                    self._index.pop(req.key, None)
+                    continue
+                req.attempts += 1
+                rep.assigned.append(req)
+                batch.append(req)
+            rep.req_seq += len(batch)
+        return batch
+
+    def _process(self, rep: _Replica, batch: List[_FleetRequest]) -> None:
+        from ..utils import faults
+
+        seq0 = rep.req_seq - len(batch)
+        stalls_before = rep.watchdog.stalls
+        t0 = time.monotonic()
+        # the health fence covers the injected faults too: a hang
+        # sleeping here is indistinguishable from a wedged dispatch,
+        # which is the point
+        rep.watchdog.arm(len(batch), label=f"replica{rep.id}-dispatch")
+        try:
+            for i in range(len(batch)):
+                s = seq0 + i + 1
+                dur = faults.engine_hang_request(rep.id, s)
+                if dur > 0:
+                    time.sleep(dur)
+                if faults.engine_kill_request(rep.id, s):
+                    raise faults.InjectedFault(
+                        f"injected engine kill on replica {rep.id} "
+                        f"(request #{s})"
+                    )
+            futs = [
+                # _validated: admission already ran the full request
+                # checks and canonicalized the arrays — no second
+                # finiteness scan per ownership
+                rep.engine.submit(
+                    r.b, mask=r.mask, smooth_init=r.smooth_init,
+                    x_orig=r.x_orig, _validated=True,
+                )
+                for r in batch
+            ]
+            results = [f.result(timeout=600.0) for f in futs]
+        finally:
+            rep.watchdog.disarm()
+        if rep.watchdog.stalls == stalls_before:
+            # teach the watchdog this replica's real measured pace
+            # (same role as LearnConfig.watchdog_slack: deadline =
+            # observed per-request time x stall_slack). A fence the
+            # watchdog fired on is NOT representative — it may include
+            # an injected hang's sleep.
+            per = (time.monotonic() - t0) / len(batch)
+            rep.watchdog.per_iter_s = max(
+                rep.watchdog.per_iter_s,
+                self.fleet_cfg.stall_slack * per,
+            )
+        for req, res in zip(batch, results):
+            self._deliver(rep, req, res)
+
+    def _worker_loop(self, rep: _Replica) -> None:
+        while True:
+            batch = self._take(rep)
+            if batch is None:
+                break
+            if not batch:
+                continue
+            try:
+                self._process(rep, batch)
+            except BaseException as e:
+                self._on_replica_death(rep, e)
+                return
+        # clean exit: fleet close, or a retire (stall handoff /
+        # recycle). The stall path already scheduled the replacement;
+        # a clean recycle claims the handoff here (reaped gates
+        # exactly one of us) and schedules it after the engine is
+        # released — nothing to requeue, _take stopped before this
+        # batch was taken.
+        with self._cv:
+            recycle = rep.state == "recycling" and not rep.reaped
+            if recycle:
+                rep.reaped = True
+        if recycle:
+            # normally nothing is in flight here (_take stopped before
+            # another batch was taken, _process delivered the last
+            # one), but the handoff contract is uniform: whoever
+            # claims `reaped` requeues whatever is left
+            self._requeue_from(rep, reason="recycle")
+        if rep.retired:
+            try:
+                rep.engine.close()
+            except Exception:
+                pass
+        if recycle:
+            self._schedule_restart(rep, charge=False)
+
+    # -- monitor: heartbeats, ceiling, overload ladder ------------------
+    def _monitor_loop(self) -> None:
+        from ..utils import perfmodel
+
+        hb_every = self.fleet_cfg.heartbeat_s
+        while not self._stop_monitor.wait(
+            self.fleet_cfg.health_interval_s
+        ):
+            now = time.monotonic()
+            with self._cv:
+                depth = len(self._queue)
+                reps = list(self._replicas)
+            if self.fleet_cfg.max_queue_depth is None:
+                self._update_ceiling(perfmodel, reps)
+            self._eval_rungs(depth, now)
+            if now - self._hb_last >= hb_every:
+                self._hb_last = now
+                for rep in reps:
+                    if rep is None:
+                        continue
+                    self._emit(
+                        "fleet_heartbeat", replica_id=rep.id,
+                        state=rep.state, generation=rep.generation,
+                        served=rep.served, inflight=len(rep.assigned),
+                        queue_depth=depth,
+                        restarts=self._restarts.get(rep.id, 0),
+                    )
+
+    def _update_ceiling(self, perfmodel, reps) -> None:
+        live = [
+            r for r in reps if r is not None and r.state == "live"
+        ]
+        it_rate = max(
+            (r.engine.last_it_rate for r in live), default=0.0
+        )
+        if it_rate <= 0:
+            return
+        # the EFFECTIVE solve budget: rung 3 recycles replicas onto
+        # max_it x degrade_max_it_factor, which raises real request
+        # throughput — the ceiling and retry-after must credit the
+        # capacity the degrade bought, or admission keeps rejecting
+        # exactly the load the ladder degraded itself to carry
+        bound = perfmodel.serving_bound(
+            it_rate,
+            max(1, self._engine_cfg(self._degraded).max_it),
+            self._total_slots,
+            occupancy=1.0,
+        )
+        self._bound_rps = bound["requests_per_sec"] * max(1, len(live))
+        derived = max(
+            self.fleet_cfg.min_queue_depth,
+            int(self._bound_rps * self.fleet_cfg.max_queue_s),
+        )
+        old = self._ceiling
+        if not self._ceiling_derived or derived > 1.5 * old or (
+            derived < old / 1.5
+        ):
+            self._ceiling = derived
+            self._ceiling_derived = True
+            self._emit(
+                "fleet_ceiling", replica_id=None, ceiling=derived,
+                bound_requests_per_sec=round(self._bound_rps, 3),
+                live_replicas=len(live),
+                source="serving_bound",
+            )
+
+    def _set_rung(self, rung: int, depth: int) -> None:
+        old = self._rung
+        if rung == old:
+            return
+        self._rung = rung
+        self._rung2_since = (
+            time.monotonic() if rung == 2 else None
+        )
+        self._emit(
+            "fleet_overload", replica_id=None,
+            rung_from=RUNGS[old], rung_to=RUNGS[rung],
+            queue_depth=depth, ceiling=self._ceiling,
+        )
+        self._run.console(
+            f"fleet: overload ladder {RUNGS[old]} -> {RUNGS[rung]} "
+            f"(queue {depth}/{self._ceiling})",
+            tier="brief",
+        )
+        # rung effects on live engines (best-effort: a replica mid-
+        # restart picks up the current rung when it next matters)
+        shed = rung >= 1
+        for rep in self._replicas:
+            if rep is None or rep.retired:
+                continue
+            try:
+                rep.engine.set_max_wait_ms(
+                    0.0 if shed else self.serve_cfg.max_wait_ms
+                )
+            except Exception:
+                pass
+        if rung == 3 and not self._degraded:
+            self._degraded = True
+            self._emit(
+                "degrade", replica_id=None, rung="serve_max_it",
+                stage="overload",
+                max_it=self._engine_cfg(True).max_it,
+            )
+            self._start_recycle()
+        elif rung == 0 and self._degraded:
+            self._degraded = False
+            self._emit(
+                "degrade", replica_id=None, rung="serve_restore",
+                stage="overload", max_it=self.cfg.max_it,
+            )
+            self._start_recycle()
+
+    def _eval_rungs(self, depth: int, now: float) -> None:
+        c = max(1, self._ceiling)
+        frac = depth / c
+        f = self.fleet_cfg
+        r = self._rung
+        if r == 3:
+            if frac < f.shed_exit:
+                self._set_rung(0, depth)
+        elif r == 2:
+            if frac < f.shed_exit:
+                self._set_rung(0, depth)
+            elif frac < f.reject_exit:
+                self._set_rung(1, depth)
+            elif (
+                f.degrade_after_s > 0
+                and self._rung2_since is not None
+                and now - self._rung2_since > f.degrade_after_s
+            ):
+                self._set_rung(3, depth)
+        elif r == 1:
+            if frac >= 1.0:
+                self._set_rung(2, depth)
+            elif frac < f.shed_exit:
+                self._set_rung(0, depth)
+        else:
+            if frac >= 1.0:
+                self._set_rung(2, depth)
+            elif frac >= f.shed_at:
+                self._set_rung(1, depth)
+
+    def _start_recycle(self) -> None:
+        """Staggered replica recycle onto the current degrade state:
+        one replica at a time, so capacity never drops below N-1."""
+        with self._cv:
+            if self._recycling or self._close_started:
+                return
+            self._recycling = True
+        threading.Thread(
+            target=self._recycle_loop, name="ccsc-fleet-recycle",
+            daemon=True,
+        ).start()
+
+    def _recycle_loop(self) -> None:
+        try:
+            # loop until every live replica matches the CURRENT target
+            # — capturing a fixed target and bailing when the ladder
+            # moves would strand already-recycled replicas on the old
+            # budget (the rung flip's own _start_recycle no-ops while
+            # this thread holds _recycling)
+            while not self._close_started:
+                target = self._degraded
+                with self._cv:
+                    todo = [
+                        rep for rep in self._replicas
+                        if rep is not None and not rep.retired
+                        and rep.degraded != target
+                    ]
+                    if not todo:
+                        if self._degraded == target:
+                            return
+                        continue  # target moved during the scan
+                    rep = todo[0]
+                    rep.retired = True
+                    rep.state = "recycling"
+                    self._cv.notify_all()
+                # wait for the replacement (engine rebuild rides the
+                # warm compile cache) before touching the next one
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline:
+                    if self._close_started:
+                        return
+                    if rep.id in self._abandoned:
+                        # the recycling replica crashed under us and
+                        # exhausted its restart budget — no replacement
+                        # is coming, move on
+                        break
+                    cur = self._replicas[rep.id]
+                    if (
+                        cur is not None
+                        and cur.generation > rep.generation
+                        and cur.state == "live"
+                    ):
+                        break
+                    time.sleep(0.05)
+        finally:
+            with self._cv:
+                self._recycling = False
+            # a rung flip that raced our exit had its _start_recycle
+            # no-oped against the flag we just cleared — re-check and
+            # reschedule so no replica is stranded on a stale budget
+            if not self._close_started:
+                with self._cv:
+                    stranded = any(
+                        rep is not None and not rep.retired
+                        and rep.degraded != self._degraded
+                        for rep in self._replicas
+                    )
+                if stranded:
+                    self._start_recycle()
+
+    # -- public API ----------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._close_started
+
+    @property
+    def queue_ceiling(self) -> int:
+        """The current admission ceiling (explicit or
+        serving_bound-derived)."""
+        return self._ceiling
+
+    @property
+    def overload_rung(self) -> str:
+        return RUNGS[self._rung]
+
+    def submit(
+        self, b, mask=None, smooth_init=None, x_orig=None,
+        key: Optional[str] = None,
+    ) -> "Future[ServedResult]":
+        """Enqueue one observation; returns a Future of
+        :class:`~.engine.ServedResult`.
+
+        ``key`` is the request's idempotency key (auto-assigned when
+        None): resubmitting a key that is still queued/in-flight
+        returns the SAME future; a key that was already delivered —
+        or already failed — is refused (at-most-once delivery and
+        exactly-once-or-error: a key resolves once, ever; the fleet
+        does not cache results). Raises :class:`Overloaded` at the
+        admission ceiling and ``CCSCInputError`` for malformed
+        requests."""
+        from ..utils import validate
+
+        if self._close_started:
+            raise RuntimeError("fleet is closed")
+        validate.check_serve_request(
+            b, self.geom, mask=mask, smooth_init=smooth_init,
+            x_orig=x_orig,
+        )
+        spatial = tuple(
+            int(s) for s in np.shape(b)[self.geom.ndim_reduce:]
+        )
+        pick_bucket(self.buckets, spatial)  # oversize refusal, pre-queue
+        # canonicalize OUTSIDE the fleet lock: four potentially-large
+        # array copies per request must not serialize every submitter
+        # against the workers' _take/_deliver — nothing here reads
+        # guarded state
+        to32 = lambda a: None if a is None else np.asarray(a, np.float32)
+        b32 = np.asarray(b, np.float32)
+        mask32 = to32(mask)
+        smooth32 = to32(smooth_init)
+        xorig32 = to32(x_orig)
+        reject = None
+        with self._cv:
+            if self._close_started:
+                raise RuntimeError("fleet is closed")
+            if len(self._abandoned) >= self.fleet_cfg.replicas:
+                # every replica's restart budget is exhausted — no
+                # worker will ever take this request, so an accepted
+                # future could never resolve
+                raise RuntimeError(
+                    "fleet has no live replicas left (restart budgets "
+                    "exhausted)"
+                )
+            if key is not None:
+                if key in self._index:
+                    return self._index[key].future
+                if key in self._delivered:
+                    raise validate.CCSCInputError(
+                        f"idempotency key {key!r} was already served "
+                        "(at-most-once delivery: the fleet does not "
+                        "cache results)"
+                    )
+                if key in self._failed_keys:
+                    raise validate.CCSCInputError(
+                        f"idempotency key {key!r} already failed "
+                        "(exactly-once-or-error: the key is spent; "
+                        "retry under a fresh key)"
+                    )
+            depth = len(self._queue)
+            # rung 2 IS the reject rung: admission stays shut while
+            # the ladder holds it, even once the queue dips back under
+            # the hard ceiling — FleetConfig.reject_exit (the monitor's
+            # exit fraction) is the hysteresis that reopens the door,
+            # not the ceiling itself. Rung 3 reopens admission: the
+            # degraded (faster) solve budget is what the fleet trades
+            # for serving under sustained pressure, so only the hard
+            # ceiling gates it there.
+            if depth >= self._ceiling or self._rung == 2:
+                self._n_rejected += 1
+                retry = (
+                    max(depth, 1) / self._bound_rps
+                    if self._bound_rps > 0
+                    else 1.0
+                )
+                retry = min(max(retry, 0.05), 60.0)
+                # emit + raise AFTER releasing the lock (the reject
+                # event write can block on the stream file)
+                reject = (depth, self._ceiling, RUNGS[self._rung], retry)
+            else:
+                if key is None:
+                    # auto-assigned keys must not collide with a
+                    # user-supplied key of the same shape: a collision
+                    # would cross-wire two requests' delivery
+                    # bookkeeping
+                    while True:
+                        self._seq += 1
+                        key = f"req-{self._seq:08d}"
+                        if (
+                            key not in self._index
+                            and key not in self._delivered
+                            and key not in self._failed_keys
+                        ):
+                            break
+                req = _FleetRequest(
+                    key=key,
+                    b=b32,
+                    mask=mask32,
+                    smooth_init=smooth32,
+                    x_orig=xorig32,
+                    future=Future(),
+                    t_submit=time.perf_counter(),
+                )
+                self._index[req.key] = req
+                self._queue.append(req)
+                self._cv.notify_all()
+        if reject is not None:
+            depth, ceiling, rung, retry = reject
+            self._emit(
+                "fleet_admission_reject", replica_id=None,
+                queue_depth=depth, ceiling=ceiling, rung=rung,
+                retry_after_s=round(retry, 3),
+            )
+            raise Overloaded(
+                f"queue at its admission ceiling ({depth}/"
+                f"{ceiling}, overload ladder at {rung}); retry "
+                f"after ~{retry:.2f}s",
+                retry_after_s=retry,
+            )
+        return req.future
+
+    def reconstruct(
+        self, b, mask=None, smooth_init=None, x_orig=None,
+        key: Optional[str] = None, timeout: Optional[float] = None,
+    ) -> ServedResult:
+        """Synchronous submit-and-wait."""
+        return self.submit(
+            b, mask=mask, smooth_init=smooth_init, x_orig=x_orig,
+            key=key,
+        ).result(timeout=timeout)
+
+    def serve_many(self, requests, timeout=None) -> List[ServedResult]:
+        """Submit an iterable of request dicts (keys b/mask/
+        smooth_init/x_orig/key) and wait for all results, in order."""
+        futs = [self.submit(**req) for req in requests]
+        return [f.result(timeout=timeout) for f in futs]
+
+    def stats(self) -> Dict[str, object]:
+        """Fleet aggregates: delivery counts, latency percentiles,
+        admission/requeue/duplicate counters, per-replica liveness."""
+        from ..utils.obs import percentile
+
+        with self._cv:
+            lat = sorted(self._latencies)
+            reps = [
+                None if r is None else {
+                    "replica": r.id,
+                    "state": r.state,
+                    "generation": r.generation,
+                    "served": r.served,
+                    "restarts": self._restarts.get(r.id, 0),
+                }
+                for r in self._replicas
+            ]
+            depth = len(self._queue)
+            n_delivered = self._n_delivered
+        return {
+            "n_requests": n_delivered,
+            "n_rejected": self._n_rejected,
+            "n_requeued": self._n_requeued,
+            "n_duplicates_suppressed": self._n_duplicates,
+            "n_failed": self._n_failed,
+            "queue_depth": depth,
+            "queue_ceiling": self._ceiling,
+            "overload_rung": RUNGS[self._rung],
+            "p50_latency_s": percentile(lat, 0.50),
+            "p99_latency_s": percentile(lat, 0.99),
+            "replicas": reps,
+        }
+
+    def close(self, drain_timeout_s: float = 600.0):
+        """Serve every queued request, retire the replicas, and close
+        the telemetry run with the fleet summary. Re-entrant and
+        race-safe (same contract as ``CodecEngine.close``). Requests
+        still undelivered after ``drain_timeout_s`` get an explicit
+        error."""
+        with self._close_lock:
+            owner = not self._close_started
+            self._close_started = True
+        if not owner:
+            self._close_done.wait()
+            return
+        self._closing.set()
+        try:
+            with self._cv:
+                self._cv.notify_all()
+            deadline = time.monotonic() + drain_timeout_s
+            while time.monotonic() < deadline:
+                with self._cv:
+                    busy = bool(self._queue) or any(
+                        rep is not None and rep.assigned
+                        and not rep.retired
+                        for rep in self._replicas
+                    )
+                    any_live = any(
+                        rep is not None and not rep.retired
+                        for rep in self._replicas
+                    )
+                if not busy or not any_live:
+                    break
+                time.sleep(0.02)
+            self._stop_monitor.set()
+            self._monitor.join(timeout=5.0)
+            # a restart thread caught mid-engine-build must finish and
+            # release its engine (the `closing` branch in _restart)
+            # before the interpreter can safely exit
+            with self._cv:
+                pending_restarts = list(self._restart_threads)
+            for t in pending_restarts:
+                t.join(timeout=120.0)
+            # workers exit once the queue is dry; join briefly, then
+            # close engines (re-entrant — a straggler's own close on
+            # exit is a no-op)
+            for rep in self._replicas:
+                if rep is None:
+                    continue
+                if rep.thread is not None:
+                    rep.thread.join(timeout=60.0)
+                try:
+                    rep.watchdog.stop()
+                except Exception:
+                    pass
+                try:
+                    rep.engine.close()
+                except Exception:
+                    pass
+                if rep.state == "live":
+                    rep.state = "stopped"
+            # final per-replica heartbeat: a short run may never reach
+            # a monitor tick, and the FLEET report's liveness column
+            # reads heartbeats — every replica gets a terminal one
+            with self._cv:
+                depth = len(self._queue)
+                for rep in self._replicas:
+                    if rep is None:
+                        continue
+                    self._emit(
+                        "fleet_heartbeat", replica_id=rep.id,
+                        state=rep.state, generation=rep.generation,
+                        served=rep.served, inflight=len(rep.assigned),
+                        queue_depth=depth,
+                        restarts=self._restarts.get(rep.id, 0),
+                        final=True,
+                    )
+            undelivered: List[_FleetRequest] = []
+            with self._cv:
+                undelivered.extend(self._queue)
+                self._queue.clear()
+                for rep in self._replicas:
+                    if rep is None:
+                        continue
+                    undelivered.extend(
+                        r for r in rep.assigned
+                        if r.key not in self._delivered
+                    )
+                    rep.assigned = []
+                for r in undelivered:
+                    self._index.pop(r.key, None)
+                self._n_failed += len(undelivered)
+            for r in undelivered:
+                try:
+                    r.future.set_exception(
+                        RuntimeError(
+                            "fleet closed before this request could "
+                            "be served"
+                        )
+                    )
+                except InvalidStateError:
+                    pass
+            if not self._run.closed:
+                st = self.stats()
+                self._run.close(
+                    status="ok",
+                    n_requests=st["n_requests"],
+                    n_rejected=st["n_rejected"],
+                    n_requeued=st["n_requeued"],
+                    n_duplicates_suppressed=st[
+                        "n_duplicates_suppressed"
+                    ],
+                    n_failed=st["n_failed"],
+                    p50_latency_s=st["p50_latency_s"],
+                    p99_latency_s=st["p99_latency_s"],
+                )
+        finally:
+            self._close_done.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

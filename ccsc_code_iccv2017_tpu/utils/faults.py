@@ -25,7 +25,20 @@ points, so a test (or ``scripts/chaos_smoke.py`` /
   seconds (default 3600) inside the host-side fence at the boundary
   after iteration ``k`` — indistinguishable from a wedged dispatch,
   so the watchdog (utils.watchdog) and the external supervisor
-  (scripts/supervise.py) are provable on CPU.
+  (scripts/supervise.py) are provable on CPU;
+- serving-replica faults: ``CCSC_FAULT_ENGINE_KILL_REQ=k`` /
+  ``CCSC_FAULT_ENGINE_HANG_REQ=k`` kill (raise ``InjectedFault`` in
+  the replica worker) or hang (sleep ``CCSC_FAULT_ENGINE_HANG_S``,
+  default 3600) a serving-fleet replica (serve.ServeFleet) while it
+  processes its k-th taken request (1-based, counted PER replica) —
+  the fleet's requeue-with-idempotency-keys and health-driven drain
+  paths are provable on CPU. ``CCSC_FAULT_ENGINE_KILL_REPLICA`` /
+  ``CCSC_FAULT_ENGINE_HANG_REPLICA`` (comma lists of replica ids)
+  restrict which replicas are armed, so a chaos schedule can kill
+  replica 0 and hang replica 1 in the same run; unset = any replica.
+  These fire at most once PER REPLICA (marker
+  ``fault-fired-engine_kill-r<id>.json``), so a restarted casualty
+  rejoins clean instead of re-dying forever.
 
 Every fault fires AT MOST ONCE per run. Within a process that is a
 set in memory; ACROSS supervisor restarts the consumption must
@@ -57,6 +70,8 @@ __all__ = [
     "ckpt_save_hook",
     "sigterm_tick",
     "hang_tick",
+    "engine_kill_request",
+    "engine_hang_request",
     "reset",
 ]
 
@@ -213,6 +228,76 @@ def hang_tick(completed_it: int) -> None:
     dur = float(os.environ.get("CCSC_FAULT_HANG_S", "3600"))
     _mark_fired("hang", iteration=int(completed_it), sleep_s=dur)
     time.sleep(dur)
+
+
+def _replica_armed(env_name: str, replica_id: int) -> bool:
+    """Whether a per-replica fault env restricts to (or includes) this
+    replica: unset/empty = every replica is armed; else a comma list
+    of replica ids. A malformed list disarms (same never-crash stance
+    as ``_env_int``)."""
+    raw = os.environ.get(env_name, "").strip()
+    if not raw:
+        return True
+    try:
+        ids = {int(x) for x in raw.split(",") if x.strip()}
+    except ValueError:
+        return False
+    return int(replica_id) in ids
+
+
+def engine_kill_request(replica_id: int, req_seq: int) -> bool:
+    """Serving-fleet kill fault (serve.ServeFleet): True exactly once
+    per armed replica when the replica is processing its
+    ``CCSC_FAULT_ENGINE_KILL_REQ``-th taken request (1-based, counted
+    per replica) — the caller then raises ``InjectedFault`` in the
+    replica worker, simulating an engine crash with requests assigned.
+    ``CCSC_FAULT_ENGINE_KILL_REPLICA`` restricts which replicas are
+    armed (comma list; unset = all)."""
+    k = _env_int("CCSC_FAULT_ENGINE_KILL_REQ")
+    if k is None or req_seq < k:
+        return False
+    if not _replica_armed("CCSC_FAULT_ENGINE_KILL_REPLICA", replica_id):
+        return False
+    name = f"engine_kill-r{int(replica_id)}"
+    if _fired_before(name):
+        return False
+    _mark_fired(
+        name, replica_id=int(replica_id), request_seq=int(req_seq)
+    )
+    return True
+
+
+def engine_hang_request(replica_id: int, req_seq: int) -> float:
+    """Serving-fleet hang fault: the seconds the replica worker should
+    sleep INSIDE its armed health fence (``CCSC_FAULT_ENGINE_HANG_S``,
+    default 3600) when it is processing its
+    ``CCSC_FAULT_ENGINE_HANG_REQ``-th taken request, else 0.0 — to the
+    fleet's per-replica watchdog this is exactly a wedged dispatch.
+    Fire-once per armed replica (``CCSC_FAULT_ENGINE_HANG_REPLICA``
+    restricts), marked BEFORE the sleep: a drained-and-restarted
+    replica must not re-hang."""
+    k = _env_int("CCSC_FAULT_ENGINE_HANG_REQ")
+    if k is None or req_seq < k:
+        return 0.0
+    if not _replica_armed("CCSC_FAULT_ENGINE_HANG_REPLICA", replica_id):
+        return 0.0
+    name = f"engine_hang-r{int(replica_id)}"
+    if _fired_before(name):
+        return 0.0
+    try:
+        dur = float(os.environ.get("CCSC_FAULT_ENGINE_HANG_S", "3600"))
+    except ValueError:
+        # never-crash stance: a malformed knob must not become a
+        # "replica crash" that burns restart budget on every
+        # generation — fall back to the wedged-forever default
+        dur = 3600.0
+    _mark_fired(
+        name,
+        replica_id=int(replica_id),
+        request_seq=int(req_seq),
+        sleep_s=dur,
+    )
+    return dur
 
 
 def reset() -> None:

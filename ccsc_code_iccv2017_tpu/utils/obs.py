@@ -170,7 +170,9 @@ def _json_default(o):
     return str(o)
 
 
-def read_events(path: str) -> List[Dict[str, Any]]:
+def read_events(
+    path: str, recursive: bool = False
+) -> List[Dict[str, Any]]:
     """Parse one events file or every ``events-*.jsonl`` in a dir.
 
     Crash tolerance: a torn trailing line (the crash window of the
@@ -178,12 +180,29 @@ def read_events(path: str) -> List[Dict[str, Any]]:
     ANYWHERE else is dropped too rather than failing the whole stream
     (append-only files can interleave a partial record from a killed
     writer with later appends from its resume). Multi-file dirs are
-    merged in timestamp order so per-host streams read as one run."""
+    merged in timestamp order so per-host streams read as one run.
+
+    ``recursive`` additionally merges streams from subdirectories —
+    the serving fleet (serve.ServeFleet) writes its own fleet stream
+    at the top level and each replica engine's stream in a
+    ``replica-NN/`` subdir, and a whole-fleet report wants the union.
+    Default off: per-dir scoping is load-bearing for the supervisor's
+    per-replica preemption judgment (scripts/supervise.py)."""
     if os.path.isdir(path):
         recs: List[Dict[str, Any]] = []
-        for name in sorted(os.listdir(path)):
-            if name.startswith("events") and name.endswith(".jsonl"):
-                recs.extend(read_events(os.path.join(path, name)))
+        if recursive:
+            for root, _dirs, files in sorted(os.walk(path)):
+                for name in sorted(files):
+                    if name.startswith("events") and name.endswith(
+                        ".jsonl"
+                    ):
+                        recs.extend(
+                            read_events(os.path.join(root, name))
+                        )
+        else:
+            for name in sorted(os.listdir(path)):
+                if name.startswith("events") and name.endswith(".jsonl"):
+                    recs.extend(read_events(os.path.join(path, name)))
         recs.sort(key=lambda r: r.get("t", 0.0))
         return recs
     out = []
@@ -223,6 +242,120 @@ _RE_SHAPES = re.compile(
 )
 
 
+class _MonitorHub:
+    """Process-global install point for the compile-harvest hooks.
+
+    The jax.monitoring listeners and the dispatch/pxla debug-log
+    handler are PROCESS-wide state, but runs can overlap — a serving
+    fleet holds N+1 open runs, each with its own
+    :class:`CompileMonitor`. Installing the hooks per monitor corrupts
+    them on out-of-order close: each install snapshots the logger
+    (level, propagate) AT INSTALL TIME, so the first uninstall
+    restores the pre-fleet level while sibling monitors still expect
+    DEBUG (their name/shape harvesting silently stops) and the last
+    uninstall "restores" another monitor's DEBUG/propagate=False
+    permanently. The hub installs the hooks exactly once (first
+    subscriber), fans every record out to all subscribed monitors, and
+    restores the TRUE pre-install logger state exactly once (last
+    unsubscriber) — any subscribe/unsubscribe interleaving is safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: List["CompileMonitor"] = []
+        self._handler: Optional[logging.Handler] = None
+        self._loggers: List[tuple] = []
+
+    def subscribe(self, mon: "CompileMonitor") -> None:
+        with self._lock:
+            first = not self._subs
+            self._subs.append(mon)
+            if not first:
+                return
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(
+                self._on_duration
+            )
+            try:
+                monitoring.register_event_listener(self._on_event)
+            except Exception:  # pragma: no cover - API drift
+                pass
+
+            class _H(logging.Handler):
+                def __init__(h, cb):
+                    super().__init__(logging.DEBUG)
+                    h._cb = cb
+
+                def emit(h, record):
+                    h._cb(record)
+
+            self._handler = _H(self._on_log)
+            for name in (
+                "jax._src.dispatch", "jax._src.interpreters.pxla"
+            ):
+                lg = logging.getLogger(name)
+                self._loggers.append((lg, lg.level, lg.propagate))
+                lg.addHandler(self._handler)
+                if lg.getEffectiveLevel() > logging.DEBUG:
+                    lg.setLevel(logging.DEBUG)
+                    # the DEBUG records exist only for this harvester;
+                    # do not let them flood the root handler / console
+                    lg.propagate = False
+
+    def unsubscribe(self, mon: "CompileMonitor") -> None:
+        with self._lock:
+            try:
+                self._subs.remove(mon)
+            except ValueError:
+                return
+            if self._subs:
+                return
+            try:
+                from jax._src import monitoring as _mon
+
+                _mon._unregister_event_duration_listener_by_callback(
+                    self._on_duration
+                )
+            except Exception:  # pragma: no cover - private API drift
+                pass
+            try:
+                from jax._src import monitoring as _mon
+
+                _mon._unregister_event_listener_by_callback(
+                    self._on_event
+                )
+            except Exception:  # pragma: no cover - private API drift
+                pass
+            for lg, level, propagate in self._loggers:
+                lg.removeHandler(self._handler)
+                lg.setLevel(level)
+                lg.propagate = propagate
+            self._loggers = []
+            self._handler = None
+
+    # fanout: snapshot subscribers under the lock, dispatch outside it
+    # (a monitor callback must never run while the hub lock is held —
+    # its sink writes to an EventWriter that can block)
+    def _snapshot(self) -> List["CompileMonitor"]:
+        with self._lock:
+            return list(self._subs)
+
+    def _on_log(self, record: logging.LogRecord) -> None:
+        for m in self._snapshot():
+            m._on_log(record)
+
+    def _on_duration(self, event: str, duration_secs: float, **kw) -> None:
+        for m in self._snapshot():
+            m._on_duration(event, duration_secs, **kw)
+
+    def _on_event(self, event: str, **kw) -> None:
+        for m in self._snapshot():
+            m._on_event(event, **kw)
+
+
+_HUB = _MonitorHub()
+
+
 class CompileMonitor:
     """jax.monitoring listeners for trace/lower/compile events.
 
@@ -231,7 +364,10 @@ class CompileMonitor:
     debug logs, which fire immediately before the matching duration
     event. A handler on those loggers stashes the latest name/shapes
     and the duration listener claims them — best-effort (a miss just
-    records an unnamed event), zero-cost when uninstalled."""
+    records an unnamed event), zero-cost when uninstalled. The hooks
+    themselves live in the process-wide :class:`_MonitorHub`;
+    install/uninstall is a hub subscription, so concurrently open runs
+    cannot corrupt the logger state."""
 
     def __init__(self):
         self.events: List[Dict[str, Any]] = []
@@ -239,8 +375,6 @@ class CompileMonitor:
         self._shapes: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._installed = False
-        self._handler: Optional[logging.Handler] = None
-        self._loggers: List[tuple] = []
         self._sink = None  # Optional[EventWriter-backed callback]
         # persistent-compilation-cache hits (jax_compilation_cache_dir;
         # the serving engine's warm-restart signal): jax fires a counter
@@ -310,58 +444,14 @@ class CompileMonitor:
         if self._installed:
             return self
         self._sink = sink
-        from jax import monitoring
-
-        monitoring.register_event_duration_secs_listener(self._on_duration)
-        try:
-            monitoring.register_event_listener(self._on_event)
-        except Exception:  # pragma: no cover - API drift
-            pass
-
-        class _H(logging.Handler):
-            def __init__(h, cb):
-                super().__init__(logging.DEBUG)
-                h._cb = cb
-
-            def emit(h, record):
-                h._cb(record)
-
-        self._handler = _H(self._on_log)
-        for name in ("jax._src.dispatch", "jax._src.interpreters.pxla"):
-            lg = logging.getLogger(name)
-            self._loggers.append((lg, lg.level, lg.propagate))
-            lg.addHandler(self._handler)
-            if lg.getEffectiveLevel() > logging.DEBUG:
-                lg.setLevel(logging.DEBUG)
-                # the DEBUG records exist only for this harvester; do
-                # not let them flood the root handler / console
-                lg.propagate = False
+        _HUB.subscribe(self)
         self._installed = True
         return self
 
     def uninstall(self) -> None:
         if not self._installed:
             return
-        try:
-            from jax._src import monitoring as _mon
-
-            _mon._unregister_event_duration_listener_by_callback(
-                self._on_duration
-            )
-        except Exception:  # pragma: no cover - private API drift
-            pass
-        try:
-            from jax._src import monitoring as _mon
-
-            _mon._unregister_event_listener_by_callback(self._on_event)
-        except Exception:  # pragma: no cover - private API drift
-            pass
-        for lg, level, propagate in self._loggers:
-            lg.removeHandler(self._handler)
-            lg.setLevel(level)
-            lg.propagate = propagate
-        self._loggers = []
-        self._handler = None
+        _HUB.unsubscribe(self)
         self._sink = None
         self._installed = False
 
@@ -617,6 +707,7 @@ def start_run(
     cfg=None,
     fingerprint: Optional[str] = None,
     mesh=None,
+    compile_monitor: bool = True,
     **extra_meta,
 ) -> Run:
     """Open a telemetry run (or a console-only null run when
@@ -626,7 +717,11 @@ def start_run(
     chip, device + process counts, mesh shape, the full knob dict of
     ``cfg``, geometry, and the checkpoint config fingerprint — then
     installs the compile monitor so every later jit trace/compile
-    lands in the stream."""
+    lands in the stream. ``compile_monitor=False`` skips the monitor:
+    compile events are process-wide, so a run nested under another
+    open run (a fleet replica's stream under the fleet stream) opts
+    out and lets the parent attribute them once instead of every open
+    stream recording every replica's compiles."""
     if metrics_dir is None:
         run = _NullWriterRun(verbose=verbose)
         _CURRENT.append(run)
@@ -677,6 +772,9 @@ def start_run(
             meta["config"] = str(cfg)
     meta.update(extra_meta)
     run.event("run_meta", **meta)
+    if not compile_monitor:
+        _CURRENT.append(run)
+        return run
     # only backend compiles land in the stream as records (every tiny
     # eager op traces through pjit too — the trace/lower durations are
     # still aggregated into the close() summary); each record carries

@@ -51,6 +51,7 @@ __all__ = [
     "DispatchWatchdog",
     "maybe_start",
     "check_peers",
+    "check_replicas",
     "EXIT_STALL",
     "DEFAULT_PEER_STALE_S",
 ]
@@ -93,8 +94,25 @@ class DispatchWatchdog:
         action: Optional[str] = None,
         metrics_dir: Optional[str] = None,
         algorithm: str = "",
+        replica_id: Optional[int] = None,
+        on_stall=None,
+        run=None,
     ):
+        # ``replica_id`` + ``on_stall``: the serving fleet
+        # (serve.ServeFleet) runs one watchdog per replica in 'event'
+        # mode — the stall record then names the replica, and the
+        # callback is the fleet's authority hook (drain + requeue +
+        # restart the casualty) since an in-process replica has no
+        # process to hard-exit. ``run`` pins the obs Run the stall
+        # record is written to; without it the record goes to the
+        # process-global current run, which in a fleet (one run per
+        # replica engine plus the fleet stream, all open at once) is
+        # whichever was opened most recently — the wrong stream for
+        # every replica but the newest.
         self.per_iter_s = float(per_iter_s)
+        self.replica_id = replica_id
+        self.run = run
+        self.on_stall = on_stall
         self.min_s = _env_f("CCSC_WATCHDOG_MIN_S", DEFAULT_MIN_S)
         self.compile_s = _env_f(
             "CCSC_WATCHDOG_COMPILE_S", DEFAULT_COMPILE_S
@@ -220,18 +238,31 @@ class DispatchWatchdog:
         from . import obs
 
         self.stalls += 1
-        obs.record(
-            "stall",
+        extra = (
+            {} if self.replica_id is None
+            else {"replica_id": self.replica_id}
+        )
+        fields = dict(
             label=label,
             algorithm=self.algorithm,
             per_iter_budget_s=round(self.per_iter_s, 4),
             action=self.action,
+            **extra,
         )
+        if self.run is not None and not self.run.closed:
+            self.run.event("stall", **fields)
+        else:
+            obs.record("stall", **fields)
         obs.console(
             f"WATCHDOG: dispatch fence '{label}' exceeded its deadline "
             f"— the device/runtime looks hung ({self.action} mode)",
             tier="always",
         )
+        if self.on_stall is not None:
+            try:
+                self.on_stall(label)
+            except Exception:  # pragma: no cover - observer must not
+                pass  # kill the monitor thread
         if self.action == "abort":
             run = obs.current_run()
             if run is not None and run.writer is not None:
@@ -395,6 +426,63 @@ def check_peers(
                     "behind_s": round(behind, 1),
                 }
             )
+    return out
+
+
+def check_replicas(
+    metrics_dir: Optional[str] = None,
+    stale_s: Optional[float] = None,
+    now: Optional[float] = None,
+    events: Optional[List[Dict]] = None,
+) -> List[Dict]:
+    """Per-replica liveness of a serving fleet, judged from its obs
+    stream by the SAME staleness rule as ``check_peers``: a replica
+    whose newest ``fleet_heartbeat`` lags the stream's newest record
+    by more than ``stale_s`` is stale. Returns one dict per KNOWN
+    replica — ``{replica, state, last_t, behind_s, stale, served,
+    restarts}`` — so ``scripts/obs_report.py`` can render a full
+    liveness column, not just the casualties. ``now`` defaults to the
+    newest record timestamp anywhere in the stream (a finished run's
+    report is stable). Pass ``events`` to judge an already-parsed
+    record list (obs_report) instead of reading ``metrics_dir``."""
+    from . import obs
+
+    stale_s = (
+        _env_f("CCSC_WATCHDOG_PEER_STALE_S", DEFAULT_PEER_STALE_S)
+        if stale_s is None
+        else stale_s
+    )
+    if events is None:
+        if metrics_dir is None:
+            raise ValueError("need metrics_dir or events")
+        events = obs.read_events(metrics_dir)
+    if not events:
+        return []
+    if now is None:
+        now = max(e.get("t", 0.0) for e in events)
+    last: Dict[int, Dict] = {}
+    for e in events:
+        if e.get("type") != "fleet_heartbeat":
+            continue
+        r = e.get("replica_id")
+        if r is None:
+            continue
+        if r not in last or e.get("t", 0.0) > last[r]["t"]:
+            last[r] = e
+    out = []
+    for r, e in sorted(last.items()):
+        behind = now - e.get("t", 0.0)
+        out.append(
+            {
+                "replica": r,
+                "state": e.get("state"),
+                "last_t": e.get("t"),
+                "behind_s": round(behind, 1),
+                "stale": behind > stale_s,
+                "served": e.get("served"),
+                "restarts": e.get("restarts"),
+            }
+        )
     return out
 
 

@@ -22,6 +22,11 @@ restores them):
                       sleeping inside the fence) -> the dispatch
                       watchdog (utils.watchdog, event mode) records a
                       `stall` event and the run still completes
+  fleet_kill          a serving-fleet replica is killed mid-stream
+                      (CCSC_FAULT_ENGINE_KILL_REQ, serve.ServeFleet):
+                      its requests are requeued onto the survivor,
+                      every request completes exactly once, and the
+                      casualty's restart is visible in the obs stream
   sigterm_subprocess  (script mode only) the same against a real child
                       process: exit code 0 + valid checkpoint
   supervise_restart   (script mode only) scripts/supervise.py restarts
@@ -242,6 +247,69 @@ def scenario_hang_watchdog():
     return ok, f"stalls={len(stalls)}, trace_len={len(res.trace['obj_vals_z'])}"
 
 
+def scenario_fleet_kill():
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import (
+        FleetConfig,
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    r = np.random.default_rng(0)
+    d = r.normal(size=(4, 3, 3)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none",
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    with tempfile.TemporaryDirectory() as mdir:
+        with _fault(
+            CCSC_FAULT_ENGINE_KILL_REQ=2,
+            CCSC_FAULT_ENGINE_KILL_REPLICA="0",
+        ):
+            fleet = ServeFleet(
+                d, ReconstructionProblem(geom), cfg, scfg,
+                FleetConfig(
+                    replicas=2, metrics_dir=mdir, min_queue_depth=64,
+                    restart_backoff_s=0.05, verbose="none",
+                ),
+            )
+            futs = []
+            for i in range(8):
+                x = r.random((12, 12)).astype(np.float32)
+                m = (r.random((12, 12)) < 0.5).astype(np.float32)
+                futs.append(fleet.submit(x * m, mask=m, key=f"k{i}"))
+            results = [f.result(timeout=180) for f in futs]
+            fleet.close()
+        events = obs.read_events(mdir)
+        dead = [e for e in events if e["type"] == "fleet_replica_dead"]
+        served = [e for e in events if e["type"] == "fleet_request"]
+        keys = [e["key"] for e in served]
+        ok = (
+            len(results) == 8
+            and len(dead) == 1
+            and dead[0]["replica_id"] == 0
+            and sorted(keys) == sorted(f"k{i}" for i in range(8))
+            and len(keys) == len(set(keys))  # exactly once each
+            and any(e["type"] == "fleet_requeue" for e in events)
+        )
+    return ok, (
+        f"served={len(results)}, dead={len(dead)}, "
+        f"unique_keys={len(set(keys))}/8"
+    )
+
+
 def scenario_supervise_restart():
     import json
 
@@ -335,6 +403,7 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
         "corrupt_fallback": scenario_corrupt_fallback,
         "sigterm_checkpoint": scenario_sigterm_checkpoint,
         "hang_watchdog": scenario_hang_watchdog,
+        "fleet_kill": scenario_fleet_kill,
     }
     if subprocess_scenarios:
         scenarios["sigterm_subprocess"] = scenario_sigterm_subprocess

@@ -66,8 +66,16 @@ def render(events, stale_after=None):
     )
 
     lines.append(_section("RUN"))
+    fleet_meta = next(
+        (m for m in reversed(metas)
+         if m.get("algorithm") == "serve_fleet"),
+        None,
+    )
     if metas:
-        m = metas[-1]  # newest attempt; earlier metas = resumes
+        # newest attempt; earlier metas = resumes. A merged fleet dir
+        # is different: the replicas' own run_metas are SIBLING
+        # streams, not resumes — the fleet's meta is the run identity.
+        m = fleet_meta or metas[-1]
         cfgknobs = m.get("config") or {}
         lines.append(f"  algorithm     {m.get('algorithm')}")
         lines.append(f"  git sha       {m.get('git_sha')}")
@@ -84,7 +92,13 @@ def render(events, stale_after=None):
             lines.append(f"  data          {m['data_shape']}")
         fp = m.get("fingerprint")
         lines.append(f"  fingerprint   {fp[:16] + '…' if fp else None}")
-        if len(metas) > 1:
+        if fleet_meta is not None:
+            if len(metas) > 1:
+                lines.append(
+                    f"  streams       {len(metas)} (fleet + replica "
+                    "engine streams, merged)"
+                )
+        elif len(metas) > 1:
             lines.append(f"  attempts      {len(metas)} (resumed run)")
         knob_keys = (
             "outer_chunk", "donate_state", "fft_impl", "fft_pad",
@@ -301,6 +315,73 @@ def render(events, stale_after=None):
                     f"({p.get('chip')} {p.get('shape_key')})"
                 )
 
+    fhbs = by.get("fleet_heartbeat", [])
+    fstart = by.get("fleet_start", [])
+    if fhbs or fstart:
+        from ccsc_code_iccv2017_tpu.utils import watchdog as _wd
+
+        lines.append(_section("FLEET"))
+        if fstart:
+            s = fstart[-1]
+            lines.append(
+                f"  fleet         {s.get('replicas')} replica(s), "
+                f"queue ceiling {s.get('queue_ceiling')} "
+                f"({s.get('ceiling_source')})"
+            )
+        ceils = by.get("fleet_ceiling", [])
+        if ceils:
+            c = ceils[-1]
+            lines.append(
+                f"  ceiling       {c.get('ceiling')} "
+                f"(serving_bound {c.get('bound_requests_per_sec')} "
+                f"req/s x {c.get('live_replicas')} live replica(s))"
+            )
+        # per-replica liveness: the SAME staleness rule as the HOSTS
+        # column and the live watchdog (--stale-after)
+        for r in _wd.check_replicas(
+            events=events, stale_s=stale_after
+        ):
+            live = (
+                f"STALE ({r['behind_s']:.0f}s behind)"
+                if r["stale"] and r["state"] == "live"
+                else r["state"]
+            )
+            lines.append(
+                f"  replica {r['replica']}: {live:<9} "
+                f"served {r.get('served')}, "
+                f"restarts {r.get('restarts')}, last heartbeat "
+                f"{_fmt_ts(r['last_t'])}"
+            )
+        if fhbs:
+            lines.append(
+                f"  (stale threshold {stale_after:g}s; --stale-after)"
+            )
+        reqs = by.get("fleet_requeue", [])
+        n_requeued = sum(r.get("n", 0) for r in reqs)
+        n_req_failed = sum(r.get("n_failed", 0) for r in reqs)
+        if reqs:
+            lines.append(
+                f"  requeues      {n_requeued} request(s) handed off "
+                f"over {len(reqs)} drain(s)"
+                + (f", {n_req_failed} failed out" if n_req_failed else "")
+            )
+        dups = by.get("fleet_duplicate_suppressed", [])
+        if dups:
+            lines.append(
+                f"  duplicates    {len(dups)} late straggler "
+                "result(s) suppressed (at-most-once delivery)"
+            )
+        rejects = by.get("fleet_admission_reject", [])
+        if rejects:
+            lines.append(
+                f"  admission     {len(rejects)} rejection(s), max "
+                "queue depth at rejection "
+                f"{max(r.get('queue_depth', 0) for r in rejects)}"
+            )
+        n_served = len(by.get("fleet_request", []))
+        if n_served:
+            lines.append(f"  delivered     {n_served} request(s)")
+
     sreqs = by.get("serve_request", [])
     sdisp = by.get("serve_dispatch", [])
     if sreqs or sdisp:
@@ -365,7 +446,10 @@ def render(events, stale_after=None):
     n_ev = 0
     for kind in ("checkpoint_save", "checkpoint_load", "recovery",
                  "preemption", "stall", "peer_stale", "degrade",
-                 "fault_fired"):
+                 "fault_fired", "fleet_replica_dead",
+                 "fleet_replica_restart", "fleet_replica_ready",
+                 "fleet_replica_abandoned", "fleet_requeue",
+                 "fleet_overload"):
         for e in by.get(kind, []):
             n_ev += 1
             detail = {
@@ -412,8 +496,22 @@ def main(argv=None):
         "more than this is flagged STALE (default: the watchdog's "
         "CCSC_WATCHDOG_PEER_STALE_S, 120)",
     )
+    ap.add_argument(
+        "--recursive", action="store_true",
+        help="merge event streams from subdirectories too (a fleet "
+        "metrics dir holds each replica engine's stream in a "
+        "replica-NN/ subdir; auto-enabled when such subdirs exist)",
+    )
     args = ap.parse_args(argv)
-    events = obs.read_events(args.path)
+    recursive = args.recursive
+    if not recursive and os.path.isdir(args.path):
+        # a fleet dir wants the whole-fleet union by default
+        recursive = any(
+            n.startswith("replica-")
+            and os.path.isdir(os.path.join(args.path, n))
+            for n in os.listdir(args.path)
+        )
+    events = obs.read_events(args.path, recursive=recursive)
     if args.json:
         print(json.dumps(events))
         return events

@@ -35,6 +35,23 @@ judgment below. This script is that piece:
   ``<metrics-dir>/supervisor_trace.json``), re-written after every
   attempt so the trace survives the supervisor itself being killed.
 
+``--metrics-dir`` is repeatable: a serving-fleet child
+(serve.ServeFleet) writes its fleet stream at the top level and one
+stream per replica in ``replica-NN/`` subdirs — pass each dir and the
+supervisor judges progress across all of them, and preemption PER DIR
+(a preemption record in any one replica's newest attempt marks the
+child preempted; merging the dirs into one stream would scope every
+record to whichever dir's run_meta happens to be newest).
+
+Multi-child mode (``--child``, repeatable): supervise N children —
+e.g. one serving engine per chip behind a shared front queue — each
+judged and restarted INDEPENDENTLY with its own restart/preemption
+budget. Per-child dirs pair with children by index (give N
+``--metrics-dir``/``--checkpoint-dir`` flags, or one parent dir from
+which ``child-NN`` subdirs are derived). The run completes when every
+child completes; a poison child (or an exhausted budget) stops the
+whole fleet with the matching exit code.
+
 The supervisor also exports ``CCSC_FAULT_STATE_DIR`` to the child (set
 to the metrics dir) so injected chaos faults (utils.faults) stay
 fire-once ACROSS restarts — the property tests/test_supervised.py
@@ -46,6 +63,10 @@ Usage:
         -- python -m ccsc_code_iccv2017_tpu.apps.learn_2d --data ... \\
            --checkpoint-dir CK --metrics-dir M
 
+    python scripts/supervise.py --metrics-dir PARENT \\
+        --child 'python -m ccsc_code_iccv2017_tpu.apps.serve ...' \\
+        --child 'python -m ccsc_code_iccv2017_tpu.apps.serve ...'
+
 Exit codes: 0 completed; 2 poison run; 3 restart budget exhausted;
 4 usage error.
 """
@@ -54,6 +75,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shlex
 import signal
 import subprocess
 import sys
@@ -69,6 +91,10 @@ EXIT_OK = 0
 EXIT_POISON = 2
 EXIT_EXHAUSTED = 3
 EXIT_USAGE = 4
+# internal: a multi-child sibling failed terminally and this child was
+# stopped mid-flight — not this child's own failure, so it never
+# becomes the fleet exit code
+EXIT_STOPPED = 5
 
 _CKPT_FILES = ("ccsc_state.npz", "ccsc_state.prev.npz")
 
@@ -79,15 +105,25 @@ def build_parser() -> argparse.ArgumentParser:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument(
-        "--checkpoint-dir", default=None,
+        "--checkpoint-dir", action="append", default=None,
         help="the child's checkpoint dir — the restart point, and the "
-        "poison-run detector's evidence of first progress",
+        "poison-run detector's evidence of first progress. Repeatable "
+        "in multi-child mode (paired with --child by index)",
     )
     p.add_argument(
-        "--metrics-dir", default=None,
-        help="the child's utils.obs metrics dir: progress signal for "
-        "hang detection, preempted-vs-completed on clean exits, and "
-        "the fault-marker state dir (CCSC_FAULT_STATE_DIR)",
+        "--metrics-dir", action="append", default=None,
+        help="the child's utils.obs metrics dir(s): progress signal "
+        "for hang detection, preempted-vs-completed on clean exits "
+        "(judged PER DIR — a fleet child has one dir per replica), "
+        "and the fault-marker state dir (CCSC_FAULT_STATE_DIR). "
+        "Repeatable",
+    )
+    p.add_argument(
+        "--child", action="append", default=None, metavar="CMDLINE",
+        help="multi-child mode: supervise this shell-quoted command "
+        "as one independent child (repeatable; mutually exclusive "
+        "with the trailing `-- CMD`). Each child gets its own "
+        "restart/preemption budget and its own per-index dirs",
     )
     p.add_argument(
         "--max-restarts", type=int, default=5,
@@ -128,8 +164,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def _progress_stamp(paths):
     """A monotone token of on-disk progress: newest (mtime, size) over
-    every file under the watched dirs. Changes whenever the child
-    writes an event, a heartbeat, or a checkpoint."""
+    every file under the watched dirs — accepts a LIST of dirs (a
+    fleet child has one metrics dir per replica) and additionally
+    scans one level of subdirectories, so a fleet child watched only
+    by its top-level dir still shows its replicas' ``replica-NN/``
+    stream writes as progress. Changes whenever the child writes an
+    event, a heartbeat, or a checkpoint."""
     stamp = (0.0, 0)
     for root in paths:
         if not root or not os.path.isdir(root):
@@ -144,25 +184,31 @@ def _progress_stamp(paths):
                 st = os.stat(fp)
             except OSError:
                 continue
+            if os.path.isdir(fp):
+                try:
+                    sub = os.listdir(fp)
+                except OSError:
+                    continue
+                for s in sub:
+                    try:
+                        sst = os.stat(os.path.join(fp, s))
+                    except OSError:
+                        continue
+                    stamp = max(stamp, (sst.st_mtime, sst.st_size))
+                continue
             stamp = max(stamp, (st.st_mtime, st.st_size))
     return stamp
 
 
-def _checkpoint_exists(checkpoint_dir) -> bool:
-    if not checkpoint_dir:
-        return False
+def _checkpoint_exists(checkpoint_dirs) -> bool:
     return any(
-        os.path.exists(os.path.join(checkpoint_dir, f))
+        os.path.exists(os.path.join(d, f))
+        for d in checkpoint_dirs if d
         for f in _CKPT_FILES
     )
 
 
-def _attempt_preempted(metrics_dir) -> bool:
-    """Whether the NEWEST attempt in the event stream was preempted
-    (asked to checkpoint-and-exit early) — a clean exit that still
-    wants a resume. Records after the last run_meta are that attempt's."""
-    if not metrics_dir:
-        return False
+def _dir_preempted(metrics_dir) -> bool:
     events = obs.read_events(metrics_dir)
     last_meta = max(
         (i for i, e in enumerate(events) if e.get("type") == "run_meta"),
@@ -171,6 +217,21 @@ def _attempt_preempted(metrics_dir) -> bool:
     return any(
         e.get("type") == "preemption" for e in events[last_meta + 1 :]
     )
+
+
+def _attempt_preempted(metrics_dirs) -> bool:
+    """Whether the NEWEST attempt in any of the child's event streams
+    was preempted (asked to checkpoint-and-exit early) — a clean exit
+    that still wants a resume. Records after the last run_meta are
+    that attempt's.
+
+    Judged PER DIR: a fleet child has one stream per replica, and one
+    preempted replica marks the child preempted. Merging the dirs into
+    a single stream first would scope every record to whichever dir's
+    run_meta happens to be newest — a replica that was preempted
+    before another replica's restart wrote its run_meta would be
+    judged by the wrong attempt."""
+    return any(_dir_preempted(d) for d in metrics_dirs if d)
 
 
 def _tail(path, nbytes=2000) -> str:
@@ -185,20 +246,40 @@ def _tail(path, nbytes=2000) -> str:
 
 
 class Supervisor:
-    def __init__(self, args):
+    """The judgment loop for ONE child. Multi-child mode instantiates
+    N of these (one per ``--child``), each with its own budgets, trace,
+    and per-index dirs; ``stop_event`` lets a sibling's terminal
+    failure stop this child's loop promptly (reason ``fleet_stop``)."""
+
+    def __init__(
+        self, args, cmd, metrics_dirs, checkpoint_dirs,
+        label="", trace_path=None, stop_event=None,
+    ):
         self.args = args
+        self.cmd = cmd
+        self.metrics_dirs = [m for m in metrics_dirs if m]
+        self.checkpoint_dirs = [c for c in checkpoint_dirs if c]
+        self.label = label
+        self.stop_event = stop_event
         self.attempts = []
         self.restarts = 0  # crash restarts (charged to --max-restarts)
         self.resumes = 0  # preemption resumes (--max-preemptions)
         self.outcome = None
-        base = args.metrics_dir or "."
-        self.trace_path = args.trace or os.path.join(
-            base, "supervisor_trace.json"
+        base = self.metrics_dirs[0] if self.metrics_dirs else "."
+        trace_name = (
+            f"supervisor_trace-{label}.json"
+            if label and not self.metrics_dirs
+            else "supervisor_trace.json"
         )
+        self.trace_path = trace_path or os.path.join(base, trace_name)
         self.log_dir = args.log_dir or base
         os.makedirs(self.log_dir, exist_ok=True)
-        if args.metrics_dir:
-            os.makedirs(args.metrics_dir, exist_ok=True)
+        for m in self.metrics_dirs:
+            os.makedirs(m, exist_ok=True)
+
+    def _say(self, msg: str) -> None:
+        tag = f" [{self.label}]" if self.label else ""
+        print(f"supervise{tag}: {msg}", flush=True)
 
     # -- trace ---------------------------------------------------------
     def _write_trace(self):
@@ -210,9 +291,10 @@ class Supervisor:
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(
                 {
-                    "cmd": self.args.cmd,
-                    "checkpoint_dir": self.args.checkpoint_dir,
-                    "metrics_dir": self.args.metrics_dir,
+                    "cmd": self.cmd,
+                    "label": self.label,
+                    "checkpoint_dir": self.checkpoint_dirs,
+                    "metrics_dir": self.metrics_dirs,
                     "max_restarts": self.args.max_restarts,
                     "restarts": self.restarts,
                     "resumes": self.resumes,
@@ -227,31 +309,52 @@ class Supervisor:
     # -- one attempt ---------------------------------------------------
     def _run_attempt(self, n: int):
         a = self.args
-        log_path = os.path.join(self.log_dir, f"supervise-attempt-{n}.log")
+        tag = f"-{self.label}" if self.label else ""
+        log_path = os.path.join(
+            self.log_dir, f"supervise{tag}-attempt-{n}.log"
+        )
         env = dict(os.environ)
-        if a.metrics_dir:
+        if self.metrics_dirs:
             # fault fire-once markers survive restarts (utils.faults)
-            env.setdefault("CCSC_FAULT_STATE_DIR", a.metrics_dir)
-        watched = (a.metrics_dir, a.checkpoint_dir)
+            env.setdefault("CCSC_FAULT_STATE_DIR", self.metrics_dirs[0])
+        watched = self.metrics_dirs + self.checkpoint_dirs
         rec = {
             "attempt": n,
             "start_t": time.time(),
             "log": log_path,
-            "checkpoint_at_start": _checkpoint_exists(a.checkpoint_dir),
+            "checkpoint_at_start": _checkpoint_exists(
+                self.checkpoint_dirs
+            ),
         }
         with open(log_path, "wb") as logf:
             proc = subprocess.Popen(
-                a.cmd, stdout=logf, stderr=subprocess.STDOUT, env=env
+                self.cmd, stdout=logf, stderr=subprocess.STDOUT, env=env
             )
             stamp = _progress_stamp(watched)
             quiet_since = time.monotonic()
             killed_for_hang = False
+            killed_for_stop = False
+
+            def _kill():
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+
             while True:
                 try:
                     proc.wait(timeout=1.0)
                     break
                 except subprocess.TimeoutExpired:
                     pass
+                if self.stop_event is not None and self.stop_event.is_set():
+                    # a sibling child failed terminally — stop this one
+                    self._say("sibling child failed — stopping")
+                    killed_for_stop = True
+                    _kill()
+                    break
                 if a.stall_timeout <= 0:
                     continue
                 new_stamp = _progress_stamp(watched)
@@ -260,32 +363,28 @@ class Supervisor:
                     stamp = new_stamp
                     quiet_since = now
                 elif now - quiet_since > a.stall_timeout:
-                    print(
-                        f"supervise: no progress for {a.stall_timeout:g}s"
-                        " — declaring the child hung, killing it",
-                        flush=True,
+                    self._say(
+                        f"no progress for {a.stall_timeout:g}s"
+                        " — declaring the child hung, killing it"
                     )
                     killed_for_hang = True
-                    proc.send_signal(signal.SIGTERM)
-                    try:
-                        proc.wait(timeout=10.0)
-                    except subprocess.TimeoutExpired:
-                        proc.kill()
-                        proc.wait()
+                    _kill()
                     break
         rc = proc.returncode
         rec.update(
             end_t=time.time(),
             rc=rc,
-            checkpoint_present=_checkpoint_exists(a.checkpoint_dir),
+            checkpoint_present=_checkpoint_exists(self.checkpoint_dirs),
         )
-        if killed_for_hang:
+        if killed_for_stop:
+            rec["reason"] = "fleet_stop"
+        elif killed_for_hang:
             rec["reason"] = "hang"
         elif rc == EXIT_STALL:
             rec["reason"] = "stall_abort"
         elif rc != 0:
             rec["reason"] = "crash"
-        elif _attempt_preempted(a.metrics_dir):
+        elif _attempt_preempted(self.metrics_dirs):
             rec["reason"] = "preempted"
         else:
             rec["reason"] = "completed"
@@ -302,15 +401,15 @@ class Supervisor:
             self.attempts.append(rec)
             self._write_trace()
             reason = rec["reason"]
-            print(
-                f"supervise: attempt {attempt} -> {reason} "
-                f"(rc={rec['rc']})",
-                flush=True,
-            )
+            self._say(f"attempt {attempt} -> {reason} (rc={rec['rc']})")
             if reason == "completed":
                 self.outcome = "completed"
                 self._write_trace()
                 return EXIT_OK
+            if reason == "fleet_stop":
+                self.outcome = "stopped"
+                self._write_trace()
+                return EXIT_STOPPED
             # every other reason wants a relaunch — judge it first
             died = reason in ("crash", "stall_abort", "hang")
             if died and not rec["checkpoint_present"]:
@@ -318,13 +417,12 @@ class Supervisor:
                 if pre_ckpt_deaths >= 2:
                     self.outcome = "poison"
                     self._write_trace()
-                    print(
-                        "supervise: POISON RUN — two consecutive deaths "
+                    self._say(
+                        "POISON RUN — two consecutive deaths "
                         "before the first checkpoint ever landed; a "
                         "restart cannot help (the run dies "
                         "deterministically in setup/compile). Last "
-                        "output:\n" + _tail(rec["log"]),
-                        flush=True,
+                        "output:\n" + _tail(rec["log"])
                     )
                     return EXIT_POISON
             else:
@@ -338,26 +436,23 @@ class Supervisor:
                 if self.resumes >= a.max_preemptions:
                     self.outcome = "exhausted"
                     self._write_trace()
-                    print(
-                        "supervise: preemption-resume budget "
-                        f"({a.max_preemptions}) exhausted.",
-                        flush=True,
+                    self._say(
+                        "preemption-resume budget "
+                        f"({a.max_preemptions}) exhausted."
                     )
                     return EXIT_EXHAUSTED
                 self.resumes += 1
-                print(
-                    f"supervise: resuming preempted run (resume "
-                    f"{self.resumes}/{a.max_preemptions})",
-                    flush=True,
+                self._say(
+                    f"resuming preempted run (resume "
+                    f"{self.resumes}/{a.max_preemptions})"
                 )
                 continue
             if self.restarts >= a.max_restarts:
                 self.outcome = "exhausted"
                 self._write_trace()
-                print(
-                    f"supervise: restart budget ({a.max_restarts}) "
-                    "exhausted. Last output:\n" + _tail(rec["log"]),
-                    flush=True,
+                self._say(
+                    f"restart budget ({a.max_restarts}) "
+                    "exhausted. Last output:\n" + _tail(rec["log"])
                 )
                 return EXIT_EXHAUSTED
             self.restarts += 1
@@ -365,12 +460,116 @@ class Supervisor:
                 a.backoff * (2 ** (self.restarts - 1)), a.backoff_cap
             )
             if delay > 0:
-                print(
-                    f"supervise: restart {self.restarts}/"
-                    f"{a.max_restarts} in {delay:g}s",
-                    flush=True,
+                self._say(
+                    f"restart {self.restarts}/{a.max_restarts} "
+                    f"in {delay:g}s"
                 )
-                time.sleep(delay)
+                if self.stop_event is not None:
+                    # interruptible backoff: a sibling failure must
+                    # not leave this child sleeping out its delay
+                    self.stop_event.wait(delay)
+                else:
+                    time.sleep(delay)
+
+
+def _pair_dirs(dirs, n: int, flag: str):
+    """Pair repeated dir flags with N children: N flags pair by index,
+    ONE flag is a parent from which child-NN subdirs are derived, none
+    means no dirs. Anything else is a usage error."""
+    if not dirs:
+        return [[] for _ in range(n)]
+    if len(dirs) == n:
+        return [[d] for d in dirs]
+    if len(dirs) == 1:
+        return [
+            [os.path.join(dirs[0], f"child-{i:02d}")] for i in range(n)
+        ]
+    raise ValueError(
+        f"{flag}: got {len(dirs)} dirs for {n} children — give one "
+        "per child (paired by index), a single parent dir (child-NN "
+        "subdirs are derived), or none"
+    )
+
+
+def _run_fleet(args, mdirs, ckdirs) -> int:
+    """Multi-child mode: one Supervisor per ``--child``, each driven on
+    its own thread with independent budgets. The run completes when
+    every child completes; the FIRST terminal failure (poison,
+    exhausted budget) stops the siblings and becomes the exit code."""
+    import threading
+
+    cmds = [shlex.split(c) for c in args.child]
+    n = len(cmds)
+    try:
+        m_per = _pair_dirs(mdirs, n, "--metrics-dir")
+        ck_per = _pair_dirs(ckdirs, n, "--checkpoint-dir")
+    except ValueError as e:
+        print(f"supervise: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    stop = threading.Event()
+    sups = [
+        Supervisor(
+            args, cmds[i], m_per[i], ck_per[i],
+            label=f"child-{i:02d}", stop_event=stop,
+        )
+        for i in range(n)
+    ]
+    codes = [None] * n
+
+    def _drive(i):
+        try:
+            codes[i] = sups[i].run()
+        except BaseException:  # a crashed supervisor fails the fleet
+            codes[i] = EXIT_EXHAUSTED
+            raise
+        finally:
+            if codes[i] not in (EXIT_OK, EXIT_STOPPED):
+                stop.set()
+
+    threads = [
+        threading.Thread(
+            target=_drive, args=(i,), name=f"supervise-child-{i:02d}"
+        )
+        for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rc = next(
+        (c for c in codes if c not in (EXIT_OK, EXIT_STOPPED)), EXIT_OK
+    )
+    if args.trace:
+        # fleet-level summary next to the per-child traces
+        tmp = args.trace + ".tmp"
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.trace)), exist_ok=True
+        )
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "children": [
+                        {
+                            "label": s.label,
+                            "cmd": s.cmd,
+                            "outcome": s.outcome,
+                            "rc": codes[i],
+                            "trace": s.trace_path,
+                        }
+                        for i, s in enumerate(sups)
+                    ],
+                    "rc": rc,
+                },
+                f,
+                indent=2,
+            )
+        os.replace(tmp, args.trace)
+    print(
+        f"supervise: fleet done — "
+        + ", ".join(f"{s.label}={s.outcome}" for s in sups),
+        flush=True,
+    )
+    return rc
 
 
 def main(argv=None) -> int:
@@ -378,15 +577,26 @@ def main(argv=None) -> int:
     cmd = list(args.cmd)
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
+    mdirs = list(args.metrics_dir or [])
+    ckdirs = list(args.checkpoint_dir or [])
+    if args.child:
+        if cmd:
+            print(
+                "supervise: --child and a trailing `-- CMD` are "
+                "mutually exclusive",
+                file=sys.stderr,
+            )
+            return EXIT_USAGE
+        return _run_fleet(args, mdirs, ckdirs)
     if not cmd:
         print(
             "supervise: no command given — pass the learner CLI after "
-            "`--`",
+            "`--` (or use --child)",
             file=sys.stderr,
         )
         return EXIT_USAGE
-    args.cmd = cmd
-    return Supervisor(args).run()
+    sup = Supervisor(args, cmd, mdirs, ckdirs, trace_path=args.trace)
+    return sup.run()
 
 
 if __name__ == "__main__":

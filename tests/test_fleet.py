@@ -1,0 +1,888 @@
+"""The fault-tolerant serving fleet (serve.ServeFleet): replicated
+engines behind one durable queue, health-driven requeue, admission
+control with a predictable overload ladder.
+
+Contracts under test (ISSUE 7):
+- CHAOS PARITY: with replicas and injected kill + hang faults
+  mid-stream, every non-faulted request completes with a result
+  bit-identical to a single unfaulted engine's serve of the same
+  request, zero requests are lost or served twice, and the restarted
+  casualty rejoins and serves — all asserted from the obs stream;
+- requeue idempotency: a request handed off mid-dispatch is served
+  exactly once; a recovered straggler's late result is suppressed
+  (at-most-once delivery);
+- admission control: beyond the queue ceiling submit raises an
+  explicit ``Overloaded`` with a retry-after hint — queue depth is
+  BOUNDED, never silent growth toward OOM — and admitted requests
+  finish with bounded latency;
+- the overload ladder walks shed-batching -> reject -> degrade and
+  back, each transition an obs event, rung 3 recycling replicas onto
+  a reduced solve budget;
+- every serve_*/fleet_* obs record carries a ``replica_id`` field
+  (runtime assertion here + source lint below).
+"""
+import os
+import re
+import time
+from concurrent.futures import Future
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import (
+    FleetConfig,
+    ProblemGeom,
+    ServeConfig,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+)
+from ccsc_code_iccv2017_tpu.serve import (
+    CodecEngine,
+    Overloaded,
+    ServeFleet,
+)
+from ccsc_code_iccv2017_tpu.serve.fleet import _FleetRequest
+from ccsc_code_iccv2017_tpu.utils import faults, obs
+from ccsc_code_iccv2017_tpu.utils.validate import CCSCInputError
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation(monkeypatch):
+    for v in (
+        "CCSC_FAULT_ENGINE_KILL_REQ",
+        "CCSC_FAULT_ENGINE_KILL_REPLICA",
+        "CCSC_FAULT_ENGINE_HANG_REQ",
+        "CCSC_FAULT_ENGINE_HANG_REPLICA",
+        "CCSC_FAULT_ENGINE_HANG_S",
+        "CCSC_FAULT_STATE_DIR",
+        "CCSC_WATCHDOG_ACTION",
+        "CCSC_WATCHDOG_MIN_S",
+        "CCSC_WATCHDOG_COMPILE_S",
+    ):
+        monkeypatch.delenv(v, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _bank(k=4, s=3, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, s, s)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return jnp.asarray(d)
+
+
+def _cfg(**kw):
+    base = dict(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=4, tol=0.0,
+        verbose="none", track_objective=True,
+    )
+    base.update(kw)
+    return SolveConfig(**base)
+
+
+def _reqs(n, side=12, seed=1):
+    r = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = r.random((side, side)).astype(np.float32)
+        m = (r.random((side, side)) < 0.5).astype(np.float32)
+        out.append((x, m))
+    return out
+
+
+def _fleet(d, cfg, tmp_path=None, *, buckets=((2, (12, 12)),), **kw):
+    scfg = ServeConfig(
+        buckets=buckets, max_wait_ms=kw.pop("max_wait_ms", 2.0),
+        verbose="none",
+    )
+    fkw = dict(
+        min_queue_depth=64, restart_backoff_s=0.05,
+        heartbeat_s=0.2, health_interval_s=0.05, verbose="none",
+        metrics_dir=str(tmp_path) if tmp_path is not None else None,
+    )
+    fkw.update(kw)
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    return ServeFleet(
+        d, ReconstructionProblem(geom), cfg, scfg, FleetConfig(**fkw)
+    )
+
+
+def _single_engine_results(d, cfg, reqs, buckets=((2, (12, 12)),)):
+    """The parity reference: one unfaulted CodecEngine, same pinned
+    (bank, problem, SolveConfig, buckets)."""
+    scfg = ServeConfig(buckets=buckets, max_wait_ms=2.0, verbose="none")
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    eng = CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
+    try:
+        futs = [eng.submit(x * m, mask=m) for x, m in reqs]
+        return [f.result(timeout=180) for f in futs]
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------------------- basics
+
+
+def test_fleet_single_replica_bit_identical_no_faults():
+    d = _bank()
+    cfg = _cfg()
+    reqs = _reqs(4)
+    ref = _single_engine_results(d, cfg, reqs)
+    fleet = _fleet(d, cfg, replicas=1)
+    try:
+        futs = [fleet.submit(x * m, mask=m) for x, m in reqs]
+        res = [f.result(timeout=180) for f in futs]
+    finally:
+        fleet.close()
+    for i in range(len(reqs)):
+        np.testing.assert_array_equal(res[i].recon, ref[i].recon)
+        assert int(res[i].trace.num_iters) == int(
+            ref[i].trace.num_iters
+        )
+
+
+def test_idempotency_key_api():
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=1, max_wait_ms=500.0)
+    try:
+        x, m = _reqs(1)[0]
+        f1 = fleet.submit(x * m, mask=m, key="dup")
+        f2 = fleet.submit(x * m, mask=m, key="dup")
+        assert f1 is f2  # still in flight: the SAME future
+        res = f1.result(timeout=120)
+        assert res.recon.shape == (12, 12)
+        # wait until delivery bookkeeping has settled
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                fleet.submit(x * m, mask=m, key="dup")
+            except CCSCInputError as e:
+                assert "already served" in str(e)
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("resubmitting a served key was not refused")
+    finally:
+        fleet.close()
+
+
+def test_fleet_close_reentrant_and_submit_after_close():
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=1)
+    x, m = _reqs(1)[0]
+    fleet.reconstruct(x * m, mask=m)
+    assert not fleet.closed
+    fleet.close()
+    assert fleet.closed
+    fleet.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        fleet.submit(x * m, mask=m)
+
+
+def test_requeue_max_attempts_exhausted_errors():
+    """The exactly-once-OR-ERROR half of the delivery contract: a
+    request whose ownership budget is spent gets an explicit error on
+    requeue, never a silent retry-forever."""
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=1, max_attempts=2)
+    try:
+        rep = fleet._replicas[0]
+        req = _FleetRequest(
+            key="doomed", b=np.zeros((12, 12), np.float32), mask=None,
+            smooth_init=None, x_orig=None, future=Future(),
+            t_submit=time.perf_counter(), attempts=2,
+        )
+        with fleet._cv:
+            fleet._index["doomed"] = req
+            rep.assigned.append(req)
+        fleet._requeue_from(rep, reason="test")
+        with pytest.raises(RuntimeError, match="delivery attempts"):
+            req.future.result(timeout=5)
+        assert fleet.stats()["n_failed"] == 1
+    finally:
+        fleet.close()
+
+
+def test_failed_key_is_spent_and_late_result_suppressed():
+    """Exactly-once-OR-error means OR: once a key's future carries the
+    max_attempts error, a recovered straggler's late result for it is
+    suppressed (not recorded as a served request) and resubmitting the
+    key is refused — the client can never see both an error and a
+    result for one key."""
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=1, max_attempts=1)
+    try:
+        x, m = _reqs(1)[0]
+        res = fleet.reconstruct(x * m, mask=m, timeout=120)
+        rep = fleet._replicas[0]
+        req = _FleetRequest(
+            key="doomed", b=x * m, mask=m, smooth_init=None,
+            x_orig=None, future=Future(),
+            t_submit=time.perf_counter(), attempts=1,
+        )
+        with fleet._cv:
+            fleet._index["doomed"] = req
+            rep.assigned.append(req)
+        fleet._requeue_from(rep, reason="test")
+        with pytest.raises(RuntimeError, match="delivery attempts"):
+            req.future.result(timeout=5)
+        n_before = fleet.stats()["n_requests"]
+        served_before = rep.served
+        # the straggler wakes with a late result for the failed key
+        fleet._deliver(rep, req, res)
+        st = fleet.stats()
+        assert st["n_requests"] == n_before  # not recorded as served
+        assert rep.served == served_before
+        assert st["n_duplicates_suppressed"] == 1
+        with pytest.raises(RuntimeError, match="delivery attempts"):
+            req.future.result(timeout=0)  # error stands, no result
+        with pytest.raises(CCSCInputError, match="already failed"):
+            fleet.submit(x * m, mask=m, key="doomed")
+    finally:
+        fleet.close()
+
+
+def test_take_drops_requeued_copy_of_resolved_key():
+    """A requeued copy of a key a straggler already delivered must be
+    dropped inside _take — running the full solve only to have the
+    delivery suppressed would waste a dispatch."""
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=1, max_wait_ms=2.0)
+    try:
+        x, m = _reqs(1)[0]
+        fleet.reconstruct(x * m, mask=m, key="k1", timeout=120)
+        ghost = _FleetRequest(
+            key="k1", b=x * m, mask=m, smooth_init=None, x_orig=None,
+            future=Future(), t_submit=time.perf_counter(), attempts=1,
+        )
+        with fleet._cv:
+            fleet._index["k1"] = ghost
+            fleet._queue.append(ghost)
+            fleet._cv.notify_all()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with fleet._cv:
+                if not fleet._queue and "k1" not in fleet._index:
+                    break
+            time.sleep(0.02)
+        else:
+            pytest.fail("requeued copy of a delivered key not dropped")
+        st = fleet.stats()
+        assert st["n_requests"] == 1  # the real delivery only
+        # dropped BEFORE the solve: nothing reached _deliver to be
+        # suppressed there
+        assert st["n_duplicates_suppressed"] == 0
+        assert not ghost.future.done()
+    finally:
+        fleet.close()
+
+
+def test_transient_all_retired_does_not_fail_queue():
+    """Replica 0 is abandoned (budget exhausted) while replica 1 sits
+    in restart backoff: the queue must survive — only when EVERY
+    replica is abandoned do pending futures get the no-capacity
+    error."""
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=2)
+    try:
+        req = _FleetRequest(
+            key="pending", b=np.zeros((12, 12), np.float32), mask=None,
+            smooth_init=None, x_orig=None, future=Future(),
+            t_submit=time.perf_counter(),
+        )
+        with fleet._cv:
+            for rep in fleet._replicas:
+                rep.retired = True  # both transiently down
+            fleet._abandoned.add(0)  # only replica 0 is terminal
+            fleet._index["pending"] = req
+            fleet._queue.append(req)
+            fleet._fail_if_no_capacity()
+            assert len(fleet._queue) == 1  # replica 1 is coming back
+            assert not req.future.done()
+            fleet._abandoned.add(1)  # now nobody is coming back
+            fleet._fail_if_no_capacity()
+            assert not fleet._queue
+        with pytest.raises(RuntimeError, match="no live replicas"):
+            req.future.result(timeout=5)
+        # and the door is closed: a fresh submit is refused up front
+        # instead of returning a future no worker will ever take
+        x, m = _reqs(1)[0]
+        with pytest.raises(RuntimeError, match="no live replicas"):
+            fleet.submit(x * m, mask=m)
+    finally:
+        with fleet._cv:  # let close() retire them cleanly
+            for rep in fleet._replicas:
+                rep.retired = False
+        fleet.close()
+
+
+def test_replica_death_drains_engine_queue():
+    """The crash path hands the casualty's engine-queued work back via
+    drain_pending (the documented handoff hook) before closing it, so
+    close() never spends a dispatch on results nobody will read."""
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=1)
+    try:
+        rep = fleet._replicas[0]
+        calls = []
+        orig = rep.engine.drain_pending
+        rep.engine.drain_pending = lambda: calls.append(1) or orig()
+        fleet._on_replica_death(rep, RuntimeError("injected"))
+        assert calls, "death path did not drain the engine queue"
+        # the replacement rejoins and serves
+        x, m = _reqs(1)[0]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with fleet._cv:
+                live = not fleet._replicas[0].retired
+            if live:
+                break
+            time.sleep(0.05)
+        res = fleet.reconstruct(x * m, mask=m, timeout=120)
+        assert res.recon.shape == (12, 12)
+    finally:
+        fleet.close()
+
+
+def test_delivery_bookkeeping_is_bounded():
+    """A long-lived fleet must not grow per-request state forever: the
+    served/failed key stores are capped at FleetConfig.key_window
+    (newest win) and the latency sample at latency_window, while the
+    delivered COUNT keeps counting — the admission control that
+    prevents queue OOM must not be undermined by the bookkeeping."""
+    d = _bank()
+    fleet = _fleet(
+        d, _cfg(), replicas=1, key_window=4, latency_window=3,
+    )
+    try:
+        for i, (x, m) in enumerate(_reqs(8, seed=11)):
+            fleet.reconstruct(x * m, mask=m, key=f"b{i}", timeout=120)
+        st = fleet.stats()
+        assert st["n_requests"] == 8  # the count never truncates
+        assert len(fleet._delivered) == 4  # the keys do
+        assert len(fleet._latencies) == 3
+        # the newest keys are the ones remembered
+        assert list(fleet._delivered) == [f"b{i}" for i in range(4, 8)]
+        # inside the window the idempotency refusal still holds
+        x, m = _reqs(1)[0]
+        with pytest.raises(CCSCInputError, match="already served"):
+            fleet.submit(x * m, mask=m, key="b7")
+    finally:
+        fleet.close()
+
+
+def test_derived_ceiling_credits_degraded_budget():
+    """Rung 3 recycles replicas onto max_it x degrade_max_it_factor,
+    which raises real request throughput — serving_bound must be
+    computed with the EFFECTIVE budget, or the admission ceiling and
+    retry-after undersell exactly the capacity the degrade bought."""
+    from ccsc_code_iccv2017_tpu.utils import perfmodel
+
+    d = _bank()
+    fleet = _fleet(
+        d, _cfg(max_it=8), replicas=1, max_queue_depth=10,
+        degrade_max_it_factor=0.5,
+        health_interval_s=30.0,  # keep the monitor out of the way
+    )
+    try:
+        rep = fleet._replicas[0]
+        rep.engine._last_it_rate = 100.0  # a measured dispatch rate
+        fleet._update_ceiling(perfmodel, [rep])
+        rps_full = fleet._bound_rps
+        assert rps_full > 0
+        fleet._degraded = True
+        fleet._update_ceiling(perfmodel, [rep])
+        assert fleet._bound_rps == pytest.approx(2.0 * rps_full)
+    finally:
+        fleet._degraded = False
+        fleet.close()
+
+
+def test_constructor_failure_stops_spawned_watchdogs(monkeypatch):
+    """ServeFleet.__init__'s failure path must release EVERYTHING the
+    replicas it did manage to spawn acquired — not just their engines.
+    A supervisor that retries fleet construction in a loop would
+    otherwise accumulate one ccsc-watchdog poll thread per spawned
+    replica per failed attempt for the life of the process."""
+    import threading
+
+    from ccsc_code_iccv2017_tpu.serve import fleet as fleet_mod
+
+    def _dogs():
+        return sum(
+            t.name == "ccsc-watchdog" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    before = _dogs()
+    real_engine = fleet_mod.CodecEngine
+    calls = {"n": 0}
+
+    def flaky_engine(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("boom: replica 1 failed to build")
+        return real_engine(*a, **kw)
+
+    monkeypatch.setattr(fleet_mod, "CodecEngine", flaky_engine)
+    with pytest.raises(RuntimeError, match="replica 1 failed"):
+        _fleet(_bank(), _cfg(), replicas=2)
+    assert calls["n"] == 2
+    # watchdog.stop() joins (2s); poll briefly for the quiet exit
+    deadline = time.monotonic() + 5.0
+    while _dogs() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert _dogs() == before
+
+
+def test_malformed_hang_env_never_crashes(monkeypatch):
+    """The chaos knobs keep the module's never-crash stance: a typo'd
+    CCSC_FAULT_ENGINE_HANG_S must not raise from inside the replica
+    worker (where it would be booked as a replica crash and burn
+    restart budget on every restarted generation)."""
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_HANG_REQ", "1")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_HANG_S", "10s")
+    faults.reset()
+    dur = faults.engine_hang_request(0, 1)
+    assert dur == 3600.0  # the wedged-forever default, not a raise
+
+
+def _recycling_with_inflight(fleet, key="inflight"):
+    """Put replica 0 in the state the rung-3 recycle loop leaves it in
+    — retired, state='recycling', handoff NOT yet performed — with one
+    request still in flight on it."""
+    x, m = _reqs(1)[0]
+    rep = fleet._replicas[0]
+    req = _FleetRequest(
+        key=key, b=x * m, mask=m, smooth_init=None, x_orig=None,
+        future=Future(), t_submit=time.perf_counter(), attempts=1,
+    )
+    with fleet._cv:
+        rep.retired = True
+        rep.state = "recycling"
+        fleet._index[key] = req
+        rep.assigned.append(req)
+    return rep, req
+
+
+def test_recycling_replica_crash_still_hands_off():
+    """A replica retired for a rung-3 recycle that CRASHES mid-dispatch
+    (before its clean recycle exit) still owes its casualty handoff:
+    its in-flight requests must be requeued onto the replacement and
+    the slot respawned. Regression — the death handler used to treat
+    any ``retired`` replica as already drained, leaving the requests'
+    futures hanging forever and the slot a dead husk (``reaped``, not
+    ``retired``, gates the handoff)."""
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=1)
+    try:
+        rep, req = _recycling_with_inflight(fleet)
+        # the worker crashes before the clean recycle exit could run
+        fleet._on_replica_death(rep, RuntimeError("injected"))
+        assert rep.reaped
+        # the request was requeued, the replacement spawns and serves
+        # it — the future resolves instead of hanging until close
+        res = req.future.result(timeout=180)
+        assert res.recon.shape == (12, 12)
+        cur = fleet._replicas[0]
+        assert cur.generation == rep.generation + 1
+        assert fleet.stats()["n_requeued"] == 1
+    finally:
+        fleet.close()
+
+
+def test_recycling_replica_stall_still_hands_off():
+    """Same hole via the stall path: a wedged recycling worker fires
+    the watchdog — the stall handler must not early-return on
+    ``retired`` but drain and respawn like any other casualty."""
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=1)
+    try:
+        rep, req = _recycling_with_inflight(fleet, key="stalled")
+        fleet._on_replica_stall(rep, "replica0-dispatch")
+        assert rep.reaped
+        res = req.future.result(timeout=180)
+        assert res.recon.shape == (12, 12)
+        assert fleet._replicas[0].generation == rep.generation + 1
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------- chaos parity
+
+
+def test_chaos_kill_hang_zero_lost_bit_identical(tmp_path, monkeypatch):
+    """The ISSUE 7 acceptance chaos test: 3 replicas, replica 0 killed
+    and replica 1 hung mid-stream. Every request completes exactly
+    once, bit-identical to a single unfaulted engine; the hung
+    straggler's late deliveries are suppressed; both casualties
+    restart, rejoin, and serve — all read back from the obs stream."""
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_KILL_REQ", "2")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_KILL_REPLICA", "0")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_HANG_REQ", "2")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_HANG_REPLICA", "1")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_HANG_S", "2.5")
+    monkeypatch.setenv("CCSC_WATCHDOG_MIN_S", "0.4")
+    monkeypatch.setenv("CCSC_WATCHDOG_COMPILE_S", "0.4")
+    faults.reset()
+    d = _bank()
+    cfg = _cfg()
+    reqs = _reqs(12)
+    ref = _single_engine_results(d, cfg, reqs)
+
+    fleet = _fleet(d, cfg, tmp_path, replicas=3)
+    try:
+        futs = [
+            fleet.submit(x * m, mask=m, key=f"k{i}")
+            for i, (x, m) in enumerate(reqs)
+        ]
+        res = [f.result(timeout=300) for f in futs]
+
+        # zero lost: every future resolved with a real result,
+        # bit-identical to the unfaulted single-engine serve
+        assert len(res) == 12
+        for i in range(12):
+            np.testing.assert_array_equal(res[i].recon, ref[i].recon)
+            assert int(res[i].trace.num_iters) == int(
+                ref[i].trace.num_iters
+            )
+
+        # the casualties rejoin: wait for 3 live replicas again
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = fleet.stats()
+            live = [
+                r for r in st["replicas"]
+                if r is not None and r["state"] == "live"
+            ]
+            if len(live) == 3:
+                break
+            time.sleep(0.05)
+        assert len(live) == 3, st["replicas"]
+        restarted = {
+            r["replica"] for r in st["replicas"]
+            if r is not None and r["generation"] > 0
+        }
+        assert restarted == {0, 1}
+
+        # ... and SERVE: keep offering fresh work until a restarted
+        # replica delivers (replicas race for the queue, so one wave
+        # may be won entirely by the incumbent)
+        served_by_restarted = False
+        for wave in range(10):
+            wf = [
+                fleet.submit(x * m, mask=m, key=f"w{wave}-{i}")
+                for i, (x, m) in enumerate(_reqs(6, seed=50 + wave))
+            ]
+            [f.result(timeout=120) for f in wf]
+            ev = obs.read_events(str(tmp_path))
+            ready_t = {
+                e["replica_id"]: e["t"]
+                for e in ev if e["type"] == "fleet_replica_ready"
+            }
+            if any(
+                e["type"] == "fleet_request"
+                and e["replica_id"] in restarted
+                and e["t"] > ready_t.get(e["replica_id"], np.inf)
+                for e in ev
+            ):
+                served_by_restarted = True
+                break
+        assert served_by_restarted
+
+        # the hung straggler wakes 2.5 s after its take and delivers
+        # late — wait for the suppression to land BEFORE closing (an
+        # abandoned worker is deliberately not joined by close())
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            ev = obs.read_events(str(tmp_path))
+            if any(
+                e["type"] == "fleet_duplicate_suppressed" for e in ev
+            ):
+                break
+            time.sleep(0.1)
+    finally:
+        fleet.close()
+
+    events = obs.read_events(str(tmp_path), recursive=True)
+    # every serve_*/fleet_* record names its replica (None allowed
+    # only for fleet-scope records) — the runtime half of the lint
+    for e in events:
+        t = e.get("type", "")
+        if t.startswith("serve_") or t.startswith("fleet_"):
+            assert "replica_id" in e, e
+
+    dead = [e for e in events if e["type"] == "fleet_replica_dead"]
+    reasons = {e["replica_id"]: e["reason"] for e in dead}
+    assert reasons[0] == "crash" and reasons[1] == "stall"
+    stalls = [e for e in events if e["type"] == "stall"]
+    assert any(e.get("replica_id") == 1 for e in stalls)
+    assert [e for e in events if e["type"] == "fleet_requeue"]
+    # exactly-once delivery of the original 12 keys
+    first_wave = [
+        e for e in events
+        if e["type"] == "fleet_request" and e["key"].startswith("k")
+    ]
+    keys = [e["key"] for e in first_wave]
+    assert sorted(keys) == sorted(f"k{i}" for i in range(12))
+    assert len(keys) == len(set(keys)), "a request was served twice"
+    # some were handed off (attempts > 1)
+    assert any(e["attempts"] > 1 for e in first_wave)
+    # the hung straggler woke after 2.5 s and its late results for
+    # already-delivered keys were suppressed (at-most-once)
+    assert [
+        e for e in events if e["type"] == "fleet_duplicate_suppressed"
+    ]
+    # the fleet closed with nothing lost
+    summary = [
+        e for e in events
+        if e["type"] == "summary" and e.get("n_requeued") is not None
+    ][-1]
+    assert summary["n_failed"] == 0
+
+
+# -------------------------------------------------- admission control
+
+
+def test_overload_explicit_ceiling_rejects_and_bounds_queue(tmp_path):
+    d = _bank()
+    fleet = _fleet(
+        d, _cfg(max_it=30), tmp_path, replicas=1,
+        buckets=((1, (12, 12)),), max_wait_ms=0.0,
+        max_queue_depth=4,
+    )
+    admitted, rejected = [], 0
+    retry_hints = []
+    try:
+        for i, (x, m) in enumerate(_reqs(16)):
+            try:
+                admitted.append(fleet.submit(x * m, mask=m, key=f"o{i}"))
+            except Overloaded as e:
+                rejected += 1
+                retry_hints.append(e.retry_after_s)
+        results = [f.result(timeout=300) for f in admitted]
+        st = fleet.stats()
+    finally:
+        fleet.close()
+    # explicit rejections, not silent queue growth
+    assert rejected >= 1
+    assert all(h > 0 for h in retry_hints)
+    assert st["n_rejected"] == rejected
+    # every ADMITTED request completed, with a real latency summary
+    assert len(results) == len(admitted)
+    assert st["p99_latency_s"] is not None
+    events = obs.read_events(str(tmp_path))
+    rej = [e for e in events if e["type"] == "fleet_admission_reject"]
+    assert len(rej) == rejected
+    # the queue never grew past its ceiling
+    assert all(e["queue_depth"] <= 4 for e in rej)
+
+
+def test_overload_derived_ceiling_from_serving_bound(tmp_path):
+    """The acceptance overload test against the DERIVED ceiling: after
+    a dispatch has measured an iteration rate, the ceiling comes from
+    perfmodel.serving_bound x live replicas x max_queue_s; submitting
+    4x that yields explicit Overloaded rejections, bounded p99 for
+    admitted requests, and no silent queue growth."""
+    d = _bank()
+    fleet = _fleet(
+        d, _cfg(max_it=40), tmp_path, replicas=1,
+        buckets=((1, (12, 12)),), max_wait_ms=0.0,
+        max_queue_depth=None, min_queue_depth=2, max_queue_s=0.05,
+    )
+    try:
+        # one served request measures the iteration rate; the monitor
+        # then derives the ceiling from serving_bound
+        x0, m0 = _reqs(1)[0]
+        fleet.reconstruct(x0 * m0, mask=m0)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            ev = obs.read_events(str(tmp_path))
+            if any(e["type"] == "fleet_ceiling" for e in ev):
+                break
+            time.sleep(0.02)
+        ceil_ev = [e for e in ev if e["type"] == "fleet_ceiling"]
+        assert ceil_ev, "ceiling was never derived from serving_bound"
+        assert ceil_ev[-1]["source"] == "serving_bound"
+        ceiling = fleet.queue_ceiling
+        assert ceiling >= 2
+
+        admitted, rejected = [], 0
+        for i, (x, m) in enumerate(_reqs(4 * ceiling, seed=7)):
+            try:
+                admitted.append(
+                    fleet.submit(x * m, mask=m, key=f"d{i}")
+                )
+            except Overloaded as e:
+                rejected += 1
+                assert e.retry_after_s > 0
+        results = [f.result(timeout=300) for f in admitted]
+        st = fleet.stats()
+    finally:
+        fleet.close()
+    assert rejected >= 1, "4x the derived ceiling must overflow it"
+    assert len(results) == len(admitted)
+    assert st["p99_latency_s"] is not None and st["p99_latency_s"] < 120
+    events = obs.read_events(str(tmp_path))
+    rej = [e for e in events if e["type"] == "fleet_admission_reject"]
+    max_ceil = max(
+        e["ceiling"] for e in events if e["type"] == "fleet_ceiling"
+    )
+    assert all(
+        e["queue_depth"] <= max(max_ceil, 64) for e in rej
+    )  # bounded, never silent growth
+
+
+def test_overload_ladder_rungs_and_degrade_recycle(tmp_path):
+    """White-box walk of the three-rung ladder: shed micro-batch
+    waiting -> reject -> (sustained) degrade-recycle onto a reduced
+    max_it, then restore on pressure release — each transition an obs
+    event, the degrade rungs rebuilding replicas one at a time."""
+    d = _bank()
+    fleet = _fleet(
+        d, _cfg(max_it=8), tmp_path, replicas=1,
+        max_wait_ms=50.0,
+        max_queue_depth=10, degrade_after_s=0.2,
+        degrade_max_it_factor=0.5,
+        health_interval_s=30.0,  # the monitor must not fight the test
+    )
+    try:
+        rep0 = fleet._replicas[0]
+        assert fleet.overload_rung == "normal"
+        fleet._eval_rungs(6, time.monotonic())  # 0.6 of ceiling
+        assert fleet.overload_rung == "shed_batching"
+        assert rep0.engine._max_wait_s == 0.0  # rung 1 sheds waits
+        fleet._eval_rungs(10, time.monotonic())
+        assert fleet.overload_rung == "reject"
+        time.sleep(0.3)  # sustain rejection past degrade_after_s
+        fleet._eval_rungs(10, time.monotonic())
+        assert fleet.overload_rung == "degrade"
+        # the recycle rebuilds the replica on the degraded budget
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            cur = fleet._replicas[0]
+            if cur.generation == 1 and cur.state == "live":
+                break
+            time.sleep(0.05)
+        assert fleet._replicas[0].generation == 1
+        assert fleet._replicas[0].engine.cfg.max_it == 4  # 8 x 0.5
+        # a request served under rung 3 uses the degraded budget
+        x, m = _reqs(1)[0]
+        res = fleet.reconstruct(x * m, mask=m, timeout=120)
+        assert int(res.trace.num_iters) <= 4
+        # pressure released: back to normal, full budget restored
+        fleet._eval_rungs(0, time.monotonic())
+        assert fleet.overload_rung == "normal"
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            cur = fleet._replicas[0]
+            if cur.generation == 2 and cur.state == "live":
+                break
+            time.sleep(0.05)
+        assert fleet._replicas[0].engine.cfg.max_it == 8
+        # recycles are maintenance, not failures: the crash-restart
+        # budget must be untouched by the two rebuild cycles
+        assert fleet._restarts.get(0, 0) == 0
+    finally:
+        fleet.close()
+    events = obs.read_events(str(tmp_path))
+    trans = [
+        (e["rung_from"], e["rung_to"])
+        for e in events if e["type"] == "fleet_overload"
+    ]
+    assert trans == [
+        ("normal", "shed_batching"),
+        ("shed_batching", "reject"),
+        ("reject", "degrade"),
+        ("degrade", "normal"),
+    ]
+    degrades = [e for e in events if e["type"] == "degrade"]
+    assert [e["rung"] for e in degrades] == [
+        "serve_max_it", "serve_restore"
+    ]
+    assert all(e["replica_id"] is None for e in degrades)
+
+
+# ------------------------------------------------------------- report
+
+
+def test_obs_report_fleet_section(tmp_path):
+    d = _bank()
+    fleet = _fleet(d, _cfg(), tmp_path, replicas=2)
+    try:
+        for i, (x, m) in enumerate(_reqs(4)):
+            fleet.submit(x * m, mask=m, key=f"r{i}")
+        # drain through close
+    finally:
+        fleet.close()
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report",
+        os.path.join(
+            os.path.dirname(__file__), "..", "scripts", "obs_report.py"
+        ),
+    )
+    obs_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_report)
+    events = obs.read_events(str(tmp_path), recursive=True)
+    out = obs_report.render(events)
+    assert "FLEET" in out
+    assert "replica 0:" in out and "replica 1:" in out
+    assert "delivered     4 request(s)" in out
+    assert "serve_fleet" in out
+
+
+def test_check_replicas_staleness_rule(tmp_path):
+    """A replica whose newest heartbeat lags the stream is stale by
+    the same rule as check_peers; judged from parsed events too."""
+    from ccsc_code_iccv2017_tpu.utils import watchdog
+
+    t0 = 1000.0
+    events = [
+        {"t": t0, "type": "fleet_heartbeat", "replica_id": 0,
+         "state": "live", "served": 3, "restarts": 0},
+        {"t": t0 + 300.0, "type": "fleet_heartbeat", "replica_id": 1,
+         "state": "live", "served": 5, "restarts": 1},
+        {"t": t0 + 301.0, "type": "fleet_request", "replica_id": 1,
+         "key": "x"},
+    ]
+    rows = watchdog.check_replicas(events=events, stale_s=120.0)
+    assert [r["replica"] for r in rows] == [0, 1]
+    assert rows[0]["stale"] is True
+    assert rows[1]["stale"] is False
+    assert rows[1]["served"] == 5 and rows[1]["restarts"] == 1
+
+
+# --------------------------------------------------------------- lint
+
+
+SERVE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "ccsc_code_iccv2017_tpu", "serve"
+)
+
+
+def test_serve_fleet_events_route_through_emit():
+    """Source lint (same discipline as the bare-print lint): every obs
+    event the serving layer emits must ride through its module's
+    ``_emit`` — the single point that stamps ``replica_id`` — so
+    per-replica health attribution can never silently regress. A new
+    direct ``_run.event("serve_...")`` call fails here, not in a
+    3am incident review."""
+    for fname in ("engine.py", "fleet.py"):
+        with open(os.path.join(SERVE_DIR, fname)) as f:
+            src = f.read()
+        direct = re.findall(r"_run\.event\(", src)
+        assert len(direct) == 1, (
+            f"{fname}: every event must go through _emit (found "
+            f"{len(direct)} direct _run.event call sites)"
+        )
+        emit_def = re.search(
+            r"def _emit\(self[^)]*\)[^\n]*:\n(?:\s+.*\n)+?"
+            r"\s+self\._run\.event\([^)]*replica_id", src
+        )
+        assert emit_def, f"{fname}: _emit must stamp replica_id"

@@ -306,6 +306,52 @@ def test_compile_monitor_uninstalls_cleanly(tmp_path):
     assert len(_mon._event_duration_secs_listeners) == before
 
 
+def test_compile_monitor_hub_out_of_order_close(tmp_path):
+    """Concurrently open runs (a serving fleet holds N+1) share ONE
+    process-wide set of compile-harvest hooks via the monitor hub:
+    the first run's close must neither remove the hooks from under
+    the survivor nor restore a logger state captured mid-flight —
+    the TRUE pre-install state comes back only when the last
+    subscriber leaves."""
+    import logging as _logging
+
+    from jax._src import monitoring as _mon
+
+    lg = _logging.getLogger("jax._src.dispatch")
+    level0, prop0 = lg.level, lg.propagate
+    before = len(_mon._event_duration_secs_listeners)
+    r1 = obs.start_run(str(tmp_path / "a"), algorithm="u", verbose="none")
+    r2 = obs.start_run(str(tmp_path / "b"), algorithm="u", verbose="none")
+    # one shared install, not one per run
+    assert len(_mon._event_duration_secs_listeners) == before + 1
+    r1.close()  # out of order: the FIRST-opened run closes first
+    # the survivor still harvests: hooks stay installed and the
+    # dispatch logger still emits the DEBUG records it reads
+    assert len(_mon._event_duration_secs_listeners) == before + 1
+    assert lg.getEffectiveLevel() <= _logging.DEBUG
+    r2.close()
+    assert len(_mon._event_duration_secs_listeners) == before
+    assert lg.level == level0 and lg.propagate == prop0
+
+
+def test_start_run_without_compile_monitor(tmp_path):
+    from jax._src import monitoring as _mon
+
+    before = len(_mon._event_duration_secs_listeners)
+    run = obs.start_run(
+        str(tmp_path / "m"), algorithm="u", verbose="none",
+        compile_monitor=False,
+    )
+    try:
+        assert run.compile_monitor is None
+        assert len(_mon._event_duration_secs_listeners) == before
+        run.event("probe", x=1)
+    finally:
+        run.close()
+    events = obs.read_events(str(tmp_path / "m"))
+    assert any(e["type"] == "probe" for e in events)
+
+
 # ------------------------------------------------------------------
 # heartbeats
 # ------------------------------------------------------------------

@@ -347,6 +347,103 @@ def test_requests_without_optional_fields_match_direct_none_path():
         eng.close()
 
 
+def test_close_idempotent_reentrant_and_closed_property():
+    """ISSUE 7 satellite: close() must be re-entrant and race-safe (a
+    fleet drain racing a user close), with a ``closed`` property the
+    fleet can poll. The second close returns AFTER the first finished
+    the drain, and a closed engine refuses new work."""
+    import threading
+
+    d = _bank()
+    eng = _engine(d, _cfg(max_it=4), ((2, (24, 24)),))
+    assert eng.closed is False
+    x, m = _req(24)
+    fut = eng.submit(x * m, mask=m)
+    done = []
+    threads = [
+        threading.Thread(target=lambda: (eng.close(), done.append(1)))
+        for _ in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert done == [1, 1, 1]  # every closer returned
+    assert eng.closed is True
+    # the pre-close request was flushed, not dropped
+    assert fut.result(timeout=5).recon.shape == (24, 24)
+    eng.close()  # idempotent after the fact too
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(x * m, mask=m)
+
+
+def test_close_noop_when_constructor_failed_early():
+    """The documented close() contract holds from the FIRST statement
+    of __init__: a constructor that raised in the pre-telemetry
+    validation block (before _run/_cv exist) must still close as a
+    clean no-op, not mask the validation error with AttributeError."""
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve.engine import CodecEngine
+    from ccsc_code_iccv2017_tpu.utils.validate import CCSCInputError
+
+    d = _bank()
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    eng = CodecEngine.__new__(CodecEngine)
+    with pytest.raises(CCSCInputError, match="smaller than the"):
+        # bucket smaller than the kernel support: raises in the
+        # once-per-engine validation, before obs.start_run
+        eng.__init__(
+            d, ReconstructionProblem(geom), _cfg(),
+            ServeConfig(buckets=((2, (4, 4)),), verbose="none"),
+        )
+    eng.close()  # the caller's `finally: engine.close()`
+    eng.close()  # and it stays idempotent
+
+
+def test_drain_pending_hands_off_queued_requests():
+    """The fleet handoff hook: queued (not yet dispatching) requests
+    are atomically removed with their payloads, their engine futures
+    cancelled — the caller requeues them elsewhere."""
+    d = _bank()
+    eng = _engine(
+        d, _cfg(), ((2, (24, 24)),), max_wait_ms=60_000.0,
+    )
+    try:
+        x, m = _req(24)
+        fut = eng.submit(x * m, mask=m)  # 1 of 2 slots: waits out the
+        # deadline, so it is still queued when we drain
+        taken = eng.drain_pending()
+        assert len(taken) == 1
+        assert fut.cancelled()
+        np.testing.assert_array_equal(taken[0]["b"], x * m)
+        np.testing.assert_array_equal(taken[0]["mask"], m)
+        assert eng.drain_pending() == []  # empty after the handoff
+    finally:
+        eng.close()
+
+
+def test_set_max_wait_ms_live_retarget():
+    """Overload rung 1: zeroing the flush deadline live dispatches a
+    lone queued request immediately instead of waiting out the
+    configured deadline."""
+    d = _bank()
+    eng = _engine(
+        d, _cfg(max_it=4), ((2, (24, 24)),), max_wait_ms=60_000.0,
+    )
+    try:
+        x, m = _req(24)
+        t0 = time.perf_counter()
+        fut = eng.submit(x * m, mask=m)
+        eng.set_max_wait_ms(0.0)
+        res = fut.result(timeout=60)
+        assert time.perf_counter() - t0 < 30.0  # not the 60 s deadline
+        assert res.recon.shape == (24, 24)
+    finally:
+        eng.close()
+
+
 def test_serving_bound_formula():
     from ccsc_code_iccv2017_tpu.utils import perfmodel
 

@@ -590,3 +590,137 @@ def test_fault_fire_once_survives_process_restart(tmp_path, monkeypatch):
     faults.reset()
     with pytest.raises(faults.InjectedFault):
         faults.ckpt_save_hook()
+
+
+# -------------------------------------------- multi-dir / multi-child
+
+
+def _write_events(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_attempt_preempted_judged_per_dir(tmp_path):
+    """ISSUE 7 satellite: a fleet child has one stream per replica,
+    judged PER DIR. Replica A's newest attempt was preempted; replica
+    B restarted later (newer run_meta, no preemption). Per-dir
+    judgment sees the preemption; a merged single-stream judgment
+    would scope A's preemption to B's newer attempt and miss it."""
+    a = tmp_path / "replica-00"
+    b = tmp_path / "replica-01"
+    t0 = time.time()
+    _write_events(
+        str(a / "events-p00000.jsonl"),
+        [
+            {"t": t0, "type": "run_meta"},
+            {"t": t0 + 1, "type": "preemption", "iteration": 2},
+        ],
+    )
+    _write_events(
+        str(b / "events-p00000.jsonl"),
+        [{"t": t0 + 5, "type": "run_meta"}, {"t": t0 + 6, "type": "step"}],
+    )
+    assert supervise._attempt_preempted([str(a), str(b)]) is True
+    assert supervise._attempt_preempted([str(b)]) is False
+    # the single merged stream WOULD have missed it — the reason the
+    # supervisor takes a list
+    merged = obs.read_events(str(a)) + obs.read_events(str(b))
+    merged.sort(key=lambda e: e["t"])
+    last_meta = max(
+        i for i, e in enumerate(merged) if e.get("type") == "run_meta"
+    )
+    assert not any(
+        e.get("type") == "preemption" for e in merged[last_meta + 1 :]
+    )
+
+
+def test_progress_stamp_sees_replica_subdirs(tmp_path):
+    sub = tmp_path / "metrics" / "replica-00"
+    os.makedirs(str(sub))
+    s0 = supervise._progress_stamp([str(tmp_path / "metrics")])
+    (sub / "events-p00000.jsonl").write_text('{"t": 1}\n')
+    s1 = supervise._progress_stamp([str(tmp_path / "metrics")])
+    assert s1 > s0  # a replica's stream write counts as progress
+
+
+def test_multi_child_all_complete(tmp_path):
+    """--child multi-child mode: two independent children, both
+    complete, per-child traces written, fleet rc 0."""
+    mdir = tmp_path / "m"
+    rc = supervise.main(
+        [
+            "--metrics-dir", str(mdir),
+            "--backoff", "0",
+            "--child", f"{sys.executable} -c pass",
+            "--child", f"{sys.executable} -c pass",
+        ]
+    )
+    assert rc == 0
+    for i in range(2):
+        tr = json.load(
+            open(
+                os.path.join(
+                    str(mdir), f"child-{i:02d}", "supervisor_trace.json"
+                )
+            )
+        )
+        assert tr["outcome"] == "completed"
+        assert tr["label"] == f"child-{i:02d}"
+
+
+def test_multi_child_sibling_failure_stops_fleet(tmp_path):
+    """A terminally failing child (restart budget exhausted, no
+    checkpoint) stops its long-running sibling; the fleet exits with
+    the failing child's code and the sibling's trace says stopped."""
+    mdir = tmp_path / "m"
+    t0 = time.monotonic()
+    rc = supervise.main(
+        [
+            "--metrics-dir", str(mdir),
+            "--max-restarts", "0",
+            "--backoff", "0",
+            "--trace", str(tmp_path / "fleet_trace.json"),
+            "--child", f"{sys.executable} -c 'import time; time.sleep(120)'",
+            "--child", f"{sys.executable} -c 'raise SystemExit(1)'",
+        ]
+    )
+    took = time.monotonic() - t0
+    assert rc == supervise.EXIT_EXHAUSTED
+    assert took < 60, "the sleeping sibling must be stopped, not waited out"
+    tr0 = json.load(
+        open(os.path.join(str(mdir), "child-00", "supervisor_trace.json"))
+    )
+    tr1 = json.load(
+        open(os.path.join(str(mdir), "child-01", "supervisor_trace.json"))
+    )
+    assert tr0["outcome"] == "stopped"
+    assert tr0["attempts"][-1]["reason"] == "fleet_stop"
+    assert tr1["outcome"] == "exhausted"
+    fleet_tr = json.load(open(str(tmp_path / "fleet_trace.json")))
+    assert fleet_tr["rc"] == supervise.EXIT_EXHAUSTED
+    assert {c["label"]: c["outcome"] for c in fleet_tr["children"]} == {
+        "child-00": "stopped", "child-01": "exhausted"
+    }
+
+
+def test_multi_child_dir_pairing_usage_error(tmp_path, capsys):
+    rc = supervise.main(
+        [
+            "--metrics-dir", str(tmp_path / "a"),
+            "--metrics-dir", str(tmp_path / "b"),
+            "--metrics-dir", str(tmp_path / "c"),
+            "--child", f"{sys.executable} -c pass",
+            "--child", f"{sys.executable} -c pass",
+        ]
+    )
+    assert rc == supervise.EXIT_USAGE
+    # and --child is mutually exclusive with a trailing command
+    rc = supervise.main(
+        [
+            "--child", f"{sys.executable} -c pass",
+            "--", sys.executable, "-c", "pass",
+        ]
+    )
+    assert rc == supervise.EXIT_USAGE
