@@ -1,0 +1,40 @@
+"""Repo-native static analysis (``scripts/lint.py``).
+
+The solvers stay fast only because every hot path is a pure, donated,
+shape-stable jitted program, and they stay correct under faults only
+because the fleet/watchdog/supervisor threading holds its locking
+discipline. Both invariant families used to be enforced by hand (and
+by three scattered pattern-lint tests); this package makes them
+machine-checked on every PR:
+
+==================  ==================================================
+``jit-purity``      host-sync / recompile hazards reachable from a
+                    ``jax.jit`` / ``lax.scan`` / ``shard_map`` boundary
+``donation-safety`` donated buffers read after the jitted call
+``thread-safety``   lock-order inversions, blocking work or obs emits
+                    under a lock, threads without a join path
+``obs-schema``      every emitted / consumed obs event validated
+                    against the declared ``utils.obs.EVENT_SCHEMA``
+``env-registry``    every ``CCSC_*`` env read routed through the
+                    shared never-crash helper ``utils.env`` and
+                    declared in its registry
+``bare-print``      library code prints via utils.obs console tiers
+``emit-routing``    serve/fleet events ride the replica-stamping
+                    ``_emit``
+``validate-routing``app CLIs route inputs through utils.validate
+==================  ==================================================
+
+Suppression: an inline ``# ccsc: allow[check-id]`` on (or alone on the
+line above) the flagged line, or a reviewed entry in
+``analysis/baseline.json``. ``python scripts/lint.py`` exits non-zero
+on any new finding; ``tests/test_analysis.py`` runs the same suite as
+a tier-1 gate.
+"""
+from .core import (  # noqa: F401
+    Finding,
+    Project,
+    all_check_names,
+    load_baseline,
+    run_checks,
+    split_baseline,
+)

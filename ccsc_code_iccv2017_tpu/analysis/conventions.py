@@ -1,0 +1,238 @@
+"""Repo convention checks — the three ad-hoc pattern-lint tests
+(tests/test_obs.py bare prints, tests/test_fleet.py _emit routing,
+tests/test_validate.py validate routing), migrated into the analysis
+framework. The old tests are thin wrappers over these check ids; the
+rules themselves are unchanged, now with AST precision and the shared
+suppression/baseline machinery.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from .core import Finding, Project, Source, dotted, register
+
+_PKG = "ccsc_code_iccv2017_tpu/"
+# the sanctioned console emitters; everything else routes through
+# utils.obs tiers so terminal and event stream cannot drift
+_PRINT_ALLOWED = {
+    _PKG + "utils/obs.py",
+}
+
+
+@register("bare-print")
+def check_bare_print(project: Project) -> List[Finding]:
+    """Console output from library code must go through the utils.obs
+    console tier. apps/ is the CLI surface and may print; scripts/
+    are operator tools and may print."""
+    findings: List[Finding] = []
+    for src in project.sources:
+        if src.tree is None or not project.in_package(src):
+            continue
+        if src.rel.startswith(_PKG + "apps/"):
+            continue
+        if src.rel in _PRINT_ALLOWED:
+            continue
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                findings.append(
+                    Finding(
+                        check="bare-print",
+                        path=src.rel,
+                        line=node.lineno,
+                        message=(
+                            "bare print() in library code — use "
+                            "the utils.obs console tiers "
+                            "(obs.console / Run.console) so the "
+                            "terminal and the event stream cannot "
+                            "drift"
+                        ),
+                    )
+                )
+    return findings
+
+
+_SERVE_FILES = (
+    _PKG + "serve/engine.py",
+    _PKG + "serve/fleet.py",
+)
+
+
+@register("emit-routing")
+def check_emit_routing(project: Project) -> List[Finding]:
+    """Every obs event the serving layer emits must ride through its
+    module's ``_emit`` — the single point that stamps ``replica_id``
+    — so per-replica health attribution can never silently regress."""
+    findings: List[Finding] = []
+    for src in project.sources:
+        if src.rel not in _SERVE_FILES or src.tree is None:
+            continue
+        emit_def = None
+        direct_sites: List[int] = []
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "_emit"
+            ):
+                emit_def = node
+        emit_lines = set()
+        if emit_def is not None:
+            emit_lines = set(
+                range(
+                    emit_def.lineno,
+                    (emit_def.end_lineno or emit_def.lineno) + 1,
+                )
+            )
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "event"
+                and dotted(node.func.value) in ("self._run", "_run")
+            ):
+                if node.lineno not in emit_lines:
+                    direct_sites.append(node.lineno)
+        if emit_def is None:
+            findings.append(
+                Finding(
+                    check="emit-routing",
+                    path=src.rel,
+                    line=1,
+                    message=(
+                        "serving module has no `_emit` — every "
+                        "serve/fleet event must ride a single "
+                        "replica_id-stamping emission point"
+                    ),
+                )
+            )
+            continue
+        for line in direct_sites:
+            findings.append(
+                Finding(
+                    check="emit-routing",
+                    path=src.rel,
+                    line=line,
+                    message=(
+                        "direct `_run.event(...)` outside `_emit` — "
+                        "serve/fleet events must route through the "
+                        "replica_id-stamping `_emit`"
+                    ),
+                )
+            )
+        stamps = any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "event"
+            and (
+                any(kw.arg == "replica_id" for kw in sub.keywords)
+            )
+            for sub in ast.walk(emit_def)
+        ) or any(
+            a.arg == "replica_id" for a in emit_def.args.kwonlyargs
+        )
+        if not stamps:
+            findings.append(
+                Finding(
+                    check="emit-routing",
+                    path=src.rel,
+                    line=emit_def.lineno,
+                    message=(
+                        "`_emit` does not stamp replica_id onto the "
+                        "event — per-replica health attribution "
+                        "would silently vanish from the stream"
+                    ),
+                )
+            )
+    return findings
+
+
+# not CLI entry points: the package hook and the shared dispatch layer
+_APP_EXEMPT = {"__init__.py", "_dispatch.py"}
+_VALIDATE_CALL_RE = re.compile(r"validate\.check_\w+\(")
+
+
+@register("validate-routing")
+def check_validate_routing(project: Project) -> List[Finding]:
+    """Every app CLI must import utils.validate and call at least one
+    of its check_* functions before dispatch — a new app that skips
+    the input boundary fails lint, not a user's run."""
+    findings: List[Finding] = []
+    for src in project.sources:
+        if not src.rel.startswith(_PKG + "apps/"):
+            continue
+        base = src.rel.rsplit("/", 1)[-1]
+        if base in _APP_EXEMPT or src.tree is None:
+            continue
+        imports_validate = any(
+            (
+                isinstance(node, ast.ImportFrom)
+                and any(
+                    a.name == "validate"
+                    or a.name.endswith(".validate")
+                    for a in node.names
+                )
+            )
+            or (
+                isinstance(node, ast.ImportFrom)
+                and node.module is not None
+                and node.module.endswith("validate")
+            )
+            for node in ast.walk(src.tree)
+        )
+        if not imports_validate:
+            findings.append(
+                Finding(
+                    check="validate-routing",
+                    path=src.rel,
+                    line=1,
+                    message=(
+                        "app CLI does not import utils.validate — "
+                        "every input must cross the hardened "
+                        "boundary before dispatch"
+                    ),
+                )
+            )
+            continue
+        # names imported FROM utils.validate (a bare call to one of
+        # those counts; a local helper that happens to be named
+        # check_* does not — the boundary must be the real module)
+        validate_names = {
+            a.asname or a.name
+            for node in ast.walk(src.tree)
+            if isinstance(node, ast.ImportFrom)
+            and node.module is not None
+            and node.module.endswith("validate")
+            for a in node.names
+        }
+        calls = any(
+            isinstance(node, ast.Call)
+            and (
+                (dotted(node.func) or "").startswith(
+                    "validate.check_"
+                )
+                or (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in validate_names
+                    and node.func.id.startswith("check_")
+                )
+            )
+            for node in ast.walk(src.tree)
+        )
+        if not calls:
+            findings.append(
+                Finding(
+                    check="validate-routing",
+                    path=src.rel,
+                    line=1,
+                    message=(
+                        "app CLI imports utils.validate but never "
+                        "calls a check_* boundary function"
+                    ),
+                )
+            )
+    return findings

@@ -1,0 +1,312 @@
+"""Framework of the static analysis suite: sources, findings,
+suppressions, baseline, check registry.
+
+Checks are plain functions ``check(project) -> List[Finding]``
+registered under their check id; the runner parses every ``*.py``
+under the given roots once (``Project``), applies inline
+``# ccsc: allow[check-id]`` suppressions, and splits the remainder
+against the reviewed ``analysis/baseline.json``. Everything here is
+stdlib-only — the linter must run in under a second per check on CPU
+and never import jax.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "Source",
+    "Project",
+    "register",
+    "all_check_names",
+    "run_checks",
+    "load_baseline",
+    "save_baseline",
+    "split_baseline",
+    "BASELINE_PATH",
+]
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(_PKG_DIR)
+BASELINE_PATH = os.path.join(
+    _PKG_DIR, "analysis", "baseline.json"
+)
+DEFAULT_ROOTS = (_PKG_DIR, os.path.join(REPO_ROOT, "scripts"))
+
+# # ccsc: allow[check-a, check-b] — applies to its own line, or to the
+# next code line when the comment stands alone
+_ALLOW_RE = re.compile(r"#\s*ccsc:\s*allow\[([a-z0-9_,\s-]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit, pinned to a source location."""
+
+    check: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+    severity: str = "error"  # 'error' | 'warning'
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift on every edit, so the
+        reviewed baseline matches on (check, path, message) — messages
+        name symbols, not line numbers."""
+        return (self.check, self.path, self.message)
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.check}] {self.message}"
+        )
+
+
+class Source:
+    """One parsed python file."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(text)
+        except SyntaxError as e:  # surfaced as its own finding
+            self.tree = None
+            self.syntax_error = e
+        else:
+            self.syntax_error = None
+        self.allow: Dict[int, Set[str]] = {}
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, 1):
+            m = _ALLOW_RE.search(line)
+            if not m:
+                continue
+            ids = {
+                s.strip() for s in m.group(1).split(",") if s.strip()
+            }
+            before = line[: m.start()]
+            # comment-only line: the allow covers the next line
+            target = i + 1 if not before.strip() else i
+            self.allow.setdefault(target, set()).update(ids)
+
+    def allows(self, check: str, line: int) -> bool:
+        ids = self.allow.get(line)
+        return bool(ids) and (check in ids or "*" in ids)
+
+
+class Project:
+    """Every source under the analyzed roots, parsed once."""
+
+    def __init__(
+        self,
+        roots: Sequence[str] = DEFAULT_ROOTS,
+        repo_root: str = REPO_ROOT,
+    ):
+        self.repo_root = os.path.abspath(repo_root)
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.sources: List[Source] = []
+        for root in self.roots:
+            if os.path.isfile(root):
+                self._add(root)
+                continue
+            for dirpath, dirnames, files in sorted(os.walk(root)):
+                dirnames[:] = sorted(
+                    d
+                    for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        self._add(os.path.join(dirpath, name))
+
+    def _add(self, path: str) -> None:
+        rel = os.path.relpath(path, self.repo_root)
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        self.sources.append(Source(path, rel, text))
+
+    def in_package(self, src: Source) -> bool:
+        """True for library sources (the ccsc package), False for
+        scripts/ and anything else under the roots."""
+        return src.rel.startswith("ccsc_code_iccv2017_tpu/")
+
+    def module_name(self, src: Source) -> Optional[str]:
+        """Dotted module name for package sources (cross-module call
+        resolution), None outside the package."""
+        if not self.in_package(src):
+            return None
+        mod = src.rel[: -len(".py")].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+
+# ---------------------------------------------------------------------
+# check registry
+# ---------------------------------------------------------------------
+
+_CHECKS: Dict[str, Callable[[Project], List[Finding]]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _CHECKS[name] = fn
+        return fn
+
+    return deco
+
+
+def all_check_names() -> List[str]:
+    _load_builtin_checks()
+    return sorted(_CHECKS)
+
+
+def _load_builtin_checks() -> None:
+    # the check modules self-register on import; imported lazily so
+    # `from analysis import core` never costs more than stdlib
+    from . import conventions, envreg, events, purity, threads  # noqa: F401
+
+
+def run_checks(
+    project: Project, checks: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Run ``checks`` (default: all registered) over ``project``,
+    apply inline suppressions, and return the surviving findings
+    sorted by location."""
+    _load_builtin_checks()
+    names = list(checks) if checks else sorted(_CHECKS)
+    unknown = [n for n in names if n not in _CHECKS]
+    if unknown:
+        raise KeyError(
+            f"unknown check(s) {unknown}; available: {sorted(_CHECKS)}"
+        )
+    findings: List[Finding] = []
+    by_rel = {s.rel: s for s in project.sources}
+    for src in project.sources:
+        if src.syntax_error is not None:
+            findings.append(
+                Finding(
+                    check="parse",
+                    path=src.rel,
+                    line=src.syntax_error.lineno or 1,
+                    message=f"syntax error: {src.syntax_error.msg}",
+                )
+            )
+    for name in names:
+        for f in _CHECKS[name](project):
+            src = by_rel.get(f.path)
+            if src is not None and src.allows(f.check, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.message))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------
+
+
+def load_baseline(path: str = BASELINE_PATH) -> List[Dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return []
+    if isinstance(data, dict):
+        data = data.get("findings", [])
+    return [e for e in data if isinstance(e, dict)]
+
+
+def save_baseline(
+    findings: Sequence[Finding], path: str = BASELINE_PATH
+) -> None:
+    entries = [
+        {
+            "check": f.check,
+            "path": f.path,
+            "line": f.line,  # advisory: matching ignores it
+            "message": f.message,
+        }
+        for f in findings
+    ]
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def split_baseline(
+    findings: Sequence[Finding], baseline: Sequence[Dict]
+) -> Tuple[List[Finding], List[Finding], List[Dict]]:
+    """-> (new, baselined, stale_entries). Matching is by
+    (check, path, message), multiset-style: one baseline entry absorbs
+    exactly one finding, so a second identical regression still
+    surfaces as new."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        k = (
+            str(e.get("check")),
+            str(e.get("path")),
+            str(e.get("message")),
+        )
+        budget[k] = budget.get(k, 0) + 1
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = []
+    for e in baseline:
+        k = (
+            str(e.get("check")),
+            str(e.get("path")),
+            str(e.get("message")),
+        )
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            stale.append(e)
+    return new, matched, stale
+
+
+# ---------------------------------------------------------------------
+# small AST helpers shared by the checks
+# ---------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_functions(tree: ast.AST):
+    """Yield every (name, FunctionDef/AsyncFunctionDef) in the tree,
+    including methods and nested defs."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

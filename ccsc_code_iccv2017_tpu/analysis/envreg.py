@@ -1,0 +1,181 @@
+"""env-registry analyzer: every ``CCSC_*`` env read goes through the
+shared never-crash helper (``utils.env``) and is declared in its
+registry.
+
+This generalizes the tune space's NON_TUNED drift guard to every
+config surface: the environment is a config surface too, and an env
+read that bypasses the helper gets raw ``int()``/``float()`` parsing
+(a typo'd knob crashes a production run) and is invisible to the
+generated ``docs/ENV_KNOBS.md``. Writes (``os.environ[...] = ...``,
+subprocess env dicts) are exempt — only reads are knob reads.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import List, Optional, Set
+
+from .core import Finding, Project, dotted, register
+
+# the helper module itself is the one sanctioned reader
+_HELPER_REL = "ccsc_code_iccv2017_tpu/utils/env.py"
+_HELPER_FNS = {
+    "env_str",
+    "env_int",
+    "env_float",
+    "env_flag",
+    "env_int_list",
+}
+
+_ENV_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "utils",
+    "env.py",
+)
+
+
+def _load_env_module():
+    """``utils/env.py`` loaded BY FILE PATH — the package
+    ``__init__`` imports jax, and the linter must stay import-light.
+    (Registered in sys.modules for the duration of the exec:
+    dataclass introspection looks itself up there.)"""
+    import sys
+
+    name = "_ccsc_env_standalone"
+    spec = importlib.util.spec_from_file_location(name, _ENV_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(name, None)
+    return mod
+
+
+def load_registry() -> dict:
+    return dict(_load_env_module().REGISTRY)
+
+
+def render_env_docs() -> str:
+    return _load_env_module().render_docs()
+
+
+def _ccsc_literal(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value.startswith("CCSC_")
+    ):
+        return node.value
+    return None
+
+
+@register("env-registry")
+def check_env_registry(project: Project) -> List[Finding]:
+    registry = load_registry()
+    findings: List[Finding] = []
+    for src in project.sources:
+        if src.tree is None or src.rel == _HELPER_REL:
+            continue
+        helper_aliases = _helper_aliases(src.tree)
+        os_aliases = _os_aliases(src.tree)
+        raw_reads = {
+            f"{a}.environ.get" for a in os_aliases
+        } | {f"{a}.getenv" for a in os_aliases}
+        environ_names = {f"{a}.environ" for a in os_aliases}
+        for node in ast.walk(src.tree):
+            # raw reads: os.environ.get("CCSC_X"), os.getenv("CCSC_X")
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d in raw_reads and node.args:
+                    name = _ccsc_literal(node.args[0])
+                    if name:
+                        findings.append(
+                            Finding(
+                                check="env-registry",
+                                path=src.rel,
+                                line=node.lineno,
+                                message=(
+                                    f"raw env read of `{name}` — "
+                                    "route it through the never-"
+                                    "crash helper utils.env "
+                                    "(env_str/env_int/env_float/"
+                                    "env_flag)"
+                                ),
+                            )
+                        )
+                        continue
+                # helper calls with an undeclared name
+                fn_name = None
+                if isinstance(node.func, ast.Name):
+                    fn_name = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    if isinstance(
+                        node.func.value, ast.Name
+                    ) and node.func.value.id in helper_aliases:
+                        fn_name = node.func.attr
+                if fn_name in _HELPER_FNS and node.args:
+                    name = _ccsc_literal(node.args[0])
+                    if name and name not in registry:
+                        findings.append(
+                            Finding(
+                                check="env-registry",
+                                path=src.rel,
+                                line=node.lineno,
+                                message=(
+                                    f"env knob `{name}` is read via "
+                                    "utils.env but not declared in "
+                                    "its REGISTRY — declare it "
+                                    "(type, default, help) so "
+                                    "docs/ENV_KNOBS.md stays "
+                                    "complete"
+                                ),
+                            )
+                        )
+            # subscript read: os.environ["CCSC_X"] in Load context
+            elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if dotted(node.value) in environ_names:
+                    name = _ccsc_literal(node.slice)
+                    if name:
+                        findings.append(
+                            Finding(
+                                check="env-registry",
+                                path=src.rel,
+                                line=node.lineno,
+                                message=(
+                                    f"raw env read of `{name}` — "
+                                    "route it through the never-"
+                                    "crash helper utils.env "
+                                    "(env_str/env_int/env_float/"
+                                    "env_flag)"
+                                ),
+                            )
+                        )
+    return findings
+
+
+def _os_aliases(tree: ast.Module) -> Set[str]:
+    """Names the os module is imported under (``import os as _os``
+    must not hide a raw read from the check)."""
+    out: Set[str] = {"os"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "os":
+                    out.add(a.asname or "os")
+    return out
+
+
+def _helper_aliases(tree: ast.Module) -> Set[str]:
+    """Local names under which utils.env is addressed (``env`` from
+    ``from ..utils import env`` / ``from . import env``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "env":
+                    out.add(a.asname or "env")
+    return out

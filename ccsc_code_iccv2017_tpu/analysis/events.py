@@ -1,0 +1,274 @@
+"""obs-schema analyzer: emit sites and consumers vs the declared
+:mod:`~.obs_schema` registry.
+
+Emit sites recognized:
+
+- ``run.event("name", k=v, ...)`` / ``obs.record("name", ...)`` —
+  the Run primitives;
+- ``self._emit("name", ...)`` — the serve/fleet replica-stamping
+  wrappers (kwargs their module's ``_emit`` def itself adds are
+  credited to every call site);
+- ``emit("name", ...)`` — the injectable tune emitter;
+- ``writer.write({"type": "name", ...})`` — raw EventWriter records
+  (the auto-degrade log, the run summary).
+
+Consumers recognized (the dashboard / liveness readers):
+
+- ``x.get("type") == "name"`` / ``x["type"] != "name"`` comparisons;
+- ``by.get("name")`` / ``by["name"]`` on obs_report's by-type index;
+- ``for kind in ("a", "b", ...):`` loops whose body reads
+  ``by.get(kind)``.
+
+Every name must be declared; every literal-kwarg emit site must carry
+the event's required fields. A producer or dashboard can then only
+drift by EDITING THE REGISTRY — a reviewed file — instead of by
+forgetting one of a dozen call sites.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, Source, dotted, register
+from .obs_schema import EVENT_SCHEMA
+
+# wrappers of Run.event whose FIRST argument is the event type
+_EMIT_ATTRS = {"event", "_emit"}
+
+
+def _emit_injected_kwargs(tree: ast.Module) -> Set[str]:
+    """kwargs the module's own ``_emit`` def passes through to
+    ``.event`` (e.g. the serve/fleet replica_id stamp) — credited to
+    every ``_emit`` call site in that module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name == "_emit"
+        ):
+            # explicit keyword-only params of _emit are provided by
+            # its callers; literal kwargs of the inner .event call
+            # are provided by _emit itself
+            for arg in node.args.kwonlyargs:
+                out.add(arg.arg)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ) and sub.func.attr == "event":
+                    for kw in sub.keywords:
+                        if kw.arg:
+                            out.add(kw.arg)
+    return out
+
+
+def _emit_sites(
+    src: Source,
+) -> List[Tuple[int, str, Set[str], bool]]:
+    """(line, event, literal kwargs, has_star_kwargs) per emit site."""
+    sites: List[Tuple[int, str, Set[str], bool]] = []
+    if src.tree is None:
+        return sites
+    injected = _emit_injected_kwargs(src.tree)
+
+    # find the enclosing _emit def lines so the inner .event call is
+    # not double-counted as its own (non-literal) site
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name: Optional[str] = None
+        is_wrapper_call = False
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _EMIT_ATTRS:
+                name = fn.attr
+                is_wrapper_call = fn.attr == "_emit"
+            elif fn.attr == "record" and isinstance(
+                fn.value, ast.Name
+            ) and fn.value.id == "obs":
+                name = "record"
+            elif fn.attr == "write" and node.args:
+                d = node.args[0]
+                if isinstance(d, ast.Dict):
+                    keys = {}
+                    star = False
+                    for k, v in zip(d.keys, d.values):
+                        if k is None:
+                            star = True
+                            continue
+                        if isinstance(k, ast.Constant):
+                            keys[k.value] = v
+                    ev = keys.get("type")
+                    if isinstance(ev, ast.Constant) and isinstance(
+                        ev.value, str
+                    ):
+                        sites.append(
+                            (
+                                node.lineno,
+                                ev.value,
+                                {
+                                    k
+                                    for k in keys
+                                    if isinstance(k, str)
+                                    and k not in ("t", "type", "host")
+                                },
+                                star,
+                            )
+                        )
+                continue
+        elif isinstance(fn, ast.Name) and fn.id in ("emit", "record"):
+            name = fn.id
+        if name is None or not node.args:
+            continue
+        first = node.args[0]
+        if not (
+            isinstance(first, ast.Constant)
+            and isinstance(first.value, str)
+        ):
+            continue
+        kwargs = {kw.arg for kw in node.keywords if kw.arg}
+        star = any(kw.arg is None for kw in node.keywords)
+        if is_wrapper_call:
+            kwargs |= injected
+        sites.append((node.lineno, first.value, kwargs, star))
+    return sites
+
+
+def _consumed_names(src: Source) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    if src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        # x.get("type") == "name"  /  x["type"] != "name"
+        if isinstance(node, ast.Compare) and len(node.ops) == 1:
+            if not isinstance(
+                node.ops[0], (ast.Eq, ast.NotEq)
+            ):
+                continue
+            sides = [node.left, node.comparators[0]]
+            lit = next(
+                (
+                    s.value
+                    for s in sides
+                    if isinstance(s, ast.Constant)
+                    and isinstance(s.value, str)
+                ),
+                None,
+            )
+            other = next(
+                (s for s in sides if not isinstance(s, ast.Constant)),
+                None,
+            )
+            if lit is None or other is None:
+                continue
+            if _mentions_type_key(other):
+                out.append((node.lineno, lit))
+        # by.get("name") / by["name"]
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if (
+                node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "by"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.append((node.lineno, node.args[0].value))
+        elif isinstance(node, ast.Subscript) and isinstance(
+            node.value, ast.Name
+        ) and node.value.id == "by":
+            if isinstance(node.slice, ast.Constant) and isinstance(
+                node.slice.value, str
+            ):
+                out.append((node.lineno, node.slice.value))
+        # for kind in ("a", "b"): ... by.get(kind)
+        elif isinstance(node, ast.For) and isinstance(
+            node.iter, (ast.Tuple, ast.List)
+        ) and isinstance(node.target, ast.Name):
+            lits = [
+                el.value
+                for el in node.iter.elts
+                if isinstance(el, ast.Constant)
+                and isinstance(el.value, str)
+            ]
+            if not lits or len(lits) != len(node.iter.elts):
+                continue
+            uses_by = any(
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "get"
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == "by"
+                and sub.args
+                and isinstance(sub.args[0], ast.Name)
+                and sub.args[0].id == node.target.id
+                for b in node.body
+                for sub in ast.walk(b)
+            )
+            if uses_by:
+                out.extend((node.lineno, lit) for lit in lits)
+    return out
+
+
+def _mentions_type_key(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and sub.value == "type":
+            return True
+    return False
+
+
+@register("obs-schema")
+def check_obs_schema(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.sources:
+        if src.tree is None:
+            continue
+        for line, event, kwargs, star in _emit_sites(src):
+            if event not in EVENT_SCHEMA:
+                findings.append(
+                    Finding(
+                        check="obs-schema",
+                        path=src.rel,
+                        line=line,
+                        message=(
+                            f"emit of undeclared obs event "
+                            f"`{event}` — declare it (and its "
+                            "required fields) in "
+                            "analysis/obs_schema.py"
+                        ),
+                    )
+                )
+                continue
+            if star:
+                continue  # pass-through fields are not statically
+                # checkable; the name check above still applies
+            missing = sorted(EVENT_SCHEMA[event] - kwargs)
+            if missing:
+                findings.append(
+                    Finding(
+                        check="obs-schema",
+                        path=src.rel,
+                        line=line,
+                        message=(
+                            f"obs event `{event}` emitted without "
+                            f"required field(s) {missing} (declared "
+                            "in analysis/obs_schema.py)"
+                        ),
+                    )
+                )
+        for line, name in _consumed_names(src):
+            if name not in EVENT_SCHEMA:
+                findings.append(
+                    Finding(
+                        check="obs-schema",
+                        path=src.rel,
+                        line=line,
+                        message=(
+                            f"consumer reads undeclared obs event "
+                            f"`{name}` — no emitter is contracted "
+                            "to produce it (analysis/obs_schema.py)"
+                        ),
+                    )
+                )
+    return findings

@@ -1,0 +1,82 @@
+"""The declared obs event schema: every event type the framework may
+emit, with the fields consumers rely on.
+
+``utils.obs`` writes whatever fields an emit site passes; the
+dashboard (``scripts/obs_report.py``), the watchdog's replica/peer
+liveness (``utils.watchdog``), the supervisor's preemption judgment
+(``scripts/supervise.py``), and the serve bench all read those fields
+back by name. Nothing used to tie the two ends together — a renamed
+field or a typo'd event type silently emptied a dashboard section.
+This registry is the contract; the ``obs-schema`` check validates
+every emit site (literal event name + required fields present) and
+every consumer-side event-name literal against it.
+
+Stdlib-only on purpose: the linter imports this module directly.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet
+
+__all__ = ["EVENT_SCHEMA", "required_fields"]
+
+
+def _s(*names: str) -> FrozenSet[str]:
+    return frozenset(names)
+
+
+# event type -> fields REQUIRED at every emit site (consumers may read
+# more — optional fields are free — but these must always be present)
+EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
+    # -- core run telemetry (utils.obs) ------------------------------
+    "run_meta": _s("algorithm"),
+    "step": _s("it"),
+    "roofline": _s("start_it", "length", "n_adopted", "dt_s",
+                   "it_per_sec"),
+    "heartbeat": _s("step", "fence_latency_s"),
+    "phase": _s("phase", "sections"),
+    "log": _s("tier", "msg"),
+    "compile": _s("kind", "duration_s"),
+    "summary": _s("status"),
+    # -- resilience / supervision ------------------------------------
+    "checkpoint_save": _s("path", "iteration"),
+    "checkpoint_load": _s("path", "iteration"),
+    "recovery": _s(),
+    "preemption": _s("iteration", "signum"),
+    "stall": _s("label", "action"),
+    "peer_stale": _s("host"),
+    "fault_fired": _s("fault"),
+    "degrade": _s("rung", "stage"),
+    # -- serving engine (serve.engine; replica_id stamped by _emit) --
+    "serve_warmup": _s("replica_id", "bucket", "warmup_s", "knobs"),
+    "serve_ready": _s("replica_id", "n_buckets", "warmup_s"),
+    "serve_request": _s("replica_id", "bucket", "latency_ms",
+                        "iters"),
+    "serve_dispatch": _s("replica_id", "bucket", "n", "slots",
+                         "occupancy", "queue_depth", "dt_s"),
+    "serve_error": _s("replica_id", "error"),
+    "serve_drain": _s("replica_id", "n"),
+    # -- serving fleet (serve.fleet) ---------------------------------
+    "fleet_start": _s("replica_id", "replicas", "queue_ceiling"),
+    "fleet_heartbeat": _s("replica_id", "state", "served",
+                          "restarts"),
+    "fleet_request": _s("replica_id", "key", "latency_ms"),
+    "fleet_requeue": _s("replica_id", "reason", "n"),
+    "fleet_duplicate_suppressed": _s("replica_id", "key"),
+    "fleet_replica_dead": _s("replica_id", "reason"),
+    "fleet_replica_restart": _s("replica_id", "attempt"),
+    "fleet_replica_ready": _s("replica_id", "generation"),
+    "fleet_replica_abandoned": _s("replica_id", "restarts"),
+    "fleet_admission_reject": _s("replica_id", "queue_depth",
+                                 "ceiling", "rung", "retry_after_s"),
+    "fleet_ceiling": _s("replica_id", "ceiling", "source"),
+    "fleet_overload": _s("replica_id", "rung_from", "rung_to",
+                         "queue_depth"),
+    # -- autotuning (tune.autotune) ----------------------------------
+    "tune_pick": _s("kind", "chip", "shape_key"),
+    "tune_guard": _s("kind", "chip"),
+    "tune_arm": _s("kind", "chip", "shape_key"),
+}
+
+
+def required_fields(event: str) -> FrozenSet[str]:
+    return EVENT_SCHEMA.get(event, frozenset())

@@ -1,0 +1,710 @@
+"""jit-purity and donation-safety analyzers.
+
+Both checks work from the same place: the set of functions that can
+execute INSIDE a jax trace. A host-sync or env read there is a silent
+recompile / wrong-constant hazard (the value is baked at trace time,
+or the trace blocks on device sync every call); a donated buffer read
+AFTER its jitted call is undefined behavior that XLA only sometimes
+punishes (CPU ignores donation, TPU aborts) — exactly the class of
+bug a reviewer has to hold the whole program in their head to catch.
+
+jit roots are found structurally — ``@jax.jit`` (bare or via
+``functools.partial``), ``jax.jit(f)`` / ``lax.scan(f, ...)`` /
+``shard_map(f, ...)`` / ``jax.vmap(f)`` call forms — and seeded with
+the named entry points of this repo (``ccsc_outer_step`` and friends,
+``_plan_arrays``, the serve bucket program). Reachability then
+follows plain calls: same-module functions by name, cross-module
+through ``from ..x import y`` / module-alias attribute calls within
+the package.
+
+Intentional trace-time host reads (the CCSC_HERM_INV family is read
+at trace time by design — a plan constant, not a jit-visible value)
+carry an inline ``# ccsc: allow[jit-purity]``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Project, Source, dotted, register
+
+# functions the repo names as jitted entry points even where the
+# structural patterns cannot see it (e.g. ``step.__name__ =`` renames)
+SEED_NAMES = {
+    "ccsc_outer_step",
+    "ccsc_outer_step_sharded",
+    "_plan_arrays",
+    "_reconstruct_impl",
+    "_bucket_program",
+}
+
+# callables whose function argument runs under trace
+_TRACING_WRAPPERS = {
+    "jax.jit",
+    "jit",
+    "jax.lax.scan",
+    "lax.scan",
+    "jax.lax.while_loop",
+    "lax.while_loop",
+    "jax.lax.cond",
+    "lax.cond",
+    "jax.lax.fori_loop",
+    "lax.fori_loop",
+    "jax.vmap",
+    "vmap",
+    "jax.pmap",
+    "pmap",
+    "shard_map",
+    "jax.shard_map",
+    "mesh.shard_map",
+    "jax.checkpoint",
+    "jax.remat",
+}
+
+# host-sync / recompile hazards inside a trace. Each entry:
+# (predicate description, message)
+_HAZARD_CALLS = {
+    "time.time": "host clock read",
+    "time.perf_counter": "host clock read",
+    "time.monotonic": "host clock read",
+    "time.sleep": "host sleep",
+    "datetime.now": "host clock read",
+    "datetime.datetime.now": "host clock read",
+    "os.environ.get": "env read (value baked at trace time)",
+    "os.getenv": "env read (value baked at trace time)",
+    "jax.device_get": "host transfer",
+    "np.asarray": "numpy materialization of a traced value",
+    "np.array": "numpy materialization of a traced value",
+    "print": "host print (fires once per trace, not per step)",
+}
+
+_HAZARD_METHODS = {
+    "item": "host sync (.item() blocks on the device)",
+    "block_until_ready": "host sync",
+    "tolist": "host sync (.tolist() materializes on host)",
+}
+
+# jnp predicates that inspect DTYPE/STRUCTURE only — static at trace
+# time, fine to branch on
+_STATIC_PREDICATES = {
+    "iscomplexobj",
+    "isrealobj",
+    "issubdtype",
+    "isscalar",
+    "result_type",
+    "dtype",
+    "ndim",
+    "shape",
+}
+
+# the shared env helper (utils.env): still a trace-time read when it
+# happens under jit — flagged like a raw os.environ read, suppressed
+# inline where baking the knob into the trace is the intent. Matched
+# by function name so import aliasing cannot hide a read.
+_ENV_HELPER_FNS = {
+    "env_str",
+    "env_int",
+    "env_float",
+    "env_flag",
+    "env_int_list",
+}
+
+
+def _func_name(fn: ast.AST) -> str:
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn.name
+    return "<lambda>"
+
+
+class _ModuleIndex:
+    """Per-module function defs, import aliases, and the call graph."""
+
+    def __init__(self, src: Source, modname: Optional[str]):
+        self.src = src
+        self.modname = modname
+        # simple name -> def node (module-level, methods, nested defs
+        # all flattened; shadowing is rare enough in this tree)
+        self.defs: Dict[str, ast.AST] = {}
+        # local alias -> (module, symbol|None): `from ..ops import x`
+        # gives ('ccsc....ops.x', None); `from .m import f` gives
+        # ('ccsc....m', 'f')
+        self.aliases: Dict[str, Tuple[str, Optional[str]]] = {}
+        # function name -> called (alias, attr|None) pairs
+        self.calls: Dict[str, Set[Tuple[str, Optional[str]]]] = {}
+        self.roots: Set[str] = set()
+        if src.tree is None:
+            return
+        for node in ast.walk(src.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self.defs.setdefault(node.name, node)
+        if modname:
+            self._collect_imports(src.tree, modname)
+        self._collect_calls()
+        self._collect_roots()
+
+    # -- imports -------------------------------------------------------
+    def _collect_imports(self, tree: ast.Module, modname: str) -> None:
+        pkg_parts = modname.split(".")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.level:
+                base = pkg_parts[: len(pkg_parts) - node.level]
+                mod = ".".join(base + (
+                    node.module.split(".") if node.module else []
+                ))
+                for a in node.names:
+                    name = a.asname or a.name
+                    self.aliases[name] = (mod, a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.startswith("ccsc_code_iccv2017_tpu"):
+                    for a in node.names:
+                        name = a.asname or a.name
+                        self.aliases[name] = (node.module, a.name)
+
+    # -- calls ---------------------------------------------------------
+    def _enclosing_functions(self):
+        """(func_node, [called names]) with nesting honored: a call in
+        a nested def belongs to the nested def."""
+        out: Dict[str, Set[Tuple[str, Optional[str]]]] = {}
+
+        class V(ast.NodeVisitor):
+            def __init__(v):
+                v.stack: List[str] = []
+
+            def visit_FunctionDef(v, node):
+                v.stack.append(node.name)
+                out.setdefault(node.name, set())
+                v.generic_visit(node)
+                v.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(v, node):
+                if v.stack:
+                    fn = node.func
+                    if isinstance(fn, ast.Name):
+                        out[v.stack[-1]].add((fn.id, None))
+                    elif isinstance(fn, ast.Attribute) and isinstance(
+                        fn.value, ast.Name
+                    ):
+                        out[v.stack[-1]].add((fn.value.id, fn.attr))
+                v.generic_visit(node)
+
+        V().visit(self.src.tree)
+        self.calls = out
+
+    def _collect_calls(self) -> None:
+        self._enclosing_functions()
+
+    # -- jit roots -----------------------------------------------------
+    def _collect_roots(self) -> None:
+        for name, node in self.defs.items():
+            if name in SEED_NAMES:
+                self.roots.add(name)
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                for dec in node.decorator_list:
+                    d = dotted(dec)
+                    if d in _TRACING_WRAPPERS:
+                        self.roots.add(name)
+                    elif isinstance(dec, ast.Call):
+                        dc = dotted(dec.func)
+                        if dc in _TRACING_WRAPPERS:
+                            self.roots.add(name)
+                        elif dc in (
+                            "functools.partial",
+                            "partial",
+                        ) and dec.args:
+                            inner = dotted(dec.args[0])
+                            if inner in _TRACING_WRAPPERS:
+                                self.roots.add(name)
+        # call forms: jax.jit(f), lax.scan(f, ...), shard_map(f, ...)
+        for node in ast.walk(self.src.tree or ast.Module(body=[])):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted(node.func)
+            if callee in _TRACING_WRAPPERS and node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name):
+                    self.roots.add(target.id)
+            elif callee in ("functools.partial", "partial") and node.args:
+                if dotted(node.args[0]) in _TRACING_WRAPPERS and len(
+                    node.args
+                ) > 1 and isinstance(node.args[1], ast.Name):
+                    self.roots.add(node.args[1].id)
+
+
+def _build_indexes(project: Project) -> Dict[str, _ModuleIndex]:
+    out: Dict[str, _ModuleIndex] = {}
+    for src in project.sources:
+        modname = project.module_name(src)
+        out[src.rel] = _ModuleIndex(src, modname)
+    return out
+
+
+def _reachable(
+    indexes: Dict[str, _ModuleIndex],
+) -> Dict[str, Set[str]]:
+    """rel-path -> set of function names that can run under trace."""
+    by_mod: Dict[str, _ModuleIndex] = {
+        ix.modname: ix for ix in indexes.values() if ix.modname
+    }
+    reach: Dict[str, Set[str]] = {rel: set() for rel in indexes}
+    work: List[Tuple[str, str]] = []
+    for rel, ix in indexes.items():
+        for r in ix.roots:
+            if r in ix.defs and r not in reach[rel]:
+                reach[rel].add(r)
+                work.append((rel, r))
+    while work:
+        rel, fname = work.pop()
+        ix = indexes[rel]
+        for alias, attr in ix.calls.get(fname, ()):  # callees
+            # same-module call by simple name
+            if attr is None and alias in ix.defs:
+                if alias not in reach[rel]:
+                    reach[rel].add(alias)
+                    work.append((rel, alias))
+                continue
+            # imported symbol: from .m import f; f(...)
+            tgt: Optional[Tuple[_ModuleIndex, str]] = None
+            if attr is None and alias in ix.aliases:
+                mod, sym = ix.aliases[alias]
+                tix = by_mod.get(mod)
+                if tix is not None and sym and sym in tix.defs:
+                    tgt = (tix, sym)
+                elif sym:
+                    # from ..pkg import module; later module.f below
+                    tix = by_mod.get(f"{mod}.{sym}")
+                    _ = tix  # no symbol to enter without an attr
+            elif attr is not None and alias in ix.aliases:
+                # module alias attribute call: mod_alias.f(...)
+                mod, sym = ix.aliases[alias]
+                tix = by_mod.get(f"{mod}.{sym}" if sym else mod)
+                if tix is None:
+                    tix = by_mod.get(mod)
+                if tix is not None and attr in tix.defs:
+                    tgt = (tix, attr)
+            if tgt is not None:
+                tix, sym = tgt
+                trel = tix.src.rel
+                if sym not in reach[trel]:
+                    reach[trel].add(sym)
+                    work.append((trel, sym))
+    return reach
+
+
+def _hazards_in(
+    src: Source, fn: ast.AST, fname: str
+) -> List[Finding]:
+    out: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        out.append(
+            Finding(
+                check="jit-purity",
+                path=src.rel,
+                line=getattr(node, "lineno", 1),
+                message=(
+                    f"{what} inside jit-reachable `{fname}`"
+                ),
+            )
+        )
+
+    # walk without descending into nested defs (they are visited as
+    # their own reachable functions, or are not reachable at all)
+    def walk(node: ast.AST, top: bool = False) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and not top:
+                continue
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                walk(child)
+                continue
+            _visit(child)
+            walk(child)
+
+    def _visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            callee = dotted(node.func)
+            if callee in _HAZARD_CALLS:
+                flag(node, _HAZARD_CALLS[callee])
+            elif (callee or "").rsplit(".", 1)[-1] in _ENV_HELPER_FNS:
+                flag(
+                    node,
+                    "env read (value baked at trace time)",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HAZARD_METHODS
+                and not node.args
+            ):
+                flag(node, _HAZARD_METHODS[node.func.attr])
+        elif isinstance(node, ast.Subscript):
+            base = dotted(node.value)
+            if base == "os.environ" and isinstance(
+                node.ctx, ast.Load
+            ):
+                flag(
+                    node,
+                    "env read (value baked at trace time)",
+                )
+        elif isinstance(node, (ast.If, ast.While)):
+            # python branching on a traced value: a jnp.* call in the
+            # condition produces a tracer, and `if tracer:` either
+            # raises or silently bakes one branch at trace time
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Call):
+                    callee = dotted(sub.func) or ""
+                    tail = callee.rsplit(".", 1)[-1]
+                    if tail in _STATIC_PREDICATES:
+                        continue
+                    if callee.startswith("jnp.") or callee.startswith(
+                        "jax.numpy."
+                    ):
+                        flag(
+                            node,
+                            "python branch on a traced value "
+                            f"(`{callee}` in the condition)",
+                        )
+                        break
+
+    walk(fn, top=True)
+    return out
+
+
+@register("jit-purity")
+def check_jit_purity(project: Project) -> List[Finding]:
+    indexes = _build_indexes(project)
+    reach = _reachable(indexes)
+    findings: List[Finding] = []
+    for rel, names in reach.items():
+        ix = indexes[rel]
+        for fname in sorted(names):
+            node = ix.defs.get(fname)
+            if node is None:
+                continue
+            findings.extend(_hazards_in(ix.src, node, fname))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# donation-safety
+# ---------------------------------------------------------------------
+
+
+def _donating_factories(
+    indexes: Dict[str, _ModuleIndex],
+) -> Dict[str, Tuple[int, ...]]:
+    """Function names (package-wide) whose body builds a jitted
+    callable with non-empty ``donate_argnums`` — calling such a
+    factory yields a donating callable. Returns name -> donated
+    positional indices (union over the literals assigned in the
+    factory; (0,) when indeterminate)."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for ix in indexes.values():
+        if ix.src.tree is None:
+            continue
+        for fname, node in ix.defs.items():
+            donated: Set[int] = set()
+            saw_dynamic = False
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if dotted(sub.func) not in ("jax.jit", "jit"):
+                    continue
+                for kw in sub.keywords:
+                    if kw.arg != "donate_argnums":
+                        continue
+                    if isinstance(kw.value, ast.Tuple):
+                        for el in kw.value.elts:
+                            if isinstance(
+                                el, ast.Constant
+                            ) and isinstance(el.value, int):
+                                donated.add(el.value)
+                    elif isinstance(kw.value, ast.Name):
+                        # e.g. donate_argnums = (0,) if donate else ()
+                        saw_dynamic = True
+                        for a in ast.walk(node):
+                            if (
+                                isinstance(a, ast.Assign)
+                                and any(
+                                    isinstance(t, ast.Name)
+                                    and t.id == kw.value.id
+                                    for t in a.targets
+                                )
+                            ):
+                                for el in ast.walk(a.value):
+                                    if isinstance(
+                                        el, ast.Constant
+                                    ) and isinstance(el.value, int):
+                                        donated.add(el.value)
+            if donated:
+                out[fname] = tuple(sorted(donated))
+            elif saw_dynamic:
+                out[fname] = (0,)
+    return out
+
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.With):
+        targets = [
+            i.optional_vars for i in stmt.items if i.optional_vars
+        ]
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+    return out
+
+
+@register("donation-safety")
+def check_donation_safety(project: Project) -> List[Finding]:
+    indexes = _build_indexes(project)
+    factories = _donating_factories(indexes)
+    findings: List[Finding] = []
+    for ix in indexes.values():
+        if ix.src.tree is None:
+            continue
+        findings.extend(_check_module_donation(ix, factories))
+    return findings
+
+
+def _check_module_donation(
+    ix: _ModuleIndex, factories: Dict[str, Tuple[int, ...]]
+) -> List[Finding]:
+    """Walk every function as its own SCOPE (nested defs are separate
+    scopes — their parameters shadow the enclosing names, and their
+    bodies run at call time, not in the enclosing lexical order);
+    donating-callable bindings flow downward into nested scopes (a
+    closure may call the enclosing scope's jitted step)."""
+    findings: List[Finding] = []
+    tree = ix.src.tree
+    # top-level function defs only; nested ones are visited by the
+    # recursion below with their parent's bindings in scope
+    top: List[ast.AST] = [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and not _is_nested(n, tree)
+    ]
+    for node in top:
+        # a factory that only RETURNS its jitted callable never calls
+        # it, so scanning it is naturally silent; a driver that builds
+        # the callable inline and calls it is scanned like any other
+        _scan_scope(ix, factories, node, {}, findings)
+    return findings
+
+
+def _is_nested(fn: ast.AST, tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and node is not fn:
+            for sub in ast.walk(node):
+                if sub is fn:
+                    return True
+    return False
+
+
+def _own_statements(fn: ast.AST) -> List[ast.stmt]:
+    """The function's statements in lexical order, EXCLUDING nested
+    function bodies (separate scopes)."""
+    out: List[ast.stmt] = []
+
+    def collect(body: Sequence[ast.stmt]) -> None:
+        for s in body:
+            if isinstance(
+                s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            out.append(s)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(s, field, None)
+                if sub:
+                    collect(sub)
+            for h in getattr(s, "handlers", []) or []:
+                collect(h.body)
+
+    collect(fn.body)
+    out.sort(key=lambda s: s.lineno)
+    return out
+
+
+def _stmt_calls(stmt: ast.stmt) -> List[ast.Call]:
+    """Calls belonging to THIS statement (child statements are their
+    own entries in the lexical stream)."""
+    out: List[ast.Call] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt,)):
+                continue
+            if isinstance(child, ast.Call):
+                out.append(child)
+            walk(child)
+
+    walk(stmt)
+    return out
+
+
+def _donating_bindings(
+    ix: _ModuleIndex,
+    factories: Dict[str, Tuple[int, ...]],
+    fn: ast.AST,
+) -> Dict[str, Tuple[int, ...]]:
+    donating: Dict[str, Tuple[int, ...]] = {}
+    for stmt in _own_statements(fn):
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not isinstance(stmt.value, ast.Call):
+            continue
+        callee = stmt.value.func
+        cname = None
+        if isinstance(callee, ast.Name):
+            cname = callee.id
+            if cname in ix.aliases:
+                _, sym = ix.aliases[cname]
+                cname = sym or cname
+        elif isinstance(callee, ast.Attribute):
+            cname = callee.attr
+        if cname in factories:
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    donating[t.id] = factories[cname]
+            continue
+        # direct form: v = jax.jit(f, donate_argnums=(..))
+        if dotted(stmt.value.func) in ("jax.jit", "jit"):
+            idxs: Set[int] = set()
+            for kw in stmt.value.keywords:
+                if kw.arg == "donate_argnums" and isinstance(
+                    kw.value, ast.Tuple
+                ):
+                    for el in kw.value.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, int
+                        ):
+                            idxs.add(el.value)
+            if idxs:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        donating[t.id] = tuple(sorted(idxs))
+    return donating
+
+
+def _scan_scope(
+    ix: _ModuleIndex,
+    factories: Dict[str, Tuple[int, ...]],
+    fn: ast.AST,
+    inherited: Dict[str, Tuple[int, ...]],
+    findings: List[Finding],
+) -> None:
+    donating = dict(inherited)
+    donating.update(_donating_bindings(ix, factories, fn))
+    # parameters shadow inherited bindings
+    params = {
+        a.arg
+        for a in (
+            fn.args.args + fn.args.kwonlyargs + fn.args.posonlyargs
+        )
+    }
+    for p in params:
+        donating.pop(p, None)
+    stmts = _own_statements(fn)
+    if donating:
+        for si, stmt in enumerate(stmts):
+            for call in _stmt_calls(stmt):
+                if not isinstance(call.func, ast.Name):
+                    continue
+                idxs = donating.get(call.func.id)
+                if not idxs:
+                    continue
+                donated_names = {
+                    a.id
+                    for i, a in enumerate(call.args)
+                    if i in idxs and isinstance(a, ast.Name)
+                }
+                if not donated_names:
+                    continue
+                # the assignment consuming the call may rebind the
+                # donated name itself (state, tr = step(state, ...))
+                # — immediately safe
+                live = donated_names - _assigned_names(stmt)
+                for later in stmts[si + 1 :]:
+                    if not live:
+                        break
+                    # reads first: `x = f(x)` on a later line reads
+                    # the dead buffer before rebinding it
+                    for sub in _stmt_loads(later):
+                        if sub.id in live:
+                            findings.append(
+                                Finding(
+                                    check="donation-safety",
+                                    path=ix.src.rel,
+                                    line=sub.lineno,
+                                    message=(
+                                        f"`{sub.id}` was donated "
+                                        f"to `{call.func.id}` and "
+                                        "is read after the call "
+                                        f"in `{fn.name}` — the "
+                                        "buffer is dead (XLA "
+                                        "aliased it in place)"
+                                    ),
+                                )
+                            )
+                            live.discard(sub.id)
+                    live -= _assigned_names(later)
+    # recurse into nested scopes with the bindings visible there
+    for stmt in fn.body:
+        for node in ast.walk(stmt):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and _direct_parent_scope(fn, node):
+                _scan_scope(ix, factories, node, donating, findings)
+
+
+def _direct_parent_scope(fn: ast.AST, nested: ast.AST) -> bool:
+    """True when ``nested`` is defined directly inside ``fn`` (not
+    inside a deeper nested def — those recurse from their parent)."""
+    for node in ast.walk(fn):
+        if node is nested:
+            continue
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and node is not fn:
+            for sub in ast.walk(node):
+                if sub is nested:
+                    return False
+    return True
+
+
+def _stmt_loads(stmt: ast.stmt):
+    """Name loads belonging to THIS statement (child statements are
+    their own lexical entries)."""
+    out = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                continue
+            if isinstance(child, ast.Name) and isinstance(
+                child.ctx, ast.Load
+            ):
+                out.append(child)
+            walk(child)
+
+    walk(stmt)
+    return out

@@ -1,0 +1,426 @@
+"""thread-safety analyzer: lock ordering, blocking work under locks,
+untracked threads.
+
+Scope: every module that imports ``threading`` (the serving fleet,
+the engine, the watchdog, the supervisor — and whatever grows next).
+Three rules, all born from real review findings on this tree:
+
+1. **lock-order** — the lock-acquisition graph (``with a: ... with
+   b:`` nestings, per module) must be a consistent partial order;
+   the pair (a→b, b→a) appearing in both directions is a deadlock
+   waiting for the right interleaving.
+2. **blocking-under-lock** — no obs emission (``_emit`` / ``.event``
+   / ``obs.record`` / ``.console``), ``print``, ``time.sleep``,
+   subprocess call, or thread ``join`` inside a held lock: the event
+   write can block on the stream file, and every submitter then
+   serializes behind file I/O (the exact bug PR 7's review caught in
+   ``submit``). ``Condition.wait`` is exempt — it releases the lock.
+3. **untracked-thread** — every ``threading.Thread(...)`` must have a
+   join path: bound to a name/attribute that is ``.join``\\ ed
+   somewhere in the module, or appended to a container the module
+   joins in a loop. A fire-and-forget daemon thread mid-XLA-call
+   aborts the interpreter at exit ("terminate called without an
+   active exception" — the PR 7 leaked-restart-thread bug class).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, Source, dotted, register
+
+# callables that create a lock-like object
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "Lock",
+    "RLock",
+    "Condition",
+}
+
+# dotted-call tails that must not run under a held lock
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep",
+    "subprocess.run": "a subprocess",
+    "subprocess.Popen": "a subprocess",
+    "subprocess.check_output": "a subprocess",
+    "os.makedirs": "filesystem work",
+}
+
+_EMIT_ATTRS = {"_emit", "event", "console", "record"}
+
+
+def _imports_threading(src: Source) -> bool:
+    if src.tree is None:
+        return False
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "threading" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "threading":
+                return True
+    return False
+
+
+def _lock_names(src: Source) -> Set[str]:
+    """Attribute/variable tails assigned from a lock factory."""
+    out: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        if dotted(node.value.func) not in _LOCK_FACTORIES:
+            continue
+        for t in node.targets:
+            tail = None
+            if isinstance(t, ast.Name):
+                tail = t.id
+            elif isinstance(t, ast.Attribute):
+                tail = t.attr
+            if tail:
+                out.add(tail)
+    return out
+
+
+def _lock_tail(expr: ast.AST, locks: Set[str]) -> Optional[str]:
+    """The lock's simple name when ``with <expr>:`` takes a known
+    lock (``self._cv``, a bare ``cv`` alias of one, ...)."""
+    tail = None
+    if isinstance(expr, ast.Attribute):
+        tail = expr.attr
+    elif isinstance(expr, ast.Name):
+        tail = expr.id
+    if tail is None:
+        return None
+    if tail in locks:
+        return tail
+    # local alias of a lock attribute: cv = getattr(self, "_cv", ...)
+    stripped = tail.lstrip("_")
+    for lk in locks:
+        if lk.lstrip("_") == stripped:
+            return lk
+    return None
+
+
+def _thread_targets(src: Source) -> List[Tuple[int, Optional[str], Optional[str]]]:
+    """(line, bound name tail, container tail) per Thread creation.
+    Both None = created-and-started inline, never bound."""
+    out: List[Tuple[int, Optional[str], Optional[str]]] = []
+    creations: Dict[int, ast.Call] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and dotted(node.func) in (
+            "threading.Thread",
+            "Thread",
+        ):
+            creations[id(node)] = node
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node):
+            # direct assignment or a comprehension building a list of
+            # threads — either way the assign target is the handle
+            found = [
+                sub
+                for sub in ast.walk(node.value)
+                if id(sub) in creations
+            ]
+            for sub in found:
+                for t in node.targets:
+                    tail = (
+                        t.id
+                        if isinstance(t, ast.Name)
+                        else t.attr
+                        if isinstance(t, ast.Attribute)
+                        else None
+                    )
+                    out.append((node.lineno, tail, None))
+                creations.pop(id(sub))
+            self.generic_visit(node)
+
+        def visit_Call(self, node):
+            # container.append(threading.Thread(...)) or
+            # container.append(t) handled via the Assign path
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"
+                and node.args
+                and id(node.args[0]) in creations
+            ):
+                cont = (
+                    node.func.value.attr
+                    if isinstance(node.func.value, ast.Attribute)
+                    else node.func.value.id
+                    if isinstance(node.func.value, ast.Name)
+                    else None
+                )
+                out.append((node.lineno, None, cont))
+                creations.pop(id(node.args[0]))
+            self.generic_visit(node)
+
+    V().visit(src.tree)
+    # whatever remains was neither assigned nor appended
+    for call in creations.values():
+        out.append((call.lineno, None, None))
+    return out
+
+
+def _joined_tails(src: Source) -> Set[str]:
+    """Receiver tails of every ``X.join(...)`` call, plus containers
+    iterated by a loop whose target gets joined."""
+    joined: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr == "join":
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute):
+                joined.add(recv.attr)
+            elif isinstance(recv, ast.Name):
+                joined.add(recv.id)
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.For):
+            continue
+        if not isinstance(node.target, ast.Name):
+            continue
+        tgt = node.target.id
+        body_joins = any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "join"
+            and isinstance(sub.func.value, ast.Name)
+            and sub.func.value.id == tgt
+            for b in node.body
+            for sub in ast.walk(b)
+        )
+        if not body_joins:
+            continue
+        it = node.iter
+        # for t in container: / for t in list(container): /
+        # for t in sorted(container):
+        cands = [it]
+        if isinstance(it, ast.Call):
+            cands.extend(it.args)
+        for c in cands:
+            if isinstance(c, ast.Attribute):
+                joined.add(c.attr)
+            elif isinstance(c, ast.Name):
+                joined.add(c.id)
+    return joined
+
+
+def _with_lock_regions(
+    fn: ast.AST, locks: Set[str]
+) -> List[Tuple[str, ast.With]]:
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            tail = _lock_tail(item.context_expr, locks)
+            if tail:
+                out.append((tail, node))
+    return out
+
+
+@register("thread-safety")
+def check_thread_safety(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.sources:
+        if src.tree is None or not _imports_threading(src):
+            continue
+        locks = _lock_names(src)
+        findings.extend(_check_lock_order(src, locks))
+        findings.extend(_check_blocking(src, locks))
+        findings.extend(_check_threads(src))
+    return findings
+
+
+def _check_lock_order(src: Source, locks: Set[str]) -> List[Finding]:
+    pairs: Dict[Tuple[str, str], int] = {}
+
+    def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            now = held
+            if isinstance(child, ast.With):
+                for item in child.items:
+                    tail = _lock_tail(item.context_expr, locks)
+                    if tail:
+                        for outer in now:
+                            if outer != tail:
+                                pairs.setdefault(
+                                    (outer, tail), child.lineno
+                                )
+                        now = now + (tail,)
+            elif isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # a nested def's body runs later, not under the lock
+                walk(child, ())
+                continue
+            walk(child, now)
+
+    walk(src.tree, ())
+    out: List[Finding] = []
+    seen: Set[Tuple[str, str]] = set()
+    for (a, b), line in sorted(pairs.items(), key=lambda kv: kv[1]):
+        if (b, a) in pairs and (b, a) not in seen:
+            seen.add((a, b))
+            out.append(
+                Finding(
+                    check="thread-safety",
+                    path=src.rel,
+                    line=line,
+                    message=(
+                        f"inconsistent lock order: `{a}` -> `{b}` "
+                        f"here but `{b}` -> `{a}` elsewhere in this "
+                        "module — deadlock risk"
+                    ),
+                )
+            )
+    return out
+
+
+def _check_blocking(src: Source, locks: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+
+    def scan_body(tail: str, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue  # runs later, not under the lock
+            _flag(tail, child)
+            scan_body(tail, child)
+
+    def _flag(tail: str, node: ast.AST) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        d = dotted(node.func)
+        if d in _BLOCKING_CALLS:
+            out.append(
+                Finding(
+                    check="thread-safety",
+                    path=src.rel,
+                    line=node.lineno,
+                    message=(
+                        f"{_BLOCKING_CALLS[d]} under lock "
+                        f"`{tail}` — blocking work must not hold "
+                        "the mutex"
+                    ),
+                )
+            )
+            return
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _EMIT_ATTRS:
+                recv = node.func.value
+                recv_name = (
+                    recv.attr
+                    if isinstance(recv, ast.Attribute)
+                    else recv.id
+                    if isinstance(recv, ast.Name)
+                    else ""
+                )
+                # obs emission points: self._emit, run.event,
+                # obs.record/console, run.console — all end in a
+                # stream write that can block on file I/O
+                if attr == "_emit" or recv_name in (
+                    "obs",
+                    "run",
+                    "_run",
+                    "self",
+                ) or recv_name.endswith("run"):
+                    if attr == "record" and recv_name == "self":
+                        return  # e.g. a local bookkeeping method
+                    out.append(
+                        Finding(
+                            check="thread-safety",
+                            path=src.rel,
+                            line=node.lineno,
+                            message=(
+                                f"obs emission `{recv_name}."
+                                f"{attr}(...)` under lock "
+                                f"`{tail}` — the stream write can "
+                                "block every thread contending "
+                                "for the mutex"
+                            ),
+                        )
+                    )
+            elif attr == "join" and node.keywords is not None:
+                recv = node.func.value
+                recv_name = (
+                    recv.attr
+                    if isinstance(recv, ast.Attribute)
+                    else recv.id
+                    if isinstance(recv, ast.Name)
+                    else None
+                )
+                # joining a thread while holding a lock the thread
+                # may need is a deadlock; string ''.join is filtered
+                # by requiring a thread-ish receiver
+                if recv_name and (
+                    "thread" in recv_name.lower()
+                    or recv_name in ("t", "_worker", "_monitor")
+                ):
+                    out.append(
+                        Finding(
+                            check="thread-safety",
+                            path=src.rel,
+                            line=node.lineno,
+                            message=(
+                                f"thread join `{recv_name}.join` "
+                                f"under lock `{tail}` — the joined "
+                                "thread may need the same lock"
+                            ),
+                        )
+                    )
+        elif isinstance(node.func, ast.Name) and node.func.id == "print":
+            out.append(
+                Finding(
+                    check="thread-safety",
+                    path=src.rel,
+                    line=node.lineno,
+                    message=(
+                        f"print under lock `{tail}` — console I/O "
+                        "must not hold the mutex"
+                    ),
+                )
+            )
+
+    for tail, node in _with_lock_regions(src.tree, locks):
+        for stmt in node.body:
+            _flag(tail, stmt)
+            scan_body(tail, stmt)
+    return out
+
+
+def _check_threads(src: Source) -> List[Finding]:
+    out: List[Finding] = []
+    joined = _joined_tails(src)
+    for line, tail, container in _thread_targets(src):
+        if tail is not None and tail in joined:
+            continue
+        if container is not None and container in joined:
+            continue
+        what = (
+            f"thread bound to `{tail}`"
+            if tail
+            else f"thread appended to `{container}`"
+            if container
+            else "fire-and-forget thread"
+        )
+        out.append(
+            Finding(
+                check="thread-safety",
+                path=src.rel,
+                line=line,
+                message=(
+                    f"{what} has no join path in this module — an "
+                    "unjoined thread alive at interpreter exit "
+                    "aborts the process mid-XLA-call"
+                ),
+            )
+        )
+    return out

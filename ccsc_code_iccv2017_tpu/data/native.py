@@ -36,8 +36,10 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         # always invoke make: it is a no-op when the .so is newer than
         # the source, and rebuilds a stale library after source updates
+        # (ONE-TIME build deliberately serialized behind this
+        # dedicated lock — nothing else ever contends on it)
         try:
-            subprocess.run(
+            subprocess.run(  # ccsc: allow[thread-safety]
                 ["make", "-C", _NATIVE_DIR],
                 check=True,
                 capture_output=True,

@@ -29,11 +29,12 @@ batched matmuls on the MXU.
 from __future__ import annotations
 
 import functools
-import os
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..utils import env as _env
 
 
 def resolve_herm_method(m: int, method: Optional[str] = None) -> str:
@@ -51,7 +52,9 @@ def resolve_herm_method(m: int, method: Optional[str] = None) -> str:
     reciprocal) and 2 < m <= 16; Cholesky everywhere else.
     """
     if method is None:
-        method = os.environ.get("CCSC_HERM_INV") or "auto"
+        # trace-time knob BY DESIGN: the method is a plan constant
+        # baked into the compiled program, never a jit-visible value
+        method = _env.env_str("CCSC_HERM_INV") or "auto"  # ccsc: allow[jit-purity]
     if method != "auto":
         return method
     if jax.default_backend() in ("tpu", "axon") and (
@@ -131,8 +134,9 @@ def resolve_newton_iters(iters: Optional[int] = None) -> int:
     regime, or validate against the Cholesky path first."""
     if iters is not None:
         return iters
-    env = os.environ.get("CCSC_HERM_INV_ITERS")
-    return int(env) if env else 30
+    # trace-time knob by design (fixed scan length of the compiled
+    # Newton iteration); never-crash parse falls back to 30
+    return _env.env_int("CCSC_HERM_INV_ITERS")  # ccsc: allow[jit-purity]
 
 
 def _hermitian_inverse_newton(
@@ -185,9 +189,9 @@ def _hermitian_inverse_newton(
 def _newton_cond_window() -> float:
     """Condition-number validity window of the default Newton-Schulz
     iteration count (resolve_newton_iters): cond <= ~3e4 measured on
-    the real HS z-kernel Gram (r5). CCSC_NEWTON_COND_MAX overrides."""
-    env = os.environ.get("CCSC_NEWTON_COND_MAX")
-    return float(env) if env else 3e4
+    the real HS z-kernel Gram (r5). CCSC_NEWTON_COND_MAX overrides
+    (trace-time: the window is a compile-time constant of the guard)."""
+    return _env.env_float("CCSC_NEWTON_COND_MAX")  # ccsc: allow[jit-purity]
 
 
 def _power_lam_max(A: jnp.ndarray, iters: int = 12) -> jnp.ndarray:
@@ -239,7 +243,8 @@ def _newton_with_cond_guard(
     (trusting the iterate count), CCSC_NEWTON_COND_MAX moves the
     window."""
     X = _hermitian_inverse_newton(G, newton_iters)
-    if os.environ.get("CCSC_NEWTON_COND_GUARD", "").strip() == "0":
+    # trace-time switch: guard on/off selects which program compiles
+    if not _env.env_flag("CCSC_NEWTON_COND_GUARD"):  # ccsc: allow[jit-purity]
         return X
     cond = jnp.max(_power_lam_max(G) * _power_lam_max(X))
     # fail CLOSED on a non-finite estimate: a NaN/inf cond means the

@@ -92,17 +92,14 @@ def initialize(
             return
         _initialized = True
         return
-    import os
     import time
 
+    from ..utils import env as _env
+
     if connect_retries is None:
-        connect_retries = int(
-            os.environ.get("CCSC_DIST_CONNECT_RETRIES", "5")
-        )
+        connect_retries = _env.env_int("CCSC_DIST_CONNECT_RETRIES")
     if connect_backoff is None:
-        connect_backoff = float(
-            os.environ.get("CCSC_DIST_CONNECT_BACKOFF", "1.0")
-        )
+        connect_backoff = _env.env_float("CCSC_DIST_CONNECT_BACKOFF")
     for attempt in range(connect_retries + 1):
         try:
             jax.distributed.initialize(

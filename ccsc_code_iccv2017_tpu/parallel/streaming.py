@@ -394,7 +394,7 @@ def _learn_streaming_impl(
     #   time — the unbounded-n contract.
     # Auto-selection by a byte budget (CCSC_STREAM_RESIDENT_GB,
     # default 10 GB); CCSC_STREAM_MODE=device|kern|paged forces a tier.
-    import os as _os
+    from ..utils import env as _envmod
 
     spatial_elems = int(np.prod(fg.spatial_shape))
     K = geom.num_filters
@@ -413,10 +413,8 @@ def _learn_streaming_impl(
     # default sized for the 16 GB v5e: the full-scale 3D bank state
     # estimates at 8.06 GB, and device mode additionally needs FFT
     # workspace for one block — 10 GB admits it with headroom
-    budget = float(
-        _os.environ.get("CCSC_STREAM_RESIDENT_GB", "10.0")
-    ) * 1e9
-    mode = stream_mode or _os.environ.get("CCSC_STREAM_MODE", "auto")
+    budget = _envmod.env_float("CCSC_STREAM_RESIDENT_GB") * 1e9
+    mode = stream_mode or _envmod.env_str("CCSC_STREAM_MODE")
     if mode == "auto":
         if state_bytes + kern_bytes + bhat_bytes + temp_bytes <= budget:
             mode = "device"

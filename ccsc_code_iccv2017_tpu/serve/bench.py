@@ -33,7 +33,6 @@ must beat the f32/xla default at matching valid-region outputs).
 """
 from __future__ import annotations
 
-import os
 import tempfile
 import time
 from typing import Dict
@@ -49,15 +48,17 @@ def run_serve_workload() -> Dict:
     from ..utils import obs
     from .engine import CodecEngine
 
-    n_req = int(os.environ.get("CCSC_SERVE_REQUESTS", 16))
-    lo = int(os.environ.get("CCSC_SERVE_SIZE_MIN", 40))
-    hi = int(os.environ.get("CCSC_SERVE_SIZE_MAX", 64))
-    k = int(os.environ.get("CCSC_SERVE_K", 32))
-    sup = int(os.environ.get("CCSC_SERVE_SUPPORT", 7))
-    slots = int(os.environ.get("CCSC_SERVE_SLOTS", 4))
-    max_it = int(os.environ.get("CCSC_SERVE_MAXIT", 20))
-    wait_ms = float(os.environ.get("CCSC_SERVE_WAIT_MS", 5))
-    homog = os.environ.get("CCSC_SERVE_HOMOG") == "1"
+    from ..utils import env as _env
+
+    n_req = _env.env_int("CCSC_SERVE_REQUESTS")
+    lo = _env.env_int("CCSC_SERVE_SIZE_MIN")
+    hi = _env.env_int("CCSC_SERVE_SIZE_MAX")
+    k = _env.env_int("CCSC_SERVE_K")
+    sup = _env.env_int("CCSC_SERVE_SUPPORT")
+    slots = _env.env_int("CCSC_SERVE_SLOTS")
+    max_it = _env.env_int("CCSC_SERVE_MAXIT")
+    wait_ms = _env.env_float("CCSC_SERVE_WAIT_MS")
+    homog = _env.env_flag("CCSC_SERVE_HOMOG")
 
     r = np.random.default_rng(0)
     d = r.normal(size=(k, sup, sup)).astype(np.float32)
@@ -152,7 +153,7 @@ def run_serve_workload() -> Dict:
     scfg = ServeConfig(
         buckets=buckets, max_wait_ms=wait_ms, metrics_dir=metrics_dir,
         verbose="none",
-        compile_cache=os.environ.get("CCSC_COMPILE_CACHE") or None,
+        compile_cache=_env.env_str("CCSC_COMPILE_CACHE") or None,
     )
     eng_res, eng_rps, t_warmup, t_ready, _ = run_engine(scfg)
     max_rel = max_rel_err(eng_res)
@@ -191,14 +192,14 @@ def run_serve_workload() -> Dict:
     # 'sweep' measures the solve arms on THIS chip first, 'auto'
     # applies a pre-existing store entry. The record carries both
     # rates so the default-vs-tuned gap is the measured number.
-    tune_mode = os.environ.get("CCSC_SERVE_TUNE", "off")
+    tune_mode = _env.env_str("CCSC_SERVE_TUNE")
     tuned_fields = {}
     if tune_mode != "off":
         metrics2 = tempfile.mkdtemp(prefix="ccsc_serve_tuned_")
         scfg2 = ServeConfig(
             buckets=buckets, max_wait_ms=wait_ms,
             metrics_dir=metrics2, verbose="none",
-            compile_cache=os.environ.get("CCSC_COMPILE_CACHE") or None,
+            compile_cache=_env.env_str("CCSC_COMPILE_CACHE") or None,
             tune=tune_mode,
         )
         res2, rps2, t_warm2, _, knobs2 = run_engine(scfg2)

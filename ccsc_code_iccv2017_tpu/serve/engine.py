@@ -57,7 +57,9 @@ def enable_compile_cache(path: Optional[str]) -> Optional[str]:
     directory actually enabled, or None. Thresholds are zeroed so the
     small bucket programs qualify; best-effort (an unsupported backend
     just keeps compiling)."""
-    path = path or os.environ.get("CCSC_COMPILE_CACHE") or None
+    from ..utils import env as _env
+
+    path = path or _env.env_str("CCSC_COMPILE_CACHE") or None
     if not path:
         return None
     import jax

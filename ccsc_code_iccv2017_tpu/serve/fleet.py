@@ -164,6 +164,7 @@ class ServeFleet:
         # interpreter exit aborts the process)
         self._closing = threading.Event()
         self._restart_threads: List[threading.Thread] = []
+        self._recycle_thread: Optional[threading.Thread] = None
 
         # fail on a garbage bank/config ONCE, before N engines build
         validate.check_solve_config(cfg)
@@ -925,10 +926,19 @@ class ServeFleet:
             if self._recycling or self._close_started:
                 return
             self._recycling = True
-        threading.Thread(
-            target=self._recycle_loop, name="ccsc-fleet-recycle",
-            daemon=True,
-        ).start()
+            # tracked, not fire-and-forget: close() joins it so an
+            # interpreter exit can never catch it mid-work (lint:
+            # thread-safety; _recycling gates at most one alive).
+            # Started INSIDE the lock: publishing an unstarted thread
+            # and starting it after release would let a racing
+            # close() join() a never-started Thread (RuntimeError
+            # mid-cleanup). The new thread's first act is to take
+            # this same lock, so it simply blocks until we release.
+            self._recycle_thread = threading.Thread(
+                target=self._recycle_loop, name="ccsc-fleet-recycle",
+                daemon=True,
+            )
+            self._recycle_thread.start()
 
     def _recycle_loop(self) -> None:
         try:
@@ -1210,6 +1220,10 @@ class ServeFleet:
                 time.sleep(0.02)
             self._stop_monitor.set()
             self._monitor.join(timeout=5.0)
+            # the recycle walker polls _close_started at 50ms — join
+            # it so it cannot be alive at interpreter exit
+            if self._recycle_thread is not None:
+                self._recycle_thread.join(timeout=10.0)
             # a restart thread caught mid-engine-build must finish and
             # release its engine (the `closing` branch in _restart)
             # before the interpreter can safely exit
@@ -1237,20 +1251,25 @@ class ServeFleet:
                     rep.state = "stopped"
             # final per-replica heartbeat: a short run may never reach
             # a monitor tick, and the FLEET report's liveness column
-            # reads heartbeats — every replica gets a terminal one
+            # reads heartbeats — every replica gets a terminal one.
+            # Snapshot under the lock, emit OUTSIDE it: the stream
+            # write can block on file I/O and must not hold the queue
+            # mutex (lint: thread-safety)
             with self._cv:
                 depth = len(self._queue)
-                for rep in self._replicas:
-                    if rep is None:
-                        continue
-                    self._emit(
-                        "fleet_heartbeat", replica_id=rep.id,
-                        state=rep.state, generation=rep.generation,
-                        served=rep.served, inflight=len(rep.assigned),
-                        queue_depth=depth,
+                final_rows = [
+                    dict(
+                        replica_id=rep.id, state=rep.state,
+                        generation=rep.generation, served=rep.served,
+                        inflight=len(rep.assigned), queue_depth=depth,
                         restarts=self._restarts.get(rep.id, 0),
                         final=True,
                     )
+                    for rep in self._replicas
+                    if rep is not None
+                ]
+            for row in final_rows:
+                self._emit("fleet_heartbeat", **row)
             undelivered: List[_FleetRequest] = []
             with self._cv:
                 undelivered.extend(self._queue)

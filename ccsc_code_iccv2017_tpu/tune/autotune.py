@@ -31,9 +31,11 @@ from . import space, store as store_mod
 def chip_now() -> str:
     """The chip identity every store key uses: CCSC_TUNE_CHIP override
     (tests / operators pinning a key) > perfmodel.detect_chip()."""
-    env = os.environ.get("CCSC_TUNE_CHIP")
-    if env:
-        return env
+    from ..utils import env as _env
+
+    override = _env.env_str("CCSC_TUNE_CHIP")
+    if override:
+        return override
     from ..utils import perfmodel
 
     return perfmodel.detect_chip()
@@ -44,12 +46,15 @@ def guard_tol() -> float:
     deviation vs the f32 reference. Default matches the on-chip
     accuracy gate (pick_tuned.ACC_BOUND): the tuned default must stay
     in the documented 'small perturbation' accuracy class."""
-    env = os.environ.get("CCSC_TUNE_GUARD_TOL")
-    return float(env) if env else 0.01
+    from ..utils import env as _env
+
+    return _env.env_float("CCSC_TUNE_GUARD_TOL")
 
 
 def _guard_enabled() -> bool:
-    return os.environ.get("CCSC_TUNE_GUARD", "").strip() != "0"
+    from ..utils import env as _env
+
+    return _env.env_flag("CCSC_TUNE_GUARD")
 
 
 def _default_emit(type_: str, **fields) -> None:
@@ -653,7 +658,9 @@ def _drop_losers(store, chip, kind, shape_key) -> None:
     falling back past the baseline should mean falling back to the
     DEFAULTS, which need no entry). Margin: CCSC_TUNE_MIN_WIN
     (default 2%)."""
-    margin = 1.0 + float(os.environ.get("CCSC_TUNE_MIN_WIN", "0.02"))
+    from ..utils import env as _env
+
+    margin = 1.0 + _env.env_float("CCSC_TUNE_MIN_WIN")
     cands = store.candidates(chip, kind, shape_key)
     base = next(
         (e for e in cands if not e["arm"] and e.get("source") == "sweep"),

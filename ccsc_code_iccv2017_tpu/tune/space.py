@@ -173,11 +173,11 @@ def code_fingerprint() -> str:
     space changes incompatibly, persisted winners stop matching
     instead of silently configuring code they were never measured
     on. CCSC_TUNE_FP overrides (pinning across a compatible rename)."""
-    import os
+    from ..utils import env as _env
 
-    env = os.environ.get("CCSC_TUNE_FP")
-    if env:
-        return env
+    override = _env.env_str("CCSC_TUNE_FP")
+    if override:
+        return override
     basis = {
         "version": SPACE_VERSION,
         "knobs": {

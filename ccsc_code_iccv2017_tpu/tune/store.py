@@ -38,10 +38,12 @@ _REPO_ROOT = os.path.dirname(
 
 
 def default_store_path() -> str:
-    env = os.environ.get("CCSC_TUNE_STORE")
-    if env:
-        return env
-    cache = os.environ.get("CCSC_COMPILE_CACHE")
+    from ..utils import env as _env
+
+    override = _env.env_str("CCSC_TUNE_STORE")
+    if override:
+        return override
+    cache = _env.env_str("CCSC_COMPILE_CACHE")
     if cache:
         return os.path.join(cache, "ccsc_tuned_knobs.json")
     return os.path.join(_REPO_ROOT, "tuned_knobs.json")
@@ -373,9 +375,11 @@ def bench_lookup(
     entries for OTHER chips refuses instead of falling back: the
     legacy file carries the same cross-chip hazard the store exists to
     close. Returns (knob_dict, source_string)."""
+    from ..utils import env as _env
+
     if store_path is None and repo is not None \
-            and not os.environ.get("CCSC_TUNE_STORE") \
-            and not os.environ.get("CCSC_COMPILE_CACHE"):
+            and not _env.env_str("CCSC_TUNE_STORE") \
+            and not _env.env_str("CCSC_COMPILE_CACHE"):
         store_path = os.path.join(repo, "tuned_knobs.json")
     store = TunedStore(store_path)
     key = learn_shape_key(

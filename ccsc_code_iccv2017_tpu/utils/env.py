@@ -1,0 +1,374 @@
+"""The shared never-crash ``CCSC_*`` environment knob surface.
+
+Every ``CCSC_*`` read in the library and scripts/ goes through the
+helpers here (lint-enforced: ``analysis`` check ``env-registry``), so:
+
+- a malformed value can NEVER crash a run — it warns once and falls
+  back to the declared default (the utils.faults stance, now
+  universal: chaos/tuning/ops knobs must not be able to take down a
+  production learner);
+- the knob space is DECLARED — :data:`REGISTRY` is the single source
+  of truth for every knob's type, default, and consumer, rendered as
+  ``docs/ENV_KNOBS.md`` (``python scripts/lint.py --write-env-docs``)
+  and staleness-checked by ``tests/test_analysis.py``. A new env read
+  that skips the registry fails lint, the generalization of the tune
+  space's NON_TUNED drift guard to all config surfaces;
+- reads hit ``os.environ`` on every query, so tests arm/disarm with
+  ``monkeypatch.setenv`` exactly as before.
+
+This module is deliberately stdlib-only and free of package-relative
+imports: the linter loads it by file path (no jax import) to build
+the registry checks and the generated docs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "Knob",
+    "REGISTRY",
+    "env_str",
+    "env_int",
+    "env_float",
+    "env_flag",
+    "env_int_list",
+    "render_docs",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str  # 'str' | 'int' | 'float' | 'flag' | 'int_list' | 'path'
+    default: object
+    help: str
+    surface: str  # the consuming module(s)
+
+
+def _knobs(*rows: Tuple[str, str, object, str, str]) -> Dict[str, Knob]:
+    out: Dict[str, Knob] = {}
+    for name, kind, default, surface, help_ in rows:
+        out[name] = Knob(name, kind, default, help_, surface)
+    return out
+
+
+REGISTRY: Dict[str, Knob] = _knobs(
+    # -- observability / supervision ---------------------------------
+    ("CCSC_GIT_SHA", "str", None, "utils.obs",
+     "git revision override for run_meta/bench provenance (deployed "
+     "copies without a .git)"),
+    ("CCSC_OBS_HEARTBEAT_S", "float", 30.0, "utils.obs",
+     "per-host heartbeat cadence in seconds (0 = every fence)"),
+    ("CCSC_WATCHDOG_ACTION", "str", "abort", "utils.watchdog",
+     "'abort' hard-exits with EXIT_STALL on a stalled fence; 'event' "
+     "only records it"),
+    ("CCSC_WATCHDOG_MIN_S", "float", 30.0, "utils.watchdog",
+     "per-fence deadline floor in seconds"),
+    ("CCSC_WATCHDOG_COMPILE_S", "float", 300.0, "utils.watchdog",
+     "extra allowance on fences that may trace+compile"),
+    ("CCSC_WATCHDOG_PEER_STALE_S", "float", 120.0,
+     "utils.watchdog, scripts/obs_report.py",
+     "peer-heartbeat staleness threshold in seconds"),
+    # -- memory / placement budgets ----------------------------------
+    ("CCSC_INMEM_HBM_GB", "float", 14.0, "utils.perfmodel",
+     "device byte budget of the in-memory learn preflight"),
+    ("CCSC_STREAM_RESIDENT_GB", "float", 10.0, "parallel.streaming",
+     "byte budget of the streaming learner's auto placement tiers"),
+    ("CCSC_STREAM_MODE", "str", "auto", "parallel.streaming",
+     "force a streaming placement tier: device | kern | paged"),
+    # -- numerics knobs ----------------------------------------------
+    ("CCSC_HERM_INV", "str", None, "ops.freq_solvers",
+     "Gram-inverse method: cholesky | schur | newton (default "
+     "'auto' platform/size resolution); trace-time read"),
+    ("CCSC_HERM_INV_ITERS", "int", 30, "ops.freq_solvers",
+     "Newton-Schulz iteration count (validity window cond <= ~3e4 "
+     "at the default)"),
+    ("CCSC_NEWTON_COND_MAX", "float", 3e4, "ops.freq_solvers",
+     "condition-number validity window of the Newton default"),
+    ("CCSC_NEWTON_COND_GUARD", "flag", True, "ops.freq_solvers",
+     "runtime condition estimate + Cholesky fallback on the Newton "
+     "path (0 disables)"),
+    # -- distributed --------------------------------------------------
+    ("CCSC_DIST_CONNECT_RETRIES", "int", 5, "parallel.distributed",
+     "extra coordinator connect attempts"),
+    ("CCSC_DIST_CONNECT_BACKOFF", "float", 1.0, "parallel.distributed",
+     "seconds before the first connect retry (doubles, capped 30s)"),
+    # -- serving ------------------------------------------------------
+    ("CCSC_COMPILE_CACHE", "path", None, "serve.engine, tune.store",
+     "persistent XLA compilation cache dir (warm restarts skip "
+     "backend compiles)"),
+    # -- autotuning ---------------------------------------------------
+    ("CCSC_TUNE_STORE", "path", None, "tune.store",
+     "tuned-knob store path (else $CCSC_COMPILE_CACHE/"
+     "ccsc_tuned_knobs.json, else repo tuned_knobs.json)"),
+    ("CCSC_TUNE_CHIP", "str", None, "tune.autotune",
+     "chip-identity override for store keys (tests/operators)"),
+    ("CCSC_TUNE_GUARD", "flag", True, "tune.autotune",
+     "numerics guard on arm application (0 trusts the store)"),
+    ("CCSC_TUNE_GUARD_TOL", "float", 0.01, "tune.autotune",
+     "max relative trajectory deviation vs the f32 reference"),
+    ("CCSC_TUNE_MIN_WIN", "float", 0.02, "tune.autotune",
+     "minimum fractional win over baseline for a sweep arm to "
+     "persist"),
+    ("CCSC_TUNE_FP", "str", None, "tune.space",
+     "knob-space fingerprint override (pin across a compatible "
+     "rename)"),
+    # -- chaos / fault injection (utils.faults) ----------------------
+    ("CCSC_FAULT_NAN_IT", "int", None, "utils.faults",
+     "poison the code iterate inside the step of this 1-based outer "
+     "iteration"),
+    ("CCSC_FAULT_CKPT_SAVE", "flag", False, "utils.faults",
+     "crash checkpoint.save between payload write and atomic commit"),
+    ("CCSC_FAULT_SIGTERM_IT", "int", None, "utils.faults",
+     "raise SIGTERM in the driver thread after this outer iteration"),
+    ("CCSC_FAULT_HANG_IT", "int", None, "utils.faults",
+     "sleep inside the armed fence after this outer iteration"),
+    ("CCSC_FAULT_HANG_S", "float", 3600.0, "utils.faults",
+     "hang-fault sleep duration"),
+    ("CCSC_FAULT_ENGINE_KILL_REQ", "int", None, "utils.faults",
+     "kill a serving replica while processing its k-th taken "
+     "request"),
+    ("CCSC_FAULT_ENGINE_HANG_REQ", "int", None, "utils.faults",
+     "hang a serving replica while processing its k-th taken "
+     "request"),
+    ("CCSC_FAULT_ENGINE_HANG_S", "float", 3600.0, "utils.faults",
+     "engine hang-fault sleep duration"),
+    ("CCSC_FAULT_ENGINE_KILL_REPLICA", "int_list", None,
+     "utils.faults",
+     "comma list of replica ids armed for the kill fault (unset = "
+     "all)"),
+    ("CCSC_FAULT_ENGINE_HANG_REPLICA", "int_list", None,
+     "utils.faults",
+     "comma list of replica ids armed for the hang fault (unset = "
+     "all)"),
+    ("CCSC_FAULT_STATE_DIR", "path", None, "utils.faults",
+     "cross-restart fire-once marker dir (supervise.py exports the "
+     "metrics dir)"),
+    # -- serve bench workload (serve.bench) --------------------------
+    ("CCSC_SERVE_REQUESTS", "int", 16, "serve.bench",
+     "bench stream length"),
+    ("CCSC_SERVE_SIZE_MIN", "int", 40, "serve.bench",
+     "min spatial side of the heterogeneous bench stream"),
+    ("CCSC_SERVE_SIZE_MAX", "int", 64, "serve.bench",
+     "max spatial side of the heterogeneous bench stream"),
+    ("CCSC_SERVE_K", "int", 32, "serve.bench",
+     "bench filter-bank size"),
+    ("CCSC_SERVE_SUPPORT", "int", 7, "serve.bench",
+     "bench filter support"),
+    ("CCSC_SERVE_SLOTS", "int", 4, "serve.bench",
+     "bench bucket slots"),
+    ("CCSC_SERVE_MAXIT", "int", 20, "serve.bench",
+     "bench solve iteration budget"),
+    ("CCSC_SERVE_WAIT_MS", "float", 5.0, "serve.bench",
+     "bench micro-batch flush deadline"),
+    ("CCSC_SERVE_HOMOG", "flag", False, "serve.bench",
+     "homogeneous stream at the bucket shape"),
+    ("CCSC_SERVE_TUNE", "str", "off", "serve.bench",
+     "also run a tuned engine on the same stream: off | auto | "
+     "sweep"),
+    # -- family bench scripts ----------------------------------------
+    ("CCSC_FAMILIES", "str", None, "scripts/family_bench.py",
+     "comma list of families to bench (default all)"),
+    ("CCSC_FAMILY_ITERS", "int", 3, "scripts/family_bench.py",
+     "outer iterations per family bench"),
+    ("CCSC_FAMILY_RECON_ITERS", "int", 40, "scripts/family_bench.py",
+     "reconstruction iterations per family bench"),
+    ("CCSC_FAMILY_FFTIMPL", "str", "xla",
+     "scripts/family_bench.py, scripts/hs_profile.py",
+     "fft_impl knob of the family benches"),
+    ("CCSC_FAMILY_STORAGE", "str", "float32",
+     "scripts/family_bench.py, scripts/hs_profile.py",
+     "storage_dtype knob of the family benches"),
+    ("CCSC_FAMILY_CARRY", "flag", False,
+     "scripts/family_bench.py, scripts/hs_profile.py",
+     "carry_freq knob of the family benches"),
+    # -- bench.py (repo root; reads stay local to the bench harness
+    # but the knobs are part of the declared surface) ----------------
+    ("CCSC_BENCH_N", "int", 20, "bench.py", "bench batch size"),
+    ("CCSC_BENCH_SIZE", "int", 100, "bench.py", "bench image side"),
+    ("CCSC_BENCH_K", "int", 100, "bench.py", "bench filter count"),
+    ("CCSC_BENCH_BLOCKS", "int", 4, "bench.py",
+     "bench consensus blocks"),
+    ("CCSC_BENCH_ITERS", "int", 10, "bench.py",
+     "bench outer iterations"),
+    ("CCSC_BENCH_TIMEOUT", "float", 1800.0, "bench.py",
+     "per-arm subprocess timeout"),
+    ("CCSC_BENCH_INPROCESS", "flag", False, "bench.py",
+     "run arms in-process instead of subprocesses"),
+    ("CCSC_BENCH_PALLAS", "flag", False, "bench.py",
+     "deprecated use_pallas arm switch"),
+    ("CCSC_BENCH_FFTPAD", "str", "none", "bench.py",
+     "fft_pad arm value"),
+    ("CCSC_BENCH_STORAGE", "str", "float32", "bench.py",
+     "storage_dtype arm value"),
+    ("CCSC_BENCH_DSTORAGE", "str", "float32", "bench.py",
+     "d_storage_dtype arm value"),
+    ("CCSC_BENCH_FFTIMPL", "str", "xla", "bench.py",
+     "fft_impl arm value"),
+    ("CCSC_BENCH_FUSEDZ", "flag", False, "bench.py",
+     "fused_z arm switch"),
+    ("CCSC_BENCH_FUSEDZ_PREC", "str", "highest", "bench.py",
+     "fused_z_precision arm value"),
+    ("CCSC_BENCH_CHUNK", "int", 1, "bench.py",
+     "outer_chunk arm value"),
+    ("CCSC_BENCH_DONATE", "flag", False, "bench.py",
+     "donate_state arm switch"),
+    ("CCSC_BENCH_CARRY", "flag", False, "bench.py",
+     "carry_freq arm switch"),
+    ("CCSC_BENCH_SERVE", "flag", False, "bench.py",
+     "run the serving arm"),
+    ("CCSC_BENCH_PROFILE", "str", None, "bench.py",
+     "xprof trace dir of the profiled arm"),
+    ("CCSC_BENCH_PROFILE_REPS", "int", 2, "bench.py",
+     "profiled-arm repetitions"),
+    ("CCSC_BENCH_XPROF", "flag", False, "bench.py",
+     "emit an xprof summary per arm"),
+    ("CCSC_BENCH_METRICS_DIR", "path", None, "bench.py",
+     "obs event-stream dir of the bench arms"),
+    ("CCSC_BENCH_NO_FALLBACK", "flag", False, "bench.py",
+     "fail instead of falling back on a degraded arm"),
+)
+
+_warned: set = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    if key in _warned:
+        return
+    _warned.add(key)
+    warnings.warn(msg)
+
+
+def _raw(name: str) -> Optional[str]:
+    """The stripped env value, or None when unset/empty. Reads the
+    environment every call (tests monkeypatch freely); warns once on
+    a name missing from the registry — helper reads of undeclared
+    knobs are lint findings, and the runtime mirror keeps a
+    mis-deployed binary loud instead of silently knob-less."""
+    if name not in REGISTRY:
+        _warn_once(
+            f"unregistered:{name}",
+            f"env knob {name} is not declared in utils.env.REGISTRY",
+        )
+    # the helper IS the sanctioned reader; jit-reachable CALLERS carry
+    # their own allow[jit-purity] where trace-time baking is intended
+    raw = os.environ.get(name)  # ccsc: allow[jit-purity]
+    if raw is None:
+        return None
+    raw = raw.strip()
+    return raw or None
+
+
+_UNSET = object()
+
+
+def _default(name: str, default):
+    if default is not _UNSET:
+        return default
+    knob = REGISTRY.get(name)
+    return knob.default if knob is not None else None
+
+
+def env_str(name: str, default=_UNSET) -> Optional[str]:
+    raw = _raw(name)
+    return raw if raw is not None else _default(name, default)
+
+
+def env_int(name: str, default=_UNSET) -> Optional[int]:
+    raw = _raw(name)
+    if raw is None:
+        return _default(name, default)
+    try:
+        return int(raw)
+    except ValueError:
+        _warn_once(
+            f"malformed:{name}",
+            f"ignoring malformed env {name}={raw!r} (expected an "
+            "integer)",
+        )
+        return _default(name, default)
+
+
+def env_float(name: str, default=_UNSET) -> Optional[float]:
+    raw = _raw(name)
+    if raw is None:
+        return _default(name, default)
+    try:
+        return float(raw)
+    except ValueError:
+        _warn_once(
+            f"malformed:{name}",
+            f"ignoring malformed env {name}={raw!r} (expected a "
+            "number)",
+        )
+        return _default(name, default)
+
+
+def env_flag(name: str, default=_UNSET) -> bool:
+    """Truthy unless unset/empty/'0' — the utils.faults convention
+    (any explicit non-zero value arms the switch)."""
+    raw = _raw(name)
+    if raw is None:
+        d = _default(name, default)
+        return bool(d)
+    return raw != "0"
+
+
+def env_int_list(name: str, default=_UNSET):
+    """Comma list of ints -> tuple; None when unset; () with a
+    one-time warning when malformed (a typo'd restriction list
+    disarms rather than arming everything)."""
+    raw = _raw(name)
+    if raw is None:
+        return _default(name, default)
+    try:
+        return tuple(
+            int(x) for x in raw.split(",") if x.strip()
+        )
+    except ValueError:
+        _warn_once(
+            f"malformed:{name}",
+            f"ignoring malformed env {name}={raw!r} (expected a "
+            "comma list of integers)",
+        )
+        return ()
+
+
+# ---------------------------------------------------------------------
+# generated documentation (docs/ENV_KNOBS.md)
+# ---------------------------------------------------------------------
+
+
+def render_docs() -> str:
+    """The generated ``docs/ENV_KNOBS.md`` content — regenerate with
+    ``python scripts/lint.py --write-env-docs``; staleness is a
+    tier-1 test (tests/test_analysis.py)."""
+    lines = [
+        "# CCSC_* environment knobs",
+        "",
+        "Generated from `ccsc_code_iccv2017_tpu/utils/env.py` "
+        "(`python scripts/lint.py --write-env-docs`). Do not edit by "
+        "hand — `tests/test_analysis.py` checks this file against "
+        "the registry.",
+        "",
+        "Every `CCSC_*` read in the library and `scripts/` goes "
+        "through the never-crash helpers in `utils.env` "
+        "(lint check `env-registry`): a malformed value warns once "
+        "and falls back to the default below instead of crashing "
+        "the run.",
+        "",
+        "| Knob | Type | Default | Surface | Purpose |",
+        "| --- | --- | --- | --- | --- |",
+    ]
+    for name in sorted(REGISTRY):
+        k = REGISTRY[name]
+        default = "—" if k.default is None else repr(k.default)
+        lines.append(
+            f"| `{k.name}` | {k.kind} | {default} | {k.surface} | "
+            f"{k.help} |"
+        )
+    lines.append("")
+    return "\n".join(lines)

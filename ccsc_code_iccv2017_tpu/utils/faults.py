@@ -63,6 +63,8 @@ import signal
 import time
 from typing import Optional
 
+from . import env as _env
+
 __all__ = [
     "InjectedFault",
     "nan_iteration",
@@ -90,7 +92,7 @@ def _state_dir() -> Optional[str]:
     """Where cross-restart fire-once markers live: the explicit
     CCSC_FAULT_STATE_DIR (scripts/supervise.py sets it to the metrics
     dir), else the active obs run's stream directory."""
-    d = os.environ.get("CCSC_FAULT_STATE_DIR", "").strip()
+    d = _env.env_str("CCSC_FAULT_STATE_DIR")
     if d:
         return d
     try:
@@ -144,24 +146,10 @@ def _mark_fired(name: str, **info) -> None:
 
 
 def _env_int(name: str) -> Optional[int]:
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return None
-    try:
-        return int(raw)
-    except ValueError:
-        # chaos tooling must never be able to crash a production run:
-        # a typo'd fault env disarms the fault, loudly, instead of
-        # raising from inside the learner loop
-        if name not in _fired:
-            _fired.add(name)
-            import warnings
-
-            warnings.warn(
-                f"ignoring malformed fault env {name}={raw!r} "
-                "(expected an integer iteration)"
-            )
-        return None
+    # the shared never-crash helper (utils.env): a typo'd fault env
+    # disarms the fault, loudly, instead of raising from inside the
+    # learner loop
+    return _env.env_int(name, None)
 
 
 def nan_iteration() -> Optional[int]:
@@ -187,7 +175,7 @@ def ckpt_save_hook() -> None:
     """Called by ``utils.checkpoint.save`` between writing the payload
     and the atomic commit; raises ``InjectedFault`` once when armed
     (CCSC_FAULT_CKPT_SAVE truthy) — simulating a crash mid-save."""
-    if os.environ.get("CCSC_FAULT_CKPT_SAVE", "").strip() in ("", "0"):
+    if not _env.env_flag("CCSC_FAULT_CKPT_SAVE"):
         return
     if _fired_before("ckpt"):
         return
@@ -225,7 +213,7 @@ def hang_tick(completed_it: int) -> None:
     k = _env_int("CCSC_FAULT_HANG_IT")
     if k is None or completed_it < k or _fired_before("hang"):
         return
-    dur = float(os.environ.get("CCSC_FAULT_HANG_S", "3600"))
+    dur = _env.env_float("CCSC_FAULT_HANG_S")
     _mark_fired("hang", iteration=int(completed_it), sleep_s=dur)
     time.sleep(dur)
 
@@ -235,13 +223,10 @@ def _replica_armed(env_name: str, replica_id: int) -> bool:
     replica: unset/empty = every replica is armed; else a comma list
     of replica ids. A malformed list disarms (same never-crash stance
     as ``_env_int``)."""
-    raw = os.environ.get(env_name, "").strip()
-    if not raw:
+    ids = _env.env_int_list(env_name, None)
+    if ids is None:
         return True
-    try:
-        ids = {int(x) for x in raw.split(",") if x.strip()}
-    except ValueError:
-        return False
+    # a malformed list parses to () — the fault disarms (never-crash)
     return int(replica_id) in ids
 
 
@@ -284,13 +269,10 @@ def engine_hang_request(replica_id: int, req_seq: int) -> float:
     name = f"engine_hang-r{int(replica_id)}"
     if _fired_before(name):
         return 0.0
-    try:
-        dur = float(os.environ.get("CCSC_FAULT_ENGINE_HANG_S", "3600"))
-    except ValueError:
-        # never-crash stance: a malformed knob must not become a
-        # "replica crash" that burns restart budget on every
-        # generation — fall back to the wedged-forever default
-        dur = 3600.0
+    # never-crash: a malformed knob must not become a "replica crash"
+    # that burns restart budget on every generation — utils.env falls
+    # back to the wedged-forever default
+    dur = _env.env_float("CCSC_FAULT_ENGINE_HANG_S")
     _mark_fired(
         name,
         replica_id=int(replica_id),

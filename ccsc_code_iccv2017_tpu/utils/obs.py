@@ -54,6 +54,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import env as _env
+
 SCHEMA_VERSION = 1
 
 # console tiers, most to least important; a Run configured at verbose
@@ -81,9 +83,9 @@ def git_sha() -> Optional[str]:
     """Best-effort git revision of the running tree (provenance field
     of run_meta and bench records). Env override CCSC_GIT_SHA first so
     deployed copies without a .git can still stamp records."""
-    env = os.environ.get("CCSC_GIT_SHA")
-    if env:
-        return env
+    override = _env.env_str("CCSC_GIT_SHA")
+    if override:
+        return override
     try:
         out = subprocess.run(
             ["git", "rev-parse", "HEAD"],
@@ -543,9 +545,7 @@ class Run:
         self.chip: Optional[str] = None
         self._host = _process_index()
         if heartbeat_every_s is None:
-            heartbeat_every_s = float(
-                os.environ.get("CCSC_OBS_HEARTBEAT_S", "30")
-            )
+            heartbeat_every_s = _env.env_float("CCSC_OBS_HEARTBEAT_S")
         self._hb_every = heartbeat_every_s
         self._hb_last = 0.0
         self._n_events = 0

@@ -250,7 +250,9 @@ def inmem_learn_estimate(b_shape, geom, cfg):
             + 2 * N * k * W * S * db  # d_local + dual_d
             + 2 * k * W * S * 4  # dbar + udbar (f32)
         )
-    budget = float(os.environ.get("CCSC_INMEM_HBM_GB", "14")) * 1e9
+    from . import env as _env
+
+    budget = _env.env_float("CCSC_INMEM_HBM_GB") * 1e9
     return est, budget
 
 

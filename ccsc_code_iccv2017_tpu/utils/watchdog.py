@@ -47,6 +47,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import env as _env
+
 __all__ = [
     "DispatchWatchdog",
     "maybe_start",
@@ -61,19 +63,13 @@ __all__ = [
 # the event stream)
 EXIT_STALL = 87
 
-DEFAULT_MIN_S = 30.0
-DEFAULT_COMPILE_S = 300.0
-DEFAULT_PEER_STALE_S = 120.0
-
-
-def _env_f(name: str, default: float) -> float:
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
+# re-exported views of the registry defaults (utils.env is the single
+# source of truth — editing these here would change nothing)
+DEFAULT_MIN_S = _env.REGISTRY["CCSC_WATCHDOG_MIN_S"].default
+DEFAULT_COMPILE_S = _env.REGISTRY["CCSC_WATCHDOG_COMPILE_S"].default
+DEFAULT_PEER_STALE_S = _env.REGISTRY[
+    "CCSC_WATCHDOG_PEER_STALE_S"
+].default
 
 
 class DispatchWatchdog:
@@ -113,17 +109,13 @@ class DispatchWatchdog:
         self.replica_id = replica_id
         self.run = run
         self.on_stall = on_stall
-        self.min_s = _env_f("CCSC_WATCHDOG_MIN_S", DEFAULT_MIN_S)
-        self.compile_s = _env_f(
-            "CCSC_WATCHDOG_COMPILE_S", DEFAULT_COMPILE_S
-        )
-        self.action = action or os.environ.get(
-            "CCSC_WATCHDOG_ACTION", "abort"
-        )
+        self.min_s = _env.env_float("CCSC_WATCHDOG_MIN_S")
+        self.compile_s = _env.env_float("CCSC_WATCHDOG_COMPILE_S")
+        self.action = action or _env.env_str("CCSC_WATCHDOG_ACTION")
         if self.action not in ("abort", "event"):
             self.action = "abort"
-        self.peer_stale_s = _env_f(
-            "CCSC_WATCHDOG_PEER_STALE_S", DEFAULT_PEER_STALE_S
+        self.peer_stale_s = _env.env_float(
+            "CCSC_WATCHDOG_PEER_STALE_S"
         )
         self.metrics_dir = metrics_dir
         self.algorithm = algorithm
@@ -398,7 +390,7 @@ def check_peers(
     from . import obs
 
     stale_s = (
-        _env_f("CCSC_WATCHDOG_PEER_STALE_S", DEFAULT_PEER_STALE_S)
+        _env.env_float("CCSC_WATCHDOG_PEER_STALE_S")
         if stale_s is None
         else stale_s
     )
@@ -448,7 +440,7 @@ def check_replicas(
     from . import obs
 
     stale_s = (
-        _env_f("CCSC_WATCHDOG_PEER_STALE_S", DEFAULT_PEER_STALE_S)
+        _env.env_float("CCSC_WATCHDOG_PEER_STALE_S")
         if stale_s is None
         else stale_s
     )

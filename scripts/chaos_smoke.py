@@ -39,6 +39,12 @@ Usage:
 
 Exit code 0 iff every scenario passed. tests/test_resilience.py runs
 ``run(subprocess_scenarios=False)`` on every verify pass.
+
+Static companion: ``python scripts/lint.py`` proves the source-level
+discipline the same subsystems depend on (jit purity, donation
+safety, lock ordering, obs schema, the CCSC_* env registry) —
+chaos proves the runtime paths, lint proves the code shape; CI runs
+both (tests/test_resilience.py + tests/test_analysis.py).
 """
 from __future__ import annotations
 

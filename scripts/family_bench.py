@@ -25,6 +25,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from ccsc_code_iccv2017_tpu.utils import env as cenv
 from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
 
 honor_jax_platforms_env()
@@ -51,7 +52,7 @@ def bench_hs():
     from ccsc_code_iccv2017_tpu.models.learn_masked import learn_masked
 
     n, side, bands, k = 2, 96, 31, 100
-    iters = int(os.environ.get("CCSC_FAMILY_ITERS", 3))
+    iters = cenv.env_int("CCSC_FAMILY_ITERS")
     b = jax.random.uniform(
         jax.random.PRNGKey(0), (n, bands, side, side), jnp.float32
     )
@@ -97,7 +98,7 @@ def bench_3d():
     from ccsc_code_iccv2017_tpu.utils import perfmodel
 
     blocks, ni, side, k = 4, 2, 50, 49
-    iters = int(os.environ.get("CCSC_FAMILY_ITERS", 3))
+    iters = cenv.env_int("CCSC_FAMILY_ITERS")
     geom = ProblemGeom((11, 11, 11), k)
     cfg = LearnConfig(
         max_it=iters, max_it_d=5, max_it_z=10, num_blocks=blocks,
@@ -152,7 +153,7 @@ def _bench_recon(family, geom, k_shape, side, reduce_shape, lam_res):
         reconstruct,
     )
 
-    max_it = int(os.environ.get("CCSC_FAMILY_RECON_ITERS", 40))
+    max_it = cenv.env_int("CCSC_FAMILY_RECON_ITERS")
     d = jax.random.normal(jax.random.PRNGKey(2), k_shape, jnp.float32)
     d = d / jnp.sqrt(
         jnp.sum(d * d, axis=tuple(range(1, d.ndim)), keepdims=True)
@@ -213,9 +214,9 @@ def bench_viewsynth():
     )
 
 
-FFT_IMPL = os.environ.get("CCSC_FAMILY_FFTIMPL", "xla")
-STORAGE = os.environ.get("CCSC_FAMILY_STORAGE", "float32")
-CARRY = os.environ.get("CCSC_FAMILY_CARRY", "0") == "1"
+FFT_IMPL = cenv.env_str("CCSC_FAMILY_FFTIMPL")
+STORAGE = cenv.env_str("CCSC_FAMILY_STORAGE")
+CARRY = cenv.env_flag("CCSC_FAMILY_CARRY")
 
 
 FAMILIES = {
@@ -227,7 +228,7 @@ FAMILIES = {
 
 
 def main():
-    names = os.environ.get("CCSC_FAMILIES", ",".join(FAMILIES)).split(",")
+    names = (cenv.env_str("CCSC_FAMILIES") or ",".join(FAMILIES)).split(",")
     for name in names:
         name = name.strip()
         if name:

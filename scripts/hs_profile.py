@@ -27,6 +27,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from ccsc_code_iccv2017_tpu.utils import env as cenv
 from ccsc_code_iccv2017_tpu.utils.platform import honor_jax_platforms_env
 
 honor_jax_platforms_env()
@@ -57,9 +58,9 @@ def main():
     side = int(os.environ.get("HSP_SIDE", 96))
     bands = int(os.environ.get("HSP_BANDS", 31))
     k = int(os.environ.get("HSP_K", 100))
-    fft_impl = os.environ.get("CCSC_FAMILY_FFTIMPL", "xla")
-    storage = os.environ.get("CCSC_FAMILY_STORAGE", "float32")
-    carry = os.environ.get("CCSC_FAMILY_CARRY", "0") == "1"
+    fft_impl = cenv.env_str("CCSC_FAMILY_FFTIMPL")
+    storage = cenv.env_str("CCSC_FAMILY_STORAGE")
+    carry = cenv.env_flag("CCSC_FAMILY_CARRY")
     b = jax.random.uniform(
         jax.random.PRNGKey(0), (n, bands, side, side), jnp.float32
     )

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Run the repo's static analysis suite (ccsc_code_iccv2017_tpu/analysis).
+
+    python scripts/lint.py                      # all checks, exit != 0
+                                                # on NEW findings
+    python scripts/lint.py --checks jit-purity,thread-safety
+    python scripts/lint.py --json               # machine-readable
+    python scripts/lint.py --update-baseline    # re-review: absorb
+                                                # current findings
+    python scripts/lint.py --write-env-docs     # regenerate
+                                                # docs/ENV_KNOBS.md
+    python scripts/lint.py --list               # available checks
+
+Findings already absorbed by analysis/baseline.json, or suppressed
+inline with `# ccsc: allow[check-id]`, do not fail the run. Stale
+baseline entries (matching nothing anymore) are reported so the
+baseline shrinks as debt is paid — tests/test_analysis.py fails on
+them, keeping the reviewed file honest.
+
+The same suite runs as a tier-1 test (tests/test_analysis.py), so CI
+enforces every check on every PR.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.analysis import core  # noqa: E402
+from ccsc_code_iccv2017_tpu.analysis import envreg  # noqa: E402
+
+ENV_DOCS_PATH = os.path.join(REPO, "docs", "ENV_KNOBS.md")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="roots to analyze (default: the package + scripts/)",
+    )
+    ap.add_argument(
+        "--checks", default=None,
+        help="comma list of check ids (default: all)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite analysis/baseline.json from the current "
+        "findings (a reviewed act — the diff is the review)",
+    )
+    ap.add_argument(
+        "--baseline", default=core.BASELINE_PATH,
+        help="baseline file (default analysis/baseline.json)",
+    )
+    ap.add_argument(
+        "--write-env-docs", action="store_true",
+        help="regenerate docs/ENV_KNOBS.md from utils.env.REGISTRY "
+        "and exit",
+    )
+    ap.add_argument(
+        "--list", action="store_true", dest="list_checks",
+        help="list available checks and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for name in core.all_check_names():
+            print(name)
+        return 0
+    if args.write_env_docs:
+        os.makedirs(os.path.dirname(ENV_DOCS_PATH), exist_ok=True)
+        with open(ENV_DOCS_PATH, "w", encoding="utf-8") as f:
+            f.write(envreg.render_env_docs())
+        print(f"wrote {os.path.relpath(ENV_DOCS_PATH, REPO)}")
+        return 0
+
+    t0 = time.perf_counter()
+    roots = args.paths or core.DEFAULT_ROOTS
+    checks = (
+        [c.strip() for c in args.checks.split(",") if c.strip()]
+        if args.checks
+        else None
+    )
+    project = core.Project(roots)
+    findings = core.run_checks(project, checks)
+    baseline = core.load_baseline(args.baseline)
+    new, baselined, stale = core.split_baseline(findings, baseline)
+
+    if args.update_baseline:
+        core.save_baseline(findings, args.baseline)
+        print(
+            f"baseline updated: {len(findings)} finding(s) absorbed "
+            f"({os.path.relpath(args.baseline, REPO)})"
+        )
+        return 0
+
+    dt = time.perf_counter() - t0
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "new": [vars(f) for f in new],
+                    "baselined": [vars(f) for f in baselined],
+                    "stale_baseline": stale,
+                    "elapsed_s": round(dt, 3),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(
+                f"-- {len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (fixed or "
+                "moved — prune with --update-baseline):"
+            )
+            for e in stale:
+                print(
+                    f"   {e.get('path')}: [{e.get('check')}] "
+                    f"{e.get('message')}"
+                )
+        print(
+            f"-- lint: {len(new)} new, {len(baselined)} baselined, "
+            f"{len(stale)} stale baseline, "
+            f"{len(project.sources)} files in {dt:.2f}s"
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

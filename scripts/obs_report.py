@@ -47,11 +47,9 @@ def render(events, stale_after=None):
     the watchdog's peer-staleness default, CCSC_WATCHDOG_PEER_STALE_S).
     """
     if stale_after is None:
-        from ccsc_code_iccv2017_tpu.utils import watchdog as _wd
+        from ccsc_code_iccv2017_tpu.utils import env as _env
 
-        stale_after = _wd._env_f(
-            "CCSC_WATCHDOG_PEER_STALE_S", _wd.DEFAULT_PEER_STALE_S
-        )
+        stale_after = _env.env_float("CCSC_WATCHDOG_PEER_STALE_S")
     by = _by_type(events)
     lines = []
 

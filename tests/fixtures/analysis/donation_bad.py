@@ -1,0 +1,19 @@
+# known-bad fixture for the donation-safety check
+import jax
+
+
+def make_step(f):
+    return jax.jit(f, donate_argnums=(0,))
+
+
+def bad_driver(state, data):
+    step = make_step(lambda s, d: s)
+    new_state, aux = step(state, data)
+    total = state.sum()  # L12: read of the donated (dead) buffer
+    return new_state, total, aux
+
+
+def bad_direct(state, f):
+    g = jax.jit(f, donate_argnums=(0,))
+    out = g(state)
+    return out + state  # L19: read of the donated (dead) buffer

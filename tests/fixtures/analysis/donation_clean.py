@@ -1,0 +1,22 @@
+# known-clean fixture for the donation-safety check: the idiomatic
+# rebind-at-the-call pattern of the chunked drivers
+import jax
+
+
+def make_step(f):
+    return jax.jit(f, donate_argnums=(0,))
+
+
+def good_driver(state, data, n):
+    step = make_step(lambda s, d: (s, 0.0))
+    for _ in range(n):
+        # rebinding at the call statement: the old buffer dies inside
+        # the call and the name now holds the fresh output
+        state, aux = step(state, data)
+    return state, aux
+
+
+def good_rebind_then_read(state, data):
+    step = make_step(lambda s, d: (s, 0.0))
+    state, aux = step(state, data)
+    return state.sum()  # reads the NEW binding — fine

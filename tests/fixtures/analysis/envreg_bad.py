@@ -1,0 +1,20 @@
+# known-bad fixture for the env-registry check
+import os
+
+
+def raw_reads():
+    a = os.environ.get("CCSC_SOME_RAW_KNOB")  # L6: raw read
+    b = os.environ["CCSC_RAW_SUBSCRIPT"]  # L7: raw subscript read
+    return a, b
+
+
+def aliased_read():
+    import os as _os
+
+    return _os.environ.get("CCSC_ALIASED_RAW")  # L14: aliased raw read
+
+
+def undeclared_helper_read():
+    from ccsc_code_iccv2017_tpu.utils import env
+
+    return env.env_int("CCSC_NOT_IN_THE_REGISTRY")  # L20: undeclared

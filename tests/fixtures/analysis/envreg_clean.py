@@ -1,0 +1,25 @@
+# known-clean fixture for the env-registry check
+import os
+
+from ccsc_code_iccv2017_tpu.utils import env
+
+
+def declared_reads():
+    return (
+        env.env_float("CCSC_WATCHDOG_MIN_S"),
+        env.env_str("CCSC_COMPILE_CACHE"),
+        env.env_flag("CCSC_FAULT_CKPT_SAVE"),
+    )
+
+
+def writes_are_exempt(tmp):
+    # env WRITES are not knob reads: chaos tooling arms faults in a
+    # subprocess environment dict or os.environ freely
+    os.environ["CCSC_FAULT_NAN_IT"] = "3"
+    child_env = dict(os.environ)
+    child_env["CCSC_FAULT_SIGTERM_IT"] = "5"
+    return child_env
+
+
+def non_ccsc_reads_are_out_of_scope():
+    return os.environ.get("JAX_PLATFORMS")

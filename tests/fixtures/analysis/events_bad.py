@@ -1,0 +1,16 @@
+# known-bad fixture for the obs-schema check
+
+
+def emit_sites(run):
+    run.event("serve_request", bucket="4@64x64")  # L5: missing fields
+    run.event("totally_new_event", value=1)  # L6: undeclared event
+
+
+def writer_site(writer):
+    import time
+
+    writer.write({"t": time.time(), "type": "bogus_record", "x": 1})  # L11
+
+
+def consumer(events):
+    return [e for e in events if e.get("type") == "never_emitted"]  # L15

@@ -1,0 +1,28 @@
+# known-clean fixture for the obs-schema check: declared events with
+# their required fields, declared consumer reads
+
+
+def emit_sites(run):
+    run.event(
+        "serve_request",
+        replica_id=0,
+        bucket="4@64x64",
+        latency_ms=1.5,
+        iters=30,
+        psnr=None,  # optional extras are free
+    )
+    run.event("fault_fired", fault="nan", iteration=3)
+
+
+def passthrough(run, **fields):
+    # **kwargs sites are not statically checkable for fields — the
+    # event-name check still applies
+    run.event("recovery", **fields)
+
+
+def consumer(events):
+    stalls = [e for e in events if e.get("type") == "stall"]
+    by = {}
+    for e in events:
+        by.setdefault(e.get("type", "?"), []).append(e)
+    return stalls, by.get("serve_dispatch", [])

@@ -1,0 +1,6 @@
+# known-bad fixture: an app CLI that skips the validate boundary
+
+
+def main(argv=None):
+    print("apps may print")  # apps/ is exempt from bare-print
+    return 0

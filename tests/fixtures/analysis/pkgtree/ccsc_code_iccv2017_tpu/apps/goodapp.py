@@ -1,0 +1,8 @@
+# known-clean fixture: an app CLI that routes through utils.validate
+from ..utils import validate
+
+
+def main(argv=None):
+    data = [1.0]
+    validate.check_finite("data", data)
+    return 0

@@ -1,0 +1,17 @@
+# known-bad fixture: a serve module with a direct event bypassing
+# the replica_id-stamping _emit
+
+
+class Engine:
+    def __init__(self, run):
+        self._run = run
+        self._replica_id = 0
+
+    def _emit(self, type_, **fields):
+        self._run.event(type_, replica_id=self._replica_id, **fields)
+
+    def good(self):
+        self._emit("serve_drain", n=1)
+
+    def bad(self):
+        self._run.event("serve_error", replica_id=0, error="x")  # L17

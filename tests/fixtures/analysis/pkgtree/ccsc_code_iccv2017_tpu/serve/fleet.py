@@ -1,0 +1,15 @@
+# known-clean fixture: every event rides the stamping _emit
+
+
+class Fleet:
+    def __init__(self, run):
+        self._run = run
+
+    def _emit(self, type_, *, replica_id, **fields):
+        self._run.event(type_, replica_id=replica_id, **fields)
+
+    def beat(self, rep):
+        self._emit(
+            "fleet_heartbeat", replica_id=rep, state="live",
+            served=0, restarts=0,
+        )

@@ -1,0 +1,11 @@
+# known-bad fixture: bare print in library code
+
+
+def report(msg):
+    print(msg)  # L5: bare-print finding
+
+
+def quiet(msg):
+    from . import obs
+
+    obs.console(msg, tier="brief")

@@ -1,0 +1,34 @@
+# known-bad fixture for the jit-purity check (tests/test_analysis.py
+# pins the exact finding lines — keep line numbers stable)
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def hot_step(x):
+    t = time.time()  # L13: host clock read
+    v = float(x.sum().item())  # L14: host sync
+    if jnp.any(x > 0):  # L15: python branch on a traced value
+        x = x + v + t
+    print("step done")  # L17: host print
+    knob = os.environ.get("CCSC_HERM_INV")  # L18: env read
+    return helper(x), knob
+
+
+def helper(x):
+    # reachable from hot_step -> hazards flagged here too
+    return np.asarray(x)  # L24: numpy materialization
+
+
+def scanned_body(carry, _):
+    carry = carry + time.perf_counter()  # L28: host clock in scan body
+    return carry, None
+
+
+def run_scan(x):
+    out, _ = jax.lax.scan(scanned_body, x, None, length=3)
+    return out

@@ -1,0 +1,30 @@
+# known-clean fixture for the jit-purity check: idiomatic jitted code
+# plus host-side code that uses host facilities legitimately
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def hot_step(x):
+    if jnp.iscomplexobj(x):  # static dtype predicate: fine
+        x = jnp.abs(x)
+    return jnp.sum(x * 2.0)
+
+
+def host_driver(x):
+    # NOT reachable from a jit boundary — host clocks are fine here
+    t0 = time.perf_counter()
+    y = hot_step(x)
+    return y, time.perf_counter() - t0
+
+
+def suppressed(x):
+    t = time.time()  # ccsc: allow[jit-purity]
+    return x + t
+
+
+@jax.jit
+def uses_suppressed(x):
+    return suppressed(x)

@@ -1,0 +1,37 @@
+# known-bad fixture for the thread-safety check (exact lines pinned
+# by tests/test_analysis.py — keep line numbers stable)
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def takes_a_then_b():
+    with _lock_a:
+        with _lock_b:  # L11: order a -> b
+            pass
+
+
+def takes_b_then_a():
+    with _lock_b:
+        with _lock_a:  # L17: order b -> a (inversion)
+            pass
+
+
+class Worker:
+    def __init__(self, run):
+        self._lock = threading.Lock()
+        self._run = run
+
+    def emits_under_lock(self):
+        with self._lock:
+            self._run.event("serve_drain", replica_id=0, n=1)  # L27
+
+    def sleeps_under_lock(self):
+        import time
+
+        with self._lock:
+            time.sleep(0.5)  # L33: blocking under the mutex
+
+    def fire_and_forget(self):
+        threading.Thread(target=self.emits_under_lock).start()  # L36

@@ -1,0 +1,44 @@
+# known-clean fixture for the thread-safety check: consistent lock
+# order, emits outside the lock, every thread joined
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def ordered_one():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def ordered_two():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+class Worker:
+    def __init__(self, run):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._run = run
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+        self._helpers = []
+        t = threading.Thread(target=self._loop, daemon=True)
+        self._helpers.append(t)
+        t.start()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                self._cv.wait(timeout=0.1)  # releases the lock: fine
+                n = 1
+            # snapshot under the lock, emit OUTSIDE it
+            self._run.event("serve_drain", replica_id=0, n=n)
+
+    def close(self):
+        self._worker.join(timeout=1.0)
+        for t in self._helpers:
+            t.join(timeout=1.0)

@@ -1,0 +1,345 @@
+"""The static analysis suite (ccsc_code_iccv2017_tpu/analysis) —
+fixture-pinned analyzer behavior, baseline mechanics, and the tier-1
+gate that runs every check over the real tree.
+
+Layout:
+- per-check fixture tests: known-bad snippets under
+  tests/fixtures/analysis/ must fire with the EXACT check id and
+  line; known-clean snippets (idiomatic patterns from the real
+  drivers) must stay silent;
+- framework tests: inline suppressions, baseline multiset matching,
+  stale-baseline detection;
+- the gate: all checks over ccsc_code_iccv2017_tpu/ + scripts/ under
+  the reviewed baseline, in under 30 s; stale baseline entries fail;
+  docs/ENV_KNOBS.md must match the utils.env registry;
+- the scripts/lint.py CLI: exit codes, --json, --update-baseline.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.analysis import core, envreg  # noqa: E402
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+PKGTREE = os.path.join(FIX, "pkgtree")
+
+
+def run_on(path, check, repo_root=FIX):
+    project = core.Project([os.path.join(FIX, path)], repo_root=repo_root)
+    return core.run_checks(project, [check])
+
+
+def hits(findings, check):
+    return [(f.line, f.message) for f in findings if f.check == check]
+
+
+# ---------------------------------------------------------------- jit-purity
+
+
+def test_jit_purity_fires_on_known_bad():
+    fs = run_on("purity_bad.py", "jit-purity")
+    lines = sorted(f.line for f in fs)
+    assert all(f.check == "jit-purity" for f in fs)
+    # hot_step: clock, .item(), traced branch, print, env read;
+    # helper (reachable): np.asarray; scan body: clock
+    assert lines == [13, 14, 15, 17, 18, 24, 28], [
+        (f.line, f.message) for f in fs
+    ]
+    msgs = {f.line: f.message for f in fs}
+    assert "host clock read" in msgs[13]
+    assert ".item()" in msgs[14]
+    assert "branch on a traced value" in msgs[15]
+    assert "env read" in msgs[18]
+    assert "numpy materialization" in msgs[24]
+
+
+def test_jit_purity_silent_on_clean_and_suppressed():
+    assert run_on("purity_clean.py", "jit-purity") == []
+
+
+# ------------------------------------------------------------ donation-safety
+
+
+def test_donation_safety_fires_on_known_bad():
+    fs = run_on("donation_bad.py", "donation-safety")
+    assert sorted(f.line for f in fs) == [12, 19], [
+        (f.line, f.message) for f in fs
+    ]
+    assert all(f.check == "donation-safety" for f in fs)
+    assert all("donated" in f.message for f in fs)
+
+
+def test_donation_safety_silent_on_rebind_pattern():
+    assert run_on("donation_clean.py", "donation-safety") == []
+
+
+# -------------------------------------------------------------- thread-safety
+
+
+def test_thread_safety_fires_on_known_bad():
+    fs = run_on("threads_bad.py", "thread-safety")
+    lines = sorted(f.line for f in fs)
+    assert lines == [11, 28, 34, 37], [
+        (f.line, f.message) for f in fs
+    ]
+    msgs = {f.line: f.message for f in fs}
+    assert "inconsistent lock order" in msgs[11]
+    assert "obs emission" in msgs[28]
+    assert "time.sleep" in msgs[34]
+    assert "no join path" in msgs[37]
+
+
+def test_thread_safety_silent_on_clean():
+    assert run_on("threads_clean.py", "thread-safety") == []
+
+
+# ----------------------------------------------------------------- obs-schema
+
+
+def test_obs_schema_fires_on_known_bad():
+    fs = run_on("events_bad.py", "obs-schema")
+    lines = sorted(f.line for f in fs)
+    assert lines == [5, 6, 12, 16], [(f.line, f.message) for f in fs]
+    msgs = {f.line: f.message for f in fs}
+    assert "without required field" in msgs[5]
+    assert "undeclared obs event `totally_new_event`" in msgs[6]
+    assert "undeclared obs event `bogus_record`" in msgs[12]
+    assert "consumer reads undeclared" in msgs[16]
+
+
+def test_obs_schema_silent_on_clean():
+    assert run_on("events_clean.py", "obs-schema") == []
+
+
+# --------------------------------------------------------------- env-registry
+
+
+def test_env_registry_fires_on_known_bad():
+    fs = run_on("envreg_bad.py", "env-registry")
+    lines = sorted(f.line for f in fs)
+    assert lines == [6, 7, 14, 20], [(f.line, f.message) for f in fs]
+    msgs = {f.line: f.message for f in fs}
+    assert "raw env read of `CCSC_SOME_RAW_KNOB`" in msgs[6]
+    assert "raw env read of `CCSC_RAW_SUBSCRIPT`" in msgs[7]
+    assert "raw env read of `CCSC_ALIASED_RAW`" in msgs[14]
+    assert "not declared in its REGISTRY" in msgs[20]
+
+
+def test_env_registry_silent_on_clean():
+    assert run_on("envreg_clean.py", "env-registry") == []
+
+
+# ---------------------------------------------------- migrated conventions
+
+
+def _pkgtree_project():
+    return core.Project(
+        [os.path.join(PKGTREE, "ccsc_code_iccv2017_tpu")],
+        repo_root=PKGTREE,
+    )
+
+
+def test_bare_print_fires_in_library_not_apps():
+    fs = core.run_checks(_pkgtree_project(), ["bare-print"])
+    assert [(f.path, f.line) for f in fs] == [
+        ("ccsc_code_iccv2017_tpu/utils/helper.py", 5)
+    ]
+
+
+def test_validate_routing_flags_boundary_skipping_app():
+    fs = core.run_checks(_pkgtree_project(), ["validate-routing"])
+    assert [f.path for f in fs] == [
+        "ccsc_code_iccv2017_tpu/apps/badapp.py"
+    ]
+    assert "does not import utils.validate" in fs[0].message
+
+
+def test_emit_routing_flags_direct_event():
+    fs = core.run_checks(_pkgtree_project(), ["emit-routing"])
+    assert [(f.path, f.line) for f in fs] == [
+        ("ccsc_code_iccv2017_tpu/serve/engine.py", 17)
+    ]
+    assert "outside `_emit`" in fs[0].message
+
+
+# ------------------------------------------------------- framework mechanics
+
+
+def test_inline_suppression_applies_to_own_and_next_line(tmp_path):
+    p = tmp_path / "s.py"
+    p.write_text(
+        "import os\n"
+        "a = os.environ.get('CCSC_X')  # ccsc: allow[env-registry]\n"
+        "# ccsc: allow[env-registry]\n"
+        "b = os.environ.get('CCSC_Y')\n"
+        "c = os.environ.get('CCSC_Z')\n"
+    )
+    project = core.Project([str(p)], repo_root=str(tmp_path))
+    fs = core.run_checks(project, ["env-registry"])
+    assert [f.line for f in fs] == [5]
+
+
+def test_baseline_multiset_matching_and_stale():
+    f1 = core.Finding("c", "p.py", 3, "msg one")
+    f2 = core.Finding("c", "p.py", 9, "msg one")  # same key, new line
+    base = [{"check": "c", "path": "p.py", "message": "msg one"},
+            {"check": "c", "path": "p.py", "message": "gone"}]
+    new, matched, stale = core.split_baseline([f1, f2], base)
+    # one entry absorbs exactly one finding; the duplicate is NEW
+    assert len(matched) == 1 and len(new) == 1
+    assert stale == [base[1]]
+
+
+def test_parse_error_is_its_own_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    project = core.Project([str(p)], repo_root=str(tmp_path))
+    fs = core.run_checks(project, ["bare-print"])
+    assert [f.check for f in fs] == ["parse"]
+
+
+# ------------------------------------------------------------------ the gate
+
+
+_REAL_TREE_CACHE = {}
+
+
+def _real_tree():
+    # one parse+analyze pass shared by the gate tests (the suite runs
+    # in seconds, but there is no reason to pay it twice)
+    if "r" not in _REAL_TREE_CACHE:
+        project = core.Project(
+            core.DEFAULT_ROOTS, repo_root=core.REPO_ROOT
+        )
+        findings = core.run_checks(project)
+        baseline = core.load_baseline()
+        _REAL_TREE_CACHE["r"] = core.split_baseline(findings, baseline)
+    return _REAL_TREE_CACHE["r"]
+
+
+def test_full_tree_is_clean_under_baseline():
+    """THE tier-1 gate: every analyzer over the package + scripts/,
+    zero findings outside the reviewed baseline, in under 30 s."""
+    t0 = time.perf_counter()
+    new, _matched, _stale = _real_tree()
+    dt = time.perf_counter() - t0
+    assert not new, "new static-analysis findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert dt < 30.0, f"lint suite took {dt:.1f}s (budget 30s)"
+
+
+def test_baseline_entries_all_resolve():
+    """Stale-baseline guard: every reviewed baseline entry must still
+    match a real finding at a real location — fixed debt leaves the
+    baseline, it does not rot in it."""
+    _new, _matched, stale = _real_tree()
+    assert not stale, (
+        "stale baseline entries (fix was shipped — prune with "
+        "`python scripts/lint.py --update-baseline`):\n"
+        + "\n".join(json.dumps(e) for e in stale)
+    )
+
+
+def test_env_knobs_docs_are_fresh():
+    """docs/ENV_KNOBS.md is generated from utils.env.REGISTRY —
+    regenerate with `python scripts/lint.py --write-env-docs`."""
+    path = os.path.join(REPO, "docs", "ENV_KNOBS.md")
+    assert os.path.exists(path), (
+        "docs/ENV_KNOBS.md missing — run "
+        "`python scripts/lint.py --write-env-docs`"
+    )
+    with open(path, encoding="utf-8") as f:
+        on_disk = f.read()
+    assert on_disk == envreg.render_env_docs(), (
+        "docs/ENV_KNOBS.md is stale vs utils.env.REGISTRY — run "
+        "`python scripts/lint.py --write-env-docs`"
+    )
+
+
+def test_obs_schema_covers_every_emitted_event():
+    """Belt-and-braces inverse of the gate: the registry declares at
+    least the events the real tree emits (an event deleted from the
+    registry while still emitted must fail here via the gate; an
+    event never emitted anywhere AND never consumed is legal — e.g.
+    reserved types)."""
+    from ccsc_code_iccv2017_tpu.analysis.obs_schema import EVENT_SCHEMA
+
+    assert "run_meta" in EVENT_SCHEMA and "summary" in EVENT_SCHEMA
+    assert all(
+        isinstance(v, frozenset) for v in EVENT_SCHEMA.values()
+    )
+
+
+# ------------------------------------------------------------------- the CLI
+
+
+def _lint(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
+         *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        timeout=120,
+    )
+
+
+def test_cli_exits_nonzero_on_new_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\nx = os.environ.get('CCSC_CLI_RAW')\n"
+    )
+    r = _lint(str(bad), "--checks", "env-registry",
+              "--baseline", str(tmp_path / "none.json"))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "CCSC_CLI_RAW" in r.stdout
+
+
+def test_cli_json_and_update_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\nx = os.environ.get('CCSC_CLI_RAW2')\n"
+    )
+    base = tmp_path / "baseline.json"
+    r = _lint(str(bad), "--checks", "env-registry",
+              "--baseline", str(base), "--update-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    # absorbed: the same tree now exits 0, finding reported baselined
+    r2 = _lint(str(bad), "--checks", "env-registry",
+               "--baseline", str(base), "--json")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    out = json.loads(r2.stdout)
+    assert out["new"] == [] and len(out["baselined"]) == 1
+    # fix the file -> the baseline entry goes stale (reported, rc 0)
+    bad.write_text("x = 1\n")
+    r3 = _lint(str(bad), "--checks", "env-registry",
+               "--baseline", str(base), "--json")
+    assert r3.returncode == 0
+    out3 = json.loads(r3.stdout)
+    assert len(out3["stale_baseline"]) == 1
+
+
+def test_cli_runs_the_shipped_tree_clean():
+    """Acceptance: `python scripts/lint.py` exits 0 on the shipped
+    tree (all five analyzers + the three convention checks, package
+    + scripts, under the reviewed baseline)."""
+    r = _lint()
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_list_names_all_checks():
+    r = _lint("--list")
+    names = set(r.stdout.split())
+    assert {
+        "jit-purity", "donation-safety", "thread-safety",
+        "obs-schema", "env-registry", "bare-print", "emit-routing",
+        "validate-routing",
+    } <= names

@@ -22,7 +22,6 @@ Contracts under test (ISSUE 7):
   (runtime assertion here + source lint below).
 """
 import os
-import re
 import time
 from concurrent.futures import Future
 
@@ -437,6 +436,26 @@ def test_constructor_failure_stops_spawned_watchdogs(monkeypatch):
     while _dogs() > before and time.monotonic() < deadline:
         time.sleep(0.05)
     assert _dogs() == before
+
+
+def test_recycle_thread_is_joined_by_close():
+    """The rung-3 recycle walker is a TRACKED thread (lint:
+    thread-safety): close() joins it, so an interpreter exit can never
+    catch it alive mid-work — the PR 7 leaked-thread abort class."""
+    import threading
+
+    d = _bank()
+    fleet = _fleet(d, _cfg(), replicas=1)
+    try:
+        fleet._start_recycle()
+        assert fleet._recycle_thread is not None
+    finally:
+        fleet.close()
+    assert not fleet._recycle_thread.is_alive()
+    assert not any(
+        t.name == "ccsc-fleet-recycle" and t.is_alive()
+        for t in threading.enumerate()
+    )
 
 
 def test_malformed_hang_env_never_crashes(monkeypatch):
@@ -867,22 +886,18 @@ SERVE_DIR = os.path.join(
 
 
 def test_serve_fleet_events_route_through_emit():
-    """Source lint (same discipline as the bare-print lint): every obs
-    event the serving layer emits must ride through its module's
-    ``_emit`` — the single point that stamps ``replica_id`` — so
-    per-replica health attribution can never silently regress. A new
-    direct ``_run.event("serve_...")`` call fails here, not in a
-    3am incident review."""
-    for fname in ("engine.py", "fleet.py"):
-        with open(os.path.join(SERVE_DIR, fname)) as f:
-            src = f.read()
-        direct = re.findall(r"_run\.event\(", src)
-        assert len(direct) == 1, (
-            f"{fname}: every event must go through _emit (found "
-            f"{len(direct)} direct _run.event call sites)"
-        )
-        emit_def = re.search(
-            r"def _emit\(self[^)]*\)[^\n]*:\n(?:\s+.*\n)+?"
-            r"\s+self\._run\.event\([^)]*replica_id", src
-        )
-        assert emit_def, f"{fname}: _emit must stamp replica_id"
+    """Thin wrapper over the migrated `emit-routing` analysis check
+    (ccsc_code_iccv2017_tpu/analysis/conventions.py): every obs event
+    the serving layer emits must ride through its module's ``_emit``
+    — the single point that stamps ``replica_id`` — so per-replica
+    health attribution can never silently regress. A new direct
+    ``_run.event("serve_...")`` call fails here, not in a 3am
+    incident review. The full suite runs in tests/test_analysis.py."""
+    from ccsc_code_iccv2017_tpu.analysis import core
+
+    pkg_root = os.path.normpath(os.path.join(SERVE_DIR, ".."))
+    project = core.Project(
+        [pkg_root], repo_root=os.path.dirname(pkg_root)
+    )
+    offenders = core.run_checks(project, ["emit-routing"])
+    assert not offenders, "\n".join(f.render() for f in offenders)

@@ -19,7 +19,6 @@
 """
 import json
 import os
-import re
 
 import jax
 import jax.numpy as jnp
@@ -604,34 +603,21 @@ def test_bench_emit_carries_provenance(capsys):
 # lint: no bare prints in the library (apps/ CLI surface exempt)
 # ------------------------------------------------------------------
 
-_PRINT_RE = re.compile(r"(?<![\w.])print\(")
-# the one sanctioned emitter; everything else must route through it
-_PRINT_ALLOWLIST = {os.path.join("utils", "obs.py")}
-
 
 def test_no_bare_prints_in_package():
-    """Console output from library code must go through the utils.obs
-    tier (Run.console / obs.console) so the terminal and the event
-    stream cannot drift. apps/ is the CLI surface and may print."""
-    offenders = []
-    for dirpath, _, files in os.walk(PKG_ROOT):
-        rel_dir = os.path.relpath(dirpath, PKG_ROOT)
-        if rel_dir.split(os.sep)[0] == "apps":
-            continue
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            rel = os.path.normpath(os.path.join(rel_dir, name))
-            if rel in _PRINT_ALLOWLIST:
-                continue
-            with open(os.path.join(dirpath, name)) as f:
-                for lineno, line in enumerate(f, 1):
-                    code = line.split("#", 1)[0]
-                    if _PRINT_RE.search(code):
-                        offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    """Thin wrapper over the migrated `bare-print` analysis check
+    (ccsc_code_iccv2017_tpu/analysis/conventions.py) — kept here so a
+    regression still fails in the telemetry test file it historically
+    lived in. The full suite runs in tests/test_analysis.py."""
+    from ccsc_code_iccv2017_tpu.analysis import core
+
+    project = core.Project(
+        [PKG_ROOT], repo_root=os.path.dirname(PKG_ROOT)
+    )
+    offenders = core.run_checks(project, ["bare-print"])
     assert not offenders, (
         "bare print() in library code — use utils.obs console tiers "
-        "instead:\n" + "\n".join(offenders)
+        "instead:\n" + "\n".join(f.render() for f in offenders)
     )
 
 

@@ -8,7 +8,6 @@ lint asserting every app CLI actually routes its inputs through
 utils.validate.
 """
 import os
-import re
 
 import jax
 import jax.numpy as jnp
@@ -310,30 +309,22 @@ def test_inpaint_cli_corrupt_filters(tmp_path):
 APPS_DIR = os.path.join(
     os.path.dirname(__file__), "..", "ccsc_code_iccv2017_tpu", "apps"
 )
-# not CLI entry points: the package hook and the shared dispatch layer
-_LINT_EXEMPT = {"__init__.py", "_dispatch.py"}
-_VALIDATE_IMPORT_RE = re.compile(
-    r"from \.\.utils import validate|from \.\.utils\.validate import"
-)
-_VALIDATE_CALL_RE = re.compile(r"validate\.check_\w+\(")
-
-
 def test_every_app_cli_routes_inputs_through_validate():
-    """Pattern lint (same discipline as the bare-print lint,
-    tests/test_obs.py): every app CLI must import utils.validate and
-    call at least one of its check_* functions before dispatch — a new
-    app that skips the input boundary fails CI, not a user's run."""
-    offenders = []
-    for name in sorted(os.listdir(APPS_DIR)):
-        if not name.endswith(".py") or name in _LINT_EXEMPT:
-            continue
-        with open(os.path.join(APPS_DIR, name)) as f:
-            src = f.read()
-        if not _VALIDATE_IMPORT_RE.search(src):
-            offenders.append(f"{name}: no utils.validate import")
-        elif not _VALIDATE_CALL_RE.search(src):
-            offenders.append(f"{name}: imports validate but never calls it")
+    """Thin wrapper over the migrated `validate-routing` analysis
+    check (ccsc_code_iccv2017_tpu/analysis/conventions.py): every app
+    CLI must import utils.validate and call at least one of its
+    check_* functions before dispatch — a new app that skips the
+    input boundary fails CI, not a user's run. The full suite runs in
+    tests/test_analysis.py."""
+    from ccsc_code_iccv2017_tpu.analysis import core
+
+    pkg_root = os.path.normpath(os.path.join(APPS_DIR, ".."))
+    project = core.Project(
+        [pkg_root], repo_root=os.path.dirname(pkg_root)
+    )
+    offenders = core.run_checks(project, ["validate-routing"])
     assert not offenders, (
         "app CLIs must route their inputs through utils.validate "
-        "before dispatching:\n" + "\n".join(offenders)
+        "before dispatching:\n"
+        + "\n".join(f.render() for f in offenders)
     )
