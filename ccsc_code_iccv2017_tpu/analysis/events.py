@@ -23,6 +23,20 @@ Every name must be declared; every literal-kwarg emit site must carry
 the event's required fields. A producer or dashboard can then only
 drift by EDITING THE REGISTRY — a reviewed file — instead of by
 forgetting one of a dozen call sites.
+
+Span conventions (the request-tracing layer, utils.trace) are part of
+the contract:
+
+- REGISTRY side: every declared ``span_*`` event must require
+  ``trace_id``/``span``/``span_id``/``replica_id``; every declared
+  ``serve_*``/``fleet_*`` event must require ``replica_id``; a
+  declared ``span_end`` implies a declared ``span_start`` (an
+  end-only vocabulary can never reassemble).
+- EMIT side: a ``span_end`` emitted with a LITERAL ``span=`` name
+  must have a matching ``span_start`` emitter for that name somewhere
+  in the project — a hand-rolled end-only span is an orphan by
+  construction (the shared ``utils.trace`` helpers always emit pairs
+  and are exempt by virtue of passing the name through).
 """
 from __future__ import annotations
 
@@ -218,9 +232,124 @@ def _mentions_type_key(node: ast.AST) -> bool:
     return False
 
 
+_SPAN_REQUIRED = frozenset(
+    ("trace_id", "span", "span_id", "replica_id")
+)
+_SCHEMA_REL = "ccsc_code_iccv2017_tpu/analysis/obs_schema.py"
+
+
+def registry_findings(schema=None) -> List[Finding]:
+    """Internal-consistency checks of the registry itself (span and
+    replica conventions). Pinned to the registry file: the fix is
+    always an edit there."""
+    if schema is None:
+        schema = EVENT_SCHEMA
+    findings: List[Finding] = []
+
+    def _f(msg: str) -> None:
+        findings.append(
+            Finding(
+                check="obs-schema", path=_SCHEMA_REL, line=1,
+                message=msg,
+            )
+        )
+
+    for name in sorted(schema):
+        req = schema[name]
+        if name.startswith("span_"):
+            missing = sorted(_SPAN_REQUIRED - set(req))
+            if missing:
+                _f(
+                    f"span event `{name}` must require "
+                    f"{missing} — span records without the full "
+                    "trace context cannot reassemble"
+                )
+        elif name.startswith(("serve_", "fleet_")):
+            if "replica_id" not in req:
+                _f(
+                    f"serving event `{name}` must require "
+                    "`replica_id` — per-replica attribution is the "
+                    "fleet health contract"
+                )
+    if "span_end" in schema and "span_start" not in schema:
+        _f(
+            "`span_end` is declared without `span_start` — an "
+            "end-only span vocabulary can never reassemble"
+        )
+    return findings
+
+
+def _span_name_literals(src: Source) -> List[Tuple[int, str, str]]:
+    """(line, 'span_start'|'span_end', literal span name) for every
+    recognized emit call of a span event carrying a LITERAL ``span=``
+    kwarg."""
+    out: List[Tuple[int, str, str]] = []
+    if src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        recognized = (
+            (isinstance(fn, ast.Attribute) and fn.attr in _EMIT_ATTRS)
+            or (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "record"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "obs"
+            )
+            or (
+                isinstance(fn, ast.Name)
+                and fn.id in ("emit", "record")
+            )
+        )
+        if not recognized:
+            continue
+        first = node.args[0]
+        if not (
+            isinstance(first, ast.Constant)
+            and first.value in ("span_start", "span_end")
+        ):
+            continue
+        for kw in node.keywords:
+            if (
+                kw.arg == "span"
+                and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, str)
+            ):
+                out.append((node.lineno, first.value, kw.value.value))
+    return out
+
+
 @register("obs-schema")
 def check_obs_schema(project: Project) -> List[Finding]:
-    findings: List[Finding] = []
+    findings: List[Finding] = list(registry_findings())
+    # project-wide span pairing: collect every literal span name with
+    # a span_start emitter first, then flag end-only names
+    start_names: Set[str] = set()
+    end_sites: List[Tuple[Source, int, str]] = []
+    for src in project.sources:
+        for line, kind, name in _span_name_literals(src):
+            if kind == "span_start":
+                start_names.add(name)
+            else:
+                end_sites.append((src, line, name))
+    for src, line, name in end_sites:
+        if name not in start_names:
+            findings.append(
+                Finding(
+                    check="obs-schema",
+                    path=src.rel,
+                    line=line,
+                    message=(
+                        f"span_end for span `{name}` has no "
+                        "span_start emitter anywhere in the project "
+                        "— an end-only span is an orphan by "
+                        "construction (use utils.trace.emit_span "
+                        "for retrospective pairs)"
+                    ),
+                )
+            )
     for src in project.sources:
         if src.tree is None:
             continue

@@ -46,11 +46,23 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     "peer_stale": _s("host"),
     "fault_fired": _s("fault"),
     "degrade": _s("rung", "stage"),
+    # -- request-level tracing (utils.trace; span conventions are
+    # themselves lint-enforced: every span_* event requires
+    # trace_id/span/span_id/replica_id, and a span_end emitted for a
+    # literal span name needs a matching span_start emitter) --------
+    "span_start": _s("trace_id", "span", "span_id", "replica_id"),
+    "span_end": _s("trace_id", "span", "span_id", "replica_id",
+                   "status"),
+    # -- SLO layer (serve.slo) ---------------------------------------
+    "slo_breach": _s("replica_id", "phase", "quantile", "target_ms",
+                     "observed_ms"),
+    "slo_histogram": _s("replica_id", "phase", "counts", "n"),
+    "slo_profile": _s("replica_id", "trace_dir"),
     # -- serving engine (serve.engine; replica_id stamped by _emit) --
     "serve_warmup": _s("replica_id", "bucket", "warmup_s", "knobs"),
     "serve_ready": _s("replica_id", "n_buckets", "warmup_s"),
-    "serve_request": _s("replica_id", "bucket", "latency_ms",
-                        "iters"),
+    "serve_request": _s("replica_id", "trace_id", "bucket",
+                        "latency_ms", "iters"),
     "serve_dispatch": _s("replica_id", "bucket", "n", "slots",
                          "occupancy", "queue_depth", "dt_s"),
     "serve_error": _s("replica_id", "error"),
@@ -59,9 +71,12 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     "fleet_start": _s("replica_id", "replicas", "queue_ceiling"),
     "fleet_heartbeat": _s("replica_id", "state", "served",
                           "restarts"),
-    "fleet_request": _s("replica_id", "key", "latency_ms"),
+    "fleet_request": _s("replica_id", "trace_id", "key",
+                        "latency_ms"),
     "fleet_requeue": _s("replica_id", "reason", "n"),
-    "fleet_duplicate_suppressed": _s("replica_id", "key"),
+    "fleet_duplicate_suppressed": _s("replica_id", "trace_id",
+                                     "key"),
+    "fleet_metricsd": _s("replica_id", "port"),
     "fleet_replica_dead": _s("replica_id", "reason"),
     "fleet_replica_restart": _s("replica_id", "attempt"),
     "fleet_replica_ready": _s("replica_id", "generation"),

@@ -81,6 +81,28 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the startup AOT warmup (buckets compile lazily on "
         "first use)",
     )
+    p.add_argument(
+        "--slo-p50-ms", type=float, default=None,
+        help="declared p50 submit->result latency target in ms "
+        "(serve.slo): breaches emit slo_breach obs events live "
+        "(default: CCSC_SLO_P50_MS env, unset = no p50 SLO)",
+    )
+    p.add_argument(
+        "--slo-p99-ms", type=float, default=None,
+        help="declared p99 latency target in ms (see --slo-p50-ms)",
+    )
+    p.add_argument(
+        "--metricsd-port", type=int, default=None,
+        help="serve a stdlib Prometheus-text metrics endpoint on "
+        "127.0.0.1:PORT (serve.metricsd; 0 = an ephemeral port, "
+        "printed at startup). Default: CCSC_METRICSD_PORT env, "
+        "unset = no endpoint",
+    )
+    p.add_argument(
+        "--metricsd-snapshot", default=None,
+        help="also write the metrics exposition atomically to this "
+        "file every few seconds (scrape-less environments)",
+    )
     p.add_argument("--keep", type=float, default=0.5,
                    help="observed fraction of each request")
     p.add_argument("--lambda-residual", type=float, default=5.0)
@@ -145,6 +167,8 @@ def main(argv=None):
         compile_cache=args.compile_cache,
         aot_warmup=not args.no_aot,
         metrics_dir=args.metrics_dir,
+        slo_p50_ms=args.slo_p50_ms,
+        slo_p99_ms=args.slo_p99_ms,
         # engine-level resolution: the engine applies the tuned solve
         # arm ONCE at startup (largest bucket's key) so every bucket
         # program is built from the same resolved knobs
@@ -154,6 +178,7 @@ def main(argv=None):
     if args.replicas < 1:
         raise SystemExit("--replicas must be >= 1")
     fleet_mode = args.replicas > 1 or args.max_queue_depth is not None
+    metricsd = None  # standalone-engine endpoint (the fleet owns its own)
     t0 = time.perf_counter()
     if fleet_mode:
         engine = ServeFleet(
@@ -162,6 +187,10 @@ def main(argv=None):
                 replicas=args.replicas,
                 max_queue_depth=args.max_queue_depth,
                 metrics_dir=args.metrics_dir,
+                slo_p50_ms=args.slo_p50_ms,
+                slo_p99_ms=args.slo_p99_ms,
+                metricsd_port=args.metricsd_port,
+                metricsd_snapshot=args.metricsd_snapshot,
             ),
         )
         print(
@@ -175,6 +204,38 @@ def main(argv=None):
             f"engine ready in {time.perf_counter() - t0:.2f}s "
             f"({len(scfg.buckets)} bucket(s))"
         )
+        from ..serve.metricsd import MetricsD, resolve_endpoint
+
+        md_port, snap = resolve_endpoint(
+            args.metricsd_port, args.metricsd_snapshot,
+            args.metrics_dir,
+        )
+        if md_port is not None or snap is not None:
+            # best-effort, like the fleet's _start_metricsd: a bound
+            # or privileged port must not crash the CLI after the
+            # expensive engine warmup (and leak the unclosed engine).
+            # A snapshot without a port is snapshot-only mode.
+            try:
+                metricsd = MetricsD(
+                    engine.metrics, port=md_port, snapshot_path=snap,
+                ).start()
+            except Exception as e:
+                metricsd = None
+                print(
+                    f"metrics endpoint failed to start "
+                    f"({type(e).__name__}: {e}) — serving without it"
+                )
+            else:
+                print(
+                    "metrics "
+                    + (
+                        f"endpoint http://127.0.0.1:{metricsd.port}"
+                        "/metrics"
+                        if metricsd.port is not None
+                        else "snapshot-only"
+                    )
+                    + (f", snapshot {snap}" if snap else "")
+                )
 
     rng = np.random.default_rng(args.seed)
     n_skipped = 0
@@ -286,6 +347,8 @@ def main(argv=None):
         # the engine must always close (flushes queued dispatches,
         # writes the telemetry summary) — even when a mid-stream
         # failure aborts the submit loop
+        if metricsd is not None:
+            metricsd.stop()
         engine.close()
         try:
             _drain(block=True)  # results the close-flush completed

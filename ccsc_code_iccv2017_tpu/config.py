@@ -466,8 +466,32 @@ class ServeConfig:
     # per-replica health/traffic is readable from the stream. None
     # (a standalone engine) records replica 0.
     replica_id: Optional[int] = None
+    # Declared latency SLO targets on submit->result latency, in ms
+    # (serve.slo): when the engine's streaming log-bucketed histogram
+    # puts the quantile past its target, an `slo_breach` obs event
+    # fires — continuously, in-process, not at post-mortem report
+    # time. None = fall back to CCSC_SLO_P50_MS / CCSC_SLO_P99_MS
+    # env knobs (unset = no SLO declared; the histograms still
+    # stream as `slo_histogram` events either way).
+    slo_p50_ms: Optional[float] = None
+    slo_p99_ms: Optional[float] = None
+    # SLO check cadence in seconds (None = CCSC_SLO_CHECK_S, 5.0)
+    slo_check_s: Optional[float] = None
+    # One-shot xprof capture on SLO breach: when set (or via
+    # CCSC_SLO_XPROF_DIR), the FIRST breach arms a
+    # utils.profiling.xla_trace capture around the engine's next
+    # dispatch and records it as an `slo_profile` event — the "why
+    # was p99 slow" answer becomes a trace, not a guess. One capture
+    # per engine lifetime (captures are heavy; re-arm by restarting).
+    slo_profile_dir: Optional[str] = None
 
     def __post_init__(self):
+        for fname in ("slo_p50_ms", "slo_p99_ms", "slo_check_s"):
+            v = getattr(self, fname)
+            if v is not None and v <= 0:
+                raise ValueError(
+                    f"{fname} must be > 0 when set, got {v}"
+                )
         if self.tune not in ("off", "auto", "sweep"):
             raise ValueError(
                 f"tune must be 'off' | 'auto' | 'sweep', got "
@@ -599,8 +623,37 @@ class FleetConfig:
     # each replica engine's stream in a replica-NN/ subdir
     metrics_dir: Optional[str] = None
     verbose: str = "brief"
+    # Fleet-wide latency SLO targets (ms) on submit->result — the
+    # full queue-wait + ownership + solve + delivery path, which is
+    # what a client experiences (a replica's engine-local histogram
+    # cannot see fleet queueing or requeue retries). Checked by the
+    # monitor thread at CCSC_SLO_CHECK_S cadence; breaches emit
+    # `slo_breach` events with replica_id=None (fleet scope). None =
+    # the CCSC_SLO_* env knobs.
+    slo_p50_ms: Optional[float] = None
+    slo_p99_ms: Optional[float] = None
+    # Live metrics surface (serve.metricsd): port for the stdlib
+    # Prometheus-text HTTP endpoint (0 = an ephemeral port, reported
+    # in the fleet_metricsd event). None = CCSC_METRICSD_PORT env
+    # knob; unset = no endpoint.
+    metricsd_port: Optional[int] = None
+    # Atomic snapshot file of the same exposition for scrape-less
+    # environments. None = CCSC_METRICSD_SNAPSHOT env, else (when the
+    # endpoint is on and a metrics_dir exists) metrics_dir/
+    # metrics.prom.
+    metricsd_snapshot: Optional[str] = None
 
     def __post_init__(self):
+        for fname in ("slo_p50_ms", "slo_p99_ms"):
+            v = getattr(self, fname)
+            if v is not None and v <= 0:
+                raise ValueError(
+                    f"{fname} must be > 0 when set, got {v}"
+                )
+        if self.metricsd_port is not None and self.metricsd_port < 0:
+            raise ValueError(
+                f"metricsd_port must be >= 0, got {self.metricsd_port}"
+            )
         if self.replicas < 1:
             raise ValueError(
                 f"replicas must be >= 1, got {self.replicas}"

@@ -19,3 +19,5 @@ from .engine import (  # noqa: F401
     pick_bucket,
 )
 from .fleet import Overloaded, ServeFleet  # noqa: F401
+from .metricsd import MetricsD  # noqa: F401
+from .slo import Histogram, SloMonitor  # noqa: F401

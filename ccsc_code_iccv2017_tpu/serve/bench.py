@@ -168,7 +168,12 @@ def run_serve_workload() -> Dict:
     dispatches = [
         e for e in events if e.get("type") == "serve_dispatch"
     ]
-    lat = sorted(
+    # the single serving percentile implementation (serve.slo): the
+    # bench quotes the same log-bucketed histogram numbers as engine
+    # stats(), the slo_histogram events, and obs_report
+    from . import slo as _slo
+
+    lat_hist = _slo.Histogram.of(
         e["latency_ms"]
         for e in events
         if e.get("type") == "serve_request"
@@ -231,8 +236,12 @@ def run_serve_workload() -> Dict:
             eng_rps / loop_rps if loop_rps else 0.0, 3
         ),
         "warmup_s": round(t_warmup, 3),
-        "p50_ms": round(obs.percentile(lat, 0.50), 3) if lat else None,
-        "p99_ms": round(obs.percentile(lat, 0.99), 3) if lat else None,
+        "p50_ms": (
+            round(lat_hist.percentile(0.50), 3) if lat_hist.n else None
+        ),
+        "p99_ms": (
+            round(lat_hist.percentile(0.99), 3) if lat_hist.n else None
+        ),
         "mean_occupancy": round(occ, 4),
         "n_dispatches": len(dispatches),
         "recompiles_after_warmup": len(compiles_after_ready),

@@ -37,6 +37,7 @@ tolerance when bucket padding engaged.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import threading
@@ -47,6 +48,8 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 from ..config import ProblemGeom, ServeConfig, SolveConfig
+from ..utils import trace as trace_util
+from . import slo as _slo
 
 
 def enable_compile_cache(path: Optional[str]) -> Optional[str]:
@@ -113,6 +116,19 @@ class _Pending:
     spatial: Tuple[int, ...]
     future: Future
     t_submit: float
+    # request-level tracing (utils.trace): every request carries a
+    # trace_id; parent_span is the fleet's ownership span when this
+    # engine is a replica (the engine's dispatch/solve spans nest
+    # under it), None for a standalone engine (which then emits the
+    # root span itself)
+    trace_id: Optional[str] = None
+    parent_span: Optional[str] = None
+    # True only for a STANDALONE submit (no fleet above): the engine
+    # then owns the root span. A fleet request whose ownership span
+    # was claimed away mid-hang arrives with parent_span None but
+    # own_root False — its engine spans ride parentless rather than
+    # fabricating a second root for the same trace.
+    own_root: bool = False
 
 
 def _bucket_name(slots: int, spatial: Tuple[int, ...]) -> str:
@@ -228,6 +244,24 @@ class CodecEngine:
                 )
         if blur_psf is not None:
             validate.check_finite("blur_psf", blur_psf)
+
+        # SLO layer (serve.slo): streaming latency histograms per
+        # phase + declared targets, checked on the dispatch path; a
+        # breach may arm a one-shot xprof capture of the next dispatch
+        from ..utils import env as _envmod
+
+        self._slo = _slo.SloMonitor(
+            _slo.resolve_targets(
+                serve_cfg.slo_p50_ms, serve_cfg.slo_p99_ms
+            ),
+            check_s=serve_cfg.slo_check_s,
+        )
+        self._slo_profile_dir = (
+            serve_cfg.slo_profile_dir
+            or _envmod.env_str("CCSC_SLO_XPROF_DIR")
+        )
+        self._profile_armed: Optional[str] = None
+        self._profiled = False
 
         self.cache_dir = enable_compile_cache(serve_cfg.compile_cache)
         self._run = obs.start_run(
@@ -392,7 +426,6 @@ class CodecEngine:
         # ladder sheds micro-batch waiting without rebuilding engines
         self._max_wait_s = serve_cfg.max_wait_ms / 1e3
         self._last_it_rate = 0.0  # newest dispatch's measured it/s
-        self._latencies: List[float] = []
         self._n_dispatches = 0
         self._occupancy_sum = 0.0
         self._worker = threading.Thread(
@@ -408,6 +441,13 @@ class CodecEngine:
         regression)."""
         self._run.event(type_, replica_id=self._replica_id, **fields)
 
+    def _emit_span(self, type_: str, **fields) -> None:
+        """Span-event adapter for utils.trace: ``_emit`` stamps this
+        engine's replica_id itself, so the helper-supplied value is
+        dropped rather than collide."""
+        fields.pop("replica_id", None)
+        self._emit(type_, **fields)
+
     def bucket_for(self, spatial: Sequence[int]) -> Tuple[int, Tuple[int, ...]]:
         """Smallest configured bucket that fits ``spatial``."""
         return pick_bucket(self._buckets, spatial)
@@ -415,6 +455,7 @@ class CodecEngine:
     def submit(
         self, b, mask=None, smooth_init=None, x_orig=None,
         _validated: bool = False,
+        _trace: Optional[Tuple[str, Optional[str]]] = None,
     ) -> "Future[ServedResult]":
         """Enqueue one observation [*reduce, *spatial] (no batch axis);
         returns a Future resolving to :class:`ServedResult`. Only the
@@ -424,7 +465,11 @@ class CodecEngine:
         the identical checks (including the O(N) finiteness scans) at
         admission and canonicalizes the arrays to float32, so its
         dispatch — and every requeue retry — must not pay them again
-        per ownership."""
+        per ownership. ``_trace`` is the fleet's span context
+        ``(trace_id, parent_span_id)``: the engine's dispatch/solve
+        spans nest under the fleet's ownership span so a request's
+        story survives replica handoffs; a standalone submit gets a
+        fresh trace_id and the engine emits the root span itself."""
         from ..utils import validate
 
         if not _validated:
@@ -432,6 +477,12 @@ class CodecEngine:
                 b, self.geom, mask=mask, smooth_init=smooth_init,
                 x_orig=x_orig,
             )
+        if _trace is None:
+            trace_id, parent_span, own_root = (
+                trace_util.new_trace_id(), None, True,
+            )
+        else:
+            (trace_id, parent_span), own_root = _trace, False
         spatial = tuple(int(s) for s in b.shape[self.geom.ndim_reduce:])
         key = self.bucket_for(spatial)
         p = _Pending(
@@ -448,6 +499,9 @@ class CodecEngine:
             spatial=spatial,
             future=Future(),
             t_submit=time.perf_counter(),
+            trace_id=trace_id,
+            parent_span=parent_span,
+            own_root=own_root,
         )
         with self._cv:
             if self._closed or self._close_started:
@@ -554,11 +608,33 @@ class CodecEngine:
             if p.x_orig is not None:
                 xx[sl] = p.x_orig
 
-        out = self._compiled[key](
-            jnp.asarray(bb), jnp.asarray(mm), jnp.asarray(ss),
-            jnp.asarray(xx), self._plans[key],
-        )
-        iters = np.asarray(out.trace.num_iters)  # the fence
+        # an SLO breach may have armed a ONE-SHOT xprof capture of
+        # the next dispatch (serve.slo): wrap the solve + its fence so
+        # the trace answers "where did the slow p99 go" with per-op
+        # timelines instead of a guess
+        prof_dir, self._profile_armed = self._profile_armed, None
+        if prof_dir:
+            from ..utils import profiling
+
+            ctx = profiling.xla_trace(prof_dir)
+        else:
+            ctx = contextlib.nullcontext()
+        try:
+            with ctx:
+                out = self._compiled[key](
+                    jnp.asarray(bb), jnp.asarray(mm), jnp.asarray(ss),
+                    jnp.asarray(xx), self._plans[key],
+                )
+                iters = np.asarray(out.trace.num_iters)  # the fence
+        finally:
+            # the capture is consumed either way (one-shot) — record
+            # it even when the profiled solve RAISES: the trace on
+            # disk exists precisely for the runs where things went
+            # wrong, and only this event makes it discoverable
+            if prof_dir:
+                self._emit(
+                    "slo_profile", trace_dir=prof_dir, bucket=name
+                )
         dt = time.perf_counter() - t0
         t_done = time.perf_counter()
 
@@ -588,7 +664,44 @@ class CodecEngine:
             )
             wait_s = t0 - p.t_submit
             latency = t_done - p.t_submit
-            self._latencies.append(latency)
+            self._slo.observe("queue", wait_s * 1e3)
+            self._slo.observe("solve", dt * 1e3)
+            self._slo.observe("total", latency * 1e3)
+            # span emission is RETROSPECTIVE (start+end written
+            # together with measured times): a replica killed
+            # mid-dispatch can never leave an orphan span_start in
+            # its stream. Wall-clock times are reconstructed from the
+            # perf-counter measurements via one shared offset.
+            wall_off = time.time() - time.perf_counter()
+            if p.trace_id is not None:
+                parent = p.parent_span
+                if p.own_root:
+                    # standalone engine: the engine owns the root
+                    parent = trace_util.emit_span(
+                        self._emit_span,
+                        trace_id=p.trace_id,
+                        span=trace_util.ROOT_SPAN,
+                        t_start=wall_off + p.t_submit,
+                        t_end=wall_off + t_done,
+                    )
+                trace_util.emit_span(
+                    self._emit_span,
+                    trace_id=p.trace_id,
+                    span="engine_queue",
+                    parent_span=parent,
+                    t_start=wall_off + p.t_submit,
+                    t_end=wall_off + t0,
+                )
+                trace_util.emit_span(
+                    self._emit_span,
+                    trace_id=p.trace_id,
+                    span="solve",
+                    parent_span=parent,
+                    t_start=wall_off + t0,
+                    t_end=wall_off + t_done,
+                    bucket=name,
+                    iters=n_it,
+                )
             res = ServedResult(
                 recon=rec_i,
                 trace=tr,
@@ -601,6 +714,7 @@ class CodecEngine:
             p.future.set_result(res)
             self._emit(
                 "serve_request",
+                trace_id=p.trace_id,
                 bucket=name,
                 spatial=list(p.spatial),
                 wait_ms=round(wait_s * 1e3, 3),
@@ -641,24 +755,60 @@ class CodecEngine:
                 bound["requests_per_sec"], 3
             ),
         )
+        # continuous SLO check on the dispatch path (cadence-gated in
+        # the monitor): breaches + periodic histogram snapshots land
+        # in the stream, and the first breach arms the one-shot xprof
+        # capture of the NEXT dispatch
+        breaches, snaps = self._slo.tick()
+        for br in breaches:
+            self._emit("slo_breach", **br)
+        for sn in snaps:
+            self._emit("slo_histogram", **sn)
+        if breaches and self._slo_profile_dir and not self._profiled:
+            self._profiled = True
+            self._profile_armed = self._slo_profile_dir
 
     # ------------------------------------------------------------------
     def stats(self) -> Dict[str, float]:
-        """Request-latency percentiles + queue/bucket aggregates."""
-        from ..utils.obs import percentile
-
-        lat = sorted(self._latencies)
-        pct = lambda q: percentile(lat, q)
+        """Request-latency percentiles + queue/bucket aggregates.
+        Percentiles come from the streaming log-bucketed histogram
+        (serve.slo — O(1) memory on a long-lived engine; honest to
+        one bucket width), the same numbers the slo_histogram events
+        and the metricsd scrape quote."""
+        pct = lambda q: self._slo.percentile("total", q)
+        to_s = lambda v: None if v is None else v / 1e3
         return {
-            "n_requests": len(lat),
+            "n_requests": self._slo.n("total"),
             "n_dispatches": self._n_dispatches,
             "mean_occupancy": (
                 self._occupancy_sum / self._n_dispatches
                 if self._n_dispatches
                 else 0.0
             ),
-            "p50_latency_s": pct(0.50),
-            "p99_latency_s": pct(0.99),
+            "p50_latency_s": to_s(pct(0.50)),
+            "p99_latency_s": to_s(pct(0.99)),
+        }
+
+    def metrics(self) -> Dict[str, object]:
+        """Live counters/gauges/histograms in the shared shape
+        ``serve.metricsd.render_prometheus`` renders — the scrape
+        source of a standalone engine's metrics endpoint."""
+        with self._cv:
+            depth = self._n_pending
+        st = self.stats()
+        return {
+            "counters": {
+                "requests_total": st["n_requests"],
+                "dispatches_total": st["n_dispatches"],
+            },
+            "gauges": {
+                "queue_depth": depth,
+                "mean_occupancy": round(st["mean_occupancy"], 4),
+            },
+            "histograms": [
+                ("latency_ms", {"phase": sn["phase"]}, sn)
+                for sn in self._slo.raw_snapshots()
+            ],
         }
 
     @property
@@ -763,6 +913,14 @@ class CodecEngine:
                             tier="always",
                         )
             if run is not None and not run.closed:
+                # closing histogram flush: the stream always ends with
+                # one complete slo_histogram per phase, so a short
+                # run's percentiles are recomputable offline
+                slo_mon = getattr(self, "_slo", None)
+                if slo_mon is not None and run.active:
+                    _breaches, snaps = slo_mon.final()
+                    for sn in snaps:
+                        self._emit("slo_histogram", **sn)
                 st = self.stats()
                 run.close(
                     status="ok",

@@ -79,12 +79,18 @@ from typing import Deque, Dict, List, Optional
 import numpy as np
 
 from ..config import FleetConfig, ServeConfig, SolveConfig
+from ..utils import trace as trace_util
+from . import slo as _slo
 from .engine import CodecEngine, ServedResult, pick_bucket
 
 __all__ = ["ServeFleet", "Overloaded", "RUNGS"]
 
 # the overload ladder, least to most drastic
 RUNGS = ("normal", "shed_batching", "reject", "degrade")
+
+
+def _ms_to_s(v):
+    return None if v is None else v / 1e3
 
 
 class Overloaded(RuntimeError):
@@ -108,6 +114,29 @@ class _FleetRequest:
     future: Future
     t_submit: float
     attempts: int = 0  # ownerships so far (incremented at take)
+    # -- request-level tracing (utils.trace). The span context RIDES
+    # the request through every requeue, so one trace survives
+    # replica kills/restarts: root_span covers submit->resolution,
+    # queue_span the open queue episode (re-opened per requeue),
+    # attempt_span the open replica ownership. Ids are assigned under
+    # the fleet lock; emission always happens OUTSIDE it. trace_id
+    # None (white-box-constructed requests) disables span emission.
+    trace_id: Optional[str] = None
+    root_span: Optional[str] = None  # assigned once, never cleared
+    # claim-to-emit pointers: a path that will emit the span_end
+    # first CLAIMS the id under the lock (reads it and clears the
+    # field / sets root_done), so racing paths can never double-end
+    queue_span: Optional[str] = None
+    attempt_span: Optional[str] = None
+    # owning replica of the OPEN attempt span: a straggler that wins
+    # the delivery race after a requeue would otherwise end the NEW
+    # owner's span as its own ok (misattributing the solve in the
+    # reassembled story)
+    attempt_rep: Optional[int] = None
+    root_done: bool = False
+    t_wall: float = 0.0  # wall-clock submit time (span timestamps)
+    queue_t: float = 0.0  # wall-clock start of the open queue episode
+    attempt_t: float = 0.0  # wall-clock start of the open ownership
 
 
 class _Replica:
@@ -224,6 +253,16 @@ class ServeFleet:
             fleet_cfg.min_queue_depth,
             2 * self._total_slots * fleet_cfg.replicas,
         )
+        # fleet-wide SLO layer (serve.slo): submit->result latency —
+        # the path a CLIENT sees, including fleet queueing and requeue
+        # retries a replica-local histogram cannot observe. Checked on
+        # the monitor thread; breaches are fleet-scope events.
+        self._slo = _slo.SloMonitor(
+            _slo.resolve_targets(
+                fleet_cfg.slo_p50_ms, fleet_cfg.slo_p99_ms
+            )
+        )
+        self._metricsd = None
 
         self._run = obs.start_run(
             fleet_cfg.metrics_dir,
@@ -260,11 +299,17 @@ class ServeFleet:
                 daemon=True,
             )
             self._monitor.start()
+            self._start_metricsd()
         except BaseException:
             with self._close_lock:
                 self._close_started = True
             self._closing.set()
             self._close_done.set()
+            if self._metricsd is not None:
+                try:
+                    self._metricsd.stop()
+                except Exception:
+                    pass
             for rep in self._replicas:
                 if rep is not None:
                     try:
@@ -291,6 +336,81 @@ class ServeFleet:
         forgotten silently — the companion of the engine's ``_emit``,
         both lint-enforced."""
         self._run.event(type_, replica_id=replica_id, **fields)
+
+    # -- live metrics surface ------------------------------------------
+    def _start_metricsd(self) -> None:
+        """Start the stdlib Prometheus endpoint + snapshot file
+        (serve.metricsd) when FleetConfig.metricsd_port or
+        CCSC_METRICSD_PORT asks for one. Best-effort: a port conflict
+        must not take the fleet down with it."""
+        from . import metricsd as metricsd_mod
+
+        port, snap = metricsd_mod.resolve_endpoint(
+            self.fleet_cfg.metricsd_port,
+            self.fleet_cfg.metricsd_snapshot,
+            self.fleet_cfg.metrics_dir,
+        )
+        if port is None and snap is None:
+            return
+        try:
+            self._metricsd = metricsd_mod.MetricsD(
+                self.metrics, port=port, snapshot_path=snap
+            ).start()
+        except Exception as e:
+            self._metricsd = None
+            self._run.console(
+                f"fleet: metrics endpoint failed to start "
+                f"({type(e).__name__}: {e}) — serving without it",
+                tier="always",
+            )
+            return
+        self._emit(
+            "fleet_metricsd", replica_id=None,
+            port=self._metricsd.port, snapshot=snap,
+        )
+        self._run.console(
+            "fleet: metrics "
+            + (
+                f"endpoint http://127.0.0.1:{self._metricsd.port}"
+                "/metrics"
+                if self._metricsd.port is not None
+                else "snapshot-only"
+            )
+            + (f", snapshot {snap}" if snap else ""),
+            tier="brief",
+        )
+
+    def metrics(self) -> Dict[str, object]:
+        """Live counters/gauges/histograms in the shared shape
+        ``serve.metricsd.render_prometheus`` renders. The request
+        counter is ``_n_delivered`` — the never-truncating delivered
+        count, so a scrape equals the number of served requests
+        EXACTLY (the metricsd acceptance contract)."""
+        with self._cv:
+            counters = {
+                "requests_total": self._n_delivered,
+                "rejected_total": self._n_rejected,
+                "requeued_total": self._n_requeued,
+                "duplicates_suppressed_total": self._n_duplicates,
+                "failed_total": self._n_failed,
+            }
+            gauges = {
+                "queue_depth": len(self._queue),
+                "queue_ceiling": self._ceiling,
+                "live_replicas": sum(
+                    1 for r in self._replicas
+                    if r is not None and r.state == "live"
+                ),
+                "overload_rung": self._rung,
+            }
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": [
+                ("latency_ms", {"phase": sn["phase"]}, sn)
+                for sn in self._slo.raw_snapshots()
+            ],
+        }
 
     # -- replica lifecycle ---------------------------------------------
     def _engine_cfg(self, degraded: bool) -> SolveConfig:
@@ -533,6 +653,7 @@ class ServeFleet:
         done-callbacks synchronously, and a client callback that
         re-enters the fleet — e.g. resubmitting under a fresh key —
         would deadlock on the non-reentrant Condition."""
+        doom_spans: List = []  # (req, queue_span, root_owed)
         with self._cv:
             alive = any(
                 rid not in self._abandoned
@@ -545,7 +666,26 @@ class ServeFleet:
             for r in doomed:
                 self._index.pop(r.key, None)
                 self._remember(self._failed_keys, r.key)
+                if r.trace_id is not None:
+                    qs, r.queue_span = r.queue_span, None
+                    owed = not r.root_done
+                    r.root_done = True
+                    doom_spans.append((r, qs, owed))
             self._n_failed += len(doomed)
+        wall = time.time()
+        for r, qs, root_owed in doom_spans:
+            if qs:
+                trace_util.end_span(
+                    self._emit, trace_id=r.trace_id, span="queue",
+                    span_id=qs, parent_span=r.root_span,
+                    status="error", ts=wall,
+                )
+            if root_owed:
+                trace_util.end_span(
+                    self._emit, trace_id=r.trace_id,
+                    span=trace_util.ROOT_SPAN, span_id=r.root_span,
+                    status="error", ts=wall, t_start=r.t_wall,
+                )
         for r in doomed:
             try:
                 r.future.set_exception(
@@ -567,6 +707,14 @@ class ServeFleet:
 
     def _requeue_from(self, rep: _Replica, reason: str) -> None:
         failed: List[_FleetRequest] = []
+        wall = time.time()
+        # span actions, emitted after the lock: the casualty's open
+        # ownership span ends ('requeued' or 'error') and each
+        # requeued request re-opens a queue span — the trace carries
+        # the handoff, so a killed replica's request still reassembles
+        # as ONE story
+        requeue_spans: List = []  # (req, old_attempt_span, att_t, new_queue_span)
+        fail_spans: List = []  # (req, old_attempt_span, att_t, root_owed)
         with self._cv:
             lost = [
                 r for r in rep.assigned
@@ -580,8 +728,21 @@ class ServeFleet:
                     failed.append(r)
                     self._index.pop(r.key, None)
                     self._remember(self._failed_keys, r.key)
+                    if r.trace_id is not None:
+                        att, r.attempt_span = r.attempt_span, None
+                        owed = not r.root_done
+                        r.root_done = True
+                        fail_spans.append((r, att, r.attempt_t, owed))
                 else:
                     requeued.append(r)
+                    if r.trace_id is not None:
+                        att, r.attempt_span = r.attempt_span, None
+                        att_t = r.attempt_t
+                        r.queue_span = trace_util.new_span_id()
+                        r.queue_t = wall
+                        requeue_spans.append(
+                            (r, att, att_t, r.queue_span)
+                        )
             # hand-offs go to the FRONT of the queue: they already
             # waited their turn once
             for r in reversed(requeued):
@@ -589,6 +750,34 @@ class ServeFleet:
             self._n_requeued += len(requeued)
             self._n_failed += len(failed)
             self._cv.notify_all()
+        for r, att, att_t, new_q in requeue_spans:
+            if att:
+                trace_util.end_span(
+                    self._emit, trace_id=r.trace_id, span="attempt",
+                    span_id=att, parent_span=r.root_span,
+                    replica_id=rep.id, status="requeued", ts=wall,
+                    t_start=att_t, reason=reason,
+                )
+            trace_util.start_span(
+                self._emit, trace_id=r.trace_id, span="queue",
+                span_id=new_q, parent_span=r.root_span, ts=wall,
+                attempt=r.attempts + 1,
+            )
+        for r, att, att_t, root_owed in fail_spans:
+            if att:
+                trace_util.end_span(
+                    self._emit, trace_id=r.trace_id, span="attempt",
+                    span_id=att, parent_span=r.root_span,
+                    replica_id=rep.id, status="error", ts=wall,
+                    t_start=att_t, reason=reason,
+                )
+            if root_owed:
+                trace_util.end_span(
+                    self._emit, trace_id=r.trace_id,
+                    span=trace_util.ROOT_SPAN, span_id=r.root_span,
+                    status="error", ts=wall, t_start=r.t_wall,
+                    attempts=r.attempts,
+                )
         for r in failed:
             try:
                 r.future.set_exception(
@@ -616,6 +805,9 @@ class ServeFleet:
         self, rep: _Replica, req: _FleetRequest, res: ServedResult
     ) -> None:
         lat = time.perf_counter() - req.t_submit
+        att_span = None
+        att_t = 0.0
+        root_owed = False
         with self._cv:
             # a key whose future already carries an error (max_attempts
             # exhausted) is as spent as a served one: recording a late
@@ -631,6 +823,14 @@ class ServeFleet:
                 self._latencies.append(lat)
                 self._n_delivered += 1
                 rep.served += 1
+                # claim the open spans under the lock: a racing
+                # requeue/close path can then never double-end them
+                if req.trace_id is not None:
+                    att_span, req.attempt_span = req.attempt_span, None
+                    att_rep = req.attempt_rep
+                    att_t = req.attempt_t
+                    root_owed = not req.root_done
+                    req.root_done = True
             else:
                 self._n_duplicates += 1
             try:
@@ -643,22 +843,52 @@ class ServeFleet:
             # already failed) is dropped
             self._emit(
                 "fleet_duplicate_suppressed", replica_id=rep.id,
-                key=req.key, failed_key=req.key in self._failed_keys,
+                trace_id=req.trace_id, key=req.key,
+                failed_key=req.key in self._failed_keys,
             )
             return
+        self._slo.observe("total", lat * 1e3)
         try:
             req.future.set_result(res)
         except InvalidStateError:
             pass  # client cancelled between checks
+        wall = time.time()
+        if att_span is not None:
+            # the claimed span keeps ITS owner's identity: when a
+            # recovered straggler wins the delivery race after a
+            # requeue, the new owner's open span ends as
+            # 'superseded' (its solve was not the delivered result —
+            # the fleet_request record names the actual deliverer)
+            owner = rep.id if att_rep is None else att_rep
+            trace_util.end_span(
+                self._emit, trace_id=req.trace_id, span="attempt",
+                span_id=att_span, parent_span=req.root_span,
+                replica_id=owner,
+                status="ok" if owner == rep.id else "superseded",
+                ts=wall, t_start=att_t, bucket=res.bucket,
+            )
+        if root_owed:
+            trace_util.end_span(
+                self._emit, trace_id=req.trace_id,
+                span=trace_util.ROOT_SPAN, span_id=req.root_span,
+                status="ok", ts=wall, t_start=req.t_wall,
+                attempts=req.attempts,
+            )
         self._emit(
-            "fleet_request", replica_id=rep.id, key=req.key,
-            attempts=req.attempts, bucket=res.bucket,
+            "fleet_request", replica_id=rep.id, trace_id=req.trace_id,
+            key=req.key, attempts=req.attempts, bucket=res.bucket,
             latency_ms=round(lat * 1e3, 3),
             requeued=req.attempts > 1,
         )
 
     # -- the replica worker --------------------------------------------
     def _take(self, rep: _Replica) -> Optional[List[_FleetRequest]]:
+        # span actions collected under the lock, EMITTED after release
+        # (no stream I/O under the queue mutex): (queue_span_id, req,
+        # status, root_end_owed) for drops, (queue_span_id,
+        # attempt_span_id, req, attempt_no, t_queue) for takes
+        dropped: List = []
+        taken: List = []
         with self._cv:
             while True:
                 if rep.retired:
@@ -668,6 +898,8 @@ class ServeFleet:
                 if self._close_started:
                     return None
                 self._cv.wait(timeout=0.1)
+            # span clock AFTER the wait: this is when the take happens
+            wall = time.time()
             batch: List[_FleetRequest] = []
             while self._queue and len(batch) < self._take_cap:
                 req = self._queue.popleft()
@@ -679,18 +911,66 @@ class ServeFleet:
                     # resolved — solving it again would only be
                     # suppressed at delivery; drop it for free here
                     self._index.pop(req.key, None)
+                    if req.trace_id is not None and req.queue_span:
+                        qs, req.queue_span = req.queue_span, None
+                        dropped.append((qs, req, "dropped", False))
                     continue
                 if req.attempts == 0:
                     if not req.future.set_running_or_notify_cancel():
                         self._index.pop(req.key, None)
+                        if req.trace_id is not None and req.queue_span:
+                            qs, req.queue_span = req.queue_span, None
+                            owed = not req.root_done
+                            req.root_done = True
+                            dropped.append(
+                                (qs, req, "cancelled", owed)
+                            )
                         continue  # client cancelled while queued
                 elif req.future.cancelled():
                     self._index.pop(req.key, None)
+                    if req.trace_id is not None and req.queue_span:
+                        qs, req.queue_span = req.queue_span, None
+                        owed = not req.root_done
+                        req.root_done = True
+                        dropped.append((qs, req, "cancelled", owed))
                     continue
                 req.attempts += 1
+                if req.trace_id is not None:
+                    qs, req.queue_span = req.queue_span, None
+                    req.attempt_span = trace_util.new_span_id()
+                    req.attempt_rep = rep.id
+                    req.attempt_t = wall
+                    taken.append(
+                        (qs, req.attempt_span, req, req.attempts,
+                         req.queue_t)
+                    )
                 rep.assigned.append(req)
                 batch.append(req)
             rep.req_seq += len(batch)
+        for qs, req, status, root_owed in dropped:
+            trace_util.end_span(
+                self._emit, trace_id=req.trace_id, span="queue",
+                span_id=qs, parent_span=req.root_span, status=status,
+                ts=wall,
+            )
+            if root_owed:
+                trace_util.end_span(
+                    self._emit, trace_id=req.trace_id,
+                    span=trace_util.ROOT_SPAN, span_id=req.root_span,
+                    status=status, ts=wall, t_start=req.t_wall,
+                )
+        for qs, att, req, attempt_no, t_queue in taken:
+            if qs:
+                trace_util.end_span(
+                    self._emit, trace_id=req.trace_id, span="queue",
+                    span_id=qs, parent_span=req.root_span,
+                    status="ok", ts=wall, t_start=t_queue,
+                )
+            trace_util.start_span(
+                self._emit, trace_id=req.trace_id, span="attempt",
+                span_id=att, parent_span=req.root_span,
+                replica_id=rep.id, ts=wall, attempt=attempt_no,
+            )
         return batch
 
     def _process(self, rep: _Replica, batch: List[_FleetRequest]) -> None:
@@ -717,10 +997,18 @@ class ServeFleet:
             futs = [
                 # _validated: admission already ran the full request
                 # checks and canonicalized the arrays — no second
-                # finiteness scan per ownership
+                # finiteness scan per ownership. _trace threads the
+                # span context: the engine's dispatch/solve spans
+                # nest under THIS ownership span, in the replica's
+                # own stream
                 rep.engine.submit(
                     r.b, mask=r.mask, smooth_init=r.smooth_init,
                     x_orig=r.x_orig, _validated=True,
+                    _trace=(
+                        (r.trace_id, r.attempt_span)
+                        if r.trace_id is not None
+                        else None
+                    ),
                 )
                 for r in batch
             ]
@@ -804,6 +1092,15 @@ class ServeFleet:
                         queue_depth=depth,
                         restarts=self._restarts.get(rep.id, 0),
                     )
+            # fleet-wide SLO check (serve.slo): submit->result
+            # latency vs the declared targets, plus the periodic
+            # histogram snapshot any stream reader can recompute
+            # percentiles from
+            breaches, snaps = self._slo.tick(now)
+            for br in breaches:
+                self._emit("slo_breach", replica_id=None, **br)
+            for sn in snaps:
+                self._emit("slo_histogram", replica_id=None, **sn)
 
     def _update_ceiling(self, perfmodel, reps) -> None:
         live = [
@@ -1049,6 +1346,7 @@ class ServeFleet:
         mask32 = to32(mask)
         smooth32 = to32(smooth_init)
         xorig32 = to32(x_orig)
+        wall0 = time.time()  # span clock: admission starts here
         reject = None
         with self._cv:
             if self._close_started:
@@ -1119,9 +1417,22 @@ class ServeFleet:
                     x_orig=xorig32,
                     future=Future(),
                     t_submit=time.perf_counter(),
+                    # span ids are assigned UNDER the lock (cheap id
+                    # generation, no I/O) so a worker that takes this
+                    # request immediately already sees them; the
+                    # span events themselves are emitted after release
+                    trace_id=trace_util.new_trace_id(),
+                    root_span=trace_util.new_span_id(),
+                    queue_span=trace_util.new_span_id(),
+                    t_wall=wall0,
+                    queue_t=time.time(),
                 )
                 self._index[req.key] = req
                 self._queue.append(req)
+                # snapshot the span ids before releasing the lock: a
+                # worker can take the request (claiming queue_span)
+                # the instant we release
+                qspan = req.queue_span
                 self._cv.notify_all()
         if reject is not None:
             depth, ceiling, rung, retry = reject
@@ -1136,6 +1447,24 @@ class ServeFleet:
                 f"after ~{retry:.2f}s",
                 retry_after_s=retry,
             )
+        # trace spans for the accepted request (emitted OUTSIDE the
+        # lock; a worker may already have taken — even delivered — it,
+        # which is fine: spans match by id, not by stream order)
+        trace_util.start_span(
+            self._emit, trace_id=req.trace_id,
+            span=trace_util.ROOT_SPAN, span_id=req.root_span,
+            ts=req.t_wall, key=req.key,
+        )
+        trace_util.emit_span(
+            self._emit, trace_id=req.trace_id, span="admission",
+            parent_span=req.root_span, t_start=req.t_wall,
+            t_end=req.queue_t,
+        )
+        trace_util.start_span(
+            self._emit, trace_id=req.trace_id, span="queue",
+            span_id=qspan, parent_span=req.root_span,
+            ts=req.queue_t, attempt=1,
+        )
         return req.future
 
     def reconstruct(
@@ -1156,11 +1485,12 @@ class ServeFleet:
 
     def stats(self) -> Dict[str, object]:
         """Fleet aggregates: delivery counts, latency percentiles,
-        admission/requeue/duplicate counters, per-replica liveness."""
-        from ..utils.obs import percentile
-
+        admission/requeue/duplicate counters, per-replica liveness.
+        Percentiles come from the fleet-wide streaming histogram
+        (serve.slo) — the same numbers the slo_histogram events and
+        the metricsd scrape quote; ``_latencies`` keeps the exact
+        newest-window sample for cross-checks and debugging."""
         with self._cv:
-            lat = sorted(self._latencies)
             reps = [
                 None if r is None else {
                     "replica": r.id,
@@ -1182,8 +1512,12 @@ class ServeFleet:
             "queue_depth": depth,
             "queue_ceiling": self._ceiling,
             "overload_rung": RUNGS[self._rung],
-            "p50_latency_s": percentile(lat, 0.50),
-            "p99_latency_s": percentile(lat, 0.99),
+            "p50_latency_s": _ms_to_s(
+                self._slo.percentile("total", 0.50)
+            ),
+            "p99_latency_s": _ms_to_s(
+                self._slo.percentile("total", 0.99)
+            ),
             "replicas": reps,
         }
 
@@ -1271,6 +1605,7 @@ class ServeFleet:
             for row in final_rows:
                 self._emit("fleet_heartbeat", **row)
             undelivered: List[_FleetRequest] = []
+            shutdown_spans: List = []  # (req, queue_span, attempt_span, root_owed)
             with self._cv:
                 undelivered.extend(self._queue)
                 self._queue.clear()
@@ -1284,7 +1619,39 @@ class ServeFleet:
                     rep.assigned = []
                 for r in undelivered:
                     self._index.pop(r.key, None)
+                    if r.trace_id is not None:
+                        qs, r.queue_span = r.queue_span, None
+                        att, r.attempt_span = r.attempt_span, None
+                        owed = not r.root_done
+                        r.root_done = True
+                        if qs or att or owed:
+                            shutdown_spans.append((r, qs, att, owed))
                 self._n_failed += len(undelivered)
+            # a shut-down fleet still closes every story: whatever
+            # span the request had open ends 'shutdown', so the trace
+            # reassembles gap-free even for requests the close failed
+            wall = time.time()
+            for r, qs, att, root_owed in shutdown_spans:
+                if qs:
+                    trace_util.end_span(
+                        self._emit, trace_id=r.trace_id, span="queue",
+                        span_id=qs, parent_span=r.root_span,
+                        status="shutdown", ts=wall,
+                    )
+                if att:
+                    trace_util.end_span(
+                        self._emit, trace_id=r.trace_id,
+                        span="attempt", span_id=att,
+                        parent_span=r.root_span, status="shutdown",
+                        ts=wall, t_start=r.attempt_t,
+                    )
+                if root_owed:
+                    trace_util.end_span(
+                        self._emit, trace_id=r.trace_id,
+                        span=trace_util.ROOT_SPAN,
+                        span_id=r.root_span, status="shutdown",
+                        ts=wall, t_start=r.t_wall,
+                    )
             for r in undelivered:
                 try:
                     r.future.set_exception(
@@ -1295,6 +1662,21 @@ class ServeFleet:
                     )
                 except InvalidStateError:
                     pass
+            if self._metricsd is not None:
+                # final snapshot rides stop(); the endpoint dies with
+                # the fleet it describes
+                try:
+                    self._metricsd.stop()
+                except Exception:
+                    pass
+            if not self._run.closed:
+                # closing histogram flush: the stream always ends
+                # with one complete fleet-wide slo_histogram per
+                # phase (offline percentile recomputation — the
+                # acceptance contract of the SLO layer)
+                _breaches, snaps = self._slo.final()
+                for sn in snaps:
+                    self._emit("slo_histogram", replica_id=None, **sn)
             if not self._run.closed:
                 st = self.stats()
                 self._run.close(

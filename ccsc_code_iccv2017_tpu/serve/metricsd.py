@@ -1,0 +1,376 @@
+"""Live metrics surface: a stdlib-only Prometheus-text HTTP endpoint
+plus an atomic snapshot file, fed by a running fleet or engine.
+
+The obs streams are the system of record, but they answer "what
+happened" after a reader parses JSONL; a serving fleet also needs
+"what is true RIGHT NOW" answerable by anything that can speak HTTP —
+a Prometheus scraper, ``curl`` in an incident, a k8s liveness probe.
+This module is that surface, with zero dependencies beyond the
+standard library:
+
+- :class:`MetricsD` serves ``GET /metrics`` in Prometheus text
+  exposition format (counters, gauges, and the ``serve.slo``
+  latency histograms as cumulative ``_bucket{le=...}`` series) from
+  a ``source`` — any callable returning the metrics dict shape of
+  ``ServeFleet.metrics()`` / ``CodecEngine.metrics()``, or a metrics
+  DIR, in which case a :class:`StreamMetrics` tails the event stream
+  incrementally (``utils.obs.EventTail`` — each scrape costs O(new
+  records), never a full re-read) so the endpoint can run beside a
+  process it does not share memory with.
+- The same text is written ATOMICALLY (tmp + rename) to a snapshot
+  file every ``CCSC_METRICSD_INTERVAL_S`` seconds for scrape-less
+  environments: a sidecar, ``cat``, or a log shipper reads a
+  complete, never-torn exposition.
+
+Wiring: ``FleetConfig.metricsd_port`` (or ``CCSC_METRICSD_PORT``;
+0 = an ephemeral port, reported in the ``fleet_metricsd`` event and
+``MetricsD.port``) starts one inside :class:`~.fleet.ServeFleet`;
+``apps/serve.py --metricsd-port`` wires a standalone engine. The
+server binds 127.0.0.1 — exposure beyond the host is a deployment
+decision, not a default.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+from ..utils import env as _env
+
+__all__ = [
+    "MetricsD",
+    "StreamMetrics",
+    "render_prometheus",
+    "resolve_endpoint",
+]
+
+_PREFIX = "ccsc"
+
+
+def resolve_endpoint(
+    port: Optional[int],
+    snapshot: Optional[str],
+    metrics_dir: Optional[str],
+) -> Tuple[Optional[int], Optional[str]]:
+    """The ONE resolution chain for the metrics surface, shared by
+    the fleet and the standalone-engine CLI so the two can never
+    diverge: port = explicit > CCSC_METRICSD_PORT > off (None);
+    snapshot = explicit > CCSC_METRICSD_SNAPSHOT >
+    metrics_dir/metrics.prom (only when the endpoint is on — a run
+    that asked for nothing gets no surprise file). A snapshot
+    REQUEST without a port is honored: scrape-less environments are
+    the snapshot's whole point, so (None, path) means snapshot-only
+    mode (:class:`MetricsD` skips the HTTP server)."""
+    if port is None:
+        port = _env.env_int("CCSC_METRICSD_PORT")
+    snap = snapshot or _env.env_str("CCSC_METRICSD_SNAPSHOT")
+    if port is None:
+        return None, snap
+    if snap is None and metrics_dir:
+        snap = os.path.join(metrics_dir, "metrics.prom")
+    return int(port), snap
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "0"
+    return repr(round(f, 6))
+
+
+def _labels(labels: Optional[Dict[str, object]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{v}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def render_prometheus(metrics: Dict, prefix: str = _PREFIX) -> str:
+    """Render the shared metrics-dict shape:
+
+    ``{"counters": {name: value}, "gauges": {name: value},
+    "histograms": [(name, labels_dict, slo-snapshot-dict), ...]}``
+
+    as Prometheus text exposition (one stable, sorted rendering — the
+    HTTP endpoint and the snapshot file emit identical bytes for
+    identical state)."""
+    lines: List[str] = []
+    for kind in ("counters", "gauges"):
+        ptype = "counter" if kind == "counters" else "gauge"
+        for name in sorted(metrics.get(kind) or {}):
+            full = f"{prefix}_{name}"
+            lines.append(f"# TYPE {full} {ptype}")
+            lines.append(f"{full} {_fmt(metrics[kind][name])}")
+    seen_types = set()
+    for name, labels, snap in metrics.get("histograms") or ():
+        full = f"{prefix}_{name}"
+        if full not in seen_types:
+            seen_types.add(full)
+            lines.append(f"# TYPE {full} histogram")
+        bounds = snap.get("bounds_ms") or []
+        counts = snap.get("counts") or []
+        cum = 0
+        for i, b in enumerate(bounds):
+            cum += counts[i] if i < len(counts) else 0
+            lab = dict(labels or {})
+            lab["le"] = _fmt(float(b))
+            lines.append(f"{full}_bucket{_labels(lab)} {cum}")
+        if len(counts) > len(bounds):
+            cum += counts[len(bounds)]
+        lab = dict(labels or {})
+        lab["le"] = "+Inf"
+        lines.append(f"{full}_bucket{_labels(lab)} {cum}")
+        lines.append(
+            f"{full}_sum{_labels(labels)} {_fmt(snap.get('sum_ms', 0.0))}"
+        )
+        lines.append(
+            f"{full}_count{_labels(labels)} {snap.get('n', cum)}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+class StreamMetrics:
+    """Metrics source derived from an obs event stream on disk.
+
+    Tails the stream INCREMENTALLY (``utils.obs.EventTail``,
+    recursive so a fleet dir's ``replica-NN/`` streams merge): each
+    call consumes only appended records, folds them into running
+    counters, and keeps the newest ``slo_histogram`` snapshot per
+    (phase, replica) — so a scrape of a day-old stream costs what the
+    last few seconds wrote, not the whole file."""
+
+    def __init__(self, metrics_dir: str):
+        from ..utils import obs
+
+        self._dir = metrics_dir
+        self._tail = obs.EventTail(metrics_dir, recursive=True)
+        # fleet mode is LATCHED (structurally from replica-NN subdirs,
+        # or from the first fleet_request): a Prometheus counter must
+        # never decrease, and flipping from the engine-side count to
+        # the (briefly lower) fleet-side delivered count mid-stream
+        # would read as a process restart to rate()/increase()
+        self._fleet_mode = self._is_fleet_dir()
+        self._counters: Dict[str, int] = {
+            "dispatches_total": 0,
+            "requeued_total": 0,
+            "rejected_total": 0,
+            "duplicates_suppressed_total": 0,
+            "slo_breaches_total": 0,
+        }
+        # a fleet dir carries BOTH record kinds for one delivery —
+        # fleet_request at the top level, serve_request in the
+        # replica's stream — so the two are counted separately and
+        # the mode is picked at READ time: any fleet_request ever
+        # seen means the fleet count is the request count (counting
+        # serve_request until the first fleet_request arrives would
+        # double-count every early delivery)
+        self._n_fleet_req = 0
+        self._n_serve_req = 0
+        self._hists: Dict[Tuple[str, object], Dict] = {}
+        self._lock = threading.Lock()
+
+    def _is_fleet_dir(self) -> bool:
+        try:
+            return any(
+                name.startswith("replica-")
+                and os.path.isdir(os.path.join(self._dir, name))
+                for name in os.listdir(self._dir)
+            )
+        except OSError:
+            return False
+
+    def __call__(self) -> Dict:
+        with self._lock:
+            if not self._fleet_mode:
+                self._fleet_mode = self._is_fleet_dir()
+            for rec in self._tail.poll():
+                kind = rec.get("type")
+                if kind == "fleet_request":
+                    self._fleet_mode = True
+                    self._n_fleet_req += 1
+                elif kind == "serve_request":
+                    self._n_serve_req += 1
+                elif kind == "serve_dispatch":
+                    self._counters["dispatches_total"] += 1
+                elif kind == "fleet_requeue":
+                    self._counters["requeued_total"] += int(
+                        rec.get("n", 0)
+                    )
+                elif kind == "fleet_admission_reject":
+                    self._counters["rejected_total"] += 1
+                elif kind == "fleet_duplicate_suppressed":
+                    self._counters["duplicates_suppressed_total"] += 1
+                elif kind == "slo_breach":
+                    self._counters["slo_breaches_total"] += 1
+                elif kind == "slo_histogram":
+                    key = (
+                        str(rec.get("phase", "total")),
+                        rec.get("replica_id"),
+                    )
+                    self._hists[key] = rec
+            hists = []
+            for (phase, rid), rec in sorted(
+                self._hists.items(), key=lambda kv: str(kv[0])
+            ):
+                labels = {"phase": phase}
+                if rid is not None:
+                    labels["replica"] = rid
+                hists.append(("latency_ms", labels, rec))
+            counters = dict(self._counters)
+            counters["requests_total"] = (
+                self._n_fleet_req
+                if self._fleet_mode
+                else self._n_serve_req
+            )
+            return {
+                "counters": counters,
+                "gauges": {},
+                "histograms": hists,
+            }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ccsc-metricsd"
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        try:
+            body = self.server._render().encode("utf-8")  # type: ignore[attr-defined]
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except Exception:  # pragma: no cover - a broken scrape must
+            # never take the server thread down
+            try:
+                self.send_error(500)
+            except Exception:
+                pass
+
+    def log_message(self, *args):  # silence per-scrape stderr noise
+        pass
+
+
+class MetricsD:
+    """The live surface: HTTP endpoint + atomic snapshot file.
+
+    ``source`` is a callable returning the shared metrics-dict shape
+    (``ServeFleet.metrics`` / ``CodecEngine.metrics``) or a metrics
+    dir (wrapped in :class:`StreamMetrics`). ``port`` 0 binds an
+    ephemeral port; the bound port is ``self.port`` after
+    ``start()``; ``port=None`` is snapshot-only mode (no HTTP server
+    — a scrape-less environment that only wants the atomic file).
+    Both background threads are tracked and joined by ``stop()`` — a
+    leaked daemon thread at interpreter exit is the failure class the
+    thread-safety lint exists for."""
+
+    def __init__(
+        self,
+        source: Union[Callable[[], Dict], str],
+        port: Optional[int] = 0,
+        host: str = "127.0.0.1",
+        snapshot_path: Optional[str] = None,
+        interval_s: Optional[float] = None,
+    ):
+        if isinstance(source, str):
+            source = StreamMetrics(source)
+        self._source = source
+        self._host = host
+        self._req_port = None if port is None else int(port)
+        self.snapshot_path = snapshot_path
+        if interval_s is None:
+            interval_s = _env.env_float("CCSC_METRICSD_INTERVAL_S")
+        self.interval_s = max(0.05, float(interval_s))
+        self.port: Optional[int] = None
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._snap_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def render(self) -> str:
+        return render_prometheus(self._source())
+
+    def write_snapshot(self) -> None:
+        """One atomic exposition write (tmp + rename): a reader can
+        never observe a torn file."""
+        if not self.snapshot_path:
+            return
+        body = self.render()
+        d = os.path.dirname(os.path.abspath(self.snapshot_path))
+        os.makedirs(d, exist_ok=True)
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(body)
+        os.replace(tmp, self.snapshot_path)
+
+    def _snap_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.write_snapshot()
+            except Exception:  # pragma: no cover - disk-full etc.;
+                pass  # the endpoint stays up regardless
+
+    def start(self) -> "MetricsD":
+        if self._req_port is not None:
+            srv = ThreadingHTTPServer(
+                (self._host, self._req_port), _Handler
+            )
+            srv.daemon_threads = True
+            srv._render = self.render  # type: ignore[attr-defined]
+            self._server = srv
+            self.port = srv.server_address[1]
+            self._server_thread = threading.Thread(
+                target=srv.serve_forever, name="ccsc-metricsd",
+                daemon=True,
+            )
+            self._server_thread.start()
+        if self.snapshot_path:
+            try:
+                self.write_snapshot()  # a snapshot exists from t=0
+                self._snap_thread = threading.Thread(
+                    target=self._snap_loop,
+                    name="ccsc-metricsd-snap",
+                    daemon=True,
+                )
+                self._snap_thread.start()
+            except BaseException:
+                # callers treat a start() failure as "no surface" and
+                # drop the instance — the server started above must
+                # not outlive that decision as an ownerless daemon
+                # squatting the port
+                self.stop()
+                raise
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.shutdown()
+                self._server.server_close()
+            except Exception:  # pragma: no cover
+                pass
+        if self._server_thread is not None:
+            self._server_thread.join(timeout=5.0)
+        if self._snap_thread is not None:
+            self._snap_thread.join(timeout=5.0)
+            try:
+                self.write_snapshot()  # final state on disk
+            except Exception:  # pragma: no cover
+                pass
+
+    def __enter__(self) -> "MetricsD":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

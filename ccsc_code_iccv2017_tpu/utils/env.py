@@ -100,6 +100,27 @@ REGISTRY: Dict[str, Knob] = _knobs(
     ("CCSC_COMPILE_CACHE", "path", None, "serve.engine, tune.store",
      "persistent XLA compilation cache dir (warm restarts skip "
      "backend compiles)"),
+    # -- serving SLOs / live metrics (serve.slo, serve.metricsd) -----
+    ("CCSC_SLO_P50_MS", "float", None, "serve.slo",
+     "declared p50 submit->result latency target in ms (fallback of "
+     "ServeConfig/FleetConfig.slo_p50_ms; unset = no p50 SLO)"),
+    ("CCSC_SLO_P99_MS", "float", None, "serve.slo",
+     "declared p99 submit->result latency target in ms (fallback of "
+     "ServeConfig/FleetConfig.slo_p99_ms; unset = no p99 SLO)"),
+    ("CCSC_SLO_CHECK_S", "float", 5.0, "serve.slo",
+     "SLO check + slo_histogram snapshot cadence in seconds"),
+    ("CCSC_SLO_XPROF_DIR", "path", None, "serve.slo, serve.engine",
+     "arm a one-shot xprof capture (utils.profiling.xla_trace) "
+     "around the next dispatch after an SLO breach, written here "
+     "(fallback of ServeConfig.slo_profile_dir; unset = off)"),
+    ("CCSC_METRICSD_PORT", "int", None, "serve.metricsd",
+     "port of the Prometheus-text metrics endpoint (0 = ephemeral; "
+     "fallback of FleetConfig.metricsd_port; unset = no endpoint)"),
+    ("CCSC_METRICSD_SNAPSHOT", "path", None, "serve.metricsd",
+     "atomic Prometheus-text snapshot file for scrape-less "
+     "environments (fallback of FleetConfig.metricsd_snapshot)"),
+    ("CCSC_METRICSD_INTERVAL_S", "float", 5.0, "serve.metricsd",
+     "snapshot-file rewrite cadence in seconds"),
     # -- autotuning ---------------------------------------------------
     ("CCSC_TUNE_STORE", "path", None, "tune.store",
      "tuned-knob store path (else $CCSC_COMPILE_CACHE/"

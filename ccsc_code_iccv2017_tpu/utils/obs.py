@@ -66,15 +66,22 @@ _TIERS = {"always": 0, "brief": 1, "all": 2}
 _VERBOSE_ADMITS = {"none": 0, "brief": 1, "all": 2}
 
 
-def percentile(sorted_vals: List[float], q: float) -> Optional[float]:
-    """Nearest-rank percentile of an ASCENDING-sorted list (None when
-    empty). One definition for the serving latency stats — the engine's
-    ``stats()``, the serve bench record, and obs_report's SERVING
-    section must quote the same statistic."""
-    if not sorted_vals:
+def percentile(vals: List[float], q: float) -> Optional[float]:
+    """Exact nearest-rank percentile of a sample (None when empty).
+
+    Sorts internally: the original contract required a pre-sorted
+    list with no guard, and an unsorted caller got a silently wrong
+    number — sorting an already-sorted list is a cheap O(n) pass
+    (timsort), so safety costs nothing on the historical call sites.
+    For the serving stack's STREAMING percentiles (engine/fleet
+    ``stats()``, serve.bench, obs_report) the log-bucketed
+    ``serve.slo.Histogram`` is the single implementation; this exact
+    form remains for small one-shot samples."""
+    if not vals:
         return None
     import math
 
+    sorted_vals = sorted(vals)
     i = min(len(sorted_vals) - 1, int(math.ceil(q * len(sorted_vals))) - 1)
     return sorted_vals[max(0, i)]
 
@@ -223,6 +230,95 @@ def read_events(
     except OSError:
         return []
     return out
+
+
+class EventTail:
+    """Incremental reader of a live event stream: remembers a byte
+    offset per file and parses only APPENDED whole lines on each
+    ``poll()``.
+
+    ``read_events`` re-reads every stream from byte 0 on each call —
+    fine for a one-shot report, ruinous for anything periodic: the
+    serving fleet's heartbeat watcher, the live metrics endpoint
+    (``serve.metricsd``), and the supervisor's preemption judgment
+    all poll a stream that grows to hundreds of MB over a long run.
+    This tail makes each poll O(new records):
+
+    - ``path`` may be one events file, a metrics dir, or (with
+      ``recursive=True``) a fleet dir whose ``replica-NN/`` subdirs
+      each hold their own stream; files appearing after construction
+      (a restarted replica's fresh stream) are picked up on the next
+      poll;
+    - only whole lines are consumed — a torn trailing line (the
+      crash window of the line-granular writer) is left for the next
+      poll, the same tolerance as ``read_events``;
+    - a file that SHRANK since the last poll (rotation/truncation)
+      is re-read from byte 0 rather than silently skipped.
+
+    Each poll's batch is returned sorted by record timestamp so
+    multi-file dirs read as one stream, matching ``read_events``
+    ordering within the batch."""
+
+    def __init__(self, path: str, recursive: bool = False):
+        self.path = path
+        self.recursive = recursive
+        self._offsets: Dict[str, int] = {}
+
+    def _files(self) -> List[str]:
+        if not os.path.isdir(self.path):
+            return [self.path] if os.path.exists(self.path) else []
+        out: List[str] = []
+        if self.recursive:
+            for root, _dirs, files in sorted(os.walk(self.path)):
+                for name in sorted(files):
+                    if name.startswith("events") and name.endswith(
+                        ".jsonl"
+                    ):
+                        out.append(os.path.join(root, name))
+        else:
+            try:
+                names = sorted(os.listdir(self.path))
+            except OSError:
+                return []
+            for name in names:
+                if name.startswith("events") and name.endswith(".jsonl"):
+                    out.append(os.path.join(self.path, name))
+        return out
+
+    def poll(self) -> List[Dict[str, Any]]:
+        recs: List[Dict[str, Any]] = []
+        for path in self._files():
+            off = self._offsets.get(path, 0)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size < off:  # rotated/truncated under us
+                off = 0
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue  # torn line only; retry next poll
+            self._offsets[path] = off + last_nl + 1
+            for line in chunk[: last_nl + 1].splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line.decode("utf-8", "replace"))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue
+                if isinstance(rec, dict):
+                    recs.append(rec)
+        recs.sort(key=lambda r: r.get("t", 0.0))
+        return recs
 
 
 # --------------------------------------------------------------------

@@ -301,61 +301,33 @@ class DispatchWatchdog:
 
 
 class _HeartbeatTail:
-    """Incremental reader of the shared metrics dir for the watchdog's
-    periodic peer check: remembers a byte offset per events file and
-    parses only APPENDED lines for heartbeats, so the per-check cost is
-    O(new records) instead of re-parsing the whole stream (which grows
-    to hundreds of MB over a long run) every interval. The one-shot
-    ``check_peers`` below stays a full read — obs_report and tests
-    call it once, not every 30 s."""
+    """Incremental heartbeat view over the shared metrics dir for the
+    watchdog's periodic peer check: rides ``utils.obs.EventTail`` (the
+    shared offset-tracking reader that also feeds the live metrics
+    endpoint and the supervisor's preemption judgment), so the
+    per-check cost is O(new records) instead of re-parsing the whole
+    stream (which grows to hundreds of MB over a long run) every
+    interval. The one-shot ``check_peers`` below stays a full read —
+    obs_report and tests call it once, not every 30 s."""
 
     def __init__(self, metrics_dir: str):
+        from . import obs
+
         self.dir = metrics_dir
-        self._offsets: Dict[str, int] = {}
+        self._tail = obs.EventTail(metrics_dir)
         self.last_hb: Dict[int, Dict] = {}
         self.newest_t = 0.0
 
     def poll(self) -> None:
-        import json
-
-        try:
-            names = sorted(os.listdir(self.dir))
-        except OSError:
-            return
-        for name in names:
-            if not (name.startswith("events") and name.endswith(".jsonl")):
+        for rec in self._tail.poll():
+            t = rec.get("t", 0.0)
+            if isinstance(t, (int, float)):
+                self.newest_t = max(self.newest_t, t)
+            if rec.get("type") != "heartbeat":
                 continue
-            path = os.path.join(self.dir, name)
-            off = self._offsets.get(name, 0)
-            try:
-                with open(path, "rb") as f:
-                    f.seek(off)
-                    chunk = f.read()
-            except OSError:
-                continue
-            if not chunk:
-                continue
-            # consume only whole lines; a torn trailing line is left
-            # for the next poll (same crash tolerance as read_events)
-            last_nl = chunk.rfind(b"\n")
-            if last_nl < 0:
-                continue
-            self._offsets[name] = off + last_nl + 1
-            for line in chunk[: last_nl + 1].splitlines():
-                try:
-                    rec = json.loads(line)
-                except Exception:
-                    continue
-                if not isinstance(rec, dict):
-                    continue
-                t = rec.get("t", 0.0)
-                if isinstance(t, (int, float)):
-                    self.newest_t = max(self.newest_t, t)
-                if rec.get("type") != "heartbeat":
-                    continue
-                h = rec.get("host", 0)
-                if h not in self.last_hb or t > self.last_hb[h]["t"]:
-                    self.last_hb[h] = rec
+            h = rec.get("host", 0)
+            if h not in self.last_hb or t > self.last_hb[h]["t"]:
+                self.last_hb[h] = rec
 
     def stale_peers(self, stale_s: float) -> List[Dict]:
         self.poll()

@@ -7,9 +7,11 @@ Usage:
 Sections: run header (identity/provenance), phase breakdown
 (SectionTimers drains), step trajectory, roofline trajectory (per-chunk
 it/s, MFU, HBM fraction), compile/recompile table, per-host heartbeat
-timeline, checkpoint/recovery/preemption events, final summary. This
-is the dashboard PERF.md sections are written from — and what bench.py
-points at via its ``event_stream`` provenance field.
+timeline, fleet liveness, serving latency, SLO histograms/breaches,
+TRACES (the N slowest request timelines reassembled from span events),
+checkpoint/recovery/preemption events, final summary. This is the
+dashboard PERF.md sections are written from — and what bench.py points
+at via its ``event_stream`` provenance field.
 
 Works on a live (still-growing) stream: the reader drops a torn
 trailing line, so the report is always renderable mid-run.
@@ -23,7 +25,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from ccsc_code_iccv2017_tpu.serve import slo as _slo  # noqa: E402
 from ccsc_code_iccv2017_tpu.utils import obs  # noqa: E402
+from ccsc_code_iccv2017_tpu.utils import trace as _trace  # noqa: E402
 
 
 def _fmt_ts(t):
@@ -41,10 +45,12 @@ def _section(title):
     return f"\n== {title} " + "=" * max(1, 64 - len(title))
 
 
-def render(events, stale_after=None):
+def render(events, stale_after=None, n_traces=3):
     """-> the dashboard string (pure function of the parsed records).
     ``stale_after``: per-host liveness threshold in seconds (default:
     the watchdog's peer-staleness default, CCSC_WATCHDOG_PEER_STALE_S).
+    ``n_traces``: how many slowest request timelines the TRACES
+    section renders (0 keeps the section to counts only).
     """
     if stale_after is None:
         from ccsc_code_iccv2017_tpu.utils import env as _env
@@ -384,14 +390,24 @@ def render(events, stale_after=None):
     sdisp = by.get("serve_dispatch", [])
     if sreqs or sdisp:
         lines.append(_section("SERVING"))
-        lat = sorted(r.get("latency_ms", 0.0) for r in sreqs)
-        # one percentile definition across engine stats(), the serve
-        # bench record, and this report (utils.obs.percentile)
-        pct = lambda q: obs.percentile(lat, q) or float("nan")
+        # one percentile implementation across engine/fleet stats(),
+        # the serve bench record, and this report: the log-bucketed
+        # serving histogram (serve.slo.Histogram)
+        lat_h = _slo.Histogram.of(
+            r.get("latency_ms", 0.0) for r in sreqs
+        )
+        pct = lambda q: (
+            lat_h.percentile(q)
+            if lat_h.percentile(q) is not None
+            else float("nan")
+        )
 
         if sreqs:
-            waits = sorted(r.get("wait_ms", 0.0) for r in sreqs)
-            wait_p50 = obs.percentile(waits, 0.5) or float("nan")
+            wait_h = _slo.Histogram.of(
+                r.get("wait_ms", 0.0) for r in sreqs
+            )
+            wait_p50 = wait_h.percentile(0.5)
+            wait_p50 = float("nan") if wait_p50 is None else wait_p50
             lines.append(
                 f"  requests      {len(sreqs)} served, latency p50 "
                 f"{pct(0.5):.1f} ms / p99 {pct(0.99):.1f} ms, queue "
@@ -440,11 +456,83 @@ def render(events, stale_after=None):
                 "miss(es) over the run"
             )
 
+    shists = by.get("slo_histogram", [])
+    sbreach = by.get("slo_breach", [])
+    sprof = by.get("slo_profile", [])
+    if shists or sbreach:
+        lines.append(_section("SLO"))
+        # newest snapshot per (phase, scope): histograms are
+        # cumulative, so the last record IS the run's distribution —
+        # percentiles recomputed offline from the stream alone
+        newest = {}
+        for h in shists:
+            newest[(h.get("phase"), h.get("replica_id"))] = h
+        for (phase, rid), h in sorted(
+            newest.items(), key=lambda kv: (str(kv[0][0]), str(kv[0][1]))
+        ):
+            hist = _slo.from_snapshot(h)
+            scope = "fleet" if rid is None else f"replica {rid}"
+            f = lambda v: "—" if v is None else f"{v:.1f}"
+            lines.append(
+                f"  {phase:<8} [{scope}]  n={hist.n}  p50 "
+                f"{f(hist.percentile(0.50))} ms  p95 "
+                f"{f(hist.percentile(0.95))} ms  p99 "
+                f"{f(hist.percentile(0.99))} ms  max "
+                f"{hist.max_ms:.1f} ms"
+            )
+        if sbreach:
+            lines.append(f"  breaches      {len(sbreach)}")
+            for b in sbreach[-5:]:
+                lines.append(
+                    f"    {_fmt_ts(b['t'])}  p"
+                    f"{int(100 * b.get('quantile', 0))} "
+                    f"{b.get('observed_ms')} ms > target "
+                    f"{b.get('target_ms')} ms (n={b.get('n')})"
+                )
+        for p in sprof:
+            lines.append(
+                f"  xprof capture {p.get('trace_dir')} (armed by an "
+                "SLO breach; scripts/xprof_report.py attributes it)"
+            )
+
+    spans = [
+        e for e in events
+        if e.get("type") in ("span_start", "span_end")
+    ]
+    if spans:
+        lines.append(_section("TRACES"))
+        traces = _trace.assemble(events)
+        complete = [t for t in traces.values() if t.complete]
+        orphan_spans = sum(
+            len(t.orphans) + len(t.unparented)
+            for t in traces.values()
+        )
+        lines.append(
+            f"  {len(traces)} trace(s), {len(complete)} complete, "
+            f"{orphan_spans} orphan/dangling span(s)"
+        )
+        bad = [t for t in traces.values() if not t.complete]
+        if bad:
+            lines.append(
+                "  INCOMPLETE: "
+                + ", ".join(t.trace_id for t in bad[:8])
+                + (" …" if len(bad) > 8 else "")
+            )
+        if n_traces:
+            lines.append(
+                f"  {min(n_traces, len(complete))} slowest request "
+                "timeline(s):"
+            )
+            for t in _trace.slowest(traces, n_traces):
+                for ln in _trace.render_timeline(t).splitlines():
+                    lines.append("  " + ln)
+
     lines.append(_section("EVENTS"))
     n_ev = 0
     for kind in ("checkpoint_save", "checkpoint_load", "recovery",
                  "preemption", "stall", "peer_stale", "degrade",
-                 "fault_fired", "fleet_replica_dead",
+                 "fault_fired", "slo_breach", "slo_profile",
+                 "fleet_replica_dead",
                  "fleet_replica_restart", "fleet_replica_ready",
                  "fleet_replica_abandoned", "fleet_requeue",
                  "fleet_overload"):
@@ -495,6 +583,11 @@ def main(argv=None):
         "CCSC_WATCHDOG_PEER_STALE_S, 120)",
     )
     ap.add_argument(
+        "--traces", type=int, default=3,
+        help="render the N slowest request timelines in the TRACES "
+        "section (reassembled from span events; 0 = counts only)",
+    )
+    ap.add_argument(
         "--recursive", action="store_true",
         help="merge event streams from subdirectories too (a fleet "
         "metrics dir holds each replica engine's stream in a "
@@ -513,7 +606,12 @@ def main(argv=None):
     if args.json:
         print(json.dumps(events))
         return events
-    print(render(events, stale_after=args.stale_after))
+    print(
+        render(
+            events, stale_after=args.stale_after,
+            n_traces=args.traces,
+        )
+    )
     return events
 
 

@@ -6,6 +6,7 @@ def emit_sites(run):
     run.event(
         "serve_request",
         replica_id=0,
+        trace_id="74726163653031",
         bucket="4@64x64",
         latency_ms=1.5,
         iters=30,

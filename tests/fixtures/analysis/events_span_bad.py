@@ -1,0 +1,15 @@
+# known-bad fixture for the obs-schema SPAN conventions: a span_end
+# emitted for a literal span name that no span_start emitter anywhere
+# in the project produces — an orphan by construction.
+
+
+def emit_sites(run):
+    run.event(  # L7: span_end for `orphan_phase` with no span_start
+        "span_end",
+        trace_id="t1",
+        span="orphan_phase",
+        span_id="s1",
+        parent_span=None,
+        replica_id=0,
+        status="ok",
+    )

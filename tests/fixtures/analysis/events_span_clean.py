@@ -1,0 +1,26 @@
+# known-clean fixture for the obs-schema SPAN conventions: every
+# span_end's literal span name has a matching span_start emitter, and
+# both carry the full trace context (trace_id/span/span_id/replica_id
+# plus status on the end).
+
+
+def emit_sites(run):
+    run.event(
+        "span_start",
+        trace_id="t1",
+        span="solve",
+        span_id="s1",
+        parent_span="root1",
+        replica_id=0,
+        ts=123.0,
+    )
+    run.event(
+        "span_end",
+        trace_id="t1",
+        span="solve",
+        span_id="s1",
+        parent_span="root1",
+        replica_id=0,
+        status="ok",
+        ts=124.0,
+    )

@@ -118,6 +118,50 @@ def test_obs_schema_silent_on_clean():
     assert run_on("events_clean.py", "obs-schema") == []
 
 
+def test_obs_schema_span_pairing_fires_on_end_only():
+    """Span convention (ISSUE 9): a span_end emitted for a literal
+    span name with no span_start emitter anywhere in the project is
+    an orphan by construction."""
+    fs = run_on("events_span_bad.py", "obs-schema")
+    assert len(fs) == 1, [(f.line, f.message) for f in fs]
+    assert fs[0].check == "obs-schema"
+    assert "no span_start emitter" in fs[0].message
+    assert "orphan_phase" in fs[0].message
+
+
+def test_obs_schema_span_pairing_silent_on_paired():
+    assert run_on("events_span_clean.py", "obs-schema") == []
+
+
+def test_obs_schema_registry_span_conventions():
+    """Registry-side conventions: span_* events must require the full
+    trace context, serve_*/fleet_* must require replica_id, and a
+    declared span_end implies a declared span_start — and the REAL
+    registry satisfies all three."""
+    from ccsc_code_iccv2017_tpu.analysis import events as ev
+
+    bad = {
+        "span_end": frozenset({"trace_id"}),
+        "fleet_thing": frozenset(),
+        "span_start": frozenset(
+            {"trace_id", "span", "span_id", "replica_id"}
+        ),
+    }
+    msgs = [f.message for f in ev.registry_findings(bad)]
+    assert any(
+        "span event `span_end` must require" in m for m in msgs
+    )
+    assert any("serving event `fleet_thing`" in m for m in msgs)
+    end_only = {
+        "span_end": frozenset(
+            {"trace_id", "span", "span_id", "replica_id", "status"}
+        )
+    }
+    msgs2 = [f.message for f in ev.registry_findings(end_only)]
+    assert any("without `span_start`" in m for m in msgs2)
+    assert ev.registry_findings() == []  # the shipped registry is clean
+
+
 # --------------------------------------------------------------- env-registry
 
 

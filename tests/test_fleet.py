@@ -626,13 +626,20 @@ def test_chaos_kill_hang_zero_lost_bit_identical(tmp_path, monkeypatch):
             time.sleep(0.1)
     finally:
         fleet.close()
+    # the exact host-measured latency sample (seconds), for the
+    # histogram-accuracy acceptance below
+    host_latencies = list(fleet._latencies)
 
     events = obs.read_events(str(tmp_path), recursive=True)
-    # every serve_*/fleet_* record names its replica (None allowed
-    # only for fleet-scope records) — the runtime half of the lint
+    # every serve_*/fleet_*/span_* record names its replica (None
+    # allowed only for fleet-scope records) — the runtime half of
+    # the lint
     for e in events:
         t = e.get("type", "")
-        if t.startswith("serve_") or t.startswith("fleet_"):
+        if (
+            t.startswith("serve_") or t.startswith("fleet_")
+            or t.startswith("span_")
+        ):
             assert "replica_id" in e, e
 
     dead = [e for e in events if e["type"] == "fleet_replica_dead"]
@@ -662,6 +669,65 @@ def test_chaos_kill_hang_zero_lost_bit_identical(tmp_path, monkeypatch):
         if e["type"] == "summary" and e.get("n_requeued") is not None
     ][-1]
     assert summary["n_failed"] == 0
+
+    # ISSUE 9 acceptance (a): from the event streams ALONE, every
+    # submitted trace_id reassembles into a complete, gap-free span
+    # tree — including the requests requeued across the replica kill
+    # and the hang (their story shows both ownerships)
+    from ccsc_code_iccv2017_tpu.utils import trace as trace_util
+
+    traces = trace_util.assemble(events)
+    tid_by_key = {
+        e["key"]: e["trace_id"]
+        for e in events
+        if e["type"] == "fleet_request"
+    }
+    for i in range(12):
+        tid = tid_by_key[f"k{i}"]
+        tr = traces[tid]
+        assert tr.complete, (
+            f"k{i}",
+            [
+                (s.name, s.status, s.closed)
+                for s in tr.spans.values()
+            ],
+        )
+    orphans = sum(
+        len(t.orphans) + len(t.unparented) for t in traces.values()
+    )
+    assert orphans == 0, "span trees must reassemble gap-free"
+    requeued_keys = [
+        e["key"] for e in first_wave if e["attempts"] > 1
+    ]
+    tr = traces[tid_by_key[requeued_keys[0]]]
+    attempts = tr.by_name("attempt")
+    assert len(attempts) >= 2, "the handoff must be visible as spans"
+    assert any(s.status == "requeued" for s in attempts)
+    assert any(s.status == "ok" for s in attempts)
+    # the fleet queue span was re-opened for the second ownership
+    assert len(tr.by_name("queue")) >= 2
+
+    # ISSUE 9 acceptance (b): fleet-wide percentiles recomputed from
+    # the LAST slo_histogram event match the host-measured exact
+    # sample within one bucket width
+    from ccsc_code_iccv2017_tpu.serve import slo as slo_mod
+
+    fleet_hists = [
+        e for e in events
+        if e["type"] == "slo_histogram"
+        and e.get("replica_id") is None
+        and e.get("phase") == "total"
+    ]
+    assert fleet_hists, "the fleet must flush its histogram at close"
+    hist = slo_mod.from_snapshot(fleet_hists[-1])
+    exact_ms = sorted(v * 1e3 for v in host_latencies)
+    assert hist.n == len(exact_ms)
+    for q in (0.50, 0.95, 0.99):
+        ex = obs.percentile(exact_ms, q)
+        got = hist.percentile(q)
+        assert abs(got - ex) <= hist.bucket_width_ms(ex) + 1e-6, (
+            q, got, ex,
+        )
 
 
 # -------------------------------------------------- admission control

@@ -1,0 +1,811 @@
+"""Request-level tracing, SLO histograms, and the live metrics
+surface (ISSUE 9): utils.trace span emission/reassembly, the
+serve.slo streaming histograms + breach monitor, serve.metricsd's
+Prometheus endpoint and atomic snapshot, obs.EventTail incremental
+reads, and the xprof_report degrade path."""
+import importlib.util
+import json
+import os
+import sys
+import time
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import (
+    FleetConfig,
+    ProblemGeom,
+    ServeConfig,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.serve import metricsd, slo
+from ccsc_code_iccv2017_tpu.utils import obs
+from ccsc_code_iccv2017_tpu.utils import trace as trace_util
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------ span assembly
+
+
+def _collector():
+    evs = []
+
+    def emit(type_, **fields):
+        evs.append({"t": time.time(), "type": type_, **fields})
+
+    return evs, emit
+
+
+def test_span_pair_assembles_complete():
+    evs, emit = _collector()
+    tid = trace_util.new_trace_id()
+    root = trace_util.start_span(
+        emit, trace_id=tid, span="request", ts=100.0
+    )
+    trace_util.emit_span(
+        emit, trace_id=tid, span="solve", parent_span=root,
+        t_start=100.2, t_end=100.7, replica_id=1, bucket="2@12x12",
+    )
+    trace_util.end_span(
+        emit, trace_id=tid, span="request", span_id=root,
+        status="ok", ts=101.0, t_start=100.0,
+    )
+    traces = trace_util.assemble(evs)
+    assert list(traces) == [tid]
+    tr = traces[tid]
+    assert tr.complete
+    assert tr.root.dur_ms == pytest.approx(1000.0)
+    solve = tr.by_name("solve")[0]
+    assert solve.parent_span == root
+    assert solve.replica_id == 1
+    assert solve.fields["bucket"] == "2@12x12"
+    assert tr.duration_ms == pytest.approx(1000.0)
+    txt = trace_util.render_timeline(tr)
+    assert "request" in txt and "solve" in txt and "ok" in txt
+
+
+def test_orphans_and_dangling_parents_detected():
+    evs, emit = _collector()
+    tid = "t1"
+    root = trace_util.start_span(
+        emit, trace_id=tid, span="request", ts=1.0
+    )
+    # start with no end -> orphan
+    trace_util.start_span(
+        emit, trace_id=tid, span="queue", parent_span=root, ts=1.1
+    )
+    # end with no start -> orphan
+    trace_util.end_span(
+        emit, trace_id=tid, span="attempt", span_id="lonely",
+        parent_span=root, status="ok", ts=1.5,
+    )
+    # dangling parent ref -> gap
+    trace_util.emit_span(
+        emit, trace_id=tid, span="solve", parent_span="no-such-span",
+        t_start=1.2, t_end=1.3,
+    )
+    tr = trace_util.assemble(evs)[tid]
+    assert not tr.complete
+    assert len(tr.orphans) == 3  # open root + open queue + lonely end
+    assert [s.span_id for s in tr.unparented] != []
+    txt = trace_util.render_timeline(tr)
+    assert "INCOMPLETE" in txt
+
+
+def test_slowest_ranks_complete_traces_only():
+    evs, emit = _collector()
+    for i, dur in enumerate((0.5, 2.0, 1.0)):
+        trace_util.emit_span(
+            emit, trace_id=f"t{i}", span="request",
+            t_start=10.0, t_end=10.0 + dur,
+        )
+    trace_util.start_span(  # incomplete trace never ranks
+        emit, trace_id="t9", span="request", ts=0.0
+    )
+    traces = trace_util.assemble(evs)
+    ranked = trace_util.slowest(traces, 2)
+    assert [t.trace_id for t in ranked] == ["t1", "t2"]
+
+
+# ---------------------------------------------------------- histogram
+
+
+def test_histogram_percentile_within_one_bucket_width():
+    r = np.random.default_rng(0)
+    vals = list(np.abs(r.normal(50.0, 40.0, 500)) + 0.2)
+    h = slo.Histogram.of(vals)
+    assert h.n == 500
+    for q in (0.5, 0.9, 0.99):
+        exact = obs.percentile(vals, q)
+        got = h.percentile(q)
+        assert got is not None
+        assert abs(got - exact) <= h.bucket_width_ms(exact) + 1e-9
+    assert h.percentile(1.0) == pytest.approx(h.max_ms)
+
+
+def test_histogram_empty_merge_and_snapshot_roundtrip():
+    h = slo.Histogram()
+    assert h.percentile(0.5) is None
+    h.observe(3.0)
+    h2 = slo.Histogram.of([100.0, 200.0])
+    h.merge(h2)
+    assert h.n == 3
+    back = slo.from_snapshot(h.snapshot())
+    assert back.counts == h.counts
+    assert back.percentile(0.5) == h.percentile(0.5)
+    with pytest.raises(ValueError):
+        h.merge(slo.Histogram(bounds=(1.0, 2.0)))
+
+
+def test_percentile_sorts_internally():
+    # the historical contract required pre-sorted input with no
+    # guard; unsorted callers now get the correct answer
+    assert obs.percentile([5.0, 1.0, 3.0], 0.5) == 3.0
+    assert obs.percentile([], 0.5) is None
+
+
+def test_slo_monitor_breach_and_snapshot():
+    mon = slo.SloMonitor(targets={0.99: 10.0}, check_s=0.0)
+    for _ in range(20):
+        mon.observe("total", 50.0)
+    breaches, snaps = mon.tick()
+    assert len(breaches) == 1
+    br = breaches[0]
+    assert br["quantile"] == 0.99 and br["target_ms"] == 10.0
+    assert br["observed_ms"] > 10.0
+    assert [s["phase"] for s in snaps] == ["total"]
+    # no NEW observations -> the same breach does not re-fire
+    breaches2, _ = mon.tick()
+    assert breaches2 == []
+    mon.observe("total", 60.0)
+    breaches3, _ = mon.tick()
+    assert len(breaches3) == 1
+    # raw_snapshots must not consume the breach bookkeeping
+    mon.observe("total", 70.0)
+    assert mon.raw_snapshots()
+    assert len(mon.tick()[0]) == 1
+
+
+def test_breach_check_is_conservative_to_bucket_width():
+    """A target that merely falls INSIDE the rank bucket must not
+    breach: the reported percentile is the bucket upper edge (can
+    overstate by a width), so the check compares the LOWER edge —
+    only a provable violation fires (and burns the one-shot xprof)."""
+    mon = slo.SloMonitor(targets={0.5: 100.0}, check_s=0.0)
+    for _ in range(9):
+        mon.observe("total", 95.0)  # true p50 = 95: SLO met
+    mon.observe("total", 200.0)  # keeps max_ms off the clamp
+    breaches, _ = mon.tick()
+    assert breaches == [], breaches
+    mon2 = slo.SloMonitor(targets={0.5: 40.0}, check_s=0.0)
+    for _ in range(10):
+        mon2.observe("total", 95.0)  # whole bucket above the target
+    b2, _ = mon2.tick()
+    assert len(b2) == 1 and b2[0]["observed_ms"] > 40.0
+
+
+def test_resolve_targets_env_fallback(monkeypatch):
+    monkeypatch.setenv("CCSC_SLO_P99_MS", "25.5")
+    t = slo.resolve_targets(None, None)
+    assert t == {0.99: 25.5}
+    assert slo.resolve_targets(10.0, 20.0) == {0.5: 10.0, 0.99: 20.0}
+
+
+# ---------------------------------------------------------- EventTail
+
+
+def test_event_tail_incremental_and_torn_lines(tmp_path):
+    p = tmp_path / "events-p00000.jsonl"
+    p.write_text('{"t": 1.0, "type": "step", "it": 1}\n')
+    tail = obs.EventTail(str(tmp_path))
+    first = tail.poll()
+    assert [e["it"] for e in first] == [1]
+    assert tail.poll() == []  # nothing new
+    with open(p, "a") as f:
+        f.write('{"t": 2.0, "type": "step", "it": 2}\n')
+        f.write('{"t": 3.0, "type": "st')  # torn trailing line
+    second = tail.poll()
+    assert [e["it"] for e in second] == [2]  # torn line left alone
+    with open(p, "a") as f:
+        f.write('ep", "it": 3}\n')
+    third = tail.poll()
+    assert [e["it"] for e in third] == [3]  # completed line consumed
+
+
+def test_event_tail_discovers_new_files_and_recurses(tmp_path):
+    (tmp_path / "events-p00000.jsonl").write_text(
+        '{"t": 1.0, "type": "step", "it": 1}\n'
+    )
+    tail = obs.EventTail(str(tmp_path), recursive=True)
+    assert len(tail.poll()) == 1
+    sub = tmp_path / "replica-00"
+    sub.mkdir()
+    (sub / "events-p00000.jsonl").write_text(
+        '{"t": 2.0, "type": "step", "it": 2}\n'
+    )
+    recs = tail.poll()
+    assert [e["it"] for e in recs] == [2]
+
+
+def test_heartbeat_tail_rides_event_tail(tmp_path):
+    from ccsc_code_iccv2017_tpu.utils.watchdog import _HeartbeatTail
+
+    p = tmp_path / "events-p00000.jsonl"
+    p.write_text(
+        '{"t": 10.0, "type": "heartbeat", "host": 0, "step": 1}\n'
+        '{"t": 200.0, "type": "heartbeat", "host": 1, "step": 9}\n'
+        '{"t": 201.0, "type": "step", "it": 9}\n'
+    )
+    ht = _HeartbeatTail(str(tmp_path))
+    stale = ht.stale_peers(120.0)
+    assert [s["host"] for s in stale] == [0]
+    # incremental: appending a fresh heartbeat un-stales host 0
+    with open(p, "a") as f:
+        f.write('{"t": 202.0, "type": "heartbeat", "host": 0, "step": 2}\n')
+    assert ht.stale_peers(120.0) == []
+
+
+# ----------------------------------------------------------- metricsd
+
+
+def test_render_prometheus_shapes():
+    h = slo.Histogram.of([1.0, 5.0, 500.0])
+    text = metricsd.render_prometheus(
+        {
+            "counters": {"requests_total": 3},
+            "gauges": {"queue_depth": 1},
+            "histograms": [
+                ("latency_ms", {"phase": "total"}, h.snapshot())
+            ],
+        }
+    )
+    assert "# TYPE ccsc_requests_total counter" in text
+    assert "ccsc_requests_total 3" in text
+    assert "ccsc_queue_depth 1" in text
+    assert 'ccsc_latency_ms_bucket{le="+Inf",phase="total"} 3' in text
+    assert 'ccsc_latency_ms_count{phase="total"} 3' in text
+    # cumulative buckets are monotone
+    cums = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("ccsc_latency_ms_bucket")
+    ]
+    assert cums == sorted(cums) and cums[-1] == 3
+
+
+def test_metricsd_http_and_snapshot(tmp_path):
+    calls = {"n": 0}
+
+    def source():
+        calls["n"] += 1
+        return {
+            "counters": {"requests_total": 7},
+            "gauges": {},
+            "histograms": [],
+        }
+
+    snap = tmp_path / "metrics.prom"
+    md = metricsd.MetricsD(
+        source, port=0, snapshot_path=str(snap), interval_s=0.05
+    ).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{md.port}/metrics", timeout=10
+        ).read().decode()
+        assert "ccsc_requests_total 7" in body
+        assert snap.exists()
+        assert "ccsc_requests_total 7" in snap.read_text()
+    finally:
+        md.stop()
+    # threads are joined — no ccsc-metricsd thread survives stop()
+    import threading
+
+    assert not any(
+        t.name.startswith("ccsc-metricsd") and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
+def test_stream_metrics_counts_from_dir(tmp_path):
+    p = tmp_path / "events-p00000.jsonl"
+    recs = [
+        {"t": 1.0, "type": "fleet_request", "replica_id": 0,
+         "trace_id": "t", "key": "k1", "latency_ms": 5.0},
+        {"t": 2.0, "type": "fleet_request", "replica_id": 0,
+         "trace_id": "t", "key": "k2", "latency_ms": 6.0},
+        {"t": 3.0, "type": "fleet_admission_reject", "replica_id": None,
+         "queue_depth": 4, "ceiling": 4, "rung": "reject",
+         "retry_after_s": 1.0},
+    ]
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    sm = metricsd.StreamMetrics(str(tmp_path))
+    m = sm()
+    assert m["counters"]["requests_total"] == 2
+    assert m["counters"]["rejected_total"] == 1
+    text = metricsd.render_prometheus(m)
+    assert "ccsc_requests_total 2" in text
+
+
+def test_stream_metrics_fleet_dir_never_double_counts(tmp_path):
+    """A fleet dir carries BOTH records for one delivery — the
+    replica's serve_request (earlier t) and the fleet's
+    fleet_request. Fleet mode is latched STRUCTURALLY from the
+    replica-NN subdirs, so the counter is the delivered count from
+    the first scrape on: never serve+fleet summed, and never a
+    non-monotone flip from the engine-side count to the (briefly
+    lower) fleet count — a Prometheus counter must not decrease."""
+    sub = tmp_path / "replica-00"
+    sub.mkdir()
+    (sub / "events-p00000.jsonl").write_text(
+        json.dumps(
+            {"t": 1.0, "type": "serve_request", "replica_id": 0,
+             "trace_id": "t1", "bucket": "2@12x12",
+             "latency_ms": 4.0, "iters": 3}
+        ) + "\n"
+    )
+    top = tmp_path / "events-p00000.jsonl"
+    sm = metricsd.StreamMetrics(str(tmp_path))
+    # scrape BETWEEN dispatch and delivery: the fleet's delivered
+    # count (0) is authoritative for a fleet dir
+    assert sm()["counters"]["requests_total"] == 0
+    top.write_text(
+        json.dumps(
+            {"t": 1.01, "type": "fleet_request", "replica_id": 0,
+             "trace_id": "t1", "key": "k1", "latency_ms": 5.0}
+        ) + "\n"
+    )
+    assert sm()["counters"]["requests_total"] == 1
+
+
+# ------------------------------------------- live engine + fleet e2e
+
+jnp = pytest.importorskip("jax.numpy")
+from ccsc_code_iccv2017_tpu.models.reconstruct import (  # noqa: E402
+    ReconstructionProblem,
+)
+from ccsc_code_iccv2017_tpu.serve import CodecEngine, ServeFleet  # noqa: E402
+
+
+def _bank(k=4, s=3, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, s, s)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return jnp.asarray(d)
+
+
+def _cfg(**kw):
+    base = dict(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=4, tol=0.0,
+        verbose="none", track_objective=True,
+    )
+    base.update(kw)
+    return SolveConfig(**base)
+
+
+def _reqs(n, side=12, seed=1):
+    r = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = r.random((side, side)).astype(np.float32)
+        m = (r.random((side, side)) < 0.5).astype(np.float32)
+        out.append((x, m))
+    return out
+
+
+def test_standalone_engine_emits_complete_traces(tmp_path):
+    d = _bank()
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none",
+        metrics_dir=str(tmp_path),
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    eng = CodecEngine(d, ReconstructionProblem(geom), _cfg(), scfg)
+    try:
+        futs = [eng.submit(x * m, mask=m) for x, m in _reqs(3)]
+        [f.result(timeout=120) for f in futs]
+        st = eng.stats()
+        assert st["n_requests"] == 3
+        assert st["p99_latency_s"] is not None
+    finally:
+        eng.close()
+    events = obs.read_events(str(tmp_path))
+    sreqs = [e for e in events if e["type"] == "serve_request"]
+    assert len(sreqs) == 3
+    assert all(e.get("trace_id") for e in sreqs)
+    traces = trace_util.assemble(events)
+    assert len(traces) == 3
+    for tr in traces.values():
+        assert tr.complete, [
+            (s.name, s.closed) for s in tr.spans.values()
+        ]
+        assert {s.name for s in tr.spans.values()} == {
+            "request", "engine_queue", "solve",
+        }
+    # closing histogram flush: offline percentiles within one bucket
+    hists = [e for e in events if e["type"] == "slo_histogram"]
+    assert {h["phase"] for h in hists} >= {"total", "queue", "solve"}
+    last_total = [h for h in hists if h["phase"] == "total"][-1]
+    back = slo.from_snapshot(last_total)
+    assert back.n == 3
+    # snapshot max_ms rounds to 1e-3 ms — equal to that precision
+    assert back.percentile(0.99) / 1e3 == pytest.approx(
+        st["p99_latency_s"], abs=1e-5
+    )
+
+
+def test_engine_slo_breach_arms_one_shot_xprof(tmp_path):
+    d = _bank()
+    prof = tmp_path / "prof"
+    scfg = ServeConfig(
+        buckets=((1, (12, 12)),), max_wait_ms=0.0, verbose="none",
+        metrics_dir=str(tmp_path / "m"),
+        slo_p99_ms=0.001,  # everything breaches
+        slo_check_s=0.001,
+        slo_profile_dir=str(prof),
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    eng = CodecEngine(d, ReconstructionProblem(geom), _cfg(), scfg)
+    try:
+        for x, m in _reqs(3):
+            eng.reconstruct(x * m, mask=m, timeout=120)
+            time.sleep(0.01)  # let the check cadence elapse
+    finally:
+        eng.close()
+    events = obs.read_events(str(tmp_path / "m"))
+    breaches = [e for e in events if e["type"] == "slo_breach"]
+    assert breaches, "a 1us p99 target must breach"
+    assert breaches[0]["observed_ms"] > breaches[0]["target_ms"]
+    profiles = [e for e in events if e["type"] == "slo_profile"]
+    assert len(profiles) == 1, "the capture is one-shot"
+    assert profiles[0]["trace_dir"] == str(prof)
+    assert os.path.isdir(prof) and os.listdir(prof)
+
+
+def test_fleet_metricsd_scrape_counts_exactly(tmp_path):
+    d = _bank()
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), _cfg(), scfg,
+        FleetConfig(
+            replicas=1, min_queue_depth=64, verbose="none",
+            metrics_dir=str(tmp_path), metricsd_port=0,
+            heartbeat_s=0.2, health_interval_s=0.05,
+        ),
+    )
+    try:
+        assert fleet._metricsd is not None and fleet._metricsd.port
+        n = 6
+        futs = [
+            fleet.submit(x * m, mask=m, key=f"m{i}")
+            for i, (x, m) in enumerate(_reqs(n, seed=3))
+        ]
+        [f.result(timeout=180) for f in futs]
+        url = f"http://127.0.0.1:{fleet._metricsd.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        # ISSUE 9 acceptance (c): the live scrape's request counter
+        # equals the number of served requests EXACTLY
+        assert f"ccsc_requests_total {n}" in body
+        assert "ccsc_live_replicas 1" in body
+        assert 'ccsc_latency_ms_bucket{le="+Inf",phase="total"}' in body
+    finally:
+        fleet.close()
+    events = obs.read_events(str(tmp_path), recursive=True)
+    md = [e for e in events if e["type"] == "fleet_metricsd"]
+    assert md and md[0]["port"] == fleet._metricsd.port
+    # the atomic snapshot (default path under the metrics dir) holds
+    # the final exposition for scrape-less readers
+    snap = os.path.join(str(tmp_path), "metrics.prom")
+    assert os.path.exists(snap)
+    with open(snap) as f:
+        assert f"ccsc_requests_total {n}" in f.read()
+
+
+def test_resolve_endpoint_chain(monkeypatch, tmp_path):
+    """One resolution chain shared by the fleet and the standalone
+    CLI: explicit > CCSC_METRICSD_* env > metrics_dir default."""
+    monkeypatch.delenv("CCSC_METRICSD_PORT", raising=False)
+    monkeypatch.delenv("CCSC_METRICSD_SNAPSHOT", raising=False)
+    assert metricsd.resolve_endpoint(None, None, None) == (None, None)
+    assert metricsd.resolve_endpoint(0, None, str(tmp_path)) == (
+        0, os.path.join(str(tmp_path), "metrics.prom"),
+    )
+    # a snapshot request WITHOUT a port is honored: snapshot-only
+    # mode (scrape-less environments are the snapshot's whole point)
+    assert metricsd.resolve_endpoint(None, "/s.prom", None) == (
+        None, "/s.prom",
+    )
+    monkeypatch.setenv("CCSC_METRICSD_PORT", "9104")
+    monkeypatch.setenv("CCSC_METRICSD_SNAPSHOT", "/x/y.prom")
+    assert metricsd.resolve_endpoint(None, None, None) == (
+        9104, "/x/y.prom",
+    )
+    assert metricsd.resolve_endpoint(None, "/z.prom", None)[1] == "/z.prom"
+
+
+def test_metricsd_start_failure_does_not_leak_server(tmp_path):
+    """If the initial snapshot write fails after the HTTP server
+    started, start() must shut the server down before re-raising —
+    callers catch the exception and drop the instance, and an
+    ownerless daemon squatting the port would block every fleet
+    rebuild with EADDRINUSE."""
+    import threading
+
+    bad = tmp_path / "f"
+    bad.write_text("not a dir")  # makedirs under a FILE raises
+    md = metricsd.MetricsD(
+        lambda: {"counters": {}, "gauges": {}, "histograms": []},
+        port=0, snapshot_path=str(bad / "x" / "m.prom"),
+    )
+    with pytest.raises(Exception):
+        md.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and any(
+        t.name.startswith("ccsc-metricsd") and t.is_alive()
+        for t in threading.enumerate()
+    ):
+        time.sleep(0.05)
+    assert not any(
+        t.name.startswith("ccsc-metricsd") and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
+def test_metricsd_snapshot_only_mode(tmp_path):
+    """port=None starts no HTTP server but still writes the atomic
+    snapshot — the scrape-less deployment shape."""
+    snap = tmp_path / "only.prom"
+    md = metricsd.MetricsD(
+        lambda: {"counters": {"requests_total": 4}, "gauges": {},
+                 "histograms": []},
+        port=None, snapshot_path=str(snap), interval_s=0.05,
+    ).start()
+    try:
+        assert md.port is None
+        assert "ccsc_requests_total 4" in snap.read_text()
+    finally:
+        md.stop()
+
+
+def test_straggler_delivery_does_not_misattribute_attempt(tmp_path):
+    """A recovered straggler that wins the delivery race after a
+    requeue must not end the NEW owner's attempt span as its own
+    'ok': the span keeps its owner's replica_id and closes
+    'superseded' (the fleet_request record names the actual
+    deliverer)."""
+    from concurrent.futures import Future
+
+    from ccsc_code_iccv2017_tpu.serve.fleet import _FleetRequest
+
+    d = _bank()
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), _cfg(), scfg,
+        FleetConfig(
+            replicas=1, min_queue_depth=64, verbose="none",
+            metrics_dir=str(tmp_path),
+            heartbeat_s=0.2, health_interval_s=0.05,
+        ),
+    )
+    try:
+        x, m = _reqs(1)[0]
+        res = fleet.reconstruct(x * m, mask=m, key="real", timeout=180)
+        # a request whose OPEN attempt span belongs to replica 7,
+        # delivered by the straggler worker of replica 0
+        req = _FleetRequest(
+            key="race", b=x * m, mask=m, smooth_init=None,
+            x_orig=None, future=Future(),
+            t_submit=time.time(), attempts=2,
+            trace_id="racetrace", root_span="root1",
+            attempt_span="att-owner7", attempt_rep=7,
+            attempt_t=time.time(),
+        )
+        with fleet._cv:
+            fleet._index["race"] = req
+        fleet._deliver(fleet._replicas[0], req, res)
+    finally:
+        fleet.close()
+    events = obs.read_events(str(tmp_path))
+    end = [
+        e for e in events
+        if e["type"] == "span_end" and e.get("span_id") == "att-owner7"
+    ]
+    assert len(end) == 1
+    assert end[0]["replica_id"] == 7
+    assert end[0]["status"] == "superseded"
+    # the delivery record still names the replica that delivered
+    fr = [
+        e for e in events
+        if e["type"] == "fleet_request" and e["key"] == "race"
+    ]
+    assert fr and fr[0]["replica_id"] == 0
+
+
+def test_fleet_stats_percentiles_come_from_histogram(tmp_path):
+    d = _bank()
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), _cfg(), scfg,
+        FleetConfig(
+            replicas=1, min_queue_depth=64, verbose="none",
+            heartbeat_s=0.2, health_interval_s=0.05,
+        ),
+    )
+    try:
+        for i, (x, m) in enumerate(_reqs(5, seed=5)):
+            fleet.reconstruct(x * m, mask=m, key=f"s{i}", timeout=180)
+        st = fleet.stats()
+        exact_ms = sorted(v * 1e3 for v in fleet._latencies)
+        assert st["n_requests"] == 5
+        for key, q in (("p50_latency_s", 0.5), ("p99_latency_s", 0.99)):
+            got_ms = st[key] * 1e3
+            ex = obs.percentile(exact_ms, q)
+            width = slo.Histogram.of(exact_ms).bucket_width_ms(ex)
+            assert abs(got_ms - ex) <= width + 1e-6
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------- xprof_report
+
+
+def _load_xprof_report():
+    spec = importlib.util.spec_from_file_location(
+        "xprof_report", os.path.join(REPO, "scripts", "xprof_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Ev:
+    def __init__(self, mid, ps):
+        self.metadata_id = mid
+        self.duration_ps = ps
+
+
+class _Line:
+    def __init__(self, name, events):
+        self.name = name
+        self.events = events
+
+
+class _Meta:
+    def __init__(self, id_, name):
+        self.id = id_
+        self.name = name
+
+
+class _Plane:
+    def __init__(self, name, lines, metadata):
+        self.name = name
+        self.lines = lines
+        self.event_metadata = {m.id: m for m in metadata}
+
+
+class _XSpace:
+    """Synthetic XPlane stand-in: 'ParseFromString' reads our JSON
+    fixture format instead of the real proto wire format."""
+
+    def __init__(self):
+        self.planes = []
+
+    def ParseFromString(self, data):  # noqa: N802 - proto API
+        spec = json.loads(data.decode("utf-8"))
+        for pl in spec["planes"]:
+            metas = [
+                _Meta(m["id"], m["name"]) for m in pl["metadata"]
+            ]
+            lines = [
+                _Line(
+                    ln["name"],
+                    [_Ev(e["mid"], e["ps"]) for e in ln["events"]],
+                )
+                for ln in pl["lines"]
+            ]
+            self.planes.append(_Plane(pl["name"], lines, metas))
+
+
+def _install_fake_xplane(monkeypatch):
+    leaf = types.ModuleType("xplane_pb2")
+    leaf.XSpace = _XSpace
+    mods = {}
+    for name in (
+        "tensorflow",
+        "tensorflow.tsl",
+        "tensorflow.tsl.profiler",
+        "tensorflow.tsl.profiler.protobuf",
+    ):
+        mods[name] = types.ModuleType(name)
+    mods["tensorflow.tsl.profiler.protobuf"].xplane_pb2 = leaf
+    mods["tensorflow.tsl.profiler.protobuf.xplane_pb2"] = leaf
+    for name, mod in mods.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+
+
+def test_xprof_report_synthetic_xplane(tmp_path, monkeypatch):
+    _install_fake_xplane(monkeypatch)
+    fixture = {
+        "planes": [
+            {
+                "name": "/device:TPU:0",
+                "metadata": [
+                    {"id": 1, "name": "fusion.1"},
+                    {"id": 2, "name": "copy.2"},
+                ],
+                "lines": [
+                    {
+                        "name": "XLA Modules",
+                        "events": [{"mid": 1, "ps": 90_000_000_000}],
+                    },
+                    {
+                        "name": "XLA Ops",
+                        "events": [
+                            {"mid": 1, "ps": 30_000_000_000},
+                            {"mid": 2, "ps": 10_000_000_000},
+                        ],
+                    },
+                ],
+            },
+            {
+                "name": "Host Threads",
+                "metadata": [{"id": 9, "name": "python"}],
+                "lines": [
+                    {
+                        "name": "threads",
+                        "events": [{"mid": 9, "ps": 999_000_000_000}],
+                    }
+                ],
+            },
+        ]
+    }
+    sub = tmp_path / "plugins" / "profile"
+    sub.mkdir(parents=True)
+    (sub / "host.xplane.pb").write_bytes(
+        json.dumps(fixture).encode()
+    )
+    xr = _load_xprof_report()
+    out = xr.summarize(str(tmp_path))
+    assert out["xprof"] == "ok"
+    assert out["plane"] == "/device:TPU:0"  # TPU beats busier host
+    assert out["line"] == "XLA Ops"  # per-HLO line, not the module
+    assert out["total_ms"] == pytest.approx(40.0)
+    assert out["top_ops"][0] == {
+        "op": "fusion.1", "ms": 30.0, "pct": 75.0,
+    }
+
+
+def test_xprof_report_degrades_to_json_error(
+    tmp_path, monkeypatch, capsys
+):
+    # no tensorflow in the container: summarize answers with a JSON
+    # error record, main() prints it and returns — never a traceback
+    monkeypatch.setitem(sys.modules, "tensorflow", None)
+    xr = _load_xprof_report()
+    out = xr.summarize(str(tmp_path))
+    assert out["xprof"] == "unavailable"
+    assert "error" in out and out["dir"] == str(tmp_path)
+    printed = xr.main([str(tmp_path)])
+    assert printed["xprof"] == "unavailable"
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["xprof"] == "unavailable"
+
+
+def test_xprof_report_empty_dir_reports_no_traces(
+    tmp_path, monkeypatch
+):
+    _install_fake_xplane(monkeypatch)
+    xr = _load_xprof_report()
+    out = xr.summarize(str(tmp_path))
+    assert out["xprof"] == "no .xplane.pb found"
