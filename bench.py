@@ -61,6 +61,13 @@ def run_workload():
     from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
     from ccsc_code_iccv2017_tpu.models import common, learn as learn_mod
     from ccsc_code_iccv2017_tpu.parallel import consensus
+    from ccsc_code_iccv2017_tpu.utils import memwatch, obs as obs_mod
+
+    # measured HBM watermark (utils.memwatch, sampled at the fences
+    # below) and compile count for the record + the perf ledger —
+    # installed before the first trace so warmup compiles count too
+    mw = memwatch.MemWatch()
+    cmon = obs_mod.CompileMonitor().install()
 
     n = int(os.environ.get("CCSC_BENCH_N", 128))
     size = int(os.environ.get("CCSC_BENCH_SIZE", 100))
@@ -206,11 +213,13 @@ def run_workload():
     except Exception:
         compiled = step  # backends without full AOT support
 
+    mw.sample()  # post-AOT-compile allocator state
     # warmup. NB: jax.block_until_ready is a no-op on the axon TPU
     # platform — a scalar readback is the only reliable fence.
     s1, m0 = compiled(state, b_blocks)
     fence(m0)  # real scalar computed from the chain, not the
     # constant-0 objective (verbose='none' skips the objective)
+    mw.sample()  # post-warmup fence: state + metrics resident
 
     calls = max(1, iters // outer_chunk) if chunked else iters
     eff_iters = calls * outer_chunk if chunked else iters
@@ -220,6 +229,7 @@ def run_workload():
         cur, m = compiled(cur, b_blocks)
     fence(m)  # fences the whole chain
     dt = time.perf_counter() - t0
+    mw.sample()  # post-timed-loop fence
 
     # optional xprof capture (CCSC_BENCH_XPROF=<dir>) of two EXTRA
     # steps AFTER the timed loop — tracing costs real time, and a
@@ -271,6 +281,20 @@ def run_workload():
     util["cost_source"] = cost_src
 
     platform = jax.devices()[0].platform
+    # measured vs modeled HBM watermark: the modeled estimate is the
+    # same preflight the auto-degrade ladder trusts
+    # (perfmodel.inmem_learn_estimate) — recording both per round is
+    # what keeps the model honest
+    modeled_hbm = None
+    try:
+        est, _budget = perfmodel.inmem_learn_estimate(
+            (n, size, size), geom, cfg
+        )
+        modeled_hbm = int(est)
+    except Exception:
+        pass
+    n_compiles = cmon.summary()["n_compiles"]
+    cmon.uninstall()
     # optional telemetry stream for the bench itself
     # (CCSC_BENCH_METRICS_DIR): run metadata + the measured numbers as
     # a summary record; the emitted jsonl record points at it via
@@ -284,6 +308,10 @@ def run_workload():
             metrics_dir, algorithm="bench", verbose="none", cfg=cfg,
             geom=geom, workload="2d_consensus_outer_step",
         )
+        # the bench's own sampler carries the fence watermarks; its
+        # close() then emits the mem_watermark record into the stream
+        brun.memwatch = mw if mw.enabled else None
+        brun.modeled_hbm_bytes = modeled_hbm
         brun.chunk(0, eff_iters, eff_iters, dt, cost=cost)
         brun.close(
             status="ok", iters_per_sec=round(ips, 4), n=n, size=size,
@@ -298,6 +326,9 @@ def run_workload():
         "k": k,
         "blocks": blocks,
         "platform": platform,
+        "peak_hbm_bytes": mw.peak_bytes,
+        "modeled_hbm_bytes": modeled_hbm,
+        "n_compiles": n_compiles,
         "util": util,
         "knobs": {
             "fft_pad": fft_pad,
@@ -505,6 +536,12 @@ def emit(r, degraded=False):
     }
     if r.get("knobs"):
         out["knobs"] = r["knobs"]
+    if r.get("peak_hbm_bytes") is not None:
+        out["peak_hbm_bytes"] = r["peak_hbm_bytes"]
+    if r.get("modeled_hbm_bytes") is not None:
+        out["modeled_hbm_bytes"] = r["modeled_hbm_bytes"]
+    if r.get("n_compiles") is not None:
+        out["n_compiles"] = r["n_compiles"]
     u = r.get("util")
     if u:
         out["mfu"] = round(u["mfu_vs_bf16_peak"], 5)
@@ -515,6 +552,7 @@ def emit(r, degraded=False):
         out["bytes_per_step"] = u["bytes_per_step"]
         out["chip"] = u["chip"]
         out["cost_source"] = u["cost_source"]
+    _ledger_append_bench(r, out, degraded)
     if degraded:
         last, fastest = last_onchip_record()
         if last is not None:
@@ -529,6 +567,43 @@ def emit(r, degraded=False):
         ):
             out["best_onchip"] = fastest
     print(json.dumps(out))
+
+
+def _ledger_append_bench(r, out, degraded):
+    """Append this arm's normalized record to the durable perf ledger
+    (analysis.ledger; no-op unless CCSC_PERF_LEDGER is set). Keyed by
+    the chip that actually measured it — a degraded CPU fallback
+    accrues cpu history, never poisons a TPU key."""
+    from ccsc_code_iccv2017_tpu.analysis import ledger as _ledger
+
+    if not _ledger.enabled():
+        return
+    from ccsc_code_iccv2017_tpu.tune import store as _tstore
+
+    u = r.get("util") or {}
+    chip = u.get("chip") or r.get("platform")
+    if not chip:
+        return
+    _ledger.maybe_append(
+        chip=chip,
+        kind="bench",
+        workload="consensus2d",
+        shape_key=_tstore.learn_shape_key(
+            "consensus2d", k=r["k"], support=(11, 11), n=r["n"],
+            size=(r["size"], r["size"]), blocks=r["blocks"],
+        ),
+        knobs=r.get("knobs") or {},
+        value=r["iters_per_sec"],
+        unit="outer_iters/sec",
+        git_sha=out.get("git_sha"),
+        mfu=u.get("mfu_vs_bf16_peak"),
+        hbm_frac=u.get("hbm_frac"),
+        n_compiles=r.get("n_compiles"),
+        peak_hbm_bytes=r.get("peak_hbm_bytes"),
+        modeled_hbm_bytes=r.get("modeled_hbm_bytes"),
+        degraded=bool(degraded),
+        source="bench.py",
+    )
 
 
 def emit_serve(r, degraded=False):
@@ -563,6 +638,19 @@ def emit_serve(r, degraded=False):
         "warmup_s": r["warmup_s"],
         "knobs": r.get("knobs"),
     }
+    if r.get("peak_hbm_bytes") is not None:
+        out["peak_hbm_bytes"] = r["peak_hbm_bytes"]
+    if r.get("n_compiles") is not None:
+        out["n_compiles"] = r["n_compiles"]
+    # durable perf ledger (env-gated CCSC_PERF_LEDGER): the serving
+    # arm's record, keyed by the chip that measured it — the parent
+    # knows the degraded-ness the child workload cannot
+    from ccsc_code_iccv2017_tpu.analysis import ledger as _ledger
+
+    _ledger.append_serve_record(
+        r, degraded=bool(degraded), git_sha=out.get("git_sha"),
+        source="bench.py:serve",
+    )
     print(json.dumps(out))
 
 

@@ -1,0 +1,822 @@
+"""The durable perf ledger: the repo's cross-run performance memory.
+
+Every measured perf number so far lived in write-only artifacts —
+``BENCH_r*.json`` / ``onchip_r*.jsonl`` rows that no tool ever read
+back — so the bench trajectory was effectively empty and a silent 2x
+slowdown would ship unnoticed. This module gives the measured record
+a durable home and a read path:
+
+- **Append-only JSONL ledger** (one normalized record per line) with
+  the tune-store durability stance: appends repair a torn trailing
+  line first and fsync (a preempted run can never destroy history),
+  reads drop corrupt/torn lines instead of failing the stream, and a
+  missing/corrupt file reads as empty, never raises.
+- **Primary key** = ``chip | kind | workload | shape_key |
+  knob-digest`` — the same key structure the tuned-knob store uses
+  (``tune.store``), extended with a content digest of the resolved
+  knob dict so each distinct configuration accrues its OWN history
+  (comparing a bf16+matmul arm against an f32 baseline is not a
+  regression signal, it is noise).
+- **Robust history statistics**: per-key median ± MAD bands
+  (:func:`robust_band`) drive both the offline regression gate
+  (``scripts/perf_gate.py``) and the live :class:`AnomalyWatch` the
+  obs layer arms on a run's rolling roofline fraction — thermal
+  throttle, silent recompiles, and bad knob picks surface while the
+  run is still alive instead of at the next bench round.
+- **Seeding** from the existing historical record
+  (:func:`seed_all`): ``BENCH_r*.json`` round files and
+  ``onchip_r*.jsonl`` arm rows (via ``tune.store.parse_onchip_rows``
+  — the same run/value/FAILED row filters the tuned-knob seeding
+  applies), so the trajectory is non-empty on day one.
+
+Degraded rows (a TPU bench that fell back to CPU) are kept, keyed by
+the chip that ACTUALLY measured them with ``degraded: true`` on the
+record — the chip key already fences them off from TPU history, and
+an honest cpu number is still cpu history. FAILED / zero-value /
+chip-less rows never enter the ledger (nothing honest to key by).
+
+Location: ``CCSC_PERF_LEDGER`` env > ``$CCSC_COMPILE_CACHE/
+ccsc_perf_ledger.jsonl`` > repo-root ``perf_ledger.jsonl`` (next to
+the bench artifacts it replaces as the record of record).
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import math
+import os
+import time
+from typing import Dict, Iterable, List, Optional
+
+from ..utils import env as _env
+
+SCHEMA_VERSION = 1
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+__all__ = [
+    "Ledger",
+    "AnomalyWatch",
+    "default_ledger_path",
+    "enabled",
+    "knob_digest",
+    "normalize_record",
+    "record_key",
+    "robust_band",
+    "gate",
+    "watch_for",
+    "maybe_append",
+    "seed_from_bench_json",
+    "seed_from_onchip",
+    "seed_all",
+]
+
+
+def default_ledger_path() -> str:
+    override = _env.env_str("CCSC_PERF_LEDGER")
+    if override:
+        return override
+    cache = _env.env_str("CCSC_COMPILE_CACHE")
+    if cache:
+        return os.path.join(cache, "ccsc_perf_ledger.jsonl")
+    return os.path.join(_REPO_ROOT, "perf_ledger.jsonl")
+
+
+def enabled() -> bool:
+    """Auto-append from runs is opt-in: only an explicit
+    ``CCSC_PERF_LEDGER`` path arms the run/bench/fleet append hooks
+    (tests and casual runs must not grow a repo-root ledger as a side
+    effect). The gate/seed tooling takes explicit paths."""
+    return bool(_env.env_str("CCSC_PERF_LEDGER"))
+
+
+def knob_digest(knobs: Optional[Dict]) -> str:
+    """Content digest of a resolved knob dict — the ledger key's
+    configuration component. Canonical-JSON sha256, first 12 hex
+    chars; {} and None digest identically (an unknobbed record)."""
+    try:
+        blob = json.dumps(
+            knobs or {}, sort_keys=True, default=str
+        )
+    except (TypeError, ValueError):  # pragma: no cover - defensive
+        blob = str(sorted((knobs or {}).items()))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def normalize_record(
+    *,
+    chip: str,
+    kind: str,
+    value: float,
+    unit: str,
+    workload: str = "",
+    shape_key: str = "",
+    knobs: Optional[Dict] = None,
+    git_sha: Optional[str] = None,
+    roofline_frac: Optional[float] = None,
+    mfu: Optional[float] = None,
+    hbm_frac: Optional[float] = None,
+    n_compiles: Optional[int] = None,
+    peak_hbm_bytes: Optional[int] = None,
+    modeled_hbm_bytes: Optional[int] = None,
+    degraded: bool = False,
+    source: str = "",
+    t: Optional[float] = None,
+) -> Dict:
+    """One normalized ledger record. ``kind`` is the run family the
+    value measures ('learn' | 'bench' | 'serve' | 'solve');
+    ``roofline_frac`` is the achieved fraction of the binding
+    perfmodel roof (= max(mfu, hbm_frac) — the bound is set by the
+    tighter of the two)."""
+    if not chip:
+        raise ValueError("ledger records require a chip (the key)")
+    # canonical chip token: perfmodel.utilization labels an unknown
+    # generation '<kind>->v5e' — the ledger keys by the real chip
+    chip = str(chip).split("->")[0]
+    if roofline_frac is None and (
+        mfu is not None or hbm_frac is not None
+    ):
+        roofline_frac = max(mfu or 0.0, hbm_frac or 0.0)
+    return {
+        "schema": SCHEMA_VERSION,
+        "t": time.time() if t is None else float(t),
+        "chip": str(chip),
+        "kind": str(kind),
+        "workload": str(workload),
+        "shape_key": str(shape_key),
+        "knobs": dict(knobs or {}),
+        "knob_digest": knob_digest(knobs),
+        "value": float(value),
+        "unit": str(unit),
+        "git_sha": git_sha,
+        "roofline_frac": (
+            None if roofline_frac is None else round(
+                float(roofline_frac), 6
+            )
+        ),
+        "mfu": None if mfu is None else round(float(mfu), 6),
+        "hbm_frac": (
+            None if hbm_frac is None else round(float(hbm_frac), 6)
+        ),
+        "n_compiles": (
+            None if n_compiles is None else int(n_compiles)
+        ),
+        "peak_hbm_bytes": (
+            None if peak_hbm_bytes is None else int(peak_hbm_bytes)
+        ),
+        "modeled_hbm_bytes": (
+            None if modeled_hbm_bytes is None
+            else int(modeled_hbm_bytes)
+        ),
+        "degraded": bool(degraded),
+        "source": str(source),
+    }
+
+
+_RECORD_FIELDS = frozenset(
+    ("chip", "kind", "value", "unit", "workload", "shape_key",
+     "knobs", "git_sha", "roofline_frac", "mfu", "hbm_frac",
+     "n_compiles", "peak_hbm_bytes", "modeled_hbm_bytes", "degraded",
+     "source", "t")
+)
+_REQUIRED_FIELDS = frozenset(("chip", "kind", "value", "unit"))
+
+
+def coerce_record(d: Dict) -> Dict:
+    """Normalize an EXTERNAL record dict (``perf_gate.py --record``):
+    unknown keys are dropped (a bench emit record carries metric/
+    vs_baseline/... fields the ledger does not key on), required keys
+    are checked up front — a malformed record is a :class:`ValueError`
+    (a usage error the CLI reports as exit 2), never a TypeError
+    traceback that CI would misread as a regression verdict."""
+    if not isinstance(d, dict):
+        raise ValueError("record must be a JSON object")
+    missing = sorted(
+        f for f in _REQUIRED_FIELDS if d.get(f) in (None, "")
+    )
+    if missing:
+        raise ValueError(
+            f"record missing required field(s) {missing} "
+            "(chip, kind, value, unit)"
+        )
+    return normalize_record(
+        **{k: v for k, v in d.items() if k in _RECORD_FIELDS}
+    )
+
+
+def record_key(rec: Dict) -> str:
+    """The per-configuration history key."""
+    return "|".join(
+        (
+            rec.get("chip", ""),
+            rec.get("kind", ""),
+            rec.get("workload", ""),
+            rec.get("shape_key", ""),
+            rec.get("knob_digest") or knob_digest(rec.get("knobs")),
+        )
+    )
+
+
+class Ledger:
+    """Append-only JSONL perf history at ``path`` (default resolved
+    by :func:`default_ledger_path`). Reads are stateless — every
+    query re-parses the file, so concurrent appenders (a bench child
+    and a serving fleet) never fight an in-memory cache."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_ledger_path()
+
+    # -- write ---------------------------------------------------------
+    def append(self, rec: Dict) -> Dict:
+        """Append one record (normalize first via
+        :func:`normalize_record` if the digest is missing). A torn
+        trailing line from a killed writer is terminated before the
+        append so the new record can never be welded onto it; the
+        line is flushed AND fsynced — the ledger is the durable
+        record of record, one fsync per run is cheap."""
+        if "knob_digest" not in rec:
+            rec = normalize_record(
+                **{
+                    k: rec[k]
+                    for k in rec
+                    if k in normalize_record.__kwdefaults__
+                    or k in ("chip", "kind", "value", "unit")
+                }
+            )
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        torn = False
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b"\n"
+        except (OSError, ValueError):
+            pass
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(
+                ("\n" if torn else "")
+                + json.dumps(rec, sort_keys=True, default=str)
+                + "\n"
+            )
+            f.flush()
+            try:
+                os.fsync(f.fileno())
+            except OSError:  # pragma: no cover - exotic filesystems
+                pass
+        return rec
+
+    # -- read ----------------------------------------------------------
+    def read(self) -> List[Dict]:
+        """Every parseable record, in file order. Corrupt or torn
+        lines are dropped (the crash window of a line-granular
+        writer); a missing file reads as empty."""
+        out: List[Dict] = []
+        try:
+            with open(self.path, encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(rec, dict) and "value" in rec \
+                            and rec.get("chip"):
+                        out.append(rec)
+        except OSError:
+            return []
+        return out
+
+    def records(
+        self,
+        chip: Optional[str] = None,
+        kind: Optional[str] = None,
+        workload: Optional[str] = None,
+        shape_key: Optional[str] = None,
+        knob_digest_: Optional[str] = None,
+        include_degraded: bool = True,
+    ) -> List[Dict]:
+        out = []
+        for rec in self.read():
+            if chip is not None and rec.get("chip") != chip:
+                continue
+            if kind is not None and rec.get("kind") != kind:
+                continue
+            if workload is not None and rec.get("workload") != workload:
+                continue
+            if shape_key is not None and \
+                    rec.get("shape_key") != shape_key:
+                continue
+            if knob_digest_ is not None and \
+                    rec.get("knob_digest") != knob_digest_:
+                continue
+            if not include_degraded and rec.get("degraded"):
+                continue
+            out.append(rec)
+        return out
+
+    def by_key(self) -> Dict[str, List[Dict]]:
+        """Records grouped by :func:`record_key`, each group in
+        timestamp order (the gate's unit of history)."""
+        groups: Dict[str, List[Dict]] = {}
+        for rec in self.read():
+            groups.setdefault(record_key(rec), []).append(rec)
+        for rows in groups.values():
+            rows.sort(key=lambda r: r.get("t", 0.0))
+        return groups
+
+    @property
+    def empty(self) -> bool:
+        return not self.read()
+
+
+# ---------------------------------------------------------------------
+# robust statistics + the regression gate
+# ---------------------------------------------------------------------
+
+# MAD -> sigma for a normal distribution; the band is
+# median - max(k * 1.4826 * MAD, frac * median): the MAD term adapts
+# to a noisy history, the fractional floor keeps a zero-MAD history
+# (identical repeat measurements) from flagging ordinary jitter.
+_MAD_SIGMA = 1.4826
+
+
+def robust_band(
+    values: Iterable[float],
+    mad_k: Optional[float] = None,
+    frac: Optional[float] = None,
+) -> Optional[Dict[str, float]]:
+    """Median / MAD / lower-band of a history sample (None when
+    empty). ``mad_k`` defaults to CCSC_PERF_GATE_MAD, ``frac`` (the
+    minimum relative drop treated as regression) to
+    CCSC_PERF_GATE_FRAC."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return None
+    if mad_k is None:
+        mad_k = _env.env_float("CCSC_PERF_GATE_MAD")
+    if frac is None:
+        frac = _env.env_float("CCSC_PERF_GATE_FRAC")
+
+    def _median(xs: List[float]) -> float:
+        m = len(xs) // 2
+        return xs[m] if len(xs) % 2 else 0.5 * (xs[m - 1] + xs[m])
+
+    med = _median(vals)
+    mad = _median(sorted(abs(v - med) for v in vals))
+    lo = med - max(mad_k * _MAD_SIGMA * mad, frac * abs(med))
+    return {
+        "n": len(vals),
+        "median": med,
+        "mad": mad,
+        "lo": lo,
+        "mad_k": float(mad_k),
+        "frac": float(frac),
+    }
+
+
+def gate(
+    ledger: Ledger,
+    mad_k: Optional[float] = None,
+    frac: Optional[float] = None,
+    min_history: Optional[int] = None,
+    record: Optional[Dict] = None,
+) -> List[Dict]:
+    """Per-key regression verdicts.
+
+    Default mode judges each key's NEWEST record against the robust
+    band of its prior history; with ``record`` given, only that
+    record is judged, against the key's FULL ledger history (the
+    CI shape: gate the run you just measured). Keys with fewer than
+    ``min_history`` prior records are reported as ``skipped`` — a
+    young ledger passes trivially and starts gating as history
+    accrues. Verdict dicts carry ok/skipped/value/band fields;
+    ``ok`` is False only for a judged regression."""
+    if min_history is None:
+        min_history = _env.env_int("CCSC_PERF_GATE_MIN_HISTORY")
+    verdicts: List[Dict] = []
+
+    def _judge(key: str, newest: Dict, history: List[Dict]) -> Dict:
+        vals = [r["value"] for r in history]
+        band = robust_band(vals, mad_k=mad_k, frac=frac)
+        v = float(newest["value"])
+        if band is None or band["n"] < min_history:
+            return {
+                "key": key,
+                "value": v,
+                "unit": newest.get("unit"),
+                "n_history": 0 if band is None else band["n"],
+                "skipped": True,
+                "ok": True,
+                "reason": (
+                    f"history < {min_history} record(s)"
+                ),
+            }
+        ok = v >= band["lo"]
+        return {
+            "key": key,
+            "value": v,
+            "unit": newest.get("unit"),
+            "n_history": band["n"],
+            "median": band["median"],
+            "mad": band["mad"],
+            "lo": band["lo"],
+            "ratio_vs_median": (
+                v / band["median"] if band["median"] else None
+            ),
+            "skipped": False,
+            "ok": ok,
+            "t": newest.get("t"),
+            "source": newest.get("source"),
+        }
+
+    groups = ledger.by_key()
+    if record is not None:
+        rec = (
+            record
+            if "knob_digest" in record
+            else coerce_record(record)
+        )
+        key = record_key(rec)
+        verdicts.append(_judge(key, rec, groups.get(key, [])))
+        return verdicts
+    for key, rows in sorted(groups.items()):
+        if len(rows) < 2:
+            verdicts.append(
+                _judge(key, rows[-1], [])
+            )
+            continue
+        verdicts.append(_judge(key, rows[-1], rows[:-1]))
+    return verdicts
+
+
+# ---------------------------------------------------------------------
+# live anomaly watch (rolling roofline fraction vs the historical band)
+# ---------------------------------------------------------------------
+
+
+class AnomalyWatch:
+    """Rolling-window watch on a run's achieved roofline fraction.
+
+    ``observe(frac)`` pushes one chunk's achieved fraction of the
+    perfmodel roof; once the window is full, a rolling median below
+    the historical band's lower edge returns a ``perf_anomaly``
+    record (the obs layer emits it). Fires ONCE per excursion: the
+    watch re-arms only after the rolling median recovers above the
+    band — a long throttled stretch is one event, not one per chunk.
+    Not thread-safe by design (a Run's chunks are sequential)."""
+
+    def __init__(
+        self,
+        band: Dict[str, float],
+        window: Optional[int] = None,
+        key: str = "",
+    ):
+        self.band = dict(band)
+        self.window = window or _env.env_int("CCSC_ANOMALY_WINDOW")
+        self.key = key
+        self._recent: List[float] = []
+        self._armed = True
+        self.n_fired = 0
+
+    def observe(self, frac: float) -> Optional[Dict]:
+        frac = float(frac)
+        if not math.isfinite(frac):
+            return None
+        self._recent.append(frac)
+        if len(self._recent) > self.window:
+            self._recent.pop(0)
+        if len(self._recent) < self.window:
+            return None
+        rolling = sorted(self._recent)[len(self._recent) // 2]
+        lo = self.band["lo"]
+        if rolling >= lo:
+            self._armed = True
+            return None
+        if not self._armed:
+            return None
+        self._armed = False
+        self.n_fired += 1
+        return {
+            "rolling_frac": round(rolling, 6),
+            "band_lo": round(lo, 6),
+            "median": round(self.band["median"], 6),
+            "mad": round(self.band["mad"], 6),
+            "n_history": int(self.band["n"]),
+            "window": self.window,
+            "key": self.key,
+        }
+
+
+def watch_for(
+    chip: str,
+    kind: str,
+    workload: Optional[str] = None,
+    shape_key: Optional[str] = None,
+    knobs: Optional[Dict] = None,
+    ledger: Optional[Ledger] = None,
+    min_history: Optional[int] = None,
+) -> Optional[AnomalyWatch]:
+    """Build an :class:`AnomalyWatch` from the ledger's roofline-
+    fraction history for THIS configuration: the knob digest is
+    always part of the match (a legitimate f32 baseline judged
+    against bf16-arm history would alarm on every run — the exact
+    cross-configuration noise the ledger key exists to prevent),
+    relaxing only shape then workload when the exact combination has
+    no history yet. None (no watch) when even the relaxed history is
+    thinner than ``min_history`` or the ledger is disabled. Degraded
+    records never set the band."""
+    if ledger is None:
+        if not enabled():
+            return None
+        ledger = Ledger()
+    if min_history is None:
+        min_history = _env.env_int("CCSC_PERF_GATE_MIN_HISTORY")
+    digest = knob_digest(knobs)
+    tiers = []
+    if workload and shape_key:
+        tiers.append((workload, shape_key))
+    if workload:
+        tiers.append((workload, None))
+    tiers.append((None, None))
+    for wl, sk in tiers:
+        fracs = [
+            r["roofline_frac"]
+            for r in ledger.records(
+                chip=chip, kind=kind, workload=wl, shape_key=sk,
+                knob_digest_=digest, include_degraded=False,
+            )
+            if r.get("roofline_frac")
+        ]
+        if len(fracs) >= min_history:
+            band = robust_band(fracs)
+            return AnomalyWatch(
+                band,
+                key="|".join(
+                    (chip, kind, wl or "*", sk or "*", digest)
+                ),
+            )
+    return None
+
+
+def maybe_append(**fields) -> Optional[Dict]:
+    """Append a normalized record iff the ledger is armed
+    (``CCSC_PERF_LEDGER`` set) — the one-line hook every producer
+    (bench arms, learner runs, serve sessions) calls. Never raises:
+    a ledger IO failure must not take down the run it measures."""
+    if not enabled():
+        return None
+    try:
+        return Ledger().append(normalize_record(**fields))
+    except Exception:
+        return None
+
+
+def append_serve_record(
+    rec: Dict,
+    degraded: bool = False,
+    git_sha: Optional[str] = None,
+    source: str = "serve.bench",
+) -> Optional[Dict]:
+    """Append a serving-workload record (the ``serve.bench
+    run_serve_workload`` dict shape) — the ONE mapping from that
+    record to a normalized ledger row, shared by ``bench.py``'s
+    CCSC_BENCH_SERVE arm and ``scripts/serve_bench.py`` so the two
+    entry points cannot drift. No-op (None) when the ledger is
+    disarmed or the record is chip-less."""
+    chip = rec.get("chip") or rec.get("platform")
+    if not enabled() or not chip:
+        return None
+    return maybe_append(
+        chip=chip,
+        kind="serve",
+        workload="serve2d",
+        shape_key=rec.get("shape_key", ""),
+        knobs=rec.get("knobs") or {},
+        value=rec["engine_requests_per_sec"],
+        unit="requests/sec",
+        git_sha=git_sha,
+        n_compiles=rec.get("n_compiles"),
+        peak_hbm_bytes=rec.get("peak_hbm_bytes"),
+        degraded=bool(degraded),
+        source=source,
+    )
+
+
+# ---------------------------------------------------------------------
+# seeding from the historical record
+# ---------------------------------------------------------------------
+
+
+_SERVE_METRIC_RE = None
+
+
+def _serve_shape_key(metric: str) -> str:
+    """Shape bucket of a serve-bench metric string ('... requests
+    40..64^2, k=32 7x7, ...'), built with the SAME key builder the
+    live producers use (serve.bench's solve_shape_key of the largest
+    bucket) — a seeded serve row that keyed differently from every
+    future record would never contribute history. Empty when
+    unparsable."""
+    global _SERVE_METRIC_RE
+    if _SERVE_METRIC_RE is None:
+        import re
+
+        _SERVE_METRIC_RE = re.compile(
+            r"requests \d+\.\.(\d+)\^2, k=(\d+) (\d+)x\d+"
+        )
+    m = _SERVE_METRIC_RE.search(metric)
+    if not m:
+        return ""
+    hi, k, sup = (int(g) for g in m.groups())
+    from ..tune import store as tune_store
+
+    return tune_store.solve_shape_key(
+        "solve2d", k=k, support=(sup, sup), spatial=(hi, hi)
+    )
+
+
+def _bench_shape_key(metric: str) -> str:
+    """Shape bucket of a bench-emit metric string, via the same
+    parser and key builder the tuned-knob store seeds with (empty
+    when unparsable — the record still keys by chip/kind/knobs)."""
+    from ..tune import store as tune_store
+
+    shape = tune_store._parse_learn_metric(metric)
+    if shape is None:
+        return ""
+    k, sup, n, size, blocks = shape
+    return tune_store.learn_shape_key(
+        "consensus2d", k=k, support=(sup, sup), n=n,
+        size=(size, size), blocks=blocks,
+    )
+
+
+def _seed_rec_from_parsed(parsed: Dict, source: str) -> Optional[Dict]:
+    """Normalize one bench-emit dict (the ``parsed`` object of a
+    BENCH_r*.json round file) — the same row filters as
+    ``tune.store.parse_onchip_rows``: zero/FAILED rows are dropped,
+    chip-less rows are dropped (nothing honest to key by; a
+    'ran on cpu' DEGRADED metric names its chip and is kept, keyed
+    cpu + flagged degraded)."""
+    metric = parsed.get("metric", "")
+    value = float(parsed.get("value", 0.0) or 0.0)
+    if value <= 0 or "FAILED" in metric:
+        return None
+    chip = parsed.get("chip")
+    degraded = bool(parsed.get("degraded")) or "DEGRADED" in metric
+    if not chip:
+        if "ran on cpu" in metric:
+            chip = "cpu"
+        elif ", 1 chip" in metric:
+            # an on-chip row predating the chip field: v5e was the
+            # only TPU generation in the measured record
+            chip = "v5e"
+        else:
+            return None
+    unit = parsed.get("unit", "outer_iters/sec")
+    kind = "serve" if unit == "requests/sec" else "bench"
+    return normalize_record(
+        chip=chip,
+        kind=kind,
+        workload="consensus2d" if kind == "bench" else "serve2d",
+        shape_key=(
+            _bench_shape_key(metric)
+            if kind == "bench"
+            else _serve_shape_key(metric)
+        ),
+        knobs=parsed.get("knobs") or {},
+        value=value,
+        unit=unit,
+        git_sha=parsed.get("git_sha"),
+        mfu=parsed.get("mfu"),
+        hbm_frac=parsed.get("hbm_frac"),
+        degraded=degraded,
+        source=source,
+    )
+
+
+def _seen_seed_pairs(ledger: Ledger) -> set:
+    """(key, source) pairs already in the ledger — the seeders'
+    idempotence index. A seed row's source names its artifact
+    (``BENCH_r05.json``, ``onchip_r5.jsonl:run``), so re-running
+    ``--seed-from`` skips everything it already imported instead of
+    duplicating the whole record (duplicates would shrink the MAD
+    and let young keys past min_history on copied evidence)."""
+    return {
+        (record_key(r), r.get("source", "")) for r in ledger.read()
+    }
+
+
+def seed_from_bench_json(
+    ledger: Ledger, path: str, seen: Optional[set] = None
+) -> int:
+    """Seed from one ``BENCH_r*.json`` round file (the driver's
+    end-of-round snapshot: ``{"n": N, "parsed": {bench emit
+    record}}``). The nested last_onchip/best_onchip rows are NOT
+    seeded — they are copies of onchip_r*.jsonl rows the jsonl
+    seeder reads directly. Idempotent: rows whose (key, source)
+    already exist in the ledger are skipped."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            top = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    if not isinstance(top, dict):
+        return 0
+    parsed = top.get("parsed")
+    if not isinstance(parsed, dict):
+        return 0
+    rec = _seed_rec_from_parsed(
+        parsed, source=os.path.basename(path)
+    )
+    if rec is None:
+        return 0
+    if seen is None:
+        seen = _seen_seed_pairs(ledger)
+    pair = (record_key(rec), rec["source"])
+    if pair in seen:
+        return 0
+    ledger.append(rec)
+    seen.add(pair)
+    return 1
+
+
+def seed_from_onchip(
+    ledger: Ledger, path: str, seen: Optional[set] = None
+) -> int:
+    """Seed from one ``onchip_r*.jsonl`` round file via
+    ``tune.store.parse_onchip_rows`` (the shared row filters: run
+    present, value > 0, not FAILED). Chip-less rows are dropped;
+    degraded rows are kept under their actual chip, flagged.
+    Idempotent like :func:`seed_from_bench_json`."""
+    from ..tune import store as tune_store
+
+    if seen is None:
+        seen = _seen_seed_pairs(ledger)
+    n = 0
+    for row in tune_store.parse_onchip_rows(path):
+        if not row["chip"]:
+            continue
+        unit = row["unit"]
+        kind = "serve" if unit == "requests/sec" else "bench"
+        if kind == "serve":
+            shape_key = _serve_shape_key(row["metric"])
+        elif row["shape"] is not None:
+            k, sup, nn, size, blocks = row["shape"]
+            shape_key = tune_store.learn_shape_key(
+                "consensus2d", k=k, support=(sup, sup), n=nn,
+                size=(size, size), blocks=blocks,
+            )
+        else:
+            shape_key = ""
+        rec = normalize_record(
+            chip=row["chip"],
+            kind=kind,
+            workload=(
+                "consensus2d" if kind == "bench" else "serve2d"
+            ),
+            shape_key=shape_key,
+            knobs=row["knobs"],
+            value=row["value"],
+            unit=unit,
+            mfu=row["mfu"],
+            hbm_frac=row["hbm_frac"],
+            degraded=row["degraded"],
+            source=f"{os.path.basename(path)}:{row['run']}",
+        )
+        pair = (record_key(rec), rec["source"])
+        if pair in seen:
+            continue
+        ledger.append(rec)
+        seen.add(pair)
+        n += 1
+    return n
+
+
+def seed_all(
+    ledger: Ledger,
+    paths: Optional[List[str]] = None,
+    repo: Optional[str] = None,
+) -> Dict[str, int]:
+    """Seed from every historical artifact: explicit ``paths`` or the
+    repo's ``BENCH_r*.json`` + ``onchip_r*.jsonl`` globs. Returns
+    per-file seeded-row counts."""
+    if paths is None:
+        root = repo or _REPO_ROOT
+        paths = sorted(
+            glob.glob(os.path.join(root, "BENCH_r*.json"))
+        ) + sorted(glob.glob(os.path.join(root, "onchip_r*.jsonl")))
+    counts: Dict[str, int] = {}
+    seen = _seen_seed_pairs(ledger)  # one idempotence index per pass
+    for path in paths:
+        if path.endswith(".jsonl"):
+            counts[path] = seed_from_onchip(ledger, path, seen=seen)
+        else:
+            counts[path] = seed_from_bench_json(
+                ledger, path, seen=seen
+            )
+    return counts

@@ -90,6 +90,19 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     "tune_pick": _s("kind", "chip", "shape_key"),
     "tune_guard": _s("kind", "chip"),
     "tune_arm": _s("kind", "chip", "shape_key"),
+    # -- performance observatory (analysis.ledger, utils.memwatch) ---
+    # perf_anomaly: the live anomaly watch — a run's rolling roofline
+    # fraction fell below its historical band (analysis.ledger
+    # AnomalyWatch, emitted from Run.chunk)
+    "perf_anomaly": _s("rolling_frac", "band_lo", "n_history"),
+    # mem_watermark: measured peak HBM vs the perfmodel estimate
+    # (utils.memwatch sampled at dispatch fences; emitted at close)
+    "mem_watermark": _s("peak_hbm_bytes", "n_samples"),
+    # mem_oom_dump: RESOURCE_EXHAUSTED forensic dump written
+    "mem_oom_dump": _s("path"),
+    # ledger_append: a normalized perf record entered the durable
+    # run ledger (CCSC_PERF_LEDGER)
+    "ledger_append": _s("key", "value", "unit"),
 }
 
 

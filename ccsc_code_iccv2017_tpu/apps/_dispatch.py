@@ -214,15 +214,10 @@ def _retry_discards_progress(metrics_dir, checkpoint_dir, t_start):
 
 def _looks_oom(e: BaseException) -> bool:
     """Recognize an XLA device-memory failure at compile or dispatch
-    without importing jaxlib exception types (they move between
-    releases): the status string is the stable surface."""
-    s = f"{type(e).__name__}: {e}"
-    return (
-        "RESOURCE_EXHAUSTED" in s
-        or "Out of memory" in s
-        or "out of memory" in s
-        or "OOM" in s
-    )
+    (the shared status-string recognizer, utils.memwatch.is_oom)."""
+    from ..utils import memwatch
+
+    return memwatch.is_oom(e)
 
 
 def _can_stream(mesh, solver, forbidden, kwargs) -> bool:
@@ -333,6 +328,31 @@ class _DegradeLog:
                 }
             )
 
+    def oom_forensics(
+        self, e: BaseException, metrics_dir
+    ) -> None:
+        """Write the utils.memwatch OOM forensic dump (device memory
+        stats + error) next to the metrics stream and mirror a
+        ``mem_oom_dump`` record into the dispatch events file — the
+        learner's own run is already closed when the exception
+        reaches the ladder, so this writer is the surviving surface."""
+        from ..utils import memwatch
+
+        path = memwatch.oom_dump(e, dump_dir=metrics_dir)
+        if path is None:
+            return
+        print(f"auto-degrade: OOM forensic dump written to {path}")
+        if self._writer is not None:
+            self._writer.write(
+                {
+                    "t": time.time(),
+                    "type": "mem_oom_dump",
+                    "host": self._host,
+                    "path": path,
+                    "error": str(e)[:300],
+                }
+            )
+
     def close(self) -> None:
         if self._writer is not None:
             self._writer.close()
@@ -434,6 +454,9 @@ def dispatch_learn(
             except Exception as e:
                 if not _looks_oom(e):
                     raise
+                # forensics first — whatever the ladder decides, the
+                # OOM leaves a device-memory post-mortem
+                log.oom_forensics(e, cfg.metrics_dir)
                 if _retry_discards_progress(
                     cfg.metrics_dir, kwargs.get("checkpoint_dir"),
                     t_attempt,

@@ -430,6 +430,17 @@ def learn(
                 fused_z=cfg.fused_z,
                 donate_state=cfg.donate_state,
             )
+            if run.memwatch is not None:
+                # modeled peak working set, so the close-time
+                # mem_watermark record can report the modeled-vs-
+                # measured delta (utils.memwatch)
+                try:
+                    est, _budget = perfmodel.inmem_learn_estimate(
+                        b.shape, geom, cfg
+                    )
+                    run.modeled_hbm_bytes = int(est)
+                except Exception:
+                    pass
         # hang/stall watchdog (utils.watchdog): armed around every
         # fenced dispatch below; deadline = roofline bound x slack
         wd = watchdog.maybe_start(

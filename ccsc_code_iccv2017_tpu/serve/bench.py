@@ -45,10 +45,14 @@ def run_serve_workload() -> Dict:
 
     from ..config import ProblemGeom, ServeConfig, SolveConfig
     from ..models.reconstruct import ReconstructionProblem, reconstruct
-    from ..utils import obs
+    from ..utils import memwatch, obs, perfmodel
     from .engine import CodecEngine
 
     from ..utils import env as _env
+
+    # measured HBM watermark across the whole workload (baseline loop
+    # + engine) — rides the record and the perf ledger
+    mw = memwatch.MemWatch()
 
     n_req = _env.env_int("CCSC_SERVE_REQUESTS")
     lo = _env.env_int("CCSC_SERVE_SIZE_MIN")
@@ -112,6 +116,7 @@ def run_serve_workload() -> Dict:
         )
         float(rr.trace.num_iters)
     t_loop_warm = time.perf_counter() - t0
+    mw.sample()  # post-baseline-loop watermark
 
     # ---- the engine: two buckets covering the size range, AOT-warmed
     mid = (lo + hi) // 2
@@ -135,6 +140,7 @@ def run_serve_workload() -> Dict:
         t_eng = time.perf_counter() - t0
         knobs = dict(eng._knob_dict)
         eng.close()
+        mw.sample()  # engine drained: peak request-serving state
         rate = len(reqs) / t_eng if t_eng > 0 else 0.0
         return results, rate, warmup_s, t_ready, knobs
 
@@ -219,9 +225,19 @@ def run_serve_workload() -> Dict:
             "tuned_max_rel_err_vs_loop": round(max_rel2, 6),
             "tuned_event_stream": metrics2,
         }
+    from ..tune import store as tune_store
+
     return {
         "serve": True,
         "platform": jax.devices()[0].platform,
+        "chip": perfmodel.detect_chip(),
+        "shape_key": tune_store.solve_shape_key(
+            "solve2d", k=k, support=(sup, sup), spatial=(hi, hi)
+        ),
+        "peak_hbm_bytes": mw.peak_bytes,
+        "n_compiles": (summary.get("compile") or {}).get(
+            "n_compiles"
+        ),
         "workload": (
             f"2D inpainting serving, {n_req} "
             f"{'homogeneous' if homog else 'heterogeneous'} requests "

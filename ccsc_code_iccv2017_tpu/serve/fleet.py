@@ -263,6 +263,7 @@ class ServeFleet:
             )
         )
         self._metricsd = None
+        self._t_start = time.time()
 
         self._run = obs.start_run(
             fleet_cfg.metrics_dir,
@@ -1521,6 +1522,74 @@ class ServeFleet:
             "replicas": reps,
         }
 
+    def _ledger_append(self, st: Dict[str, object]) -> None:
+        """Append this serving session's normalized record to the
+        durable perf ledger (analysis.ledger; no-op unless
+        CCSC_PERF_LEDGER is set): achieved fleet requests/sec over
+        the session lifetime, keyed by chip + solve-shape bucket +
+        the replicas' resolved knob dict. Never raises — the ledger
+        must not fail a fleet close."""
+        try:
+            from ..analysis import ledger as _ledger
+
+            if not _ledger.enabled():
+                return
+            n = int(st.get("n_requests") or 0)
+            elapsed = time.time() - self._t_start
+            chip = self._run.chip
+            if n <= 0 or elapsed <= 0 or not chip:
+                return
+            from ..tune import store as tune_store
+            from ..utils import obs
+
+            knobs = next(
+                (
+                    dict(rep.engine._knob_dict)
+                    for rep in self._replicas
+                    if rep is not None
+                    and getattr(rep.engine, "_knob_dict", None)
+                ),
+                {},
+            )
+            knobs["replicas"] = len(self._replicas)
+            _spatial = max(
+                (sp for _s_, sp in self.buckets),
+                key=lambda sp: tuple(sp),
+            )
+            workload = tune_store.solve_workload(self.geom)
+            rec = _ledger.maybe_append(
+                chip=chip,  # normalize_record canonicalizes
+                kind="serve",
+                workload=workload,
+                shape_key=tune_store.solve_shape_key(
+                    workload,
+                    k=self.geom.num_filters,
+                    support=tuple(self.geom.spatial_support),
+                    spatial=tuple(_spatial),
+                ),
+                knobs=knobs,
+                value=n / elapsed,
+                unit="requests/sec",
+                git_sha=obs.git_sha(),
+                n_compiles=(
+                    self._run.compile_monitor.summary()["n_compiles"]
+                    if self._run.compile_monitor is not None
+                    else None
+                ),
+                source="serve.fleet",
+            )
+            if rec is not None:
+                self._emit(
+                    "ledger_append",
+                    replica_id=None,
+                    key=_ledger.record_key(rec),
+                    value=rec["value"],
+                    unit=rec["unit"],
+                    path=_ledger.default_ledger_path(),
+                )
+        except Exception:  # pragma: no cover - defensive
+            pass
+
     def close(self, drain_timeout_s: float = 600.0):
         """Serve every queued request, retire the replicas, and close
         the telemetry run with the fleet summary. Re-entrant and
@@ -1679,6 +1748,7 @@ class ServeFleet:
                     self._emit("slo_histogram", replica_id=None, **sn)
             if not self._run.closed:
                 st = self.stats()
+                self._ledger_append(st)
                 self._run.close(
                     status="ok",
                     n_requests=st["n_requests"],

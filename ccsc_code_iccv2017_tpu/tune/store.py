@@ -275,49 +275,82 @@ def _parse_learn_metric(metric: str):
     return k, sup, n, size, blocks
 
 
+def parse_onchip_rows(jsonl_path: str):
+    """Normalized rows of an on-chip round file (onchip_r*.jsonl —
+    the records scripts/onchip_queue.sh appends), with the shared
+    baseline row filters applied: a row must name its run and carry a
+    positive value, and FAILED rows (no measurement happened) are
+    dropped. Malformed lines are skipped, a missing file yields
+    nothing. Each row:
+    ``{run, metric, value, unit, chip, knobs, mfu, hbm_frac,
+    degraded, shape}`` — ``shape`` is the parsed north-star learn
+    tuple (k, support, n, size, blocks) or None, ``degraded`` covers
+    both the explicit boolean and the legacy metric-string marker.
+    Consumers layer their own policy on top: :func:`seed_from_onchip`
+    additionally refuses degraded / non-learner / chip-less /
+    shape-less rows (a tuned arm must be reapplicable), the perf
+    ledger (``analysis.ledger``) keeps degraded rows under their
+    actual chip."""
+    try:
+        lines = open(jsonl_path, encoding="utf-8").read().splitlines()
+    except OSError:
+        return
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        res = rec.get("result") or {}
+        metric = res.get("metric", "")
+        try:
+            value = float(res.get("value", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            continue
+        if not rec.get("run") or value <= 0 or "FAILED" in metric:
+            continue
+        yield {
+            "run": rec["run"],
+            "metric": metric,
+            "value": value,
+            "unit": res.get("unit", "outer_iters/sec"),
+            "chip": res.get("chip"),
+            "knobs": res.get("knobs") or {},
+            "mfu": res.get("mfu"),
+            "hbm_frac": res.get("hbm_frac"),
+            "degraded": bool(res.get("degraded"))
+            or "DEGRADED" in metric,
+            "shape": _parse_learn_metric(metric),
+        }
+
+
 def seed_from_onchip(
     store: TunedStore, jsonl_path: str, workload: str = "consensus2d"
 ) -> int:
-    """Seed the store from an on-chip round file (onchip_r*.jsonl —
-    the records scripts/onchip_queue.sh appends). Only real-chip
+    """Seed the store from an on-chip round file. Only real-chip
     learner records qualify: DEGRADED/FAILED rows, zero values,
     non-learner units, and rows without a chip field are skipped —
     the store key is the ACTUAL chip that measured the arm, so a CPU
     fallback can never seed a TPU key. Returns the number of arms
     recorded."""
     n_added = 0
-    try:
-        lines = open(jsonl_path, encoding="utf-8").read().splitlines()
-    except OSError:
-        return 0
-    for line in lines:
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            continue
-        res = rec.get("result") or {}
-        metric = res.get("metric", "")
-        value = float(res.get("value", 0.0) or 0.0)
+    for row in parse_onchip_rows(jsonl_path):
         if (
-            not rec.get("run")
-            or value <= 0
-            or res.get("degraded")
-            or "DEGRADED" in metric
-            or "FAILED" in metric
-            or res.get("unit", "outer_iters/sec") != "outer_iters/sec"
+            row["degraded"]
+            or row["unit"] != "outer_iters/sec"
         ):
             continue
         # a chip-less row is unkeyable (nothing honest to key by); an
         # intentional-CPU row seeds only a cpu key, which the chip
         # match at lookup already fences off from TPU runs
-        chip = res.get("chip")
+        chip = row["chip"]
         if not chip:
             continue
-        shape = _parse_learn_metric(metric)
-        if shape is None:
+        if row["shape"] is None:
             continue
-        k, sup, n, size, blocks = shape
-        knobs = res.get("knobs") or {}
+        k, sup, n, size, blocks = row["shape"]
+        knobs = row["knobs"]
         arm = {
             name: v
             for name, v in knobs.items()
@@ -336,9 +369,9 @@ def seed_from_onchip(
                 size=(size, size), blocks=blocks,
             ),
             arm,
-            value,
-            res.get("unit", "outer_iters/sec"),
-            source=f"{os.path.basename(jsonl_path)}:{rec['run']}",
+            row["value"],
+            row["unit"],
+            source=f"{os.path.basename(jsonl_path)}:{row['run']}",
         )
         n_added += 1
     return n_added

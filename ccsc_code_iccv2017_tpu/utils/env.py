@@ -121,6 +121,39 @@ REGISTRY: Dict[str, Knob] = _knobs(
      "environments (fallback of FleetConfig.metricsd_snapshot)"),
     ("CCSC_METRICSD_INTERVAL_S", "float", 5.0, "serve.metricsd",
      "snapshot-file rewrite cadence in seconds"),
+    # -- performance observatory (analysis.ledger, utils.memwatch,
+    # scripts/perf_gate.py) ------------------------------------------
+    ("CCSC_PERF_LEDGER", "path", None,
+     "analysis.ledger, utils.obs, bench.py, serve.bench, serve.fleet",
+     "durable perf-ledger JSONL path; setting it arms the automatic "
+     "run/bench/serve appends and the live roofline anomaly watch "
+     "(unset = observatory off; gate/seed tools take explicit "
+     "paths)"),
+    ("CCSC_PERF_GATE_MAD", "float", 3.0,
+     "analysis.ledger, scripts/perf_gate.py",
+     "regression band half-width in MAD-sigmas below the per-key "
+     "history median"),
+    ("CCSC_PERF_GATE_FRAC", "float", 0.25,
+     "analysis.ledger, scripts/perf_gate.py",
+     "minimum relative drop treated as a regression (the band floor "
+     "when the history MAD is ~0)"),
+    ("CCSC_PERF_GATE_MIN_HISTORY", "int", 3,
+     "analysis.ledger, scripts/perf_gate.py",
+     "prior records a key needs before the gate/anomaly watch judge "
+     "it (younger keys pass trivially)"),
+    ("CCSC_ANOMALY_WINDOW", "int", 3, "analysis.ledger, utils.obs",
+     "rolling chunk window of the live anomaly watch (the rolling "
+     "median of achieved roofline fraction is compared to the "
+     "historical band)"),
+    ("CCSC_MEMWATCH", "flag", True, "utils.memwatch, utils.obs",
+     "sample device.memory_stats() at dispatch fences for the "
+     "measured HBM watermark (0 disables the poller)"),
+    ("CCSC_MEM_DELTA_FRAC", "float", 0.5, "utils.memwatch",
+     "modeled-vs-measured peak-HBM relative delta above which the "
+     "mem_watermark record is flagged"),
+    ("CCSC_MEM_DUMP_DIR", "path", None, "utils.memwatch",
+     "OOM forensic dump directory override (default: the run's "
+     "metrics dir, else the system temp dir)"),
     # -- autotuning ---------------------------------------------------
     ("CCSC_TUNE_STORE", "path", None, "tune.store",
      "tuned-knob store path (else $CCSC_COMPILE_CACHE/"

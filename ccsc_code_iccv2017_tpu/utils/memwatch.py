@@ -1,0 +1,264 @@
+"""Measured HBM watermark accounting + OOM forensics.
+
+Device memory has only ever been *modeled* in this repo
+(``perfmodel.inmem_learn_estimate`` prices the working set before a
+run) — never *measured*. The model drives real decisions (the
+auto-degrade ladder's preflight, the streaming placement tiers), so a
+drifting model silently mis-ladders runs. This module closes the
+loop:
+
+- :class:`MemWatch` — samples ``device.memory_stats()`` at the
+  driver's existing dispatch fences (the obs layer calls ``sample()``
+  from ``Run.chunk``, so instrumentation adds zero extra fences) and
+  tracks the peak. Backends that expose the allocator's own
+  ``peak_bytes_in_use`` report the true high-water mark; others get
+  the max of ``bytes_in_use`` across fence samples (a lower bound —
+  labeled as such by ``watermark_source``). Platforms without memory
+  stats at all (CPU jaxlib returns None) degrade to a no-op poller.
+- :meth:`MemWatch.watermark_record` — the ``mem_watermark`` obs
+  record: measured peak vs the modeled estimate, with the relative
+  delta flagged when it exceeds ``CCSC_MEM_DELTA_FRAC`` (modeled-vs-
+  measured drift is a bug in the model or a leak in the program;
+  either way it should be loud).
+- :func:`oom_dump` — on a RESOURCE_EXHAUSTED (:func:`is_oom`
+  recognizes the stable status strings without importing jaxlib
+  exception types), write an atomic JSON forensic dump of every
+  device's memory stats + the error text, emit a ``mem_oom_dump``
+  obs record, and return the dump path. Wired into the auto-degrade
+  ladder (``apps._dispatch``) so every OOM leaves a post-mortem even
+  when the ladder recovers.
+
+Peak measurements ride the perf ledger (``analysis.ledger``,
+``peak_hbm_bytes``) so HBM watermarks accrue history next to the
+throughput record they explain.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from . import env as _env
+
+__all__ = ["MemWatch", "is_oom", "oom_dump"]
+
+
+def _device_stats(dev) -> Optional[Dict[str, float]]:
+    """One device's memory_stats dict, or None when the backend does
+    not implement it (CPU returns None; some plugins raise)."""
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not isinstance(stats, dict) or not stats:
+        return None
+    return stats
+
+
+class MemWatch:
+    """Peak device-memory poller. ``enabled=False`` (or
+    ``CCSC_MEMWATCH=0``) makes every method a cheap no-op; a backend
+    without memory stats degrades to the same. ``devices`` is
+    injectable for tests (anything with a ``memory_stats()`` method
+    and an ``id`` attribute)."""
+
+    def __init__(self, devices=None, enabled: Optional[bool] = None):
+        self.enabled = (
+            _env.env_flag("CCSC_MEMWATCH") if enabled is None
+            else bool(enabled)
+        )
+        self._devices = devices
+        self._peak: Dict[object, int] = {}
+        self._exact: Dict[object, bool] = {}
+        self.n_samples = 0
+
+    def _resolve_devices(self) -> List:
+        if self._devices is None:
+            try:
+                import jax
+
+                self._devices = list(jax.devices())
+            except Exception:
+                self._devices = []
+        return self._devices
+
+    def sample(self) -> Optional[int]:
+        """Poll every device once; returns the current total
+        bytes_in_use (None when no backend reports). Call at dispatch
+        fences — the only points where host-visible allocator state
+        is meaningful anyway."""
+        if not self.enabled:
+            return None
+        total = None
+        for dev in self._resolve_devices():
+            stats = _device_stats(dev)
+            if stats is None:
+                continue
+            key = getattr(dev, "id", id(dev))
+            in_use = stats.get("bytes_in_use")
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                # the allocator's own high-water mark: exact, and
+                # monotone — no fence can miss a transient peak
+                self._peak[key] = max(
+                    self._peak.get(key, 0), int(peak)
+                )
+                self._exact[key] = True
+            elif in_use is not None:
+                self._peak[key] = max(
+                    self._peak.get(key, 0), int(in_use)
+                )
+                self._exact.setdefault(key, False)
+            if in_use is not None:
+                total = (total or 0) + int(in_use)
+        self.n_samples += 1
+        return total
+
+    @property
+    def peak_bytes(self) -> Optional[int]:
+        """Max per-device peak observed so far (None when no device
+        ever reported — distinguish 'not measured' from 0). This is
+        the per-chip watermark — the number that answers 'will a
+        chip OOM'."""
+        if not self._peak:
+            return None
+        return max(self._peak.values())
+
+    @property
+    def total_peak_bytes(self) -> Optional[int]:
+        """Sum of per-device peaks — the whole-problem footprint a
+        sharded run spreads across its mesh. This is what the
+        modeled estimate (perfmodel prices the FULL working set, not
+        one shard) is comparable to; comparing the model against the
+        per-device max would read every D-device run as ~-(1-1/D)
+        'drift'."""
+        if not self._peak:
+            return None
+        return sum(self._peak.values())
+
+    @property
+    def watermark_source(self) -> Optional[str]:
+        """'allocator_peak' when the backend exposed its true
+        high-water mark, 'fence_samples' when the peak is the max of
+        sampled bytes_in_use (a lower bound), None when unmeasured."""
+        if not self._peak:
+            return None
+        return (
+            "allocator_peak"
+            if all(self._exact.values())
+            else "fence_samples"
+        )
+
+    def watermark_record(
+        self, modeled_bytes: Optional[int] = None
+    ) -> Optional[Dict]:
+        """The ``mem_watermark`` obs record: measured peaks (per-chip
+        max AND whole-mesh total), modeled estimate, relative delta,
+        and whether the delta exceeds the CCSC_MEM_DELTA_FRAC drift
+        threshold. The delta compares the modeled whole-problem
+        estimate against the measured TOTAL across devices — the two
+        commensurable numbers. None when there is nothing to report
+        (no measurement and no model)."""
+        peak = self.peak_bytes
+        total = self.total_peak_bytes
+        if peak is None and modeled_bytes is None:
+            return None
+        delta = None
+        flagged = False
+        if total is not None and modeled_bytes:
+            delta = (total - modeled_bytes) / float(modeled_bytes)
+            flagged = abs(delta) > _env.env_float(
+                "CCSC_MEM_DELTA_FRAC"
+            )
+        return {
+            "peak_hbm_bytes": peak,
+            "peak_hbm_bytes_total": total,
+            "modeled_hbm_bytes": (
+                None if modeled_bytes is None else int(modeled_bytes)
+            ),
+            "delta_frac": (
+                None if delta is None else round(delta, 4)
+            ),
+            "flagged": flagged,
+            "n_samples": self.n_samples,
+            "source": self.watermark_source,
+        }
+
+
+def is_oom(e: BaseException) -> bool:
+    """Recognize an XLA device-memory failure at compile or dispatch
+    without importing jaxlib exception types (they move between
+    releases): the status string is the stable surface."""
+    s = f"{type(e).__name__}: {e}"
+    return (
+        "RESOURCE_EXHAUSTED" in s
+        or "Out of memory" in s
+        or "out of memory" in s
+        or "OOM" in s
+    )
+
+
+def oom_dump(
+    exc: BaseException,
+    dump_dir: Optional[str] = None,
+    devices=None,
+) -> Optional[str]:
+    """Write an OOM forensic dump and return its path (None when
+    ``exc`` is not a device-memory failure). The dump carries every
+    device's full memory_stats (or its absence), the error text, and
+    provenance — written atomically (tmp + rename) so a cascading
+    crash can never leave a torn post-mortem. Emits a
+    ``mem_oom_dump`` record into the current obs run when one is
+    open. Never raises: forensics must not mask the original error."""
+    if not is_oom(exc):
+        return None
+    try:
+        # CCSC_MEM_DUMP_DIR is an OVERRIDE (documented precedence):
+        # operators aiming forensics at persistent storage must win
+        # over the caller's (often ephemeral) metrics dir
+        out_dir = (
+            _env.env_str("CCSC_MEM_DUMP_DIR")
+            or dump_dir
+            or tempfile.gettempdir()
+        )
+        if devices is None:
+            try:
+                import jax
+
+                devices = list(jax.devices())
+            except Exception:
+                devices = []
+        rows = []
+        for dev in devices:
+            rows.append(
+                {
+                    "id": getattr(dev, "id", None),
+                    "platform": getattr(dev, "platform", None),
+                    "device_kind": getattr(dev, "device_kind", None),
+                    "stats": _device_stats(dev),
+                }
+            )
+        from . import obs
+
+        dump = {
+            "t": time.time(),
+            "error": f"{type(exc).__name__}: {exc}"[:4000],
+            "git_sha": obs.git_sha(),
+            "devices": rows,
+        }
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir, f"ccsc_oom_dump_{int(time.time() * 1e3)}.json"
+        )
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(dump, f, indent=1, default=str)
+        os.replace(tmp, path)
+        obs.record(
+            "mem_oom_dump", path=path, error=dump["error"][:300]
+        )
+        return path
+    except Exception:  # pragma: no cover - forensics must not mask
+        return None

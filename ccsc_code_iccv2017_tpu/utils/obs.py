@@ -639,6 +639,17 @@ class Run:
         self.closed = False
         self.compile_monitor: Optional[CompileMonitor] = None
         self.chip: Optional[str] = None
+        # performance observatory hooks (analysis.ledger /
+        # utils.memwatch): start_run arms them when CCSC_PERF_LEDGER /
+        # CCSC_MEMWATCH say so; every hook is None-safe so a plain Run
+        # costs nothing
+        self.anomaly = None  # analysis.ledger.AnomalyWatch
+        self.memwatch = None  # utils.memwatch.MemWatch
+        self.modeled_hbm_bytes: Optional[int] = None
+        self._ledger_meta: Optional[Dict[str, Any]] = None
+        self._led_iters = 0
+        self._led_dt = 0.0
+        self._led_fracs: List[float] = []
         self._host = _process_index()
         if heartbeat_every_s is None:
             heartbeat_every_s = _env.env_float("CCSC_OBS_HEARTBEAT_S")
@@ -685,6 +696,12 @@ class Run:
         live roofline (MFU + HBM fraction vs the chip's bounds) rides
         the same record and the 'all' console tier."""
         ips = (n_adopted / dt_s) if dt_s > 0 and n_adopted else 0.0
+        # the chunk fence just completed — the one host-visible point
+        # where allocator state is meaningful (utils.memwatch)
+        if self.memwatch is not None:
+            self.memwatch.sample()
+        self._led_iters += int(n_adopted)
+        self._led_dt += float(dt_s)
         fields: Dict[str, Any] = {
             "start_it": int(start_it),
             "length": int(length),
@@ -696,7 +713,10 @@ class Run:
             f"chunk {start_it + 1}..{start_it + n_adopted}: "
             f"{ips:.3g} it/s"
         )
+        frac = None
         if cost is not None and ips > 0:
+            import math
+
             from . import perfmodel
 
             util = perfmodel.utilization(cost, ips, chip=self.chip)
@@ -710,6 +730,13 @@ class Run:
                 achieved_gbps=round(util["achieved_gbps"], 3),
                 bound_it_per_sec=round(bound, 4),
             )
+            if bound > 0 and math.isfinite(bound):
+                # achieved fraction of the binding roof — the number
+                # the perf ledger's anomaly band is built from
+                frac = ips / bound
+                fields["roofline_frac"] = round(frac, 6)
+                if len(self._led_fracs) < 4096:
+                    self._led_fracs.append(frac)
             line += (
                 f", MFU {100 * util['mfu_vs_bf16_peak']:.2f}%, "
                 f"HBM {100 * util['hbm_frac']:.1f}%, "
@@ -719,6 +746,20 @@ class Run:
         self.event("roofline", **fields)
         if _VERBOSE_ADMITS[self.verbose] >= _TIERS["all"]:
             print(line)
+        if self.anomaly is not None and frac is not None:
+            anom = self.anomaly.observe(frac)
+            if anom is not None:
+                self.event("perf_anomaly", **anom)
+                self.console(
+                    "perf anomaly: rolling roofline fraction "
+                    f"{anom['rolling_frac']:.3g} fell below the "
+                    f"historical band ({anom['band_lo']:.3g}, "
+                    f"median {anom['median']:.3g} over "
+                    f"{anom['n_history']} run(s)) — thermal "
+                    "throttle, silent recompiles, or a bad knob "
+                    "pick while the run is still alive",
+                    tier="brief",
+                )
 
     def heartbeat(self, step: int, fence_latency_s: float) -> None:
         """Periodic per-host liveness record (cadence
@@ -746,6 +787,67 @@ class Run:
         if drained:
             self.event("phase", phase=phase, sections=drained)
 
+    def _ledger_record(
+        self, status: str, compile_summary: Optional[Dict] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Append this run's normalized perf record to the durable
+        ledger (analysis.ledger) iff CCSC_PERF_LEDGER armed it at
+        start_run and the run actually measured something. Returns
+        {key, value, unit, path} for the ledger_append event, or
+        None. Never raises — the ledger must not take down the run
+        it records."""
+        meta = self._ledger_meta
+        if (
+            meta is None
+            or status != "ok"
+            or self._led_iters <= 0
+            or self._led_dt <= 0
+            or self.chip is None
+            # multi-host runs: ONE run = ONE record — every process
+            # drives the same program, so N appends would inflate
+            # n_history N-fold and collapse the gate's MAD to ~0
+            or self._host != 0
+        ):
+            return None
+        try:
+            from ..analysis import ledger as _ledger
+
+            if not _ledger.enabled():
+                return None
+            fracs = sorted(self._led_fracs)
+            frac = fracs[len(fracs) // 2] if fracs else None
+            rec = _ledger.maybe_append(
+                chip=self.chip,  # normalize_record canonicalizes
+                kind=meta["kind"],
+                workload=meta["workload"],
+                shape_key=meta["shape_key"],
+                knobs=meta["knobs"],
+                value=self._led_iters / self._led_dt,
+                unit="outer_iters/sec",
+                git_sha=git_sha(),
+                roofline_frac=frac,
+                n_compiles=(
+                    (compile_summary or {}).get("n_compiles")
+                ),
+                peak_hbm_bytes=(
+                    self.memwatch.peak_bytes
+                    if self.memwatch is not None
+                    else None
+                ),
+                modeled_hbm_bytes=self.modeled_hbm_bytes,
+                source=f"run:{meta['algorithm']}",
+            )
+            if rec is None:
+                return None
+            return {
+                "key": _ledger.record_key(rec),
+                "value": rec["value"],
+                "unit": rec["unit"],
+                "path": _ledger.default_ledger_path(),
+            }
+        except Exception:  # pragma: no cover - defensive
+            return None
+
     # -- lifecycle -----------------------------------------------------
     def close(self, status: str = "ok", **fields) -> None:
         """Emit the compile summary + final summary record and release
@@ -763,7 +865,42 @@ class Run:
             self.compile_monitor.uninstall()
         else:
             summary = None
+        # performance-observatory closing work: the final memwatch
+        # sample and the durable ledger append happen with or WITHOUT
+        # a stream (CCSC_PERF_LEDGER alone is enough); only the
+        # provenance records below need a writer.
+        if self.memwatch is not None:
+            self.memwatch.sample()
+        led = self._ledger_record(status, summary)
         if self.writer is not None:
+            # closing records — written directly (the run is already
+            # marked closed, so event() would no-op) and BEFORE the
+            # summary so readers see them inside the run.
+            if self.memwatch is not None:
+                wm = self.memwatch.watermark_record(
+                    self.modeled_hbm_bytes
+                )
+                if wm is not None:
+                    self.writer.write(
+                        {
+                            "t": time.time(),
+                            "type": "mem_watermark",
+                            "host": self._host,
+                            **wm,
+                        }
+                    )
+            if led is not None:
+                self.writer.write(
+                    {
+                        "t": time.time(),
+                        "type": "ledger_append",
+                        "host": self._host,
+                        "key": led["key"],
+                        "value": led["value"],
+                        "unit": led["unit"],
+                        "path": led["path"],
+                    }
+                )
             rec = {
                 "t": time.time(),
                 "type": "summary",
@@ -795,6 +932,119 @@ class _NullWriterRun(Run):
         super().__init__(None, verbose=verbose)
 
 
+# learner algorithm string -> tune.store workload-token algo — the
+# runs whose close() auto-appends a normalized record to the perf
+# ledger (bench/serve arms append through their own record paths)
+_LEARN_ALGOS = {
+    "consensus": "consensus",
+    "masked_admm": "masked",
+    "consensus_streaming": "streaming",
+}
+
+# the perf-relevant LearnConfig knobs a ledger record keys on (the
+# knob-dict component of the ledger primary key: each distinct
+# configuration accrues its own history)
+_LEDGER_KNOB_KEYS = (
+    "outer_chunk", "donate_state", "fft_impl", "fft_pad", "fused_z",
+    "fused_z_precision", "storage_dtype", "d_storage_dtype",
+    "num_blocks", "carry_freq", "use_pallas", "tune",
+)
+
+
+def _ledger_kind(algorithm: str) -> Optional[str]:
+    if algorithm in _LEARN_ALGOS:
+        return "learn"
+    if algorithm.startswith("serve"):
+        return "serve"
+    if algorithm == "bench":
+        return "bench"
+    if algorithm == "reconstruct":
+        return "solve"
+    return None
+
+
+def _arm_observatory(run: Run, algorithm, geom, cfg, extra_meta):
+    """Arm the performance-observatory hooks on a freshly opened run:
+    the HBM watermark poller (CCSC_MEMWATCH), the close-time ledger
+    append for learner runs, and the live anomaly watch when the
+    durable ledger (CCSC_PERF_LEDGER) holds enough roofline history
+    for this (chip, kind, workload). All best-effort: a broken
+    observatory must never break the run it observes."""
+    ledger_armed = False
+    try:
+        from ..analysis import ledger as _ledger
+
+        ledger_armed = _ledger.enabled()
+    except Exception:  # pragma: no cover - defensive
+        pass
+    # a telemetry-off run (writer None) still participates in the
+    # observatory when CCSC_PERF_LEDGER is set: chunk() accumulates
+    # and close() appends without a stream (only the ledger_append/
+    # mem_watermark EVENTS need a writer) — the registry promises
+    # 'setting it arms the automatic appends', not 'if telemetry is
+    # also on'
+    if not run.active and not ledger_armed:
+        return
+    try:
+        from . import memwatch as _memwatch
+
+        mw = _memwatch.MemWatch()
+        if mw.enabled:
+            run.memwatch = mw
+    except Exception:  # pragma: no cover - defensive
+        pass
+    kind = _ledger_kind(algorithm)
+    workload = str(extra_meta.get("workload") or "")
+    algo = _LEARN_ALGOS.get(algorithm)
+    if algo is not None and geom is not None and cfg is not None:
+        shape_key = ""
+        try:
+            from ..tune import store as tune_store
+
+            workload = tune_store.learn_workload(geom, algo)
+            ds = extra_meta.get("data_shape")
+            if ds:
+                shape_key = tune_store.learn_shape_key(
+                    workload,
+                    k=geom.num_filters,
+                    support=tuple(geom.spatial_support),
+                    n=int(ds[0]),
+                    size=tuple(ds[-geom.ndim_spatial:]),
+                    blocks=int(getattr(cfg, "num_blocks", 1) or 1),
+                )
+        except Exception:  # pragma: no cover - defensive
+            pass
+        run._ledger_meta = {
+            "kind": "learn",
+            "workload": workload,
+            "shape_key": shape_key,
+            "knobs": {
+                k: getattr(cfg, k)
+                for k in _LEDGER_KNOB_KEYS
+                if hasattr(cfg, k)
+            },
+            "algorithm": algorithm,
+        }
+    if kind is None or run.chip is None or not ledger_armed:
+        return
+    try:
+        from ..analysis import ledger as _ledger
+
+        # band strictly within this CONFIGURATION (the knob digest is
+        # part of the match): an f32 baseline judged against bf16
+        # history would alarm on every legitimate run
+        meta = run._ledger_meta or {}
+        run.anomaly = _ledger.watch_for(
+            run.chip.split("->")[0],
+            kind,
+            workload or None,
+            shape_key=meta.get("shape_key") or None,
+            knobs=meta.get("knobs"),
+        )
+    except Exception:  # pragma: no cover - defensive
+        pass
+
+
 def start_run(
     metrics_dir: Optional[str],
     algorithm: str,
@@ -820,6 +1070,19 @@ def start_run(
     stream recording every replica's compiles."""
     if metrics_dir is None:
         run = _NullWriterRun(verbose=verbose)
+        # the durable ledger does not require telemetry: when
+        # CCSC_PERF_LEDGER is armed, even a stream-less run detects
+        # its chip and accrues a close-time record
+        try:
+            from ..analysis import ledger as _ledger
+
+            if _ledger.enabled():
+                from . import perfmodel
+
+                run.chip = perfmodel.detect_chip()
+                _arm_observatory(run, algorithm, geom, cfg, extra_meta)
+        except Exception:  # pragma: no cover - defensive
+            pass
         _CURRENT.append(run)
         return run
     pid = _process_index()
@@ -867,6 +1130,7 @@ def start_run(
         except TypeError:  # pragma: no cover - non-dataclass cfg
             meta["config"] = str(cfg)
     meta.update(extra_meta)
+    _arm_observatory(run, algorithm, geom, cfg, extra_meta)
     run.event("run_meta", **meta)
     if not compile_monitor:
         _CURRENT.append(run)

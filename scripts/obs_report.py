@@ -45,12 +45,16 @@ def _section(title):
     return f"\n== {title} " + "=" * max(1, 64 - len(title))
 
 
-def render(events, stale_after=None, n_traces=3):
-    """-> the dashboard string (pure function of the parsed records).
+def render(events, stale_after=None, n_traces=3, ledger_path=None):
+    """-> the dashboard string (pure function of the parsed records
+    plus, optionally, the durable perf ledger).
     ``stale_after``: per-host liveness threshold in seconds (default:
     the watchdog's peer-staleness default, CCSC_WATCHDOG_PEER_STALE_S).
     ``n_traces``: how many slowest request timelines the TRACES
     section renders (0 keeps the section to counts only).
+    ``ledger_path``: perf-ledger JSONL to render the LEDGER section
+    from (per-key trend vs the robust history band); None skips it
+    unless the stream itself carries ledger_append records.
     """
     if stale_after is None:
         from ccsc_code_iccv2017_tpu.utils import env as _env
@@ -495,6 +499,110 @@ def render(events, stale_after=None, n_traces=3):
                 "SLO breach; scripts/xprof_report.py attributes it)"
             )
 
+    # -- MEMORY: measured vs modeled HBM watermark (utils.memwatch) --
+    wms = by.get("mem_watermark", [])
+    ooms = by.get("mem_oom_dump", [])
+    if wms or ooms:
+        lines.append(_section("MEMORY"))
+        gb = lambda b: "—" if b is None else f"{b / 1e9:.3f} GB"
+        w = wms[-1] if wms else None
+        if w is not None:
+            src = w.get("source") or "unmeasured"
+            lines.append(
+                f"  measured peak  {gb(w.get('peak_hbm_bytes'))}  "
+                f"({src}, {w.get('n_samples', 0)} sample(s))"
+            )
+            lines.append(
+                f"  modeled peak   {gb(w.get('modeled_hbm_bytes'))}  "
+                "(perfmodel.inmem_learn_estimate — the preflight the "
+                "degrade ladder trusts)"
+            )
+            if w.get("delta_frac") is not None:
+                flag = (
+                    "  <-- DRIFT past CCSC_MEM_DELTA_FRAC"
+                    if w.get("flagged") else ""
+                )
+                lines.append(
+                    f"  delta          "
+                    f"{100 * w['delta_frac']:+.1f}% measured vs "
+                    f"modeled{flag}"
+                )
+        for o in ooms:
+            lines.append(
+                f"  OOM dump       {_fmt_ts(o['t'])}  "
+                f"{o.get('path')}"
+            )
+
+    # -- LEDGER: this run's appends + per-key trend vs history band --
+    led_appends = by.get("ledger_append", [])
+    anomalies = by.get("perf_anomaly", [])
+    if led_appends or anomalies or ledger_path:
+        lines.append(_section("LEDGER"))
+        for a in led_appends:
+            lines.append(
+                f"  appended      {a.get('value'):.6g} "
+                f"{a.get('unit') or ''}  -> {a.get('key')}"
+            )
+        if anomalies:
+            lines.append(
+                f"  anomalies     {len(anomalies)} perf_anomaly "
+                "event(s) — rolling roofline fraction fell below "
+                "the historical band"
+            )
+            for a in anomalies[-3:]:
+                lines.append(
+                    f"    {_fmt_ts(a['t'])}  rolling "
+                    f"{a.get('rolling_frac')} < band lo "
+                    f"{a.get('band_lo')} (median {a.get('median')} "
+                    f"over {a.get('n_history')} run(s))"
+                )
+        if ledger_path and os.path.exists(ledger_path):
+            from ccsc_code_iccv2017_tpu.analysis import (  # noqa: E402
+                ledger as _ledger,
+            )
+
+            led = _ledger.Ledger(ledger_path)
+            groups = led.by_key()
+            verdicts = {
+                v["key"]: v for v in _ledger.gate(led)
+            }
+            lines.append(
+                f"  history       {sum(len(v) for v in groups.values())}"
+                f" record(s) over {len(groups)} key(s) "
+                f"({ledger_path})"
+            )
+            newest_first = sorted(
+                groups.items(),
+                key=lambda kv: -(kv[1][-1].get("t") or 0.0),
+            )
+            for key, recs in newest_first[:12]:
+                v = verdicts.get(key, {})
+                newest = recs[-1]
+                if v.get("skipped") or "median" not in v:
+                    judged = "(young history)"
+                else:
+                    rel = v.get("ratio_vs_median")
+                    judged = (
+                        ("OK" if v["ok"] else "REGRESSED")
+                        + (
+                            f" {100 * (rel - 1):+.1f}% vs median "
+                            f"{v['median']:.6g}, band lo "
+                            f"{v['lo']:.6g}"
+                            if rel else ""
+                        )
+                    )
+                lines.append(
+                    f"    {key}\n"
+                    f"      n={len(recs)}  newest "
+                    f"{newest['value']:.6g} "
+                    f"{newest.get('unit') or ''}  {judged}"
+                )
+            if len(newest_first) > 12:
+                lines.append(
+                    f"    … {len(newest_first) - 12} more key(s) "
+                    "(scripts/perf_gate.py --list)"
+                )
+
     spans = [
         e for e in events
         if e.get("type") in ("span_start", "span_end")
@@ -532,6 +640,7 @@ def render(events, stale_after=None, n_traces=3):
     for kind in ("checkpoint_save", "checkpoint_load", "recovery",
                  "preemption", "stall", "peer_stale", "degrade",
                  "fault_fired", "slo_breach", "slo_profile",
+                 "perf_anomaly", "mem_oom_dump",
                  "fleet_replica_dead",
                  "fleet_replica_restart", "fleet_replica_ready",
                  "fleet_replica_abandoned", "fleet_requeue",
@@ -593,6 +702,13 @@ def main(argv=None):
         "metrics dir holds each replica engine's stream in a "
         "replica-NN/ subdir; auto-enabled when such subdirs exist)",
     )
+    ap.add_argument(
+        "--ledger", default=None,
+        help="perf-ledger JSONL for the LEDGER section (default: "
+        "the standard resolution — CCSC_PERF_LEDGER, else "
+        "$CCSC_COMPILE_CACHE/ccsc_perf_ledger.jsonl, else repo "
+        "perf_ledger.jsonl — when that file exists)",
+    )
     args = ap.parse_args(argv)
     recursive = args.recursive
     if not recursive and os.path.isdir(args.path):
@@ -606,10 +722,17 @@ def main(argv=None):
     if args.json:
         print(json.dumps(events))
         return events
+    ledger_path = args.ledger
+    if ledger_path is None:
+        from ccsc_code_iccv2017_tpu.analysis import ledger as _ledger
+
+        candidate = _ledger.default_ledger_path()
+        if os.path.exists(candidate):
+            ledger_path = candidate
     print(
         render(
             events, stale_after=args.stale_after,
-            n_traces=args.traces,
+            n_traces=args.traces, ledger_path=ledger_path,
         )
     )
     return events

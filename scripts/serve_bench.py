@@ -83,6 +83,15 @@ def main(argv=None):
     from ccsc_code_iccv2017_tpu.utils import obs
 
     rec = run_serve_workload()
+    # durable perf ledger (analysis.ledger; no-op unless
+    # CCSC_PERF_LEDGER is set): this session's serving record accrues
+    # history next to the bench arms' — the same shared mapping
+    # bench.py's CCSC_BENCH_SERVE arm appends through
+    from ccsc_code_iccv2017_tpu.analysis import ledger as _ledger
+
+    _ledger.append_serve_record(
+        rec, git_sha=obs.git_sha(), source="scripts/serve_bench.py"
+    )
     print(json.dumps(rec))
     if args.json:
         return rec

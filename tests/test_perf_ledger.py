@@ -1,0 +1,777 @@
+"""Performance observatory: durable run ledger, regression gating,
+live anomaly detection, HBM watermark accounting.
+
+Covers the ISSUE acceptance set:
+
+- ledger append/read round-trips, corrupt/torn-line tolerance, and
+  the knob-digest primary key;
+- robust MAD band math on seeded history;
+- gate verdicts: exit 0 on the shipped tree's seeded ledger, nonzero
+  when a run record is injected at 0.5x its historical median;
+- the memwatch poller against a fake ``memory_stats`` and the OOM
+  forensic dump;
+- perf_anomaly emission from a degraded rolling roofline fraction
+  (both the AnomalyWatch unit and the obs.Run.chunk wiring);
+- learner-run auto-append at close + the bench record's new
+  peak_hbm_bytes / n_compiles fields;
+- obs_report LEDGER + MEMORY sections.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ccsc_code_iccv2017_tpu.analysis import ledger as ledger_mod  # noqa: E402
+from ccsc_code_iccv2017_tpu.utils import memwatch, obs  # noqa: E402
+
+GATE = os.path.join(REPO, "scripts", "perf_gate.py")
+
+
+def _rec(value, chip="v5e", kind="bench", knobs=None, t=None, **kw):
+    return ledger_mod.normalize_record(
+        chip=chip,
+        kind=kind,
+        workload=kw.pop("workload", "consensus2d"),
+        shape_key=kw.pop(
+            "shape_key", "consensus2d:k100:s11x11:n128:sz128x128:b8"
+        ),
+        knobs=knobs or {"storage_dtype": "bfloat16"},
+        value=value,
+        unit=kw.pop("unit", "outer_iters/sec"),
+        t=t,
+        **kw,
+    )
+
+
+def _gate_cli(*args, env_extra=None):
+    env = dict(os.environ)
+    env.pop("CCSC_PERF_LEDGER", None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, GATE, *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO,
+        timeout=120,
+    )
+
+
+# --------------------------------------------------------------------
+# ledger persistence
+# --------------------------------------------------------------------
+
+
+def test_append_read_filter_roundtrip(tmp_path):
+    led = ledger_mod.Ledger(str(tmp_path / "led.jsonl"))
+    led.append(_rec(2.0, t=1.0))
+    led.append(_rec(2.1, t=2.0))
+    led.append(_rec(17.0, chip="cpu", kind="serve",
+                    unit="requests/sec", t=3.0))
+    assert len(led.read()) == 3
+    assert len(led.records(chip="v5e")) == 2
+    assert len(led.records(kind="serve")) == 1
+    groups = led.by_key()
+    assert len(groups) == 2
+    # per-key history is timestamp-ordered
+    key = [k for k in groups if k.startswith("v5e|")][0]
+    assert [r["value"] for r in groups[key]] == [2.0, 2.1]
+
+
+def test_knob_digest_keys_configurations_apart(tmp_path):
+    led = ledger_mod.Ledger(str(tmp_path / "led.jsonl"))
+    led.append(_rec(2.0, knobs={"storage_dtype": "bfloat16"}))
+    led.append(_rec(1.0, knobs={"storage_dtype": "float32"}))
+    assert len(led.by_key()) == 2  # same shape, different arms
+    # {} and None digest identically; key order is canonical
+    assert ledger_mod.knob_digest({}) == ledger_mod.knob_digest(None)
+    assert ledger_mod.knob_digest(
+        {"a": 1, "b": 2}
+    ) == ledger_mod.knob_digest({"b": 2, "a": 1})
+
+
+def test_corrupt_and_torn_ledger_reads(tmp_path):
+    path = tmp_path / "led.jsonl"
+    good = json.dumps(_rec(2.0, t=1.0))
+    with open(path, "w") as f:
+        f.write(good + "\n")
+        f.write("{not json at all\n")
+        f.write(json.dumps(_rec(2.2, t=2.0)) + "\n")
+        f.write('{"torn": ')  # no newline: a killed writer
+    led = ledger_mod.Ledger(str(path))
+    vals = [r["value"] for r in led.read()]
+    assert vals == [2.0, 2.2]  # corrupt + torn lines dropped
+    # an append first terminates the torn tail — the new record is
+    # never welded onto it
+    led.append(_rec(2.4, t=3.0))
+    vals = [r["value"] for r in led.read()]
+    assert vals == [2.0, 2.2, 2.4]
+    # a missing file reads empty, never raises
+    assert ledger_mod.Ledger(str(tmp_path / "absent.jsonl")).read() == []
+
+
+# --------------------------------------------------------------------
+# robust band math + gate verdicts
+# --------------------------------------------------------------------
+
+
+def test_robust_band_mad_math():
+    band = ledger_mod.robust_band(
+        [1.0, 2.0, 3.0, 4.0, 100.0], mad_k=3.0, frac=0.25
+    )
+    assert band["n"] == 5
+    assert band["median"] == pytest.approx(3.0)
+    assert band["mad"] == pytest.approx(1.0)  # robust to the outlier
+    assert band["lo"] == pytest.approx(3.0 - 3.0 * 1.4826 * 1.0)
+    # zero-MAD history: the fractional floor keeps jitter gateable
+    band = ledger_mod.robust_band([2.0, 2.0, 2.0], mad_k=3.0,
+                                  frac=0.25)
+    assert band["mad"] == 0.0
+    assert band["lo"] == pytest.approx(1.5)
+    assert ledger_mod.robust_band([]) is None
+
+
+def test_gate_verdicts(tmp_path):
+    led = ledger_mod.Ledger(str(tmp_path / "led.jsonl"))
+    for i, v in enumerate([1.95, 2.02, 2.0, 1.98, 2.05, 2.01]):
+        led.append(_rec(v, t=100.0 + i))
+    # newest within the band -> ok
+    (v,) = ledger_mod.gate(led, min_history=3)
+    assert not v["skipped"] and v["ok"]
+    assert v["n_history"] == 5
+    # inject a record at 0.5x the historical median -> regression
+    led.append(_rec(1.0, t=200.0))
+    (v,) = ledger_mod.gate(led, min_history=3)
+    assert not v["skipped"] and not v["ok"]
+    assert v["ratio_vs_median"] == pytest.approx(0.5, abs=0.02)
+    # a young key is skipped (passes trivially)
+    led2 = ledger_mod.Ledger(str(tmp_path / "young.jsonl"))
+    led2.append(_rec(2.0, t=1.0))
+    led2.append(_rec(1.0, t=2.0))
+    (v,) = ledger_mod.gate(led2, min_history=3)
+    assert v["skipped"] and v["ok"]
+
+
+def test_gate_external_record_mode(tmp_path):
+    led = ledger_mod.Ledger(str(tmp_path / "led.jsonl"))
+    for i, v in enumerate([2.0, 2.1, 1.9, 2.0]):
+        led.append(_rec(v, t=100.0 + i))
+    # record mode judges against the FULL history without appending
+    ok = ledger_mod.gate(led, record=_rec(1.95), min_history=3)[0]
+    bad = ledger_mod.gate(led, record=_rec(0.9), min_history=3)[0]
+    assert ok["ok"] and not bad["ok"]
+    assert len(led.read()) == 4  # nothing appended
+    # a record whose key has no history is skipped
+    other = ledger_mod.gate(
+        led, record=_rec(0.1, chip="v6e"), min_history=3
+    )[0]
+    assert other["skipped"] and other["ok"]
+
+
+# --------------------------------------------------------------------
+# seeding + the gate CLI (the ISSUE acceptance pair)
+# --------------------------------------------------------------------
+
+
+def test_coerce_record_filters_and_validates():
+    # unknown keys (a bench emit record's metric/vs_baseline/...)
+    # are dropped, not TypeErrors
+    rec = ledger_mod.coerce_record(
+        {"chip": "v5e", "kind": "bench", "value": 1.2,
+         "unit": "outer_iters/sec", "metric": "ignored",
+         "vs_baseline": 3.0}
+    )
+    assert rec["chip"] == "v5e" and "metric" not in rec
+    # missing required fields are a ValueError (CLI exit 2), never a
+    # traceback CI misreads as a regression
+    with pytest.raises(ValueError):
+        ledger_mod.coerce_record({"chip": "v5e", "value": 1.0})
+    with pytest.raises(ValueError):
+        ledger_mod.coerce_record("not a dict")
+
+
+def test_gate_cli_malformed_record_exits_2(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"chip": "v5e", "value": 1.0}))
+    out = _gate_cli(
+        "--ledger", str(tmp_path / "led.jsonl"), "--record", str(bad)
+    )
+    assert out.returncode == 2
+    assert "required field" in out.stderr
+
+
+def test_seed_all_is_idempotent(tmp_path):
+    led = ledger_mod.Ledger(str(tmp_path / "led.jsonl"))
+    first = sum(ledger_mod.seed_all(led, repo=REPO).values())
+    assert first > 0
+    again = sum(ledger_mod.seed_all(led, repo=REPO).values())
+    assert again == 0  # nothing duplicated on a re-run
+    assert len(led.read()) == first
+
+
+def test_seed_all_from_repo_artifacts(tmp_path):
+    led = ledger_mod.Ledger(str(tmp_path / "led.jsonl"))
+    counts = ledger_mod.seed_all(led, repo=REPO)
+    assert sum(counts.values()) > 10  # trajectory non-empty on day 1
+    recs = led.read()
+    # the on-chip arms seeded under their real chip...
+    assert any(r["chip"] == "v5e" for r in recs)
+    # ...and the degraded CPU bench rounds under cpu, flagged — the
+    # chip key fences them off from TPU history
+    cpu = [r for r in recs if r["chip"] == "cpu"]
+    assert cpu and all(r["degraded"] for r in cpu)
+    assert all(r["value"] > 0 for r in recs)
+    assert all("FAILED" not in r.get("source", "") for r in recs)
+    # shape keys parsed from the north-star metric string
+    assert any(
+        r["shape_key"].startswith("consensus2d:k100:s11x11")
+        for r in recs
+    )
+
+
+def test_gate_cli_exit0_on_shipped_tree_seeded(tmp_path):
+    led_path = str(tmp_path / "led.jsonl")
+    out = _gate_cli("--seed-from", "--ledger", led_path)
+    assert out.returncode == 0, out.stderr
+    assert "seeded" in out.stdout
+    out = _gate_cli("--ledger", led_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 regression(s)" in out.stdout
+
+
+def test_gate_cli_nonzero_on_injected_slowdown(tmp_path):
+    led_path = str(tmp_path / "led.jsonl")
+    led = ledger_mod.Ledger(led_path)
+    for i, v in enumerate([1.95, 2.02, 2.0, 1.98, 2.05, 2.01]):
+        led.append(_rec(v, t=100.0 + i))
+    out = _gate_cli("--ledger", led_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+    # inject at 0.5x the historical median -> the gate must fail
+    led.append(_rec(1.0, t=200.0))
+    out = _gate_cli("--ledger", led_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "REGRESSION" in out.stdout
+    # --json carries the machine-readable verdicts
+    out = _gate_cli("--ledger", led_path, "--json")
+    assert out.returncode == 1
+    payload = json.loads(out.stdout)
+    assert payload["n_regressions"] == 1
+
+
+# --------------------------------------------------------------------
+# memwatch: the fake-memory_stats poller + OOM forensics
+# --------------------------------------------------------------------
+
+
+class _FakeDev:
+    def __init__(self, did, stats, platform="tpu"):
+        self.id = did
+        self.platform = platform
+        self.device_kind = "fake-tpu"
+        self.stats = stats
+
+    def memory_stats(self):
+        return self.stats
+
+
+def test_memwatch_fake_memory_stats():
+    dev = _FakeDev(0, {"bytes_in_use": 100, "peak_bytes_in_use": 150})
+    mw = memwatch.MemWatch(devices=[dev], enabled=True)
+    assert mw.sample() == 100
+    assert mw.peak_bytes == 150  # the allocator's own high-water mark
+    assert mw.watermark_source == "allocator_peak"
+    dev.stats = {"bytes_in_use": 90, "peak_bytes_in_use": 220}
+    mw.sample()
+    assert mw.peak_bytes == 220  # monotone across samples
+    rec = mw.watermark_record(modeled_bytes=100)
+    assert rec["peak_hbm_bytes"] == 220
+    assert rec["delta_frac"] == pytest.approx(1.2)
+    assert rec["flagged"]  # 120% drift > CCSC_MEM_DELTA_FRAC (50%)
+    assert rec["n_samples"] == 2
+
+
+def test_memwatch_fence_samples_and_no_stats():
+    # a backend with only bytes_in_use: peak = max of fence samples,
+    # labeled as the lower bound it is
+    dev = _FakeDev(0, {"bytes_in_use": 100})
+    mw = memwatch.MemWatch(devices=[dev], enabled=True)
+    mw.sample()
+    dev.stats = {"bytes_in_use": 300}
+    mw.sample()
+    dev.stats = {"bytes_in_use": 50}
+    mw.sample()
+    assert mw.peak_bytes == 300
+    assert mw.watermark_source == "fence_samples"
+    # no memory stats at all (CPU jaxlib): graceful no-op, and a
+    # modeled-only watermark record still reports the model
+    mw2 = memwatch.MemWatch(devices=[_FakeDev(0, None)], enabled=True)
+    assert mw2.sample() is None
+    assert mw2.peak_bytes is None
+    assert mw2.watermark_record() is None
+    rec = mw2.watermark_record(modeled_bytes=123)
+    assert rec["modeled_hbm_bytes"] == 123
+    assert rec["peak_hbm_bytes"] is None
+    assert rec["delta_frac"] is None
+    # disabled poller: every call a cheap no-op
+    mw3 = memwatch.MemWatch(devices=[dev], enabled=False)
+    assert mw3.sample() is None and mw3.peak_bytes is None
+
+
+def test_memwatch_multi_device_total_vs_model():
+    # the modeled estimate prices the WHOLE working set; a sharded
+    # run spreads it across devices — the delta must compare the
+    # model against the measured TOTAL, not the per-device max
+    devs = [
+        _FakeDev(0, {"bytes_in_use": 50, "peak_bytes_in_use": 60}),
+        _FakeDev(1, {"bytes_in_use": 55, "peak_bytes_in_use": 60}),
+    ]
+    mw = memwatch.MemWatch(devices=devs, enabled=True)
+    mw.sample()
+    assert mw.peak_bytes == 60  # per-chip watermark (OOM question)
+    assert mw.total_peak_bytes == 120  # whole-mesh footprint
+    rec = mw.watermark_record(modeled_bytes=100)
+    assert rec["peak_hbm_bytes"] == 60
+    assert rec["peak_hbm_bytes_total"] == 120
+    assert rec["delta_frac"] == pytest.approx(0.2)
+    assert not rec["flagged"]  # 20% < the 50% drift threshold
+
+
+def test_memwatch_oom_dump(tmp_path):
+    dev = _FakeDev(0, {"bytes_in_use": 99, "peak_bytes_in_use": 100})
+    err = RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 12345 bytes"
+    )
+    path = memwatch.oom_dump(err, dump_dir=str(tmp_path),
+                             devices=[dev])
+    assert path is not None and os.path.exists(path)
+    dump = json.load(open(path))
+    assert "RESOURCE_EXHAUSTED" in dump["error"]
+    assert dump["devices"][0]["stats"]["peak_bytes_in_use"] == 100
+    # a non-OOM error is not a forensic event
+    assert memwatch.oom_dump(
+        ValueError("shape mismatch"), dump_dir=str(tmp_path)
+    ) is None
+
+
+def test_dispatch_oom_forensics_writes_dump_and_event(tmp_path):
+    from ccsc_code_iccv2017_tpu.apps._dispatch import _DegradeLog
+
+    log = _DegradeLog(str(tmp_path))
+    try:
+        log.oom_forensics(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory"),
+            str(tmp_path),
+        )
+    finally:
+        log.close()
+    events = obs.read_events(str(tmp_path))
+    dumps = [e for e in events if e.get("type") == "mem_oom_dump"]
+    assert len(dumps) == 1
+    assert os.path.exists(dumps[0]["path"])
+    assert "RESOURCE_EXHAUSTED" in dumps[0]["error"]
+
+
+# --------------------------------------------------------------------
+# anomaly watch: unit + obs.Run wiring
+# --------------------------------------------------------------------
+
+
+def _band(median=0.5, mad=0.02, n=6):
+    return ledger_mod.robust_band(
+        [median - mad, median, median + mad] * (n // 3),
+        mad_k=3.0, frac=0.25,
+    )
+
+
+def test_anomaly_watch_fires_once_and_rearms():
+    watch = ledger_mod.AnomalyWatch(_band(), window=3, key="k")
+    # healthy stretch: no event, window fills silently
+    assert all(watch.observe(0.5) is None for _ in range(4))
+    # degraded stretch: exactly ONE event until recovery
+    assert watch.observe(0.1) is None  # rolling median still healthy
+    rec = None
+    for _ in range(3):
+        rec = rec or watch.observe(0.1)
+    assert rec is not None
+    assert rec["rolling_frac"] == pytest.approx(0.1)
+    assert rec["band_lo"] < 0.5 and rec["n_history"] == 6
+    assert all(watch.observe(0.1) is None for _ in range(5))
+    # recovery re-arms; the next excursion fires exactly once more
+    for _ in range(3):
+        watch.observe(0.5)
+    fired = [
+        r for r in (watch.observe(0.05) for _ in range(3))
+        if r is not None
+    ]
+    assert len(fired) == 1 and watch.n_fired == 2
+
+
+def test_watch_for_builds_from_ledger_history(tmp_path, monkeypatch):
+    led_path = str(tmp_path / "led.jsonl")
+    led = ledger_mod.Ledger(led_path)
+    arm = {"storage_dtype": "bfloat16"}
+    for i in range(4):
+        led.append(
+            _rec(2.0, chip="cpu", kind="learn", knobs=arm,
+                 roofline_frac=0.5 + 0.01 * i, t=100.0 + i)
+        )
+    monkeypatch.setenv("CCSC_PERF_LEDGER", led_path)
+    watch = ledger_mod.watch_for(
+        "cpu", "learn", "consensus2d", knobs=arm
+    )
+    assert watch is not None
+    assert watch.band["n"] == 4
+    # the band never pools ACROSS configurations: an f32 baseline
+    # must not be judged against the bf16 arm's history
+    assert ledger_mod.watch_for(
+        "cpu", "learn", "consensus2d",
+        knobs={"storage_dtype": "float32"},
+    ) is None
+    # thin history -> no watch (never judge without evidence)
+    assert ledger_mod.watch_for("v6e", "learn", knobs=arm) is None
+    # degraded records never set the band
+    led2 = ledger_mod.Ledger(str(tmp_path / "deg.jsonl"))
+    for i in range(4):
+        led2.append(
+            _rec(2.0, chip="cpu", kind="learn", knobs=arm,
+                 roofline_frac=0.5, degraded=True, t=100.0 + i)
+        )
+    assert ledger_mod.watch_for(
+        "cpu", "learn", knobs=arm, ledger=led2
+    ) is None
+
+
+def test_run_chunk_emits_perf_anomaly(tmp_path):
+    run = obs.start_run(
+        str(tmp_path / "md"), algorithm="anomaly_probe",
+        verbose="none",
+    )
+    run.anomaly = ledger_mod.AnomalyWatch(_band(), window=2, key="k")
+    cost = {"flops": 5e10, "bytes": 5e9}  # cpu roof: bound = 10 it/s
+    # healthy chunks (frac 1.0): no anomaly
+    run.chunk(0, 1, 1, 0.1, cost=cost)
+    run.chunk(1, 1, 1, 0.1, cost=cost)
+    # degraded chunks (frac 0.1 << band lo): exactly one event
+    run.chunk(2, 1, 1, 1.0, cost=cost)
+    run.chunk(3, 1, 1, 1.0, cost=cost)
+    run.chunk(4, 1, 1, 1.0, cost=cost)
+    run.close(status="ok")
+    events = obs.read_events(str(tmp_path / "md"))
+    roofs = [e for e in events if e.get("type") == "roofline"]
+    assert all("roofline_frac" in r for r in roofs)
+    anoms = [e for e in events if e.get("type") == "perf_anomaly"]
+    assert len(anoms) == 1
+    a = anoms[0]
+    assert a["rolling_frac"] == pytest.approx(0.1, rel=0.01)
+    assert a["band_lo"] > a["rolling_frac"]
+    assert a["n_history"] == 6 and a["key"] == "k"
+
+
+def test_start_run_arms_anomaly_watch_from_ledger(
+    tmp_path, monkeypatch
+):
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+
+    cfg = LearnConfig(max_it=1, num_blocks=2, verbose="none")
+    # seed with the SAME knob dict the run will extract — the watch
+    # band is per-configuration (knob-digest matched)
+    run_knobs = {
+        k: getattr(cfg, k)
+        for k in obs._LEDGER_KNOB_KEYS
+        if hasattr(cfg, k)
+    }
+    led_path = str(tmp_path / "led.jsonl")
+    led = ledger_mod.Ledger(led_path)
+    for i in range(4):
+        led.append(
+            _rec(2.0, chip="cpu", kind="learn", knobs=run_knobs,
+                 workload="consensus2d", roofline_frac=0.5,
+                 t=100.0 + i)
+        )
+    monkeypatch.setenv("CCSC_PERF_LEDGER", led_path)
+    run = obs.start_run(
+        str(tmp_path / "md"), algorithm="consensus",
+        verbose="none", geom=ProblemGeom((5, 5), 4),
+        cfg=cfg,
+        data_shape=[8, 16, 16],
+    )
+    try:
+        assert run.anomaly is not None
+        assert run.anomaly.band["n"] == 4
+        assert run._ledger_meta["kind"] == "learn"
+        assert run._ledger_meta["workload"] == "consensus2d"
+        assert run._ledger_meta["shape_key"] == (
+            "consensus2d:k4:s5x5:n8:sz16x16:b2"
+        )
+    finally:
+        run.close(status="ok")
+
+
+# --------------------------------------------------------------------
+# learner auto-append at close + bench record fields
+# --------------------------------------------------------------------
+
+
+def test_run_close_appends_learner_record(tmp_path, monkeypatch):
+    led_path = str(tmp_path / "led.jsonl")
+    monkeypatch.setenv("CCSC_PERF_LEDGER", led_path)
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+
+    run = obs.start_run(
+        str(tmp_path / "md"), algorithm="consensus",
+        verbose="none", geom=ProblemGeom((5, 5), 4),
+        cfg=LearnConfig(max_it=4, num_blocks=2, verbose="none"),
+        data_shape=[8, 16, 16],
+    )
+    cost = {"flops": 5e10, "bytes": 5e9}
+    run.chunk(0, 2, 2, 0.5, cost=cost)
+    run.chunk(2, 2, 2, 0.5, cost=cost)
+    run.close(status="ok")
+    recs = ledger_mod.Ledger(led_path).read()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kind"] == "learn"
+    assert rec["chip"] == "cpu"
+    assert rec["workload"] == "consensus2d"
+    assert rec["shape_key"] == "consensus2d:k4:s5x5:n8:sz16x16:b2"
+    assert rec["value"] == pytest.approx(4.0)  # 4 iters / 1.0 s
+    assert rec["unit"] == "outer_iters/sec"
+    assert rec["roofline_frac"] == pytest.approx(0.4)
+    assert rec["knobs"]["num_blocks"] == 2
+    # the stream carries the provenance event, before the summary
+    events = obs.read_events(str(tmp_path / "md"))
+    kinds = [e["type"] for e in events]
+    assert "ledger_append" in kinds
+    assert kinds.index("ledger_append") < kinds.index("summary")
+    led_ev = events[kinds.index("ledger_append")]
+    assert led_ev["key"] == ledger_mod.record_key(rec)
+    assert led_ev["path"] == led_path
+    # an error close never appends (a crashed run is not a datapoint)
+    run2 = obs.start_run(
+        str(tmp_path / "md2"), algorithm="consensus",
+        verbose="none", geom=ProblemGeom((5, 5), 4),
+        cfg=LearnConfig(max_it=4, num_blocks=2, verbose="none"),
+        data_shape=[8, 16, 16],
+    )
+    run2.chunk(0, 2, 2, 0.5, cost=cost)
+    run2.close(status="error")
+    assert len(ledger_mod.Ledger(led_path).read()) == 1
+    # non-zero process index never appends: one multi-host run must
+    # produce ONE record, not process_count near-identical copies
+    run3 = obs.start_run(
+        str(tmp_path / "md3"), algorithm="consensus",
+        verbose="none", geom=ProblemGeom((5, 5), 4),
+        cfg=LearnConfig(max_it=4, num_blocks=2, verbose="none"),
+        data_shape=[8, 16, 16],
+    )
+    run3._host = 1
+    run3.chunk(0, 2, 2, 0.5, cost=cost)
+    run3.close(status="ok")
+    assert len(ledger_mod.Ledger(led_path).read()) == 1
+
+
+def test_telemetry_off_run_still_appends(tmp_path, monkeypatch):
+    """CCSC_PERF_LEDGER alone (no metrics_dir) must be enough — the
+    registry promises 'setting it arms the automatic appends', not
+    'if telemetry is also on'."""
+    led_path = str(tmp_path / "led.jsonl")
+    monkeypatch.setenv("CCSC_PERF_LEDGER", led_path)
+    from ccsc_code_iccv2017_tpu.config import LearnConfig, ProblemGeom
+
+    run = obs.start_run(
+        None, algorithm="consensus", verbose="none",
+        geom=ProblemGeom((5, 5), 4),
+        cfg=LearnConfig(max_it=4, num_blocks=2, verbose="none"),
+        data_shape=[8, 16, 16],
+    )
+    assert run.writer is None and run.chip == "cpu"
+    run.chunk(0, 4, 4, 1.0, cost={"flops": 5e10, "bytes": 5e9})
+    run.close(status="ok")
+    recs = ledger_mod.Ledger(led_path).read()
+    assert len(recs) == 1
+    assert recs[0]["value"] == pytest.approx(4.0)
+    assert recs[0]["kind"] == "learn"
+
+
+def test_serve_seed_shape_key_matches_live_producer():
+    # the seeded serve shape key must be the key run_serve_workload
+    # writes live — otherwise seeded history can never gate anything
+    metric = (
+        "serving engine requests/sec (2D inpainting serving, 16 "
+        "heterogeneous requests 40..64^2, k=32 7x7, max_it=20, "
+        "1 chip)"
+    )
+    from ccsc_code_iccv2017_tpu.tune import store as tune_store
+
+    assert ledger_mod._serve_shape_key(
+        metric
+    ) == tune_store.solve_shape_key(
+        "solve2d", k=32, support=(7, 7), spatial=(64, 64)
+    )
+    assert ledger_mod._serve_shape_key("unparsable") == ""
+
+
+def test_oom_dump_env_dir_overrides_caller(tmp_path, monkeypatch):
+    # CCSC_MEM_DUMP_DIR is a true override: an operator aiming
+    # forensics at persistent storage beats the caller's ephemeral
+    # metrics dir
+    override = tmp_path / "persistent"
+    monkeypatch.setenv("CCSC_MEM_DUMP_DIR", str(override))
+    path = memwatch.oom_dump(
+        RuntimeError("RESOURCE_EXHAUSTED: boom"),
+        dump_dir=str(tmp_path / "ephemeral"),
+        devices=[],
+    )
+    assert path is not None
+    assert os.path.dirname(path) == str(override)
+
+
+def test_bench_inprocess_record_and_ledger(tmp_path, monkeypatch):
+    """The tiny in-process bench arm: the record gains
+    peak_hbm_bytes/n_compiles, and emit() appends the normalized
+    record to the armed ledger."""
+    for k, v in {
+        "CCSC_BENCH_N": "8", "CCSC_BENCH_SIZE": "16",
+        "CCSC_BENCH_K": "4", "CCSC_BENCH_BLOCKS": "2",
+        "CCSC_BENCH_ITERS": "1",
+    }.items():
+        monkeypatch.setenv(k, v)
+    led_path = str(tmp_path / "led.jsonl")
+    monkeypatch.setenv("CCSC_PERF_LEDGER", led_path)
+    spec = importlib.util.spec_from_file_location(
+        "bench_perf_ledger_test", os.path.join(REPO, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    r = bench.run_workload()
+    assert "peak_hbm_bytes" in r  # None on CPU — but measured-able
+    assert r["n_compiles"] >= 1
+    assert r["modeled_hbm_bytes"] and r["modeled_hbm_bytes"] > 0
+    bench.emit(r, degraded=False)
+    recs = ledger_mod.Ledger(led_path).read()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kind"] == "bench" and rec["chip"] == "cpu"
+    assert rec["shape_key"] == "consensus2d:k4:s11x11:n8:sz16x16:b2"
+    assert rec["value"] == pytest.approx(r["iters_per_sec"])
+    assert rec["n_compiles"] == r["n_compiles"]
+    assert rec["modeled_hbm_bytes"] == r["modeled_hbm_bytes"]
+    assert not rec["degraded"]
+
+
+def test_fleet_close_appends_serve_record(tmp_path, monkeypatch):
+    """A telemetered fleet session appends one kind='serve' record at
+    close (regression pin: the append path once died on a swallowed
+    NameError, proving the defensive except needs a positive test)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ccsc_code_iccv2017_tpu.config import (
+        ProblemGeom, ServeConfig, SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve.fleet import (
+        FleetConfig, ServeFleet,
+    )
+
+    led_path = str(tmp_path / "led.jsonl")
+    monkeypatch.setenv("CCSC_PERF_LEDGER", led_path)
+    r = np.random.default_rng(0)
+    k, sup, sz = 4, 5, 16
+    d = r.normal(size=(k, sup, sup)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    prob = ReconstructionProblem(ProblemGeom((sup, sup), k))
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=5, tol=1e-4,
+        verbose="none",
+    )
+    fleet = ServeFleet(
+        jnp.asarray(d), prob, cfg,
+        ServeConfig(
+            buckets=((2, (sz, sz)),), max_wait_ms=5.0,
+            verbose="none",
+        ),
+        FleetConfig(replicas=1, metrics_dir=str(tmp_path / "md")),
+    )
+    x = r.normal(size=(sz, sz)).astype(np.float32)
+    m = (r.random((sz, sz)) < 0.5).astype(np.float32)
+    fleet.submit(b=x * m, mask=m, key="q0").result(timeout=300)
+    fleet.close()
+    recs = ledger_mod.Ledger(led_path).read()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kind"] == "serve" and rec["chip"] == "cpu"
+    assert rec["workload"] == "solve2d"
+    assert rec["shape_key"] == "solve2d:k4:s5x5:sz16x16"
+    assert rec["unit"] == "requests/sec" and rec["value"] > 0
+    assert rec["knobs"]["replicas"] == 1
+    events = obs.read_events(str(tmp_path / "md"), recursive=True)
+    appends = [e for e in events if e.get("type") == "ledger_append"]
+    assert len(appends) == 1
+    assert appends[0]["key"] == ledger_mod.record_key(rec)
+
+
+# --------------------------------------------------------------------
+# obs_report sections
+# --------------------------------------------------------------------
+
+
+def _report_mod():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report_perf_ledger_test",
+        os.path.join(REPO, "scripts", "obs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_report_ledger_and_memory_sections(tmp_path):
+    led_path = str(tmp_path / "led.jsonl")
+    led = ledger_mod.Ledger(led_path)
+    for i, v in enumerate([2.0, 2.1, 1.9, 2.0, 0.8]):
+        led.append(_rec(v, t=100.0 + i))
+    now = time.time()
+    events = [
+        {"t": now, "type": "run_meta", "host": 0,
+         "algorithm": "consensus"},
+        {"t": now + 1, "type": "mem_watermark", "host": 0,
+         "peak_hbm_bytes": 2_000_000_000,
+         "modeled_hbm_bytes": 1_000_000_000, "delta_frac": 1.0,
+         "flagged": True, "n_samples": 3,
+         "source": "allocator_peak"},
+        {"t": now + 2, "type": "mem_oom_dump", "host": 0,
+         "path": "/tmp/dump.json", "error": "RESOURCE_EXHAUSTED"},
+        {"t": now + 3, "type": "perf_anomaly", "host": 0,
+         "rolling_frac": 0.1, "band_lo": 0.4, "median": 0.5,
+         "mad": 0.02, "n_history": 6, "window": 3, "key": "k"},
+        {"t": now + 4, "type": "ledger_append", "host": 0,
+         "key": "cpu|learn|x||d", "value": 2.0,
+         "unit": "outer_iters/sec", "path": led_path},
+    ]
+    text = _report_mod().render(events, ledger_path=led_path)
+    assert "== MEMORY" in text
+    assert "2.000 GB" in text and "+100.0%" in text
+    assert "DRIFT" in text
+    assert "OOM dump" in text
+    assert "== LEDGER" in text
+    assert "appended" in text and "cpu|learn|x||d" in text
+    assert "perf_anomaly" in text or "anomalies" in text
+    # the seeded key is judged against its band: 0.8 is REGRESSED
+    assert "REGRESSED" in text
+    # without a ledger and without observatory events the sections
+    # stay absent (dashboard noise budget)
+    quiet = _report_mod().render(
+        [{"t": now, "type": "run_meta", "host": 0,
+          "algorithm": "x"}]
+    )
+    assert "== MEMORY" not in quiet and "== LEDGER" not in quiet
