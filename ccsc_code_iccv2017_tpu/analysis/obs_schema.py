@@ -86,6 +86,18 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     "fleet_ceiling": _s("replica_id", "ceiling", "source"),
     "fleet_overload": _s("replica_id", "rung_from", "rung_to",
                          "queue_depth"),
+    # -- workload capture + replay (serve.capture, serve.replay).
+    # capture_* events are session-scope (emitted by the recorder
+    # through the fleet/engine emit wrapper); replay_* events live in
+    # the replay driver's own stream and feed obs_report's REPLAY
+    # section -------------------------------------------------------
+    "capture_start": _s("path"),
+    "capture_rotate": _s("path", "segment"),
+    "capture_error": _s("path", "error"),
+    "capture_summary": _s("path", "n_requests", "overhead_s"),
+    "replay_request": _s("key", "status", "latency_ms"),
+    "replay_summary": _s("mode", "speed", "n_recorded", "n_replayed",
+                         "n_lost", "n_mismatched"),
     # -- autotuning (tune.autotune) ----------------------------------
     "tune_pick": _s("kind", "chip", "shape_key"),
     "tune_guard": _s("kind", "chip"),

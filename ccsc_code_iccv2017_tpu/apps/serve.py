@@ -103,6 +103,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="also write the metrics exposition atomically to this "
         "file every few seconds (scrape-less environments)",
     )
+    p.add_argument(
+        "--capture-dir", default=None,
+        help="durably record every admitted request (arrival time, "
+        "payloads content-addressed by sha256, outcome digest + PSNR "
+        "+ latency) under this directory for deterministic replay "
+        "(serve.capture / scripts/replay.py). Default: the "
+        "CCSC_CAPTURE_DIR env knob, unset = capture off",
+    )
     p.add_argument("--keep", type=float, default=0.5,
                    help="observed fraction of each request")
     p.add_argument("--lambda-residual", type=float, default=5.0)
@@ -174,6 +182,7 @@ def main(argv=None):
         # program is built from the same resolved knobs
         tune=args.tune,
         tune_store=args.tune_store,
+        capture_dir=args.capture_dir,
     )
     if args.replicas < 1:
         raise SystemExit("--replicas must be >= 1")
@@ -191,6 +200,7 @@ def main(argv=None):
                 slo_p99_ms=args.slo_p99_ms,
                 metricsd_port=args.metricsd_port,
                 metricsd_snapshot=args.metricsd_snapshot,
+                capture_dir=args.capture_dir,
             ),
         )
         print(
@@ -218,6 +228,7 @@ def main(argv=None):
             try:
                 metricsd = MetricsD(
                     engine.metrics, port=md_port, snapshot_path=snap,
+                    run_id=f"serve-{os.getpid()}-{int(time.time())}",
                 ).start()
             except Exception as e:
                 metricsd = None

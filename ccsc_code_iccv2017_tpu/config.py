@@ -484,6 +484,15 @@ class ServeConfig:
     # was p99 slow" answer becomes a trace, not a guess. One capture
     # per engine lifetime (captures are heavy; re-arm by restarting).
     slo_profile_dir: Optional[str] = None
+    # Workload capture (serve.capture): when set — or via
+    # CCSC_CAPTURE_DIR — a STANDALONE engine durably records every
+    # submitted request (arrival time, payloads content-addressed by
+    # sha256, outcome digest + PSNR + latency) under this directory
+    # for deterministic replay (serve.replay). "" = explicitly off
+    # even when the env knob is armed. Fleet replicas never capture:
+    # the fleet records once at admission, so N replicas cannot
+    # write N copies of the same stream.
+    capture_dir: Optional[str] = None
 
     def __post_init__(self):
         for fname in ("slo_p50_ms", "slo_p99_ms", "slo_check_s"):
@@ -642,6 +651,21 @@ class FleetConfig:
     # endpoint is on and a metrics_dir exists) metrics_dir/
     # metrics.prom.
     metricsd_snapshot: Optional[str] = None
+    # Workload capture (serve.capture): when set — or via
+    # CCSC_CAPTURE_DIR — every ADMITTED request is durably recorded
+    # under this directory (relative arrival time, idempotency key,
+    # trace id, payloads content-addressed by sha256 with cross-
+    # request dedup) and paired with its outcome digest + PSNR +
+    # latency at delivery, so the stream can be re-served
+    # bit-checkably by serve.replay. None = the CCSC_CAPTURE_DIR env
+    # knob (unset = capture off); "" = explicitly OFF even when the
+    # env knob is armed (replay fleets must never re-capture the
+    # stream they are replaying).
+    capture_dir: Optional[str] = None
+    # Fraction of admitted requests captured, deterministic per
+    # idempotency key (a request and its outcome always land on the
+    # same side). None = CCSC_CAPTURE_SAMPLE (default 1.0).
+    capture_sample: Optional[float] = None
 
     def __post_init__(self):
         for fname in ("slo_p50_ms", "slo_p99_ms"):
@@ -653,6 +677,13 @@ class FleetConfig:
         if self.metricsd_port is not None and self.metricsd_port < 0:
             raise ValueError(
                 f"metricsd_port must be >= 0, got {self.metricsd_port}"
+            )
+        if self.capture_sample is not None and not (
+            0.0 <= self.capture_sample <= 1.0
+        ):
+            raise ValueError(
+                f"capture_sample must be in [0, 1], got "
+                f"{self.capture_sample}"
             )
         if self.replicas < 1:
             raise ValueError(

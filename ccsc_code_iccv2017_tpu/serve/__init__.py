@@ -11,7 +11,14 @@ micro-batching request queue. :class:`ServeFleet` (serve.fleet) is the
 fault-tolerance layer above it: N replicated engines behind one front
 queue with health-driven requeue, idempotent result delivery, and
 admission control with a predictable overload ladder.
+:class:`WorkloadRecorder` (serve.capture) records every admitted
+request durably — payloads content-addressed by sha256, outcomes
+digested — and :class:`ReplayDriver` (serve.replay) re-serves a
+captured stream against a fresh fleet with bit-identity
+verification: the recorded workload is the fleet's measuring
+instrument.
 """
+from .capture import WorkloadRecorder  # noqa: F401
 from .engine import (  # noqa: F401
     CodecEngine,
     ServedResult,
@@ -20,4 +27,5 @@ from .engine import (  # noqa: F401
 )
 from .fleet import Overloaded, ServeFleet  # noqa: F401
 from .metricsd import MetricsD  # noqa: F401
+from .replay import ReplayDriver, generate_diurnal  # noqa: F401
 from .slo import Histogram, SloMonitor  # noqa: F401

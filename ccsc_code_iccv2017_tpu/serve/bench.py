@@ -265,6 +265,11 @@ def run_serve_workload() -> Dict:
         "max_rel_err_vs_loop": round(max_rel, 6),
         "persistent_cache_hits": cache_hits,
         "event_stream": metrics_dir,
+        # the bench engine is a standalone engine, so CCSC_CAPTURE_DIR
+        # arms workload capture on it (serve.capture) — the record
+        # names the capture so a bench stream can be replayed
+        # (scripts/replay.py) instead of re-generated
+        "capture_dir": _env.env_str("CCSC_CAPTURE_DIR"),
         "knobs": {
             "requests": n_req,
             "size_min": lo,

@@ -129,6 +129,9 @@ class _Pending:
     # own_root False — its engine spans ride parentless rather than
     # fabricating a second root for the same trace.
     own_root: bool = False
+    # workload-capture key (serve.capture; standalone engines only):
+    # pairs this request's capture record with its outcome digest
+    cap_key: Optional[str] = None
 
 
 def _bucket_name(slots: int, spatial: Tuple[int, ...]) -> str:
@@ -287,6 +290,13 @@ class CodecEngine:
             },
         )
 
+        self._capture = None
+        self._cap_seq = 0
+        # per-engine key salt: a recorder reopened on the same capture
+        # dir (engine restart) must never reuse a previous engine's
+        # keys — read_workload pairs outcomes by key, and a collision
+        # would weld run 2's request to run 1's outcome digest
+        self._cap_prefix = f"req-{trace_util.new_trace_id()[:8]}"
         try:
             if serve_cfg.tune != "off":
                 # startup knob resolution (tune/): one pinned config
@@ -324,6 +334,43 @@ class CodecEngine:
                 tune=serve_cfg.tune,
                 tuned=self._tune_picked is not None,
             )
+            if serve_cfg.replica_id is None:
+                # standalone engines capture their own workload; a
+                # fleet replica's stream is captured ONCE at the
+                # fleet's admission boundary instead. Built AFTER
+                # tune resolution: the recorded solve params must be
+                # the ones requests are actually served under, or a
+                # replay pinned to them fails bit-parity spuriously.
+                from . import capture as _capture_mod
+
+                cap_dir = _capture_mod.resolve_capture_dir(
+                    serve_cfg.capture_dir
+                )
+                if cap_dir:
+                    self._capture = _capture_mod.WorkloadRecorder(
+                        cap_dir,
+                        emit=self._emit,
+                        meta={
+                            "source": "serve_engine",
+                            "buckets": [
+                                {"slots": s, "spatial": list(sp)}
+                                for s, sp in serve_cfg.buckets
+                            ],
+                            "geom": {
+                                "spatial_support": list(
+                                    geom.spatial_support
+                                ),
+                                "num_filters": geom.num_filters,
+                            },
+                            "solve": {
+                                "max_it": cfg.max_it,
+                                "tol": cfg.tol,
+                                "lambda_residual": cfg.lambda_residual,
+                                "lambda_prior": cfg.lambda_prior,
+                            },
+                            "knobs": self._knob_dict,
+                        },
+                    )
             self._build(d, prob, cfg, serve_cfg, blur_psf)
         except BaseException:
             # a failed construction (bad blur rank, OOM compiling an
@@ -334,6 +381,11 @@ class CodecEngine:
             with self._close_lock:
                 self._close_started = True
             self._close_done.set()
+            if self._capture is not None:
+                try:
+                    self._capture.close(status_note="init_failed")
+                except Exception:
+                    pass
             self._run.close(status="error")
             raise
 
@@ -506,9 +558,20 @@ class CodecEngine:
         with self._cv:
             if self._closed or self._close_started:
                 raise RuntimeError("engine is closed")
+            if self._capture is not None:
+                self._cap_seq += 1
+                p.cap_key = f"{self._cap_prefix}-{self._cap_seq:08d}"
             self._pending[key].append(p)
             self._n_pending += 1
             self._cv.notify()
+        if self._capture is not None and p.cap_key is not None:
+            # record OUTSIDE the queue lock: sha256 + the segment
+            # append must not serialize submitters against dispatch
+            self._capture.record_submit(
+                p.cap_key, trace_id, p.b, mask=p.mask,
+                smooth_init=p.smooth_init, x_orig=p.x_orig,
+                bucket=_bucket_name(*key),
+            )
         return p.future
 
     def reconstruct(
@@ -722,6 +785,11 @@ class CodecEngine:
                 iters=n_it,
                 psnr=final_psnr,
             )
+            if self._capture is not None and p.cap_key is not None:
+                self._capture.record_outcome(
+                    p.cap_key, rec_i, final_psnr, latency * 1e3,
+                    name, iters=n_it,
+                )
         occ = len(batch) / slots
         self._n_dispatches += 1
         self._occupancy_sum += occ
@@ -912,6 +980,15 @@ class CodecEngine:
                             "dispatch to drain",
                             tier="always",
                         )
+            cap = getattr(self, "_capture", None)
+            if cap is not None:
+                # seal the capture (meta.json counters + the
+                # capture_summary overhead record) while the run is
+                # still open to receive it
+                try:
+                    cap.close()
+                except Exception:
+                    pass
             if run is not None and not run.closed:
                 # closing histogram flush: the stream always ends with
                 # one complete slo_histogram per phase, so a short

@@ -80,8 +80,9 @@ import numpy as np
 
 from ..config import FleetConfig, ServeConfig, SolveConfig
 from ..utils import trace as trace_util
+from . import capture as _capture
 from . import slo as _slo
-from .engine import CodecEngine, ServedResult, pick_bucket
+from .engine import CodecEngine, ServedResult, _bucket_name, pick_bucket
 
 __all__ = ["ServeFleet", "Overloaded", "RUNGS"]
 
@@ -263,7 +264,12 @@ class ServeFleet:
             )
         )
         self._metricsd = None
+        self._capture: Optional[_capture.WorkloadRecorder] = None
         self._t_start = time.time()
+        # fleet run identity: stamped into the metricsd snapshot so a
+        # stale metrics.prom left by a DEAD fleet is distinguishable
+        # from this one's
+        self.run_id = f"fleet-{os.getpid()}-{int(self._t_start)}"
 
         self._run = obs.start_run(
             fleet_cfg.metrics_dir,
@@ -293,6 +299,47 @@ class ServeFleet:
                     else "static_floor"
                 ),
             )
+            cap_dir = _capture.resolve_capture_dir(
+                fleet_cfg.capture_dir
+            )
+            if cap_dir:
+                # admission-level capture: ONE recorder at the fleet
+                # boundary (replica engines never capture — N copies
+                # of the same stream would not be a workload record)
+                self._capture = _capture.WorkloadRecorder(
+                    cap_dir,
+                    sample=fleet_cfg.capture_sample,
+                    emit=lambda type_, **f: self._emit(
+                        type_, replica_id=None, **f
+                    ),
+                    meta={
+                        "source": "serve_fleet",
+                        "run_id": self.run_id,
+                        "replicas": fleet_cfg.replicas,
+                        "buckets": [
+                            {"slots": s, "spatial": list(sp)}
+                            for s, sp in self.buckets
+                        ],
+                        "geom": {
+                            "spatial_support": list(
+                                self.geom.spatial_support
+                            ),
+                            "num_filters": self.geom.num_filters,
+                        },
+                        "solve": {
+                            "max_it": cfg.max_it,
+                            "tol": cfg.tol,
+                            "lambda_residual": cfg.lambda_residual,
+                            "lambda_prior": cfg.lambda_prior,
+                        },
+                        # replicas resolve tuning themselves, so the
+                        # solve dict above is the PRE-tune config; a
+                        # replay must re-resolve under the same mode
+                        # (same chip + store reproduces the arm) for
+                        # bit parity to hold
+                        "tune": serve_cfg.tune,
+                    },
+                )
             self._stop_monitor = threading.Event()
             self._hb_last = 0.0
             self._monitor = threading.Thread(
@@ -309,6 +356,11 @@ class ServeFleet:
             if self._metricsd is not None:
                 try:
                     self._metricsd.stop()
+                except Exception:
+                    pass
+            if self._capture is not None:
+                try:
+                    self._capture.close(status_note="init_failed")
                 except Exception:
                     pass
             for rep in self._replicas:
@@ -355,7 +407,8 @@ class ServeFleet:
             return
         try:
             self._metricsd = metricsd_mod.MetricsD(
-                self.metrics, port=port, snapshot_path=snap
+                self.metrics, port=port, snapshot_path=snap,
+                run_id=self.run_id,
             ).start()
         except Exception as e:
             self._metricsd = None
@@ -430,6 +483,9 @@ class ServeFleet:
         scfg = dataclasses.replace(
             self.serve_cfg,
             replica_id=rid,
+            # replica engines never capture: the fleet records the
+            # workload once at admission
+            capture_dir=None,
             metrics_dir=(
                 None if self.fleet_cfg.metrics_dir is None
                 else os.path.join(
@@ -881,6 +937,13 @@ class ServeFleet:
             latency_ms=round(lat * 1e3, 3),
             requeued=req.attempts > 1,
         )
+        if self._capture is not None:
+            # outcome digest pairs the delivered bytes with the
+            # captured request — the bit-parity oracle replay checks
+            self._capture.record_outcome(
+                req.key, res.recon, res.psnr, lat * 1e3, res.bucket,
+                iters=int(res.trace.num_iters),
+            )
 
     # -- the replica worker --------------------------------------------
     def _take(self, rep: _Replica) -> Optional[List[_FleetRequest]]:
@@ -1337,7 +1400,9 @@ class ServeFleet:
         spatial = tuple(
             int(s) for s in np.shape(b)[self.geom.ndim_reduce:]
         )
-        pick_bucket(self.buckets, spatial)  # oversize refusal, pre-queue
+        # oversize refusal, pre-queue (the picked bucket also names
+        # the capture record's expected program)
+        bslots, bsp = pick_bucket(self.buckets, spatial)
         # canonicalize OUTSIDE the fleet lock: four potentially-large
         # array copies per request must not serialize every submitter
         # against the workers' _take/_deliver — nothing here reads
@@ -1466,6 +1531,15 @@ class ServeFleet:
             span_id=qspan, parent_span=req.root_span,
             ts=req.queue_t, attempt=1,
         )
+        if self._capture is not None:
+            # durable workload record of the ADMITTED request —
+            # outside the fleet lock (sha256 + file append must not
+            # serialize submitters against the workers)
+            self._capture.record_submit(
+                req.key, req.trace_id, b32, mask=mask32,
+                smooth_init=smooth32, x_orig=xorig32,
+                bucket=_bucket_name(bslots, bsp),
+            )
         return req.future
 
     def reconstruct(
@@ -1736,6 +1810,21 @@ class ServeFleet:
                 # the fleet it describes
                 try:
                     self._metricsd.stop()
+                except Exception:
+                    pass
+            if self._capture is not None:
+                # seal the capture with the fleet's final admission
+                # counters: replay diffs its own admission behavior
+                # against these (the recorded-vs-replayed story)
+                with self._cv:
+                    cap_final = dict(
+                        n_delivered=self._n_delivered,
+                        n_rejected=self._n_rejected,
+                        n_requeued=self._n_requeued,
+                        n_failed=self._n_failed,
+                    )
+                try:
+                    self._capture.close(**cap_final)
                 except Exception:
                     pass
             if not self._run.closed:

@@ -21,6 +21,15 @@ standard library:
   file every ``CCSC_METRICSD_INTERVAL_S`` seconds for scrape-less
   environments: a sidecar, ``cat``, or a log shipper reads a
   complete, never-torn exposition.
+- Every exposition carries a FRESHNESS STAMP:
+  ``ccsc_snapshot_timestamp_seconds`` (write time — a reader
+  comparing it to the wall clock detects a snapshot whose fleet died
+  with it), ``ccsc_snapshot_age_seconds`` (seconds since the
+  underlying metrics last CHANGED — a live sidecar over a dead
+  source shows it growing), and ``ccsc_snapshot_info{run_id=...}``
+  (the fleet run identity, so a stale file names the fleet that
+  abandoned it). ``parse_snapshot_stamp`` reads it back;
+  ``scripts/obs_report.py`` flags staleness past ``--stale-after``.
 
 Wiring: ``FleetConfig.metricsd_port`` (or ``CCSC_METRICSD_PORT``;
 0 = an ephemeral port, reported in the ``fleet_metricsd`` event and
@@ -33,6 +42,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple, Union
 
@@ -41,6 +51,7 @@ from ..utils import env as _env
 __all__ = [
     "MetricsD",
     "StreamMetrics",
+    "parse_snapshot_stamp",
     "render_prometheus",
     "resolve_endpoint",
 ]
@@ -135,6 +146,39 @@ def render_prometheus(metrics: Dict, prefix: str = _PREFIX) -> str:
             f"{full}_count{_labels(labels)} {snap.get('n', cum)}"
         )
     return "\n".join(lines) + "\n"
+
+
+def parse_snapshot_stamp(path: str) -> Optional[Dict[str, object]]:
+    """Read the freshness stamp back out of a snapshot file:
+    ``{"timestamp": ..., "age_s": ..., "run_id": ...}`` — or None
+    when the file is absent or predates the stamp. The staleness
+    judgment belongs to the READER (``scripts/obs_report.py`` flags a
+    snapshot whose timestamp lags the wall clock): a static file
+    cannot know how long ago it was written."""
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return None
+    out: Dict[str, object] = {}
+    for line in text.splitlines():
+        if line.startswith("ccsc_snapshot_timestamp_seconds "):
+            try:
+                out["timestamp"] = float(line.split()[-1])
+            except ValueError:
+                pass
+        elif line.startswith("ccsc_snapshot_age_seconds "):
+            try:
+                out["age_s"] = float(line.split()[-1])
+            except ValueError:
+                pass
+        elif line.startswith("ccsc_snapshot_info{"):
+            lo = line.find('run_id="')
+            if lo >= 0:
+                hi = line.find('"', lo + 8)
+                if hi > lo:
+                    out["run_id"] = line[lo + 8:hi]
+    return out if "timestamp" in out else None
 
 
 class StreamMetrics:
@@ -281,6 +325,7 @@ class MetricsD:
         host: str = "127.0.0.1",
         snapshot_path: Optional[str] = None,
         interval_s: Optional[float] = None,
+        run_id: Optional[str] = None,
     ):
         if isinstance(source, str):
             source = StreamMetrics(source)
@@ -291,14 +336,40 @@ class MetricsD:
         if interval_s is None:
             interval_s = _env.env_float("CCSC_METRICSD_INTERVAL_S")
         self.interval_s = max(0.05, float(interval_s))
+        # run identity stamped into every exposition: a scrape-less
+        # reader of metrics.prom can tell whether the file belongs to
+        # the fleet it thinks is alive, or is the husk of a dead one
+        self.run_id = run_id or f"pid-{os.getpid()}"
         self.port: Optional[int] = None
         self._server: Optional[ThreadingHTTPServer] = None
         self._server_thread: Optional[threading.Thread] = None
         self._snap_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # freshness tracking: _last_change is the newest time the
+        # UNSTAMPED body actually differed — a live metricsd sitting
+        # on a dead source (a sidecar tailing a stream that stopped)
+        # shows a growing ccsc_snapshot_age_seconds; a dead metricsd
+        # shows a frozen ccsc_snapshot_timestamp_seconds readers
+        # compare against the wall clock
+        self._last_body: Optional[str] = None
+        self._last_change = time.time()
 
     def render(self) -> str:
-        return render_prometheus(self._source())
+        body = render_prometheus(self._source())
+        now = time.time()
+        if body != self._last_body:
+            self._last_body = body
+            self._last_change = now
+        stamp = [
+            "# TYPE ccsc_snapshot_timestamp_seconds gauge",
+            f"ccsc_snapshot_timestamp_seconds {_fmt(now)}",
+            "# TYPE ccsc_snapshot_age_seconds gauge",
+            "ccsc_snapshot_age_seconds "
+            f"{_fmt(max(0.0, now - self._last_change))}",
+            "# TYPE ccsc_snapshot_info gauge",
+            f'ccsc_snapshot_info{{run_id="{self.run_id}"}} 1',
+        ]
+        return body + "\n".join(stamp) + "\n"
 
     def write_snapshot(self) -> None:
         """One atomic exposition write (tmp + rename): a reader can

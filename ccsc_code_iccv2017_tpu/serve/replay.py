@@ -1,0 +1,495 @@
+"""Deterministic traffic replay: re-serve a captured workload as the
+fleet's measuring instrument.
+
+A capture (:mod:`serve.capture`) records what the fleet served and
+what it answered; :class:`ReplayDriver` re-submits that stream
+against a FRESH fleet (or standalone engine) and judges the answers:
+
+- **open-loop** — requests are submitted at their recorded arrival
+  times scaled by a speed factor (the recorded diurnal curve, slowed
+  or accelerated), or at max speed (``speed=0``: back-to-back, the
+  saturation probe — admission refusals back off for the fleet's
+  retry-after hint and resubmit, so "zero lost requests" is a real
+  claim, not a dropped-on-overload one);
+- **closed-loop** — each request is submitted when the previous one
+  resolves (the latency-isolated mode: no queueing beyond one
+  request).
+
+Every replayed result is paired with its recorded original and
+verified: a request replayed in the SAME bucket must be
+BIT-IDENTICAL (sha256 of the reconstruction bytes equals the
+recorded outcome digest — the determinism contract of pinned
+(bank, problem, config) bucket programs, PAPERS.md arXiv:2412.09734);
+a request that landed in a different bucket (a replay fleet with a
+different bucket table) is held to valid-region-PSNR tolerance
+instead (``CCSC_REPLAY_PSNR_TOL`` dB).
+
+The session is itself observable and gated: ``replay_request`` /
+``replay_summary`` events land in the replay's own obs stream
+(rendered by ``scripts/obs_report.py``'s REPLAY section — recorded
+vs replayed p50/p99 side by side), and a ``kind=replay`` record is
+appended to the durable perf ledger (``CCSC_PERF_LEDGER``) so
+``scripts/perf_gate.py`` judges replay throughput against its own
+history like any other workload.
+
+:func:`generate_diurnal` writes a deterministic synthetic
+diurnal-curve capture (sinusoidal arrival intensity, seeded
+payloads, no outcomes) in the same format, for load-shape
+experiments before any real traffic exists.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import env as _env
+from . import capture as _capture
+from . import slo as _slo
+
+__all__ = ["ReplayDriver", "generate_diurnal"]
+
+# verification verdicts, strongest to weakest
+STATUSES = (
+    "match_exact", "match_psnr", "unverified", "mismatch", "lost"
+)
+
+
+def _percentiles(lat_ms) -> Tuple[Optional[float], Optional[float]]:
+    h = _slo.Histogram.of(lat_ms)
+    if not h.n:
+        return None, None
+    return h.percentile(0.50), h.percentile(0.99)
+
+
+class ReplayDriver:
+    """Re-serve one captured workload against a serving target.
+
+    ``metrics_dir`` opens the replay's own telemetry run (algorithm
+    ``serve_replay``); None replays silently (the returned report
+    still carries everything). ``psnr_tol`` is the dB tolerance for
+    cross-bucket verification (default ``CCSC_REPLAY_PSNR_TOL``).
+    """
+
+    def __init__(
+        self,
+        capture_dir: str,
+        metrics_dir: Optional[str] = None,
+        psnr_tol: Optional[float] = None,
+        verbose: str = "brief",
+    ):
+        self.capture_dir = capture_dir
+        self.metrics_dir = metrics_dir
+        self.verbose = verbose
+        self.psnr_tol = (
+            float(psnr_tol)
+            if psnr_tol is not None
+            else float(_env.env_float("CCSC_REPLAY_PSNR_TOL"))
+        )
+        self.meta = _capture.read_meta(capture_dir)
+        self.requests = _capture.read_workload(capture_dir)
+        self._payloads: Dict[str, np.ndarray] = {}
+
+    # -- payload access (cached: dedup means one sha loads once) -------
+    def _payload(self, sha: Optional[str]) -> Optional[np.ndarray]:
+        if sha is None:
+            return None
+        arr = self._payloads.get(sha)
+        if arr is None:
+            arr = _capture.load_payload(self.capture_dir, sha)
+            self._payloads[sha] = arr
+        return arr
+
+    def _arrays(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "b": self._payload(req.get("b")),
+            "mask": self._payload(req.get("mask")),
+            "smooth_init": self._payload(req.get("smooth_init")),
+            "x_orig": self._payload(req.get("x_orig")),
+        }
+
+    # -- verification --------------------------------------------------
+    def _verify(self, req: Dict[str, Any], res) -> str:
+        out = req.get("outcome")
+        if out is None:
+            return "unverified"
+        if res.bucket == out.get("bucket"):
+            # same bucket program, same bytes in: determinism demands
+            # the same bytes out
+            digest = _capture.payload_sha(np.asarray(res.recon))
+            return (
+                "match_exact"
+                if digest == out.get("digest")
+                else "mismatch"
+            )
+        rec_psnr = out.get("psnr")
+        if rec_psnr is not None and res.psnr is not None:
+            return (
+                "match_psnr"
+                if abs(float(res.psnr) - float(rec_psnr))
+                <= self.psnr_tol
+                else "mismatch"
+            )
+        return "unverified"
+
+    # -- the replay ----------------------------------------------------
+    def replay(
+        self,
+        target,
+        speed: float = 1.0,
+        mode: str = "open",
+        timeout_s: float = 600.0,
+    ) -> Dict[str, Any]:
+        """Replay the captured stream against ``target`` (a
+        :class:`~.fleet.ServeFleet` or :class:`~.engine.CodecEngine`)
+        and return the verification + latency report.
+
+        ``speed`` scales the recorded inter-arrival gaps (2.0 = twice
+        as fast); ``speed<=0`` is max-speed saturation. ``mode`` is
+        ``'open'`` (recorded arrival clock) or ``'closed'`` (submit
+        on completion)."""
+        from ..utils import obs as _obs
+        from .fleet import Overloaded
+
+        import os as _os
+
+        if mode not in ("open", "closed"):
+            raise ValueError(
+                f"mode must be 'open' | 'closed', got {mode!r}"
+            )
+        rec = getattr(target, "_capture", None)
+        if rec is not None and _os.path.abspath(
+            rec.path
+        ) == _os.path.abspath(self.capture_dir):
+            raise ValueError(
+                "replay target is capturing into the very directory "
+                "being replayed — it would append every replayed "
+                "request as a duplicate-key record and corrupt the "
+                "capture (build the replay fleet with "
+                "capture_dir='' to force capture off)"
+            )
+        is_fleet = hasattr(target, "fleet_cfg")
+        run = _obs.start_run(
+            self.metrics_dir,
+            algorithm="serve_replay",
+            verbose=self.verbose,
+            compile_monitor=False,
+            capture_dir=self.capture_dir,
+            mode=mode,
+            speed=speed,
+            n_recorded=len(self.requests),
+        )
+        try:
+            return self._replay_inner(
+                target, run, speed, mode, timeout_s, is_fleet,
+                Overloaded,
+            )
+        finally:
+            if not run.closed:
+                run.close(status="ok")
+
+    def _submit_one(
+        self, target, rkey, arrays, is_fleet, overloaded_cls
+    ):
+        """Submit with explicit-backpressure retries; returns
+        (future, n_overload_backoffs, t_submit). Admission refusals
+        are honored (sleep the retry-after hint) and retried until
+        admitted — replay's zero-lost contract sheds nothing.
+        ``rkey`` is a replay-unique key, NOT the recorded one: a
+        multi-session capture legitimately repeats idempotency keys
+        (auto-keys restart per fleet), and resubmitting a spent key
+        would be refused. ``t_submit`` is taken after the last
+        refusal, so backoff sleeps never inflate the replayed
+        latency — the recorded side only ever measures admitted
+        submit->delivery, and the comparison must too."""
+        n_over = 0
+        while True:
+            t_sub = time.perf_counter()
+            try:
+                if is_fleet:
+                    return (
+                        target.submit(
+                            arrays["b"],
+                            mask=arrays["mask"],
+                            smooth_init=arrays["smooth_init"],
+                            x_orig=arrays["x_orig"],
+                            key=rkey,
+                        ),
+                        n_over,
+                        t_sub,
+                    )
+                return (
+                    target.submit(
+                        arrays["b"],
+                        mask=arrays["mask"],
+                        smooth_init=arrays["smooth_init"],
+                        x_orig=arrays["x_orig"],
+                    ),
+                    n_over,
+                    t_sub,
+                )
+            except overloaded_cls as e:
+                n_over += 1
+                time.sleep(min(e.retry_after_s, 5.0))
+
+    def _replay_inner(
+        self, target, run, speed, mode, timeout_s, is_fleet,
+        overloaded_cls,
+    ) -> Dict[str, Any]:
+        reqs = self.requests
+        t_start = time.perf_counter()
+        inflight: List[Tuple[Dict, Any, float]] = []
+        # verdicts, not results: each ServedResult is verified (and
+        # its reconstruction dropped) the moment we collect it — a
+        # thousands-of-requests replay must not hold every recon
+        # array until the report
+        verdicts: List[Tuple[Dict, str, float, Optional[str]]] = []
+        n_overloaded = 0
+        for i, req in enumerate(reqs):
+            arrays = self._arrays(req)
+            if mode == "open" and speed > 0:
+                due = t_start + req.get("t_rel", 0.0) / speed
+                lag = due - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+            fut, n_over, t_sub = self._submit_one(
+                target, f"replay-{i:06d}", arrays, is_fleet,
+                overloaded_cls,
+            )
+            n_overloaded += n_over
+            if mode == "closed":
+                verdicts.append(self._settle(req, fut, t_sub, timeout_s))
+            else:
+                inflight.append((req, fut, t_sub))
+        # submitted payloads now live in the target's own queue; drop
+        # the reader cache so delivered requests' arrays can be freed
+        self._payloads.clear()
+        while inflight:
+            req, fut, t_sub = inflight.pop(0)
+            verdicts.append(self._settle(req, fut, t_sub, timeout_s))
+        elapsed = time.perf_counter() - t_start
+        return self._report(
+            run, verdicts, elapsed, speed, mode, n_overloaded,
+            target, is_fleet,
+        )
+
+    def _settle(
+        self, req, fut, t_sub, timeout_s
+    ) -> Tuple[Dict, str, float, Optional[str]]:
+        """Wait one future out and reduce it to its verdict
+        (status, latency, served bucket) — the result arrays are
+        released here, not carried to the report."""
+        try:
+            res = fut.result(timeout=timeout_s)
+        except Exception:
+            return req, "lost", 0.0, None
+        lat_ms = (time.perf_counter() - t_sub) * 1e3
+        return req, self._verify(req, res), lat_ms, res.bucket
+
+    def _report(
+        self, run, verdicts, elapsed, speed, mode, n_overloaded,
+        target, is_fleet,
+    ) -> Dict[str, Any]:
+        counts = {s: 0 for s in STATUSES}
+        replayed_lat: List[float] = []
+        recorded_lat: List[float] = []
+        for req, status, lat_ms, bucket in verdicts:
+            counts[status] += 1
+            if status != "lost":
+                replayed_lat.append(lat_ms)
+            out = req.get("outcome")
+            if out is not None and out.get("latency_ms") is not None:
+                recorded_lat.append(out["latency_ms"])
+            run.event(
+                "replay_request",
+                key=req["key"],
+                status=status,
+                latency_ms=round(lat_ms, 3),
+                recorded_latency_ms=(
+                    None if out is None else out.get("latency_ms")
+                ),
+                bucket=bucket,
+            )
+        rec_p50, rec_p99 = _percentiles(recorded_lat)
+        rep_p50, rep_p99 = _percentiles(replayed_lat)
+        n = len(verdicts)
+        rps = n / elapsed if elapsed > 0 else 0.0
+        report: Dict[str, Any] = {
+            "mode": mode,
+            "speed": speed,
+            "n_recorded": len(self.requests),
+            "n_replayed": n,
+            "n_lost": counts["lost"],
+            "n_mismatched": counts["mismatch"],
+            "n_exact": counts["match_exact"],
+            "n_psnr": counts["match_psnr"],
+            "n_unverified": counts["unverified"],
+            "replay_overload_backoffs": n_overloaded,
+            "recorded_rejected": self.meta.get("n_rejected"),
+            "recorded_p50_ms": rec_p50,
+            "recorded_p99_ms": rec_p99,
+            "replayed_p50_ms": rep_p50,
+            "replayed_p99_ms": rep_p99,
+            "elapsed_s": round(elapsed, 4),
+            "requests_per_sec": round(rps, 4),
+            "ok": counts["lost"] == 0 and counts["mismatch"] == 0,
+        }
+        run.event(
+            "replay_summary",
+            mode=mode,
+            speed=speed,
+            n_recorded=report["n_recorded"],
+            n_replayed=n,
+            n_lost=report["n_lost"],
+            n_mismatched=report["n_mismatched"],
+            n_exact=report["n_exact"],
+            n_psnr=report["n_psnr"],
+            n_unverified=report["n_unverified"],
+            replay_overload_backoffs=n_overloaded,
+            recorded_rejected=report["recorded_rejected"],
+            recorded_p50_ms=rec_p50,
+            recorded_p99_ms=rec_p99,
+            replayed_p50_ms=rep_p50,
+            replayed_p99_ms=rep_p99,
+            elapsed_s=report["elapsed_s"],
+            requests_per_sec=report["requests_per_sec"],
+        )
+        led = self._ledger_append(report, target, is_fleet)
+        if led is not None:
+            run.event(
+                "ledger_append",
+                key=led["key"],
+                value=led["value"],
+                unit=led["unit"],
+                path=led["path"],
+            )
+            report["ledger_key"] = led["key"]
+        run.console(
+            f"replay: {n} request(s) at {mode}/"
+            + ("max-speed" if speed <= 0 else f"{speed:g}x")
+            + f", {report['n_exact']} bit-exact, "
+            f"{report['n_psnr']} psnr-matched, "
+            f"{report['n_mismatched']} mismatched, "
+            f"{report['n_lost']} lost",
+            tier="brief",
+        )
+        return report
+
+    def _ledger_append(
+        self, report: Dict[str, Any], target, is_fleet
+    ) -> Optional[Dict[str, Any]]:
+        """Append this replay session to the durable perf ledger
+        (kind=replay, requests/sec) so scripts/perf_gate.py gates
+        replay throughput against its own per-configuration history.
+        Never raises — the ledger must not fail a replay."""
+        try:
+            from ..analysis import ledger as _ledger
+            from ..tune import store as tune_store
+            from ..utils import obs as _obs
+            from ..utils import perfmodel
+
+            if not _ledger.enabled() or report["n_replayed"] <= 0:
+                return None
+            chip = perfmodel.detect_chip()
+            if not chip:
+                return None
+            geom = target.geom
+            buckets = (
+                target.buckets if is_fleet else target._buckets
+            )
+            spatial = max(
+                (sp for _s, sp in buckets), key=lambda sp: tuple(sp)
+            )
+            workload = tune_store.solve_workload(geom)
+            rec = _ledger.maybe_append(
+                chip=chip,
+                kind="replay",
+                workload=workload,
+                shape_key=tune_store.solve_shape_key(
+                    workload,
+                    k=geom.num_filters,
+                    support=tuple(geom.spatial_support),
+                    spatial=tuple(spatial),
+                ),
+                knobs={
+                    "mode": report["mode"],
+                    "speed": report["speed"],
+                    "replicas": (
+                        target.fleet_cfg.replicas if is_fleet else 1
+                    ),
+                },
+                value=report["requests_per_sec"],
+                unit="requests/sec",
+                git_sha=_obs.git_sha(),
+                source="serve.replay",
+            )
+            if rec is None:
+                return None
+            return {
+                "key": _ledger.record_key(rec),
+                "value": rec["value"],
+                "unit": rec["unit"],
+                "path": _ledger.default_ledger_path(),
+            }
+        except Exception:  # pragma: no cover - defensive
+            return None
+
+
+# ---------------------------------------------------------------------
+# synthetic diurnal workload
+# ---------------------------------------------------------------------
+
+
+def generate_diurnal(
+    path: str,
+    n_requests: int = 64,
+    duration_s: float = 60.0,
+    spatial: Tuple[int, int] = (24, 24),
+    keep: float = 0.5,
+    amp: float = 0.8,
+    seed: int = 0,
+) -> str:
+    """Write a deterministic synthetic diurnal-curve capture.
+
+    Arrival times follow a sinusoidal intensity —
+    ``rate(t) ∝ 1 + amp·sin(2π·t/T − π/2)`` (trough at t=0, peak at
+    mid-stream, the compressed shape of a day's traffic) — placed by
+    inverse-CDF of the cumulative intensity, so the same (n,
+    duration, amp, seed) always yields byte-identical requests and
+    the identical arrival clock. Payloads are seeded masked images
+    with ground truth (``x_orig``) attached, so a replay of the
+    synthetic stream still measures PSNR. No outcomes are recorded
+    (there was no serve) — replay marks these ``unverified`` and the
+    stream functions as a pure load shape."""
+    rng = np.random.default_rng(seed)
+    # inverse-CDF placement on a fine grid of the cumulative intensity
+    grid = np.linspace(0.0, duration_s, 4096)
+    rate = 1.0 + amp * np.sin(
+        2.0 * math.pi * grid / max(duration_s, 1e-9) - math.pi / 2.0
+    )
+    cum = np.concatenate([[0.0], np.cumsum(rate[:-1] + rate[1:])])
+    cum /= max(cum[-1], 1e-12)
+    targets = (np.arange(n_requests) + 0.5) / n_requests
+    arrivals = np.interp(targets, cum, grid)
+    rec = _capture.WorkloadRecorder(path, sample=1.0)
+    h, w = int(spatial[0]), int(spatial[1])
+    for i, t_rel in enumerate(arrivals):
+        x = rng.random((h, w), dtype=np.float64).astype(np.float32)
+        m = (rng.random((h, w)) < keep).astype(np.float32)
+        key = f"diurnal-{i:06d}"
+        # curve time, not generation time: t_rel comes from the
+        # intensity inversion so generation speed never leaks into
+        # the workload
+        rec.record_submit(
+            key, None, x * m, mask=m, x_orig=x, t_rel=float(t_rel),
+        )
+    rec.close(
+        synthetic="diurnal",
+        duration_s=duration_s,
+        amp=amp,
+        seed=seed,
+        keep=keep,
+    )
+    return path

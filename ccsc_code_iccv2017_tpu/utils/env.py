@@ -100,6 +100,27 @@ REGISTRY: Dict[str, Knob] = _knobs(
     ("CCSC_COMPILE_CACHE", "path", None, "serve.engine, tune.store",
      "persistent XLA compilation cache dir (warm restarts skip "
      "backend compiles)"),
+    # -- workload capture + replay (serve.capture, serve.replay) -----
+    ("CCSC_CAPTURE_DIR", "path", None,
+     "serve.capture, serve.fleet, serve.engine",
+     "workload-capture directory: every admitted request is durably "
+     "recorded (payloads content-addressed by sha256, outcome digest "
+     "+ PSNR + latency) for deterministic replay (unset = capture "
+     "off; fallback of FleetConfig/ServeConfig.capture_dir)"),
+    ("CCSC_CAPTURE_SAMPLE", "float", 1.0, "serve.capture",
+     "fraction of admitted requests captured, deterministic per "
+     "idempotency key (a request and its outcome always land on the "
+     "same side)"),
+    ("CCSC_CAPTURE_ROTATE_MB", "float", 64.0, "serve.capture",
+     "request-segment rotation threshold in MB: a long-lived fleet "
+     "rotates to a fresh requests-NNNN.jsonl instead of growing one "
+     "file forever"),
+    ("CCSC_REPLAY_PSNR_TOL", "float", 0.1, "serve.replay",
+     "PSNR tolerance in dB for cross-bucket replay verification "
+     "(same-bucket replays are held to bit-identity instead)"),
+    ("CCSC_REPLAY_SPEED", "float", 1.0, "scripts/replay.py",
+     "default replay speed factor over the recorded arrival clock "
+     "(2.0 = twice as fast; 0 = max-speed saturation)"),
     # -- serving SLOs / live metrics (serve.slo, serve.metricsd) -----
     ("CCSC_SLO_P50_MS", "float", None, "serve.slo",
      "declared p50 submit->result latency target in ms (fallback of "

@@ -27,6 +27,14 @@ restores them):
                       its requests are requeued onto the survivor,
                       every request completes exactly once, and the
                       casualty's restart is visible in the obs stream
+  replay_parity       a stream served UNDER kill/hang faults with
+                      workload capture on (serve.capture) is replayed
+                      at max speed against a clean fleet
+                      (serve.replay): zero lost requests and every
+                      replayed result bit-identical to its recorded
+                      outcome — faults must not leak into the served
+                      bytes, and the capture must be a faithful
+                      oracle
   sigterm_subprocess  (script mode only) the same against a real child
                       process: exit code 0 + valid checkpoint
   supervise_restart   (script mode only) scripts/supervise.py restarts
@@ -316,6 +324,86 @@ def scenario_fleet_kill():
     )
 
 
+def scenario_replay_parity():
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import (
+        FleetConfig,
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+    from ccsc_code_iccv2017_tpu.serve.replay import ReplayDriver
+
+    r = np.random.default_rng(0)
+    d = r.normal(size=(4, 3, 3)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_psnr=True, track_objective=True,
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+
+    def fleet_cfg(mdir, cap=None):
+        return FleetConfig(
+            replicas=2, metrics_dir=mdir, capture_dir=cap,
+            min_queue_depth=64, restart_backoff_s=0.05,
+            verbose="none",
+        )
+
+    with tempfile.TemporaryDirectory() as root:
+        cap = os.path.join(root, "capture")
+        # serve under a mid-stream replica kill, capture armed
+        with _fault(
+            CCSC_FAULT_ENGINE_KILL_REQ=2,
+            CCSC_FAULT_ENGINE_KILL_REPLICA="0",
+        ):
+            fleet = ServeFleet(
+                d, ReconstructionProblem(geom), cfg, scfg,
+                fleet_cfg(os.path.join(root, "m-serve"), cap),
+            )
+            futs = []
+            for i in range(8):
+                x = r.random((12, 12)).astype(np.float32)
+                m = (r.random((12, 12)) < 0.5).astype(np.float32)
+                futs.append(
+                    fleet.submit(x * m, mask=m, x_orig=x, key=f"k{i}")
+                )
+            n_served = len([f.result(timeout=180) for f in futs])
+            fleet.close()
+        # replay at max speed against a CLEAN fleet ("" = capture
+        # explicitly off even if CCSC_CAPTURE_DIR is armed globally)
+        fresh = ServeFleet(
+            d, ReconstructionProblem(geom), cfg, scfg,
+            fleet_cfg(os.path.join(root, "m-replay"), cap=""),
+        )
+        try:
+            rep = ReplayDriver(
+                cap, metrics_dir=os.path.join(root, "m-replay")
+            ).replay(fresh, speed=0.0, mode="open")
+        finally:
+            fresh.close()
+        ok = (
+            n_served == 8
+            and rep["n_replayed"] == 8
+            and rep["n_lost"] == 0
+            and rep["n_mismatched"] == 0
+            and rep["n_exact"] == 8
+        )
+    return ok, (
+        f"served={n_served}, replayed={rep['n_replayed']}, "
+        f"exact={rep['n_exact']}, lost={rep['n_lost']}, "
+        f"mismatched={rep['n_mismatched']}"
+    )
+
+
 def scenario_supervise_restart():
     import json
 
@@ -410,6 +498,7 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
         "sigterm_checkpoint": scenario_sigterm_checkpoint,
         "hang_watchdog": scenario_hang_watchdog,
         "fleet_kill": scenario_fleet_kill,
+        "replay_parity": scenario_replay_parity,
     }
     if subprocess_scenarios:
         scenarios["sigterm_subprocess"] = scenario_sigterm_subprocess

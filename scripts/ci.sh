@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# The one pre-merge entrypoint: static analysis -> tier-1 tests ->
+# perf regression gate, in that order (cheapest signal first).
+#
+#   bash scripts/ci.sh
+#
+# Exit-code contract (stable — wire CI stages to these):
+#   0   everything passed
+#   10  scripts/lint.py found NEW findings (not baselined/suppressed)
+#   20  tier-1 pytest has NEW failures (the ROADMAP.md tier-1
+#       invocation: -m 'not slow' on CPU). Failures listed in
+#       scripts/ci_known_failures.txt — the documented environment-
+#       dependent set (absent /root/reference mount, golden drift,
+#       the pallas-mesh replication gap) — are tolerated, mirroring
+#       the driver's "no worse than the seed" rule; anything NOT on
+#       that list fails the stage.
+#   30  scripts/perf_gate.py judged a regression against the durable
+#       perf ledger (skipped silently when no ledger file exists yet
+#       — a young repo must not fail CI on an empty history)
+#
+# Each stage runs only if the previous passed: a lint finding or test
+# failure makes the perf verdict moot, and fail-fast keeps the signal
+# attributable.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== ci: 1/3 static analysis (scripts/lint.py)"
+python scripts/lint.py || exit 10
+
+echo "== ci: 2/3 tier-1 tests (pytest -m 'not slow', CPU)"
+T1_LOG=$(mktemp)
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee "$T1_LOG"
+T1_RC=${PIPESTATUS[0]}
+if [ "$T1_RC" -ne 0 ]; then
+    OBSERVED=$(grep -aE '^(FAILED|ERROR) ' "$T1_LOG" \
+        | awk '{print $2}' | sort -u)
+    if [ -z "$OBSERVED" ]; then
+        # nonzero exit with no per-test verdicts = a harness-level
+        # failure (timeout, internal error) — never tolerated
+        echo "== ci: tier-1 exited $T1_RC with no test verdicts"
+        exit 20
+    fi
+    NEW=$(echo "$OBSERVED" \
+        | grep -vxF -f scripts/ci_known_failures.txt || true)
+    if [ -n "$NEW" ]; then
+        echo "== ci: NEW tier-1 failures (not in scripts/ci_known_failures.txt):"
+        echo "$NEW"
+        exit 20
+    fi
+    echo "== ci: tier-1 failures are all on the documented known list — tolerated"
+fi
+
+echo "== ci: 3/3 perf regression gate (scripts/perf_gate.py)"
+# resolve the same ledger path perf_gate would; gate only when a
+# ledger actually exists (exit 0 on an empty observatory)
+LEDGER_PATH=$(python - <<'EOF'
+import os, sys
+sys.path.insert(0, os.getcwd())
+from ccsc_code_iccv2017_tpu.analysis import ledger
+print(ledger.default_ledger_path())
+EOF
+)
+if [ -f "$LEDGER_PATH" ]; then
+    python scripts/perf_gate.py || exit 30
+else
+    echo "== ci: no perf ledger at $LEDGER_PATH — gate skipped"
+fi
+
+echo "== ci: all stages passed"

@@ -45,7 +45,8 @@ def _section(title):
     return f"\n== {title} " + "=" * max(1, 64 - len(title))
 
 
-def render(events, stale_after=None, n_traces=3, ledger_path=None):
+def render(events, stale_after=None, n_traces=3, ledger_path=None,
+           snapshot=None):
     """-> the dashboard string (pure function of the parsed records
     plus, optionally, the durable perf ledger).
     ``stale_after``: per-host liveness threshold in seconds (default:
@@ -55,6 +56,10 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None):
     ``ledger_path``: perf-ledger JSONL to render the LEDGER section
     from (per-key trend vs the robust history band); None skips it
     unless the stream itself carries ledger_append records.
+    ``snapshot``: parsed metrics.prom freshness stamp
+    (serve.metricsd.parse_snapshot_stamp + an ``age_wall_s`` the
+    caller computes against its clock) — flagged STALE past
+    ``stale_after`` so a metrics file left by a dead fleet is loud.
     """
     if stale_after is None:
         from ccsc_code_iccv2017_tpu.utils import env as _env
@@ -499,6 +504,88 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None):
                 "SLO breach; scripts/xprof_report.py attributes it)"
             )
 
+    # -- SNAPSHOT: metrics.prom freshness (serve.metricsd stamp) -----
+    if snapshot:
+        lines.append(_section("SNAPSHOT"))
+        age = snapshot.get("age_wall_s")
+        stale = age is not None and age > stale_after
+        lines.append(
+            f"  metrics.prom  run {snapshot.get('run_id')}, written "
+            f"{_fmt_ts(snapshot.get('timestamp', 0.0))}"
+            + (
+                f", {age:.0f}s ago"
+                + (
+                    "  <-- STALE (the fleet that wrote this is gone "
+                    "or wedged)" if stale else ""
+                )
+                if age is not None else ""
+            )
+        )
+        if snapshot.get("age_s"):
+            lines.append(
+                f"  data age      {snapshot['age_s']:.0f}s at write "
+                "time (the source had stopped changing)"
+            )
+
+    # -- REPLAY: recorded vs replayed traffic (serve.replay) ---------
+    rsums = by.get("replay_summary", [])
+    rreqs = by.get("replay_request", [])
+    caps = by.get("capture_summary", [])
+    if rsums or rreqs or caps:
+        lines.append(_section("REPLAY"))
+        for c in caps:
+            lines.append(
+                f"  capture       {c.get('n_requests')} request(s), "
+                f"{c.get('n_payloads')} payload(s) "
+                f"({c.get('n_dedup_hits')} dedup hit(s), "
+                f"{(c.get('payload_bytes') or 0) / 1e6:.2f} MB), "
+                f"overhead {c.get('overhead_s')}s "
+                f"({c.get('overhead_ms_per_request')} ms/req) -> "
+                f"{c.get('path')}"
+            )
+        fmt = lambda v: "—" if v is None else f"{v:.1f}"
+        for s in rsums:
+            speed = (
+                "max" if (s.get("speed") or 0) <= 0
+                else f"{s['speed']:g}x"
+            )
+            lines.append(
+                f"  session       {s.get('mode')}/{speed}: "
+                f"{s.get('n_replayed')}/{s.get('n_recorded')} "
+                f"replayed, {s.get('n_exact')} bit-exact, "
+                f"{s.get('n_psnr')} psnr-matched, "
+                f"{s.get('n_unverified')} unverified, "
+                f"{s.get('n_mismatched')} MISMATCHED, "
+                f"{s.get('n_lost')} LOST"
+            )
+            lines.append(
+                "                latency p50 "
+                f"{fmt(s.get('recorded_p50_ms'))} -> "
+                f"{fmt(s.get('replayed_p50_ms'))} ms, p99 "
+                f"{fmt(s.get('recorded_p99_ms'))} -> "
+                f"{fmt(s.get('replayed_p99_ms'))} ms "
+                "(recorded -> replayed), "
+                f"{s.get('requests_per_sec')} req/s"
+            )
+            rej = s.get("recorded_rejected")
+            backs = s.get("replay_overload_backoffs") or 0
+            if rej is not None or backs:
+                lines.append(
+                    f"                admission: {backs} replay "
+                    f"backoff(s) vs {rej} recorded rejection(s)"
+                )
+        if rreqs and not rsums:
+            # a replay killed before its summary: reconstruct counts
+            per = {}
+            for r in rreqs:
+                per[r.get("status", "?")] = (
+                    per.get(r.get("status", "?"), 0) + 1
+                )
+            lines.append(
+                f"  (no summary — live/killed replay; statuses so "
+                f"far: {json.dumps(per)})"
+            )
+
     # -- MEMORY: measured vs modeled HBM watermark (utils.memwatch) --
     wms = by.get("mem_watermark", [])
     ooms = by.get("mem_oom_dump", [])
@@ -676,6 +763,78 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None):
     return "\n".join(lines)
 
 
+def _snapshot_stamp(path):
+    """Parsed freshness stamp of ``path``/metrics.prom (None when the
+    target is not a dir or carries no stamped snapshot), with
+    ``age_wall_s`` computed against THIS process's clock — the
+    reader-side half of the staleness contract."""
+    if not os.path.isdir(path):
+        return None
+    from ccsc_code_iccv2017_tpu.serve import metricsd as _metricsd
+
+    stamp = _metricsd.parse_snapshot_stamp(
+        os.path.join(path, "metrics.prom")
+    )
+    if stamp is not None and stamp.get("timestamp"):
+        stamp["age_wall_s"] = max(
+            0.0, time.time() - stamp["timestamp"]
+        )
+    return stamp
+
+
+def follow(path, recursive=False, interval_s=2.0, stale_after=None,
+           n_traces=3, ledger_path=None, max_polls=None, out=None):
+    """Live dashboard: tail the stream incrementally
+    (``obs.EventTail`` — each poll costs O(new records), never a
+    re-parse of the whole stream) and re-render whenever records
+    arrive — or when the metrics.prom snapshot's staleness verdict
+    FLIPS (a dead fleet emits no new records, which is exactly when
+    the STALE flag must appear). Runs until interrupted (or
+    ``max_polls`` polls, for tests/one-shots). Returns the
+    accumulated event list."""
+    import builtins
+
+    if stale_after is None:
+        from ccsc_code_iccv2017_tpu.utils import env as _env
+
+        stale_after = _env.env_float("CCSC_WATCHDOG_PEER_STALE_S")
+    emit = out if out is not None else builtins.print
+    tail = obs.EventTail(path, recursive=recursive)
+    events = []
+    polls = 0
+    last_stale = False
+    try:
+        while max_polls is None or polls < max_polls:
+            polls += 1
+            fresh = tail.poll()
+            snapshot = _snapshot_stamp(path)
+            stale = bool(
+                snapshot is not None
+                and snapshot.get("age_wall_s") is not None
+                and snapshot["age_wall_s"] > stale_after
+            )
+            if fresh or stale != last_stale:
+                events.extend(fresh)
+                emit(
+                    "\n" + "#" * 72 + f"\n# follow: +{len(fresh)} "
+                    f"record(s), {len(events)} total, "
+                    f"{_fmt_ts(time.time())}\n" + "#" * 72
+                )
+                emit(
+                    render(
+                        events, stale_after=stale_after,
+                        n_traces=n_traces, ledger_path=ledger_path,
+                        snapshot=snapshot,
+                    )
+                )
+            last_stale = stale
+            if max_polls is None or polls < max_polls:
+                time.sleep(interval_s)
+    except KeyboardInterrupt:
+        emit(f"\nfollow: stopped ({len(events)} record(s) seen)")
+    return events
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("path", help="metrics dir or one events-*.jsonl")
@@ -709,6 +868,17 @@ def main(argv=None):
         "$CCSC_COMPILE_CACHE/ccsc_perf_ledger.jsonl, else repo "
         "perf_ledger.jsonl — when that file exists)",
     )
+    ap.add_argument(
+        "--follow", action="store_true",
+        help="live mode: tail the stream incrementally "
+        "(obs.EventTail, per-file offsets — each poll parses only "
+        "appended records) and re-render on growth until "
+        "interrupted",
+    )
+    ap.add_argument(
+        "--interval", type=float, default=2.0,
+        help="--follow poll cadence in seconds",
+    )
     args = ap.parse_args(argv)
     recursive = args.recursive
     if not recursive and os.path.isdir(args.path):
@@ -718,10 +888,6 @@ def main(argv=None):
             and os.path.isdir(os.path.join(args.path, n))
             for n in os.listdir(args.path)
         )
-    events = obs.read_events(args.path, recursive=recursive)
-    if args.json:
-        print(json.dumps(events))
-        return events
     ledger_path = args.ledger
     if ledger_path is None:
         from ccsc_code_iccv2017_tpu.analysis import ledger as _ledger
@@ -729,10 +895,28 @@ def main(argv=None):
         candidate = _ledger.default_ledger_path()
         if os.path.exists(candidate):
             ledger_path = candidate
+    if args.follow and args.json:
+        ap.error(
+            "--follow renders the live text dashboard; it cannot "
+            "honor --json (use --json on a one-shot run, or tail "
+            "the events-*.jsonl files directly for machine "
+            "consumption)"
+        )
+    if args.follow:
+        return follow(
+            args.path, recursive=recursive,
+            interval_s=args.interval, stale_after=args.stale_after,
+            n_traces=args.traces, ledger_path=ledger_path,
+        )
+    events = obs.read_events(args.path, recursive=recursive)
+    if args.json:
+        print(json.dumps(events))
+        return events
     print(
         render(
             events, stale_after=args.stale_after,
             n_traces=args.traces, ledger_path=ledger_path,
+            snapshot=_snapshot_stamp(args.path),
         )
     )
     return events

@@ -14,6 +14,7 @@
                                                      # measured) without
                                                      # appending it
     python scripts/perf_gate.py --list               # per-key history
+    python scripts/perf_gate.py --list --kind replay # one run family
     python scripts/perf_gate.py --json               # machine-readable
 
 Exit status: 0 = no regression (keys with fewer than
@@ -27,7 +28,10 @@ The ledger path comes from --ledger, else CCSC_PERF_LEDGER, else the
 standard resolution (analysis.ledger.default_ledger_path). This is
 the CI-runnable end of the performance observatory: run it after any
 bench/serve session that appended to the ledger and a silent
-slowdown fails the build instead of shipping.
+slowdown fails the build instead of shipping. Record kinds judged:
+learn | bench | serve | solve | replay (traffic-replay sessions,
+serve.replay — requests/sec of a captured stream re-served);
+--kind restricts gating/listing to one family.
 """
 from __future__ import annotations
 
@@ -102,6 +106,11 @@ def main(argv=None) -> int:
         help="print per-key history summaries and exit",
     )
     ap.add_argument(
+        "--kind", default=None,
+        help="restrict gating/--list to one record kind (learn | "
+        "bench | serve | solve | replay)",
+    )
+    ap.add_argument(
         "--json", action="store_true", dest="as_json",
         help="emit verdicts as JSON",
     )
@@ -128,8 +137,17 @@ def main(argv=None) -> int:
             )
         return 0
 
+    def _kind_of(key: str) -> str:
+        parts = key.split("|")
+        return parts[1] if len(parts) > 1 else ""
+
     if args.list_keys:
         groups = led.by_key()
+        if args.kind:
+            groups = {
+                k: v for k, v in groups.items()
+                if _kind_of(k) == args.kind
+            }
         rows = []
         for key, recs in sorted(groups.items()):
             band = ledger_mod.robust_band(
@@ -198,6 +216,10 @@ def main(argv=None) -> int:
         # regression verdict (exit 1) CI would act on
         print(f"perf-gate: {e}", file=sys.stderr)
         return 2
+    if args.kind:
+        verdicts = [
+            v for v in verdicts if _kind_of(v["key"]) == args.kind
+        ]
     judged = [v for v in verdicts if not v.get("skipped")]
     bad = [v for v in judged if not v["ok"]]
     skipped = [v for v in verdicts if v.get("skipped")]

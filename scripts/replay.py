@@ -1,0 +1,403 @@
+#!/usr/bin/env python
+"""Replay a captured serving workload against a fresh fleet
+(serve.capture -> serve.replay) and verify the answers.
+
+    python scripts/replay.py CAPTURE_DIR [--replicas N]
+        [--speed X | --max-speed] [--mode open|closed]
+        [--filters BANK.mat] [--metrics-dir DIR] [--json]
+
+    python scripts/replay.py --generate-diurnal OUT_DIR
+        [--requests N --duration S --side PX --seed K]
+
+    python scripts/replay.py --demo [--demo-dir DIR]
+
+Default mode rebuilds a serving fleet pinned to the capture's own
+geometry/solve parameters (recorded in the capture's meta.json; the
+bank comes from --filters or, for synthetic-bank captures, the
+deterministic --bank-seed bank) and re-submits the recorded stream:
+open-loop on the recorded arrival clock x --speed (--max-speed =
+back-to-back saturation, admission refusals honored + retried so
+nothing is shed) or closed-loop. Every replayed result is paired with
+its recorded outcome — same-bucket replays must be BIT-IDENTICAL
+(sha256 of the reconstruction bytes), cross-bucket replays are held
+to --psnr-tol dB. Exit 0 iff zero lost and zero mismatched.
+
+The replay session appends a kind=replay record to the durable perf
+ledger when CCSC_PERF_LEDGER is armed, so `scripts/perf_gate.py`
+(try `--list --kind replay`) gates replay throughput against its own
+history, and the replay metrics stream renders in obs_report's
+REPLAY section.
+
+--generate-diurnal writes a deterministic synthetic diurnal-curve
+capture (sinusoidal arrival intensity) for load-shape experiments.
+
+--demo is the self-contained end-to-end proof: a 3-replica fleet
+serves a stream UNDER INJECTED KILL/HANG FAULTS with capture on, the
+captured stream is replayed at 1x and at max speed against fresh
+fleets, and both replays must complete with zero lost requests and
+full bit-parity.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _synth_bank(k: int, support, seed: int):
+    """The deterministic synthetic bank (serve.bench's construction):
+    seeded normal filters, unit-normalized — the same (k, support,
+    seed) always yields the same bytes, which is what lets a replay
+    rebuild the exact capture-side operator without shipping it."""
+    import numpy as np
+
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, *support)).astype(np.float32)
+    axes = tuple(range(1, d.ndim))
+    d /= np.sqrt((d**2).sum(axis=axes, keepdims=True))
+    return d
+
+
+def _build_fleet(meta, args, metrics_dir, capture_requests):
+    """A fresh fleet pinned to the capture's recorded configuration."""
+    from ccsc_code_iccv2017_tpu.config import (
+        FleetConfig,
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+
+    geom_meta = meta.get("geom") or {}
+    if args.filters:
+        from ccsc_code_iccv2017_tpu.utils.io_mat import load_filters_2d
+
+        d = load_filters_2d(args.filters)
+        geom = ProblemGeom(d.shape[1:], d.shape[0])
+    else:
+        support = tuple(
+            geom_meta.get("spatial_support") or (args.support,) * 2
+        )
+        k = int(geom_meta.get("num_filters") or args.k)
+        d = _synth_bank(k, support, args.bank_seed)
+        geom = ProblemGeom(support, k)
+    solve = meta.get("solve") or {}
+    cfg = SolveConfig(
+        lambda_residual=float(solve.get("lambda_residual", 5.0)),
+        lambda_prior=float(solve.get("lambda_prior", 0.3)),
+        max_it=int(solve.get("max_it", 20)),
+        tol=float(solve.get("tol", 0.0)),
+        verbose="none",
+        track_psnr=True,
+        track_objective=True,
+    )
+    buckets = meta.get("buckets")
+    if buckets:
+        btab = tuple(
+            (int(b["slots"]), tuple(int(s) for s in b["spatial"]))
+            for b in buckets
+        )
+    else:
+        # no recorded table (synthetic capture): one bucket over the
+        # largest recorded request shape
+        hi = max(
+            (tuple(r.get("spatial") or ()) for r in capture_requests),
+            default=(args.side,) * 2,
+        )
+        btab = ((args.slots, tuple(int(s) for s in hi)),)
+    # re-resolve tuning under the capture's recorded mode: on the
+    # same chip + tuned store this reproduces the arm the capture
+    # was served under, which same-bucket bit parity depends on
+    tune = str(meta.get("tune") or "off")
+    if tune != "off":
+        # stderr: --json consumers own stdout
+        print(
+            f"replay: capture was served with tune={tune!r} — "
+            "re-resolving on this chip (bit parity holds only when "
+            "the same arm is picked)",
+            file=sys.stderr,
+        )
+    scfg = ServeConfig(
+        buckets=btab, max_wait_ms=args.max_wait_ms, verbose="none",
+        tune=tune,
+    )
+    fcfg = FleetConfig(
+        replicas=args.replicas,
+        metrics_dir=metrics_dir,
+        # "" = capture explicitly OFF: a replay run in a shell that
+        # still has CCSC_CAPTURE_DIR armed must never re-capture
+        # itself into the capture it is replaying
+        capture_dir="",
+        min_queue_depth=max(64, 2 * len(capture_requests)),
+        restart_backoff_s=0.05,
+        verbose="none",
+    )
+    return ServeFleet(d, ReconstructionProblem(geom), cfg, scfg, fcfg)
+
+
+def _print_report(rep, as_json=False):
+    if as_json:
+        print(json.dumps(rep, indent=1))
+        return
+    f = lambda v: "—" if v is None else f"{v:.1f}"
+    speed = "max" if rep["speed"] <= 0 else f"{rep['speed']:g}x"
+    print(
+        f"replay[{rep['mode']}/{speed}]: {rep['n_replayed']}/"
+        f"{rep['n_recorded']} replayed, {rep['n_exact']} bit-exact, "
+        f"{rep['n_psnr']} psnr-matched, {rep['n_unverified']} "
+        f"unverified, {rep['n_mismatched']} MISMATCHED, "
+        f"{rep['n_lost']} LOST"
+    )
+    print(
+        f"  latency p50 {f(rep['recorded_p50_ms'])} -> "
+        f"{f(rep['replayed_p50_ms'])} ms, p99 "
+        f"{f(rep['recorded_p99_ms'])} -> {f(rep['replayed_p99_ms'])} "
+        f"ms (recorded -> replayed), "
+        f"{rep['requests_per_sec']:.2f} req/s over "
+        f"{rep['elapsed_s']:.2f}s"
+    )
+    if rep.get("replay_overload_backoffs"):
+        print(
+            f"  admission: {rep['replay_overload_backoffs']} overload "
+            f"backoff(s) during replay vs {rep['recorded_rejected']} "
+            "recorded rejection(s)"
+        )
+
+
+def _run_replay(args) -> int:
+    from ccsc_code_iccv2017_tpu.serve.replay import ReplayDriver
+    from ccsc_code_iccv2017_tpu.utils import env as _env
+
+    # the driver parses meta + every segment once; reuse its state
+    # for the emptiness check and the fleet reconstruction instead of
+    # re-reading a potentially large capture
+    driver = ReplayDriver(
+        args.capture_dir,
+        metrics_dir=args.metrics_dir,
+        psnr_tol=args.psnr_tol,
+        # --json promises a machine-readable stdout: the driver's
+        # console line must not precede the JSON document
+        verbose="none" if args.json else "brief",
+    )
+    if not driver.requests:
+        print(
+            f"replay: no captured requests under {args.capture_dir}",
+            file=sys.stderr,
+        )
+        return 2
+    speed = args.speed
+    if speed is None:
+        speed = (
+            0.0 if args.max_speed
+            else float(_env.env_float("CCSC_REPLAY_SPEED"))
+        )
+    fleet = _build_fleet(
+        driver.meta, args, args.metrics_dir, driver.requests
+    )
+    try:
+        rep = driver.replay(fleet, speed=speed, mode=args.mode)
+    finally:
+        fleet.close()
+    _print_report(rep, as_json=args.json)
+    return 0 if rep["ok"] else 1
+
+
+def _run_generate(args) -> int:
+    from ccsc_code_iccv2017_tpu.serve.replay import generate_diurnal
+
+    generate_diurnal(
+        args.generate_diurnal,
+        n_requests=args.requests,
+        duration_s=args.duration,
+        spatial=(args.side, args.side),
+        seed=args.seed,
+    )
+    print(
+        f"generated {args.requests} diurnal request(s) over "
+        f"{args.duration:g}s -> {args.generate_diurnal}"
+    )
+    return 0
+
+
+def _run_demo(args) -> int:
+    """The end-to-end acceptance story, self-contained on CPU."""
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import (
+        FleetConfig,
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+    from ccsc_code_iccv2017_tpu.serve.replay import ReplayDriver
+
+    # chaos_smoke owns the fault-env save/arm/reset discipline — one
+    # implementation, shared
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from chaos_smoke import _fault
+    finally:
+        sys.path.pop(0)
+
+    root = args.demo_dir or tempfile.mkdtemp(prefix="ccsc_replay_demo_")
+    os.makedirs(root, exist_ok=True)
+    cap_dir = os.path.join(root, "capture")
+    k, support, seed = 4, (3, 3), 0
+    d = _synth_bank(k, support, seed)
+    geom = ProblemGeom(support, k)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_psnr=True, track_objective=True,
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    r = np.random.default_rng(0)
+
+    print("demo 1/3: 3-replica fleet, kill+hang faults, capture on")
+    with _fault(
+        CCSC_FAULT_ENGINE_KILL_REQ=2,
+        CCSC_FAULT_ENGINE_KILL_REPLICA="0",
+        CCSC_FAULT_ENGINE_HANG_REQ=2,
+        CCSC_FAULT_ENGINE_HANG_REPLICA="1",
+        CCSC_FAULT_ENGINE_HANG_S="3.0",
+        CCSC_WATCHDOG_MIN_S="0.5",
+    ):
+        fleet = ServeFleet(
+            d, ReconstructionProblem(geom), cfg, scfg,
+            FleetConfig(
+                replicas=3,
+                metrics_dir=os.path.join(root, "serve-metrics"),
+                capture_dir=cap_dir,
+                min_queue_depth=64,
+                restart_backoff_s=0.05,
+                verbose="none",
+            ),
+        )
+        futs = []
+        for i in range(args.requests):
+            x = r.random((12, 12)).astype(np.float32)
+            m = (r.random((12, 12)) < 0.5).astype(np.float32)
+            futs.append(
+                fleet.submit(x * m, mask=m, x_orig=x, key=f"d{i}")
+            )
+        n_served = sum(1 for f in futs if f.result(timeout=300))
+        fleet.close()
+    print(f"  served {n_served}/{args.requests} under faults")
+
+    rc = 0
+    for label, speed in (("1x", 1.0), ("max-speed", 0.0)):
+        print(f"demo {2 if speed else 3}/3: replay at {label}")
+        fresh = ServeFleet(
+            d, ReconstructionProblem(geom), cfg, scfg,
+            FleetConfig(
+                replicas=3,
+                metrics_dir=os.path.join(root, f"replay-{label}"),
+                capture_dir="",  # replay fleets never re-capture
+                min_queue_depth=64,
+                restart_backoff_s=0.05,
+                verbose="none",
+            ),
+        )
+        try:
+            rep = ReplayDriver(
+                cap_dir,
+                metrics_dir=os.path.join(root, f"replay-{label}"),
+            ).replay(fresh, speed=speed, mode="open")
+        finally:
+            fresh.close()
+        _print_report(rep)
+        if not rep["ok"] or rep["n_exact"] != rep["n_replayed"]:
+            rc = 1
+    print(
+        ("demo PASSED" if rc == 0 else "demo FAILED")
+        + f" — artifacts under {root} (obs_report the replay-* dirs "
+        "for the REPLAY section)"
+    )
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "capture_dir", nargs="?", default=None,
+        help="capture directory to replay (serve.capture layout)",
+    )
+    ap.add_argument(
+        "--filters", default=None,
+        help=".mat/.npz bank the capture was served with (default: "
+        "the deterministic synthetic bank from the capture's "
+        "recorded geometry + --bank-seed)",
+    )
+    ap.add_argument("--bank-seed", type=int, default=0)
+    ap.add_argument("--k", type=int, default=4,
+                    help="synthetic-bank filters when meta lacks geom")
+    ap.add_argument("--support", type=int, default=3)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument(
+        "--speed", type=float, default=None,
+        help="arrival-clock speed factor (default CCSC_REPLAY_SPEED; "
+        "0 = max-speed)",
+    )
+    ap.add_argument(
+        "--max-speed", action="store_true",
+        help="saturation mode: submit back-to-back, honoring "
+        "admission backpressure",
+    )
+    ap.add_argument("--mode", choices=("open", "closed"),
+                    default="open")
+    ap.add_argument("--psnr-tol", type=float, default=None)
+    ap.add_argument(
+        "--metrics-dir", default=None,
+        help="obs stream dir of the replay session (REPLAY section "
+        "of scripts/obs_report.py)",
+    )
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument(
+        "--generate-diurnal", default=None, metavar="OUT_DIR",
+        help="write a deterministic synthetic diurnal-curve capture "
+        "and exit",
+    )
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument("--side", type=int, default=12)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--demo", action="store_true",
+        help="run the self-contained capture-under-faults -> "
+        "replay-verify acceptance story",
+    )
+    ap.add_argument("--demo-dir", default=None)
+    args = ap.parse_args(argv)
+
+    if args.generate_diurnal:
+        return _run_generate(args)
+    if args.demo:
+        return _run_demo(args)
+    if not args.capture_dir:
+        ap.error(
+            "a CAPTURE_DIR (or --generate-diurnal / --demo) is "
+            "required"
+        )
+    return _run_replay(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

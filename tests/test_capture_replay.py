@@ -1,0 +1,442 @@
+"""Workload capture + deterministic traffic replay (serve.capture /
+serve.replay / scripts/replay.py):
+
+- recorder round-trip: request/outcome pairing, payload dedup across
+  requests, segment rotation, deterministic sampling;
+- the acceptance contract: a fleet served WITH capture on, replayed
+  at max speed against a fresh fleet — zero lost requests, every
+  same-bucket result bit-identical to its recorded outcome, the
+  replay session appended to the perf ledger as kind=replay and
+  judged by the perf gate (exit 0 on parity, 1 on an injected
+  slowdown);
+- the synthetic diurnal generator is byte-deterministic;
+- obs_report renders the REPLAY section and the --follow tail sees a
+  growing stream incrementally;
+- the metricsd snapshot freshness stamp (timestamp + run id + data
+  age).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import (
+    FleetConfig,
+    ProblemGeom,
+    ServeConfig,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.serve import capture as cap
+from ccsc_code_iccv2017_tpu.serve.replay import (
+    ReplayDriver,
+    generate_diurnal,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bank(k=4, sup=3, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, sup, sup)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return d
+
+
+def _fleet(tmp, cap_dir=None, replicas=2, metrics_sub="metrics"):
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_psnr=True, track_objective=True,
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    return ServeFleet(
+        _bank(), ReconstructionProblem(geom), cfg, scfg,
+        FleetConfig(
+            replicas=replicas,
+            metrics_dir=os.path.join(tmp, metrics_sub),
+            capture_dir=cap_dir,
+            min_queue_depth=64,
+            restart_backoff_s=0.05,
+            verbose="none",
+        ),
+    )
+
+
+# ------------------------------------------------------------------
+# recorder primitives
+# ------------------------------------------------------------------
+
+def test_recorder_roundtrip_dedup_and_pairing(tmp_path):
+    d = str(tmp_path / "capture")
+    rec = cap.WorkloadRecorder(d, meta={"source": "unit"})
+    x = np.arange(16, dtype=np.float32).reshape(4, 4)
+    m = np.ones_like(x)
+    rec.record_submit("a", "tr-a", x, mask=m, bucket="2@4x4")
+    rec.record_submit("b", "tr-b", x, mask=m, bucket="2@4x4")
+    rec.record_outcome("a", x * 2, 31.5, 12.0, "2@4x4", iters=3)
+    rec.close(n_rejected=7)
+    w = cap.read_workload(d)
+    assert [r["key"] for r in w] == ["a", "b"]
+    assert w[0]["t_rel"] <= w[1]["t_rel"]
+    # identical payloads across requests stored once
+    assert w[0]["b"] == w[1]["b"]
+    assert rec.n_payloads == 2  # x and m
+    assert rec.n_dedup_hits == 2  # b's copies of both
+    # outcome pairing: digest matches an independent hash of the
+    # delivered bytes; b never delivered
+    assert w[0]["outcome"]["digest"] == cap.payload_sha(x * 2)
+    assert w[0]["outcome"]["iters"] == 3
+    assert w[1]["outcome"] is None
+    # payload bytes round-trip exactly
+    assert np.array_equal(cap.load_payload(d, w[0]["b"]), x)
+    meta = cap.read_meta(d)
+    assert meta["status"] == "closed"
+    assert meta["n_rejected"] == 7
+    assert meta["n_requests"] == 2
+
+
+def test_recorder_rotation_and_reader_merge(tmp_path):
+    d = str(tmp_path / "capture")
+    # ~1e-4 MB = 100 bytes: every record rotates
+    rec = cap.WorkloadRecorder(d, rotate_mb=1e-4)
+    x = np.zeros((2, 2), np.float32)
+    for i in range(5):
+        rec.record_submit(f"k{i}", None, x + i)
+    rec.close()
+    segs = [
+        n for n in os.listdir(d)
+        if n.startswith("requests-") and n.endswith(".jsonl")
+    ]
+    assert len(segs) >= 2  # rotation actually happened
+    w = cap.read_workload(d)
+    assert [r["key"] for r in w] == [f"k{i}" for i in range(5)]
+
+
+def test_capture_sampling_is_deterministic_per_key(tmp_path):
+    d1 = str(tmp_path / "c1")
+    d2 = str(tmp_path / "c2")
+    x = np.zeros((2, 2), np.float32)
+    kept = []
+    for d_ in (d1, d2):
+        rec = cap.WorkloadRecorder(d_, sample=0.5)
+        for i in range(40):
+            rec.record_submit(f"k{i}", None, x)
+            # outcomes follow their request's verdict even when
+            # recorded "before" (deterministic verdict, no shared set)
+            rec.record_outcome(f"k{i}", x, None, 1.0, "b")
+        rec.close()
+        w = cap.read_workload(d_)
+        kept.append(sorted(r["key"] for r in w))
+        assert all(r["outcome"] is not None for r in w)
+        assert 0 < len(w) < 40  # the sampler actually sampled
+    assert kept[0] == kept[1]  # same keys, both passes
+
+
+def test_diurnal_generator_is_deterministic(tmp_path):
+    d1 = generate_diurnal(
+        str(tmp_path / "g1"), n_requests=12, duration_s=30.0,
+        spatial=(8, 8), seed=3,
+    )
+    d2 = generate_diurnal(
+        str(tmp_path / "g2"), n_requests=12, duration_s=30.0,
+        spatial=(8, 8), seed=3,
+    )
+    w1, w2 = cap.read_workload(d1), cap.read_workload(d2)
+    assert len(w1) == 12
+    assert [r["t_rel"] for r in w1] == [r["t_rel"] for r in w2]
+    assert [r["b"] for r in w1] == [r["b"] for r in w2]  # same bytes
+    # arrivals follow the curve: monotone, denser mid-stream (peak)
+    ts = [r["t_rel"] for r in w1]
+    assert ts == sorted(ts)
+    gaps = np.diff(ts)
+    assert gaps[len(gaps) // 2] < gaps[0]  # peak gap < trough gap
+    assert cap.read_meta(d1)["synthetic"] == "diurnal"
+
+
+# ------------------------------------------------------------------
+# fleet capture -> replay: the acceptance contract
+# ------------------------------------------------------------------
+
+def test_fleet_capture_replay_bit_parity_and_ledger(
+    tmp_path, monkeypatch
+):
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("CCSC_PERF_LEDGER", ledger_path)
+    cap_dir = str(tmp_path / "capture")
+    fleet = _fleet(str(tmp_path), cap_dir=cap_dir)
+    r = np.random.default_rng(0)
+    futs = []
+    for i in range(6):
+        x = r.random((12, 12)).astype(np.float32)
+        m = (r.random((12, 12)) < 0.5).astype(np.float32)
+        futs.append(fleet.submit(x * m, mask=m, x_orig=x, key=f"q{i}"))
+    for f in futs:
+        f.result(timeout=180)
+    fleet.close()
+    w = cap.read_workload(cap_dir)
+    assert len(w) == 6 and all(r_["outcome"] for r_ in w)
+
+    replay_metrics = str(tmp_path / "replay-metrics")
+    fresh = _fleet(str(tmp_path), metrics_sub="replay-fleet")
+    try:
+        rep = ReplayDriver(cap_dir, metrics_dir=replay_metrics).replay(
+            fresh, speed=0.0, mode="open"
+        )
+    finally:
+        fresh.close()
+    assert rep["n_replayed"] == 6
+    assert rep["n_lost"] == 0
+    assert rep["n_mismatched"] == 0
+    assert rep["n_exact"] == 6  # bit-identical, every one
+    assert rep["ok"]
+
+    # the session entered the durable ledger as kind=replay and the
+    # gate judges it (young history -> skip/pass, exit 0)
+    from ccsc_code_iccv2017_tpu.analysis import ledger as ledger_mod
+
+    led = ledger_mod.Ledger(ledger_path)
+    reps = [r_ for r_ in led.read() if r_["kind"] == "replay"]
+    assert len(reps) == 1 and reps[0]["unit"] == "requests/sec"
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import perf_gate
+
+    assert perf_gate.main(["--ledger", ledger_path]) == 0
+    # an injected slowdown on accrued history fails the gate (exit 1)
+    base = reps[0]
+    for v in (base["value"] * 1.01, base["value"] * 0.99,
+              base["value"] * 1.02):
+        led.append(dict(base, value=v, knob_digest=base["knob_digest"]))
+    led.append(dict(base, value=base["value"] * 0.1,
+                    knob_digest=base["knob_digest"]))
+    assert perf_gate.main(
+        ["--ledger", ledger_path, "--kind", "replay"]
+    ) == 1
+
+    # the replay stream renders in obs_report's REPLAY section
+    import obs_report
+
+    from ccsc_code_iccv2017_tpu.utils import obs as obs_mod
+
+    events = obs_mod.read_events(replay_metrics)
+    text = obs_report.render(events)
+    assert "REPLAY" in text
+    assert "6 bit-exact" in text
+    assert "0 LOST" in text
+
+    # and the serving-side stream carries the capture accounting
+    serve_events = obs_mod.read_events(
+        os.path.join(str(tmp_path), "metrics"), recursive=True
+    )
+    summaries = [
+        e for e in serve_events if e["type"] == "capture_summary"
+    ]
+    assert len(summaries) == 1
+    assert summaries[0]["n_requests"] == 6
+    assert summaries[0]["overhead_s"] >= 0.0
+    assert any(
+        e["type"] == "capture_start" for e in serve_events
+    )
+
+
+def test_closed_loop_replay_and_psnr_fallback(tmp_path):
+    """Closed-loop mode replays sequentially; a replay fleet with a
+    DIFFERENT bucket table falls back to PSNR-tolerance verification
+    instead of bit-identity."""
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+
+    cap_dir = str(tmp_path / "capture")
+    fleet = _fleet(str(tmp_path), cap_dir=cap_dir, replicas=1)
+    r = np.random.default_rng(1)
+    futs = []
+    for i in range(3):
+        x = r.random((12, 12)).astype(np.float32)
+        m = (r.random((12, 12)) < 0.5).astype(np.float32)
+        futs.append(fleet.submit(x * m, mask=m, x_orig=x))
+    for f in futs:
+        f.result(timeout=180)
+    fleet.close()
+
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_psnr=True, track_objective=True,
+    )
+    bigger = ServeFleet(
+        _bank(), ReconstructionProblem(geom), cfg,
+        ServeConfig(
+            buckets=((2, (14, 14)),), max_wait_ms=2.0, verbose="none"
+        ),
+        FleetConfig(
+            replicas=1, min_queue_depth=64, verbose="none",
+        ),
+    )
+    try:
+        rep = ReplayDriver(cap_dir, psnr_tol=1.0).replay(
+            bigger, speed=0.0, mode="closed"
+        )
+    finally:
+        bigger.close()
+    assert rep["n_lost"] == 0
+    assert rep["n_exact"] == 0  # different bucket: no bit contract
+    assert rep["n_psnr"] + rep["n_unverified"] + rep["n_mismatched"] == 3
+    # padding-excluded valid-region solves stay within 1 dB here
+    assert rep["n_psnr"] == 3
+
+
+def test_standalone_engine_capture(tmp_path):
+    """A bare CodecEngine (no fleet) captures its own workload when
+    ServeConfig.capture_dir is set — and a replica-flagged engine
+    never does."""
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import CodecEngine
+
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_objective=True,
+    )
+    cap_dir = str(tmp_path / "cap")
+    eng = CodecEngine(
+        _bank(), ReconstructionProblem(geom), cfg,
+        ServeConfig(
+            buckets=((2, (12, 12)),), max_wait_ms=1.0,
+            verbose="none", capture_dir=cap_dir,
+        ),
+    )
+    r = np.random.default_rng(0)
+    x = r.random((12, 12)).astype(np.float32)
+    res = eng.reconstruct(x, timeout=120)
+    eng.close()
+    w = cap.read_workload(cap_dir)
+    assert len(w) == 1
+    assert w[0]["outcome"]["digest"] == cap.payload_sha(
+        np.asarray(res.recon)
+    )
+    # replica engines are capture-inert even with the env knob set
+    # (the fleet records once at admission)
+    assert cap.read_meta(cap_dir)["source"] == "serve_engine"
+
+
+# ------------------------------------------------------------------
+# satellites: follow mode, snapshot stamp
+# ------------------------------------------------------------------
+
+def test_obs_report_follow_tails_incrementally(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import obs_report
+
+    from ccsc_code_iccv2017_tpu.utils import obs as obs_mod
+
+    d = str(tmp_path / "m")
+    run = obs_mod.start_run(d, algorithm="unit", verbose="none")
+    run.step(it=1, obj_z=1.0)
+    chunks = []
+    events = obs_report.follow(
+        d, interval_s=0.01, max_polls=1, out=chunks.append
+    )
+    assert len(events) >= 2  # run_meta + step
+    assert any("follow: +" in c for c in chunks)
+    # more records appended -> a second follow from a FRESH tail sees
+    # everything; the incremental contract itself (offsets, torn
+    # lines, rotation) is covered by the EventTail tests
+    run.step(it=2, obj_z=2.0)
+    run.close()
+    events2 = obs_report.follow(
+        d, interval_s=0.01, max_polls=1, out=chunks.append
+    )
+    assert len(events2) > len(events)
+
+
+def test_metricsd_snapshot_stamp_and_age(tmp_path):
+    from ccsc_code_iccv2017_tpu.serve.metricsd import (
+        MetricsD,
+        parse_snapshot_stamp,
+    )
+
+    state = {"n": 1}
+    source = lambda: {
+        "counters": {"requests_total": state["n"]},
+        "gauges": {},
+        "histograms": [],
+    }
+    snap = str(tmp_path / "metrics.prom")
+    md = MetricsD(
+        source, port=None, snapshot_path=snap, run_id="fleet-test-1"
+    )
+    md.write_snapshot()
+    stamp = parse_snapshot_stamp(snap)
+    assert stamp is not None
+    assert stamp["run_id"] == "fleet-test-1"
+    assert abs(stamp["timestamp"] - time.time()) < 5.0
+    assert stamp["age_s"] == 0.0  # body just changed
+    # source stops changing -> data age grows across rewrites
+    time.sleep(0.05)
+    md.write_snapshot()
+    stamp2 = parse_snapshot_stamp(snap)
+    assert stamp2["age_s"] > 0.0
+    # source changes again -> age resets
+    state["n"] = 2
+    md.write_snapshot()
+    assert parse_snapshot_stamp(snap)["age_s"] == 0.0
+
+
+def test_obs_report_flags_stale_snapshot(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import obs_report
+
+    text = obs_report.render(
+        [{"t": time.time(), "type": "run_meta", "host": 0,
+          "algorithm": "unit"}],
+        stale_after=60.0,
+        snapshot={
+            "timestamp": time.time() - 3600.0,
+            "age_s": 12.0,
+            "run_id": "fleet-dead-1",
+            "age_wall_s": 3600.0,
+        },
+    )
+    assert "SNAPSHOT" in text
+    assert "STALE" in text
+    assert "fleet-dead-1" in text
+
+
+def test_ci_script_contract():
+    """scripts/ci.sh documents and wires the 10/20/30 exit-code
+    contract (static check — running the full chain re-runs the
+    whole tier-1 suite)."""
+    path = os.path.join(REPO, "scripts", "ci.sh")
+    assert os.access(path, os.X_OK)
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    assert "exit 10" in text and "lint.py" in text
+    assert "exit 20" in text and "pytest" in text
+    assert "exit 30" in text and "perf_gate.py" in text
+    # the tolerated-failure baseline the stage-2 comparison reads
+    # (documented environment-dependent failures only)
+    known = os.path.join(REPO, "scripts", "ci_known_failures.txt")
+    assert os.path.exists(known)
+    with open(known, encoding="utf-8") as f:
+        ids = [ln.strip() for ln in f if ln.strip()]
+    assert all("::" in i for i in ids)
+    # the lint stage actually runs standalone (cheap, no jax)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr

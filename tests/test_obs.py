@@ -101,6 +101,83 @@ def test_crash_truncation_drops_partial_line(tmp_path):
     assert len([e for e in events if e["type"] == "run_meta"]) == 2
 
 
+def test_event_tail_rotation_mid_tail(tmp_path):
+    """EventTail under capture-style rotation: the writer rolls over
+    to a NEW segment file mid-tail — the tail must pick the fresh
+    file up on its next poll, consume only whole lines from both, and
+    never re-read or skip records."""
+    d = str(tmp_path / "stream")
+    os.makedirs(d)
+
+    def seg(i):
+        return os.path.join(d, f"events-{i:04d}.jsonl")
+
+    def w(path, recs, torn=None):
+        with open(path, "a") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+            if torn is not None:
+                f.write(torn)  # no newline: the crash window
+
+    tail = obs.EventTail(d)
+    w(seg(0), [{"t": 1.0, "type": "step", "it": 1}])
+    assert [r["it"] for r in tail.poll()] == [1]
+    # segment 0 gains one whole record + a torn tail, and the writer
+    # rotates: segment 1 appears with its own records
+    w(seg(0), [{"t": 2.0, "type": "step", "it": 2}],
+      torn='{"t": 2.5, "type": "step", "i')
+    w(seg(1), [{"t": 3.0, "type": "step", "it": 3}])
+    got = tail.poll()
+    assert [r["it"] for r in got] == [2, 3]  # torn line NOT consumed
+    # the torn line is completed later (resumed writer terminates it)
+    # and both files keep growing — the tail resumes cleanly from its
+    # per-file offsets
+    with open(seg(0), "a") as f:
+        f.write("\n")
+    w(seg(0), [{"t": 4.0, "type": "step", "it": 4}])
+    w(seg(1), [{"t": 5.0, "type": "step", "it": 5}])
+    got = tail.poll()
+    # the completed line parses as garbage-free records only: the
+    # torn fragment became a whole (but truncated-JSON) line and is
+    # dropped, never welded onto later records
+    assert [r["it"] for r in got if "it" in r] == [4, 5]
+    assert tail.poll() == []  # idempotent at rest
+
+
+def test_payload_index_torn_tail(tmp_path):
+    """serve.capture's payload index under the same crash window: a
+    torn final line is dropped by the reader, and a recorder
+    re-opened on the directory repairs the tail before appending (no
+    welded records)."""
+    from ccsc_code_iccv2017_tpu.serve import capture as cap
+
+    d = str(tmp_path / "capture")
+    rec = cap.WorkloadRecorder(d)
+    a = np.arange(9, dtype=np.float32).reshape(3, 3)
+    rec.record_submit("k0", None, a)
+    rec.close()
+    idx_path = os.path.join(d, "payloads.jsonl")
+    with open(idx_path, "a") as f:
+        f.write('{"sha": "deadbeef", "shape": [3')  # torn
+    idx = cap.read_payload_index(d)
+    assert len(idx) == 1 and "deadbeef" not in idx
+    # a re-opened recorder terminates the torn tail; its new index
+    # entry parses whole and the old one survives
+    rec2 = cap.WorkloadRecorder(d)
+    rec2.record_submit("k1", None, a * 2.0)
+    rec2.close()
+    idx = cap.read_payload_index(d)
+    assert len(idx) == 2
+    shas = set(idx)
+    for sha in shas:
+        assert np.asarray(cap.load_payload(d, sha)).shape == (3, 3)
+    # segments survived the reopen too: both requests read back
+    # (t_rel is per-recorder-epoch, so cross-reopen order is not
+    # asserted)
+    w = cap.read_workload(d)
+    assert sorted(r["key"] for r in w) == ["k0", "k1"]
+
+
 def test_null_run_is_inert(tmp_path, capsys):
     run = obs.start_run(None, algorithm="unit", verbose="brief")
     try:
