@@ -98,6 +98,21 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     "replay_request": _s("key", "status", "latency_ms"),
     "replay_summary": _s("mode", "speed", "n_recorded", "n_replayed",
                          "n_lost", "n_mismatched"),
+    # -- cross-host federation (serve.dqueue, serve.federation).
+    # dqueue_* are queue-protocol events (submit/claim/complete/
+    # requeue/fail/suppress — the ``host`` field is the federated
+    # host id, not the process index); fed_* are host-pool lifecycle
+    # events the FEDERATION report section and per-host liveness
+    # read --------------------------------------------------------
+    "dqueue_submit": _s("key"),
+    "dqueue_claim": _s("key", "host", "attempt"),
+    "dqueue_complete": _s("key", "host", "digest"),
+    "dqueue_requeue": _s("key", "from_host", "reason"),
+    "dqueue_failed": _s("key", "attempts"),
+    "dqueue_suppressed": _s("key", "host", "reason"),
+    "fed_join": _s("host", "epoch"),
+    "fed_leave": _s("host", "served"),
+    "fed_heartbeat": _s("host", "epoch", "served"),
     # -- autotuning (tune.autotune) ----------------------------------
     "tune_pick": _s("kind", "chip", "shape_key"),
     "tune_guard": _s("kind", "chip"),

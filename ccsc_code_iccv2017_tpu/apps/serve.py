@@ -16,13 +16,25 @@ end.
 fleet instead (serve.ServeFleet): N engine replicas behind one front
 queue, health-driven requeue of a crashed/stalled replica's requests,
 and admission control — an Overloaded refusal here backs off for the
-fleet's retry-after hint and resubmits.
+fleet's (jittered) retry-after hint with exponential escalation on
+consecutive refusals and resubmits.
+
+--federate DIR joins the cross-host pool instead (serve.federation):
+this process runs its fleet as a drain worker against the shared
+file-lease queue at DIR — no local data source; requests arrive from
+any FederatedFrontend, results land durably in the queue, and a
+SIGKILL of this whole process loses nothing (survivor hosts reap the
+expired leases). The process exits once the queue is sealed and
+drained; under scripts/supervise.py --federate it is restarted until
+then, re-joining under a fresh lease epoch.
 
 Usage:
     python -m ccsc_code_iccv2017_tpu.apps.serve --filters f.mat \
         --data DIR [--bucket 64 --bucket 128:8] [--compile-cache DIR]
     ls imgs/*.png | python -m ccsc_code_iccv2017_tpu.apps.serve \
         --filters f.mat --stdin
+    python -m ccsc_code_iccv2017_tpu.apps.serve --filters f.mat \
+        --federate /shared/queue --replicas 2
 """
 from __future__ import annotations
 
@@ -39,11 +51,27 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--filters", required=True, help=".mat/.npz filter bank")
-    src = p.add_mutually_exclusive_group(required=True)
+    src = p.add_mutually_exclusive_group()
     src.add_argument("--data", help="serve every image in this folder")
     src.add_argument(
         "--stdin", action="store_true",
         help="serve image paths streamed one per line on stdin",
+    )
+    src.add_argument(
+        "--federate", nargs="?", const="", default=None,
+        metavar="DIR",
+        help="join the cross-host serving pool at this shared "
+        "file-lease queue directory (serve.federation) instead of "
+        "serving a local data source: this process drains the queue "
+        "through its fleet until the queue is sealed and empty. "
+        "With no DIR, the CCSC_DQUEUE_DIR env knob names the queue "
+        "(scripts/supervise.py --federate exports it)",
+    )
+    p.add_argument(
+        "--host-id", default=None,
+        help="federated host identity (default hostname-pid); a "
+        "restarted host with the same id fences its previous "
+        "incarnation's leases by epoch",
     )
     p.add_argument(
         "--bucket", action="append", default=None, metavar="SIDE[:SLOTS]",
@@ -150,6 +178,21 @@ def main(argv=None):
     from ..serve import CodecEngine, Overloaded, ServeFleet
     from ..utils.io_mat import load_filters_2d
 
+    from ..utils import env as _env
+
+    federate_dir = args.federate
+    if federate_dir == "":
+        federate_dir = _env.env_str("CCSC_DQUEUE_DIR")
+        if not federate_dir:
+            raise SystemExit(
+                "--federate with no DIR needs CCSC_DQUEUE_DIR set "
+                "(scripts/supervise.py --federate exports it)"
+            )
+    if federate_dir is None and not (args.data or args.stdin):
+        raise SystemExit(
+            "one of --data, --stdin or --federate is required"
+        )
+
     d = load_filters_2d(args.filters)
     geom = ProblemGeom(d.shape[1:], d.shape[0])
     from ..utils import validate
@@ -186,6 +229,46 @@ def main(argv=None):
     )
     if args.replicas < 1:
         raise SystemExit("--replicas must be >= 1")
+    if federate_dir is not None:
+        # federated host mode: no local data source — requests come
+        # from the shared queue, results go back into it durably
+        from ..serve.federation import FederatedHost
+
+        host = FederatedHost(
+            federate_dir,
+            d,
+            ReconstructionProblem(geom),
+            cfg,
+            scfg,
+            FleetConfig(
+                replicas=args.replicas,
+                max_queue_depth=args.max_queue_depth,
+                metrics_dir=None,  # nested under the host's dir
+                slo_p50_ms=args.slo_p50_ms,
+                slo_p99_ms=args.slo_p99_ms,
+                metricsd_port=args.metricsd_port,
+                metricsd_snapshot=args.metricsd_snapshot,
+                capture_dir=args.capture_dir,
+            ),
+            host=args.host_id,
+            metrics_dir=args.metrics_dir,
+        )
+        print(
+            f"federated host {host.host} (epoch {host.epoch}) "
+            f"joined {federate_dir} — draining until sealed"
+        )
+        try:
+            while not host.serve_until_sealed(timeout=5.0):
+                pass
+        except KeyboardInterrupt:
+            print("interrupted — leaving the pool cleanly")
+        finally:
+            host.close()
+        print(
+            f"host {host.host} served {host.served} request(s), "
+            f"left the pool"
+        )
+        return host.served
     fleet_mode = args.replicas > 1 or args.max_queue_depth is not None
     metricsd = None  # standalone-engine endpoint (the fleet owns its own)
     t0 = time.perf_counter()
@@ -256,21 +339,31 @@ def main(argv=None):
         nonlocal n_skipped, n_overloaded
         mask = (rng.random(x.shape) < args.keep).astype(np.float32)
         sm = smooth_fill_batch(x[None], mask[None])[0]
+        consec = 0
         while True:
             try:
                 fut = engine.submit(
                     x * mask, mask=mask, smooth_init=sm, x_orig=x
                 )
             except Overloaded as e:
-                # explicit backpressure: the fleet told us how long to
-                # back off — honor it instead of dropping the request
-                # (this producer has nowhere else to shed load to)
+                # explicit backpressure: the fleet told us how long
+                # to back off — honor the (already jittered,
+                # CCSC_FED_RETRY_JITTER) hint instead of dropping the
+                # request, escalating exponentially on CONSECUTIVE
+                # refusals: a hint computed at the admission ceiling
+                # describes the queue as it was, and N producers
+                # re-colliding on it forever is the thundering herd
+                # the jitter + escalation exist to break up
                 n_overloaded += 1
+                consec += 1
+                delay = min(
+                    e.retry_after_s * (2 ** min(consec - 1, 5)), 60.0
+                )
                 print(
                     f"  {label}: overloaded, retrying in "
-                    f"{e.retry_after_s:.2f}s"
+                    f"{delay:.2f}s"
                 )
-                time.sleep(e.retry_after_s)
+                time.sleep(delay)
                 continue
             except validate.CCSCInputError as e:
                 # one bad request (oversize for every bucket, NaN

@@ -16,14 +16,24 @@ request durably — payloads content-addressed by sha256, outcomes
 digested — and :class:`ReplayDriver` (serve.replay) re-serves a
 captured stream against a fresh fleet with bit-identity
 verification: the recorded workload is the fleet's measuring
-instrument.
+instrument. :class:`DurableQueue` (serve.dqueue) and
+:class:`FederatedHost` / :class:`FederatedFrontend`
+(serve.federation) take the same contracts cross-host: fleets in
+separate processes drain one shared file-lease queue, and a
+whole-host SIGKILL is just an expired lease the survivors reap.
 """
 from .capture import WorkloadRecorder  # noqa: F401
+from .dqueue import DurableQueue  # noqa: F401
 from .engine import (  # noqa: F401
     CodecEngine,
     ServedResult,
     enable_compile_cache,
     pick_bucket,
+)
+from .federation import (  # noqa: F401
+    FederatedFrontend,
+    FederatedHost,
+    FederatedResult,
 )
 from .fleet import Overloaded, ServeFleet  # noqa: F401
 from .metricsd import MetricsD  # noqa: F401

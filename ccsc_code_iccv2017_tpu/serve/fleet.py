@@ -70,6 +70,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import threading
 import time
 from collections import OrderedDict, deque
@@ -79,6 +80,7 @@ from typing import Deque, Dict, List, Optional
 import numpy as np
 
 from ..config import FleetConfig, ServeConfig, SolveConfig
+from ..utils import env as _env
 from ..utils import trace as trace_util
 from . import capture as _capture
 from . import slo as _slo
@@ -1365,6 +1367,13 @@ class ServeFleet:
         return self._close_started
 
     @property
+    def capacity_hint(self) -> int:
+        """Total concurrent request slots across replicas — the
+        natural claim-batch bound for a drain worker feeding this
+        fleet from an external queue (serve.federation)."""
+        return self._total_slots * self.fleet_cfg.replicas
+
+    @property
     def queue_ceiling(self) -> int:
         """The current admission ceiling (explicit or
         serving_bound-derived)."""
@@ -1502,6 +1511,15 @@ class ServeFleet:
                 self._cv.notify_all()
         if reject is not None:
             depth, ceiling, rung, retry = reject
+            # jitter the retry hint (CCSC_FED_RETRY_JITTER): N
+            # federated frontends refused on the same tick would
+            # otherwise all resubmit on the same tick too, arriving
+            # as the very thundering herd the ceiling just rejected.
+            # Applied outside the lock — the hint is advice, not
+            # shared state.
+            jitter = _env.env_float("CCSC_FED_RETRY_JITTER") or 0.0
+            if jitter > 0:
+                retry *= 1.0 + random.random() * jitter
             self._emit(
                 "fleet_admission_reject", replica_id=None,
                 queue_depth=depth, ceiling=ceiling, rung=rung,

@@ -121,6 +121,38 @@ REGISTRY: Dict[str, Knob] = _knobs(
     ("CCSC_REPLAY_SPEED", "float", 1.0, "scripts/replay.py",
      "default replay speed factor over the recorded arrival clock "
      "(2.0 = twice as fast; 0 = max-speed saturation)"),
+    # -- cross-host federation (serve.dqueue, serve.federation) ------
+    ("CCSC_DQUEUE_DIR", "path", None,
+     "serve.dqueue, serve.federation, apps/serve.py, "
+     "scripts/supervise.py",
+     "shared federated work-queue directory (a shared filesystem "
+     "path): hosts drain it, frontends submit into it; fallback of "
+     "apps/serve.py --federate and exported to children by "
+     "scripts/supervise.py --federate"),
+    ("CCSC_DQUEUE_TTL_S", "float", 30.0, "serve.dqueue",
+     "lease TTL in seconds: a claimed item whose owning host's "
+     "heartbeat is older than this (+ the skew allowance) is "
+     "requeued by the reaper — the whole-host-death recovery path"),
+    ("CCSC_DQUEUE_SKEW_S", "float", 5.0, "serve.dqueue",
+     "clock-skew allowance added to every lease-expiry judgment "
+     "(hosts share a filesystem, not a clock — a fast local clock "
+     "must never reap a healthy host's lease)"),
+    ("CCSC_DQUEUE_ATTEMPTS", "int", 3, "serve.dqueue",
+     "cross-host ownership budget per queue item before the reaper "
+     "writes an explicit error result (exactly-once-or-error, the "
+     "fleet's max_attempts contract made cross-host)"),
+    ("CCSC_FED_HEARTBEAT_S", "float", 1.0, "serve.federation",
+     "federated host heartbeat + reaper cadence in seconds (must be "
+     "well under CCSC_DQUEUE_TTL_S or a healthy host loses its own "
+     "leases)"),
+    ("CCSC_FED_POLL_S", "float", 0.05, "serve.federation",
+     "claim/result poll cadence of federated hosts and frontends "
+     "when the queue is idle"),
+    ("CCSC_FED_RETRY_JITTER", "float", 0.25,
+     "serve.fleet, apps/serve.py",
+     "random jitter fraction applied to Overloaded.retry_after_s so "
+     "N federated frontends refused on the same tick don't "
+     "thundering-herd the queue on the same tick (0 disables)"),
     # -- serving SLOs / live metrics (serve.slo, serve.metricsd) -----
     ("CCSC_SLO_P50_MS", "float", None, "serve.slo",
      "declared p50 submit->result latency target in ms (fallback of "

@@ -35,6 +35,15 @@ restores them):
                       outcome — faults must not leak into the served
                       bytes, and the capture must be a faithful
                       oracle
+  host_kill           (script mode only) whole-host chaos: 2 federated
+                      fleet PROCESSES drain a shared file-lease queue
+                      (serve.dqueue / serve.federation); one is
+                      SIGKILLed mid-stream while holding leases. The
+                      survivor's reaper requeues the dead host's
+                      leases, the stream finishes with zero lost
+                      requests, and every delivered result is
+                      bit-identical to the capture oracle's recorded
+                      outcome digests (serve.capture)
   sigterm_subprocess  (script mode only) the same against a real child
                       process: exit code 0 + valid checkpoint
   supervise_restart   (script mode only) scripts/supervise.py restarts
@@ -404,6 +413,184 @@ def scenario_replay_parity():
     )
 
 
+def _host_kill_child_code(qdir, bank_path, mdir, host_id):
+    """Source of one federated host process (shared by the chaos
+    scenario and tests/test_federation.py): join the pool at qdir,
+    drain until sealed, leave cleanly."""
+    return f"""
+import numpy as np
+from ccsc_code_iccv2017_tpu.config import (
+    FleetConfig, ProblemGeom, ServeConfig, SolveConfig)
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem)
+from ccsc_code_iccv2017_tpu.serve.federation import FederatedHost
+d = np.load({bank_path!r})
+geom = ProblemGeom((3, 3), 4)
+cfg = SolveConfig(lambda_residual=5.0, lambda_prior=0.3, max_it=3,
+                  tol=0.0, verbose="none", track_psnr=True,
+                  track_objective=True)
+scfg = ServeConfig(buckets=((2, (12, 12)),), max_wait_ms=2.0,
+                   verbose="none")
+host = FederatedHost(
+    {qdir!r}, d, ReconstructionProblem(geom), cfg, scfg,
+    FleetConfig(replicas=1, min_queue_depth=64,
+                restart_backoff_s=0.05, verbose="none"),
+    host={host_id!r}, metrics_dir={mdir!r},
+    heartbeat_s=0.2, ttl_s=1.5, skew_s=0.3, verbose="none",
+)
+print("JOINED", flush=True)
+while not host.serve_until_sealed(timeout=5.0):
+    pass
+host.close()
+"""
+
+
+def scenario_host_kill():
+    import signal
+    import time
+
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import (
+        FleetConfig,
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+    from ccsc_code_iccv2017_tpu.serve import capture as cap
+    from ccsc_code_iccv2017_tpu.serve.federation import (
+        FederatedFrontend,
+    )
+
+    r = np.random.default_rng(0)
+    d = r.normal(size=(4, 3, 3)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_psnr=True, track_objective=True,
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    n_req = 8
+    with tempfile.TemporaryDirectory() as root:
+        # 1) the ORACLE: serve the stream once on a plain in-process
+        # fleet with capture armed — the recorded outcome digests are
+        # the bit-parity reference the federated serve must reproduce
+        reqs = []
+        for i in range(n_req):
+            x = r.random((12, 12)).astype(np.float32)
+            m = (r.random((12, 12)) < 0.5).astype(np.float32)
+            reqs.append((x * m, m, x))
+        cap_dir = os.path.join(root, "capture")
+        fleet = ServeFleet(
+            d, ReconstructionProblem(geom), cfg, scfg,
+            FleetConfig(
+                replicas=1, metrics_dir=os.path.join(root, "m-oracle"),
+                capture_dir=cap_dir, min_queue_depth=64,
+                verbose="none",
+            ),
+        )
+        futs = [
+            fleet.submit(b, mask=m, x_orig=x, key=f"k{i}")
+            for i, (b, m, x) in enumerate(reqs)
+        ]
+        for f in futs:
+            f.result(timeout=180)
+        fleet.close()
+        oracle = {
+            rec["key"]: rec["outcome"]["digest"]
+            for rec in cap.read_workload(cap_dir)
+            if rec.get("outcome")
+        }
+        # 2) federated serve of the SAME bytes: host0 claims, gets
+        # SIGKILLed while holding leases; host1 reaps and finishes
+        qdir = os.path.join(root, "q")
+        bank = os.path.join(root, "bank.npy")
+        np.save(bank, d)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+
+        def _spawn(i, extra_env=None):
+            e = dict(env)
+            e.update(extra_env or {})
+            return subprocess.Popen(
+                [
+                    sys.executable, "-c",
+                    _host_kill_child_code(
+                        qdir, bank,
+                        os.path.join(root, f"m-host{i}"), f"host{i}",
+                    ),
+                ],
+                env=e, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+
+        # host0 wedges (injected engine hang) on its third taken
+        # request while holding leases — the deterministic "caught
+        # mid-attempt" window the SIGKILL lands in
+        p0 = _spawn(0, {
+            "CCSC_FAULT_ENGINE_HANG_REQ": "3",
+            "CCSC_FAULT_ENGINE_HANG_S": "600",
+        })
+        fe = FederatedFrontend(
+            qdir, client="fe0",
+            metrics_dir=os.path.join(root, "m-frontend"),
+            verbose="none",
+        )
+        futs = [
+            fe.submit(b, mask=m, x_orig=x, key=f"fed{i}")
+            for i, (b, m, x) in enumerate(reqs)
+        ]
+        # wait until host0 is mid-stream: at least one delivery AND
+        # leases still held — then kill the WHOLE PROCESS
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            st = fe.queue.stats()
+            if st["results"] >= 1 and st["leased"] >= 1:
+                break
+            time.sleep(0.05)
+        os.kill(p0.pid, signal.SIGKILL)  # no handler, no cleanup
+        p0.wait()
+        p1 = _spawn(1)
+        fe.seal()
+        results = [f.result(timeout=300) for f in futs]
+        rc1 = p1.wait(timeout=300)
+        fe.close()
+        served_by = {res.host for res in results}
+        parity = all(
+            res.digest == oracle[f"k{i}"]
+            for i, res in enumerate(results)
+        )
+        from ccsc_code_iccv2017_tpu.utils import obs
+
+        events = obs.read_events(root, recursive=True)
+        requeues = [
+            e for e in events
+            if e["type"] == "dqueue_requeue"
+            and e.get("from_host") != e.get("by_host")
+        ]
+        ok = (
+            len(results) == n_req
+            and parity
+            and "host1" in served_by
+            and len(requeues) >= 1
+            and rc1 == 0
+        )
+    return ok, (
+        f"served={len(results)}/{n_req}, parity={parity}, "
+        f"hosts={sorted(served_by)}, cross_host_requeues="
+        f"{len(requeues)}, survivor_rc={rc1}"
+    )
+
+
 def scenario_supervise_restart():
     import json
 
@@ -501,6 +688,7 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
         "replay_parity": scenario_replay_parity,
     }
     if subprocess_scenarios:
+        scenarios["host_kill"] = scenario_host_kill
         scenarios["sigterm_subprocess"] = scenario_sigterm_subprocess
         scenarios["supervise_restart"] = scenario_supervise_restart
     if only is not None:

@@ -395,6 +395,73 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
         if n_served:
             lines.append(f"  delivered     {n_served} request(s)")
 
+    # -- FEDERATION: the cross-host pool over the durable file-lease
+    # queue (serve.dqueue / serve.federation). Per-host liveness uses
+    # the SAME staleness rule as HOSTS/FLEET (--stale-after): a host
+    # whose newest fed_heartbeat lags the stream's newest record by
+    # more than the threshold is flagged — a SIGKILLed host shows up
+    # here before its leases even expire.
+    fed_hbs = by.get("fed_heartbeat", [])
+    fed_joins = by.get("fed_join", [])
+    dq_subs = by.get("dqueue_submit", [])
+    if fed_hbs or fed_joins or dq_subs:
+        lines.append(_section("FEDERATION"))
+        stream_now = max((e.get("t", 0.0) for e in events), default=0.0)
+        newest = {}
+        for e in fed_hbs + fed_joins:
+            h = e.get("host")
+            if h is None:
+                continue
+            if h not in newest or e.get("t", 0.0) > newest[h].get(
+                "t", 0.0
+            ):
+                newest[h] = e
+        # newest fed_leave per host: 'left' only when no NEWER
+        # join/heartbeat follows it (a supervised host that left and
+        # was restarted into a fresh epoch is live again, not left)
+        left_t = {}
+        for e in by.get("fed_leave", []):
+            h = e.get("host")
+            if h is not None:
+                left_t[h] = max(left_t.get(h, 0.0), e.get("t", 0.0))
+        for h in sorted(newest):
+            e = newest[h]
+            behind = stream_now - e.get("t", 0.0)
+            if left_t.get(h, -1.0) >= e.get("t", 0.0):
+                state = "left"
+            elif behind > stale_after:
+                state = f"STALE ({behind:.0f}s behind)"
+            else:
+                state = "live"
+            lines.append(
+                f"  host {h}: {state}, epoch {e.get('epoch')}, "
+                f"served {e.get('served', 0)}, leased "
+                f"{e.get('leased', 0)}, last heartbeat "
+                f"{_fmt_ts(e.get('t', 0.0))}"
+            )
+        if newest:
+            lines.append(
+                f"  (stale threshold {stale_after:g}s; --stale-after)"
+            )
+        dq_req = by.get("dqueue_requeue", [])
+        n_cross = sum(
+            1 for e in dq_req
+            if e.get("from_host") != e.get("by_host")
+        )
+        lines.append(
+            f"  queue         {len(dq_subs)} submitted, "
+            f"{len(by.get('dqueue_claim', []))} claimed, "
+            f"{len(by.get('dqueue_complete', []))} completed, "
+            f"{len(by.get('dqueue_failed', []))} failed, "
+            f"{len(by.get('dqueue_suppressed', []))} suppressed"
+        )
+        if dq_req:
+            lines.append(
+                f"  requeues      {len(dq_req)} lease hand-off(s), "
+                f"{n_cross} across hosts (dead-owner leases reaped "
+                "by survivors)"
+            )
+
     sreqs = by.get("serve_request", [])
     sdisp = by.get("serve_dispatch", [])
     if sreqs or sdisp:
@@ -731,7 +798,8 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
                  "fleet_replica_dead",
                  "fleet_replica_restart", "fleet_replica_ready",
                  "fleet_replica_abandoned", "fleet_requeue",
-                 "fleet_overload"):
+                 "fleet_overload", "fed_join", "fed_leave",
+                 "dqueue_requeue", "dqueue_failed"):
         for e in by.get(kind, []):
             n_ev += 1
             detail = {

@@ -52,6 +52,14 @@ which ``child-NN`` subdirs are derived). The run completes when every
 child completes; a poison child (or an exhausted budget) stops the
 whole fleet with the matching exit code.
 
+Federated serving (``--federate DIR``): exports ``CCSC_DQUEUE_DIR`` so
+each child started with ``apps/serve.py --federate`` drains the shared
+file-lease work queue at DIR (serve.federation) — one supervised child
+per host, each joining the pool under a fresh lease epoch on every
+(re)start and leaving cleanly on completion. A child killed outright
+(even SIGKILL, which no in-process layer survives) leaves only expired
+leases; the surviving hosts' reapers requeue its work.
+
 The supervisor also exports ``CCSC_FAULT_STATE_DIR`` to the child (set
 to the metrics dir) so injected chaos faults (utils.faults) stay
 fire-once ACROSS restarts — the property tests/test_supervised.py
@@ -124,6 +132,17 @@ def build_parser() -> argparse.ArgumentParser:
         "as one independent child (repeatable; mutually exclusive "
         "with the trailing `-- CMD`). Each child gets its own "
         "restart/preemption budget and its own per-index dirs",
+    )
+    p.add_argument(
+        "--federate", default=None, metavar="DIR",
+        help="cross-host federation: export CCSC_DQUEUE_DIR=DIR to "
+        "every child so a serving child started with --federate "
+        "(apps/serve.py) joins the shared file-lease work queue at "
+        "DIR. Each supervised child is one pool host: it joins under "
+        "a fresh lease epoch on every (re)start and leaves cleanly "
+        "on completion — per-host supervisors join/leave the pool "
+        "dynamically, and a child SIGKILLed mid-solve just leaves "
+        "expired leases the surviving hosts reap",
     )
     p.add_argument(
         "--max-restarts", type=int, default=5,
@@ -348,6 +367,11 @@ class Supervisor:
         if self.metrics_dirs:
             # fault fire-once markers survive restarts (utils.faults)
             env.setdefault("CCSC_FAULT_STATE_DIR", self.metrics_dirs[0])
+        if a.federate:
+            # the shared work-queue dir rides the env so a federated
+            # serving child (apps/serve.py --federate) joins the pool
+            # without per-child flag plumbing
+            env["CCSC_DQUEUE_DIR"] = a.federate
         watched = self.metrics_dirs + self.checkpoint_dirs
         rec = {
             "attempt": n,

@@ -1,0 +1,325 @@
+"""Lease-protocol races of the durable file-lease work queue
+(serve.dqueue) — the cross-host layer everything in
+serve.federation stands on:
+
+- concurrent claim of one item has exactly one winner (the atomic
+  rename IS the lock);
+- torn/truncated request, lease, and host-record files read as
+  absent, never as errors;
+- lease expiry is clock-skew-bounded: a heartbeat within
+  ttl + skew is alive even when the clocks disagree, and only one
+  older than that is reaped;
+- reaper vs. late delivery fencing: a requeued lease's original
+  owner is suppressed at complete time (lease gone / epoch stale /
+  spent marker), the survivor's result stands, and epoch fencing
+  refuses a previous incarnation of a rejoined host;
+- the cross-host attempt budget rides the item record and
+  exhaustion writes an explicit error result (exactly-once-or-
+  error); spent keys stay spent — resubmission refused, requeued
+  copies dropped at claim.
+
+Pure filesystem tests: no engine, no backend, no fleet.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.serve.dqueue import DurableQueue, safe_key
+
+
+def _x(seed=0, shape=(6, 6)):
+    return (
+        np.random.default_rng(seed)
+        .random(shape)
+        .astype(np.float32)
+    )
+
+
+def _q(tmp_path, host, **kw):
+    ev = []
+    kw.setdefault("ttl_s", 0.5)
+    kw.setdefault("skew_s", 0.1)
+    q = DurableQueue(
+        str(tmp_path), host=host,
+        emit=lambda t, **f: ev.append(dict(f, type=t)), **kw,
+    )
+    q.events = ev
+    return q
+
+
+def test_submit_claim_complete_roundtrip(tmp_path):
+    client = _q(tmp_path, "client")
+    a = _q(tmp_path, "A")
+    a.join()
+    x = _x(1)
+    client.submit("k1", x, mask=None, x_orig=x)
+    items = a.claim(limit=4)
+    assert len(items) == 1
+    it = items[0]
+    assert it["key"] == "k1" and it["attempts"] == 1
+    assert np.array_equal(a.load_array(it["b"]), x)
+    assert a.complete(
+        it, x * 2, psnr=31.5, latency_ms=4.0, bucket="2@6x6", iters=3
+    )
+    res = client.result("k1")
+    assert res["status"] == "ok"
+    assert res["host"] == "A" and res["attempts"] == 1
+    assert np.array_equal(client.load_array(res["recon"]), x * 2)
+    # content digest pairs with the capture oracle's convention
+    from ccsc_code_iccv2017_tpu.serve.capture import payload_sha
+
+    assert res["digest"] == payload_sha(
+        np.ascontiguousarray(np.asarray(x * 2))
+    )
+    assert client.spent("k1")
+    st = client.stats()
+    assert st["queued"] == 0 and st["leased"] == 0
+
+
+def test_concurrent_claim_exactly_one_winner(tmp_path):
+    client = _q(tmp_path, "client")
+    hosts = [_q(tmp_path, f"H{i}") for i in range(4)]
+    for h in hosts:
+        h.join()
+    client.submit("solo", _x(2))
+    won = []
+    barrier = threading.Barrier(len(hosts))
+
+    def race(h):
+        barrier.wait()
+        won.extend(h.claim(limit=4))
+
+    ts = [threading.Thread(target=race, args=(h,)) for h in hosts]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(won) == 1  # the rename has one winner, no lock file
+    assert won[0]["key"] == "solo"
+
+
+def test_torn_request_and_lease_files_read_as_absent(tmp_path):
+    client = _q(tmp_path, "client")
+    a = _q(tmp_path, "A")
+    a.join()
+    # torn request file in queue/: claim skips (quarantines), never
+    # raises, and a good item behind it is still claimed
+    with open(tmp_path / "queue" / "000-torn.json", "w") as f:
+        f.write('{"key": "tor')
+    client.submit("good", _x(3))
+    items = a.claim(limit=4)
+    assert [i["key"] for i in items] == ["good"]
+    assert not os.path.exists(tmp_path / "queue" / "000-torn.json")
+    # torn lease file: stats and reap treat it as absent; after a
+    # full TTL it is quarantined, not requeued as garbage
+    lease = tmp_path / "leases" / "A" / "zzz-torn.json"
+    with open(lease, "w") as f:
+        f.write('{"key": "half')
+    assert a.reap() == []  # young torn lease: left alone
+    old = time.time() - 10.0
+    os.utime(lease, (old, old))
+    a.reap()
+    assert not lease.exists()
+    # torn host record reads as absent: expiry falls back to lease_t
+    with open(tmp_path / "hosts" / "B.json", "w") as f:
+        f.write('{"host": "B", "epo')
+    assert "B" not in a._host_table()
+
+
+def test_expiry_is_clock_skew_bounded(tmp_path):
+    client = _q(tmp_path, "client", ttl_s=1.0, skew_s=0.5)
+    a = _q(tmp_path, "A", ttl_s=1.0, skew_s=0.5)
+    b = _q(tmp_path, "B", ttl_s=1.0, skew_s=0.5)
+    a.join()
+    b.join()
+    client.submit("k", _x(4))
+    assert a.claim()
+    hb_path = a._host_path("A")
+
+    def stamp(dt):
+        rec = json.load(open(hb_path))
+        rec["t"] = time.time() + dt
+        with open(hb_path, "w") as f:
+            json.dump(rec, f)
+
+    # owner's clock running AHEAD of ours (skewed future heartbeat):
+    # trivially alive, never reaped
+    stamp(+3.0)
+    assert b.reap() == []
+    # heartbeat older than ttl but WITHIN the skew allowance: the
+    # clocks may simply disagree — not death
+    stamp(-1.2)
+    assert b.reap() == []
+    # older than ttl + skew: dead no matter whose clock is right
+    stamp(-1.8)
+    reaped = b.reap()
+    assert [r["key"] for r in reaped] == ["k"]
+    # the requeued item drains again, attempt count carried
+    it = b.claim()[0]
+    assert it["attempts"] == 2
+
+
+def test_reaper_vs_late_delivery_fencing(tmp_path):
+    client = _q(tmp_path, "client")
+    a = _q(tmp_path, "A", ttl_s=0.2, skew_s=0.05)
+    b = _q(tmp_path, "B", ttl_s=0.2, skew_s=0.05)
+    a.join()
+    b.join()
+    x = _x(5)
+    client.submit("k", x, trace_id="t1", root_span="r1")
+    it_a = a.claim()[0]
+    time.sleep(0.4)  # A's heartbeat goes stale (it is wedged)
+    b.heartbeat()
+    assert [r["key"] for r in b.reap()] == ["k"]
+    it_b = b.claim()[0]
+    assert b.complete(it_b, x * 3, latency_ms=1.0)
+    # A wakes up and tries to deliver its stale ownership: fenced —
+    # the spent marker + missing lease suppress it, B's result stands
+    assert not a.complete(it_a, x * 3)
+    res = client.result("k")
+    assert res["host"] == "B" and res["attempts"] == 2
+    sup = [e for e in a.events if e["type"] == "dqueue_suppressed"]
+    assert sup and sup[-1]["key"] == "k"
+    # the reaper wrote the dead ownership's span retrospectively, so
+    # the trace still closes across the host boundary
+    req_spans = [
+        e for e in b.events
+        if e["type"] in ("span_start", "span_end")
+        and e.get("trace_id") == "t1"
+    ]
+    assert len(req_spans) == 2  # one retrospective start+end pair
+    assert req_spans[-1]["status"] == "requeued"
+
+
+def test_epoch_fencing_refuses_previous_incarnation(tmp_path):
+    client = _q(tmp_path, "client")
+    a1 = _q(tmp_path, "A")
+    a1.join()
+    client.submit("k", _x(6))
+    it = a1.claim()[0]
+    # the same host id rejoins (a supervisor restarted the process):
+    # the NEW epoch fences the old incarnation even though the lease
+    # file still exists and the heartbeat is fresh
+    a2 = _q(tmp_path, "A")
+    assert a2.join() == a1.epoch + 1
+    assert a2.reap()  # epoch rule: old-epoch lease requeued at once
+    assert not a1.complete(it, _x(6))  # stale epoch → suppressed
+
+
+def test_attempt_budget_writes_explicit_error(tmp_path):
+    client = _q(tmp_path, "client", max_attempts=2)
+    client.submit("doomed", _x(7))
+    b = _q(tmp_path, "B", ttl_s=0.1, skew_s=0.0)
+    b.join()
+    for _ in range(2):
+        assert b.claim()
+        time.sleep(0.25)
+        # stale own heartbeat: reap from a fresh handle judges it
+        r = _q(tmp_path, "R", ttl_s=0.1, skew_s=0.0)
+        r.join()
+        r.reap()
+    res = client.result("doomed")
+    assert res is not None and res["status"] == "error"
+    assert res["attempts"] == 2
+    assert client.spent("doomed")
+    # exactly-once-OR-error: the spent key is refused forever
+    with pytest.raises(ValueError):
+        client.submit("doomed", _x(7))
+
+
+def test_requeued_copy_of_spent_key_dropped_at_claim(tmp_path):
+    client = _q(tmp_path, "client")
+    a = _q(tmp_path, "A")
+    a.join()
+    x = _x(8)
+    client.submit("k", x)
+    it = a.claim()[0]
+    assert a.complete(it, x)
+    # a stale requeued copy reappears (a racing reaper's rename that
+    # lost the delivery race): claim drops it for free
+    stale = dict(it)
+    stale["attempts"] = 1
+    with open(tmp_path / "queue" / it["name"], "w") as f:
+        json.dump(stale, f)
+    assert a.claim(limit=4) == []
+    assert not os.path.exists(tmp_path / "queue" / it["name"])
+
+
+def test_reaper_spares_unstamped_fresh_claim(tmp_path):
+    """The claim window: the rename into the lease dir has landed but
+    the ownership stamp has not. A reaper judging that record by its
+    absent lease fields would read expired-since-epoch and steal a
+    healthy host's fresh claim (then the claimer's stamp would
+    recreate a ghost lease no reaper ever expires). The record must
+    be judged by file age instead."""
+    client = _q(tmp_path, "client", ttl_s=0.3, skew_s=0.1)
+    a = _q(tmp_path, "A", ttl_s=0.3, skew_s=0.1)
+    b = _q(tmp_path, "B", ttl_s=0.3, skew_s=0.1)
+    a.join()
+    b.join()
+    name = client.submit("k", _x(12))
+    # simulate mid-claim: rename only, no ownership stamp yet
+    os.rename(
+        tmp_path / "queue" / name, tmp_path / "leases" / "A" / name
+    )
+    assert b.reap() == []  # fresh unstamped claim: hands off
+    st = client.stats()
+    assert st["leased"] == 1 and st["queued"] == 0
+    # the claimer died right there: after a full TTL the unstamped
+    # lease is requeued, not leaked
+    old = time.time() - 5.0
+    os.utime(tmp_path / "leases" / "A" / name, (old, old))
+    reaped = b.reap()
+    assert [r["key"] for r in reaped] == ["k"]
+    assert client.stats()["queued"] == 1
+
+
+def test_result_record_is_first_wins(tmp_path):
+    """A spent-race loser must never overwrite the winner's durable
+    result with a contradictory record — the first published outcome
+    is the client-visible one."""
+    from ccsc_code_iccv2017_tpu.serve.dqueue import _publish_json
+
+    p = str(tmp_path / "r.json")
+    assert _publish_json(p, {"status": "ok", "who": "winner"})
+    assert not _publish_json(p, {"status": "error", "who": "loser"})
+    assert json.load(open(p))["who"] == "winner"
+    # end-to-end: the reaper's budget-exhaustion error loses to a
+    # delivery that already published
+    client = _q(tmp_path, "client", max_attempts=1)
+    a = _q(tmp_path, "A", ttl_s=0.1, skew_s=0.0)
+    a.join()
+    client.submit("k", _x(13))
+    it = a.claim()[0]
+    assert a.complete(it, _x(13) * 2)
+    # a stale reaper view of the same exhausted item changes nothing
+    assert not a._requeue(dict(it), str(tmp_path / "nope.json"), "x")
+    assert client.result("k")["status"] == "ok"
+
+
+def test_leave_releases_leases_and_seal_drained(tmp_path):
+    client = _q(tmp_path, "client")
+    a = _q(tmp_path, "A")
+    a.join()
+    client.submit("k1", _x(9))
+    client.submit("k2", _x(10))
+    assert len(a.claim(limit=4)) == 2
+    assert not client.drained
+    assert a.leave() == 2  # orderly exit hands both back
+    st = client.stats()
+    assert st["queued"] == 2 and st["leased"] == 0
+    assert st["hosts"]["A"]["status"] == "left"
+    assert not client.sealed
+    client.seal()
+    assert client.sealed
+    b = _q(tmp_path, "B")
+    b.join()
+    for it in b.claim(limit=4):
+        assert b.complete(it, _x(11))
+    assert client.drained
+    # result/spent names are digest-safe for hostile keys
+    assert "/" not in safe_key("../../etc/passwd")

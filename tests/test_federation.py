@@ -1,0 +1,442 @@
+"""Cross-host federated serving (serve.dqueue + serve.federation):
+
+- an in-process federated host serves a frontend's stream with
+  results BIT-IDENTICAL to the same requests served by a plain
+  in-process fleet (federation adds durability, not numerics);
+- the acceptance chaos proof: two federated fleet PROCESSES drain a
+  shared queue, one is SIGKILLed mid-attempt while holding leases —
+  the survivor reaps and finishes with ZERO lost requests, every
+  delivered result bit-identical to the capture oracle's recorded
+  outcome digests, and every trace_id reassembles complete with both
+  host ownerships visible;
+- frontend contract: in-flight resubmit returns the same future,
+  spent keys are refused, close resolves leftovers explicitly;
+- scripts/obs_report.py renders the FEDERATION section (per-host
+  liveness via the --stale-after rule, queue counters, cross-host
+  requeues).
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import (
+    FleetConfig,
+    ProblemGeom,
+    ServeConfig,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.serve import capture as cap
+from ccsc_code_iccv2017_tpu.serve.federation import (
+    FederatedFrontend,
+    FederatedHost,
+)
+from ccsc_code_iccv2017_tpu.utils import obs
+from ccsc_code_iccv2017_tpu.utils import trace as trace_util
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bank(k=4, sup=3, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, sup, sup)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return d
+
+
+def _cfgs():
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_psnr=True, track_objective=True,
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    return geom, cfg, scfg
+
+
+def _requests(n, seed=0):
+    r = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = r.random((12, 12)).astype(np.float32)
+        m = (r.random((12, 12)) < 0.5).astype(np.float32)
+        out.append((x * m, m, x))
+    return out
+
+
+def _host(tmp, d, host_id, metrics_sub, **kw):
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+
+    geom, cfg, scfg = _cfgs()
+    return FederatedHost(
+        os.path.join(tmp, "q"), d, ReconstructionProblem(geom), cfg,
+        scfg,
+        FleetConfig(
+            replicas=1, min_queue_depth=64, restart_backoff_s=0.05,
+            verbose="none",
+        ),
+        host=host_id, metrics_dir=os.path.join(tmp, metrics_sub),
+        heartbeat_s=0.2, ttl_s=1.0, skew_s=0.2, verbose="none", **kw,
+    )
+
+
+def test_federated_serve_bit_identical_to_plain_fleet(tmp_path):
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+
+    d = _bank()
+    geom, cfg, scfg = _cfgs()
+    reqs = _requests(5)
+    # reference: the same bytes through a plain in-process fleet
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), cfg, scfg,
+        FleetConfig(replicas=1, min_queue_depth=64, verbose="none"),
+    )
+    ref = [
+        fleet.reconstruct(b, mask=m, x_orig=x, timeout=180)
+        for b, m, x in reqs
+    ]
+    fleet.close()
+    host = _host(str(tmp_path), d, "hostA", "m-host")
+    fe = FederatedFrontend(
+        os.path.join(str(tmp_path), "q"), client="fe0",
+        metrics_dir=os.path.join(str(tmp_path), "m-fe"),
+        verbose="none",
+    )
+    try:
+        futs = [
+            fe.submit(b, mask=m, x_orig=x) for b, m, x in reqs
+        ]
+        res = [f.result(timeout=180) for f in futs]
+        fe.seal()
+        assert host.serve_until_sealed(timeout=120)
+    finally:
+        host.close()
+        fe.close()
+    for got, want in zip(res, ref):
+        # federation moved the bytes through the durable queue and a
+        # content-addressed result store — and changed NOTHING
+        assert np.array_equal(got.recon, want.recon)
+        assert got.digest == cap.payload_sha(
+            np.ascontiguousarray(np.asarray(want.recon))
+        )
+        assert got.host == "hostA" and got.attempts == 1
+    evs = obs.read_events(str(tmp_path), recursive=True)
+    kinds = {e["type"] for e in evs}
+    assert {
+        "fed_join", "fed_leave", "fed_heartbeat", "dqueue_submit",
+        "dqueue_claim", "dqueue_complete",
+    } <= kinds
+    # every request's trace reassembles complete across the
+    # frontend's and the host's streams
+    traces = trace_util.assemble(evs)
+    for r in res:
+        assert traces[r.trace_id].complete
+
+
+@pytest.mark.parametrize("who", ["frontend"])
+def test_frontend_contract(tmp_path, who):
+    d = _bank()
+    host = _host(str(tmp_path), d, "hostA", "m-host")
+    fe = FederatedFrontend(
+        os.path.join(str(tmp_path), "q"), client="fe0",
+        verbose="none",
+    )
+    try:
+        b, m, x = _requests(1)[0]
+        f1 = fe.submit(b, mask=m, x_orig=x, key="pin")
+        # in-flight resubmit of the same key returns the SAME future
+        assert fe.submit(b, mask=m, key="pin") is f1
+        r1 = f1.result(timeout=180)
+        assert r1.key == "pin"
+        # a spent key is refused across the whole pool
+        with pytest.raises(ValueError):
+            fe.submit(b, mask=m, key="pin")
+        # leftovers at close get an explicit error, not a hang
+        host.close()
+        f2 = fe.submit(b, mask=m, key="orphaned")
+        fe.close()
+        with pytest.raises(RuntimeError):
+            f2.result(timeout=5)
+        with pytest.raises(RuntimeError):
+            fe.submit(b, mask=m)  # closed frontend refuses
+    finally:
+        host.close()
+        fe.close()
+
+
+def test_frontend_concurrent_same_key_single_item(tmp_path):
+    """Two threads submitting the same key concurrently get the SAME
+    future and enqueue exactly one durable item (the pending check
+    and registration are atomic under the frontend lock)."""
+    import threading
+
+    fe = FederatedFrontend(
+        os.path.join(str(tmp_path), "q"), client="fe0",
+        verbose="none",
+    )
+    try:
+        b, m, x = _requests(1)[0]
+        got = []
+        barrier = threading.Barrier(2)
+
+        def go():
+            barrier.wait()
+            got.append(fe.submit(b, mask=m, key="dup"))
+
+        ts = [threading.Thread(target=go) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(got) == 2 and got[0] is got[1]
+        qdir = os.path.join(str(tmp_path), "q", "queue")
+        items = [n for n in os.listdir(qdir) if n.endswith(".json")]
+        assert len(items) == 1
+    finally:
+        fe.close()
+
+
+def test_failed_request_resolves_error_with_complete_trace(tmp_path):
+    """A request whose cross-host attempt budget is exhausted gets an
+    explicit error Future AND a complete trace — every ownership
+    visible with status 'error'/'requeued' (no engine involved: dead
+    hosts are simulated with stale queue handles)."""
+    import time as _time
+
+    from ccsc_code_iccv2017_tpu.serve.dqueue import DurableQueue
+
+    qdir = os.path.join(str(tmp_path), "q")
+    fe = FederatedFrontend(
+        qdir, client="fe0",
+        metrics_dir=os.path.join(str(tmp_path), "m-fe"),
+        verbose="none",
+    )
+    fe.queue.max_attempts = 1  # item-record budget: one ownership
+    ev = []
+    ghost = DurableQueue(
+        qdir, host="ghost",
+        emit=lambda t, **f: ev.append(dict(f, type=t, t=_time.time())),
+        ttl_s=0.15, skew_s=0.0,
+    )
+    reaper = DurableQueue(
+        qdir, host="reaper",
+        emit=lambda t, **f: ev.append(dict(f, type=t, t=_time.time())),
+        ttl_s=0.15, skew_s=0.0,
+    )
+    try:
+        b, m, x = _requests(1)[0]
+        fut = fe.submit(b, mask=m, key="doomed")
+        ghost.join()
+        assert ghost.claim()  # then the "host" dies silently
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+            reaper.heartbeat()
+            reaper.reap()
+            if fut.done():
+                break
+        with pytest.raises(RuntimeError, match="ownership"):
+            fut.result(timeout=1)
+    finally:
+        fe.close()
+    events = obs.read_events(str(tmp_path), recursive=True) + ev
+    traces = trace_util.assemble(events)
+    (tr,) = traces.values()
+    assert tr.complete
+    assert tr.root.status == "error"
+    attempts = tr.by_name("attempt")
+    assert len(attempts) == 1 and attempts[0].status == "error"
+
+
+def test_whole_host_kill_zero_lost_bit_parity(tmp_path):
+    """The ISSUE acceptance: >=2 federated fleet processes serving a
+    captured stream; SIGKILL of one FULL PROCESS mid-attempt loses
+    zero requests, every delivered result is bit-identical to the
+    capture's recorded outcome digests, and every trace_id
+    reassembles complete with both host ownerships visible."""
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+    from scripts.chaos_smoke import _host_kill_child_code
+
+    tmp = str(tmp_path)
+    d = _bank()
+    geom, cfg, scfg = _cfgs()
+    reqs = _requests(8)
+    # 1) capture oracle: one unfaulted in-process fleet records the
+    # stream's outcome digests
+    cap_dir = os.path.join(tmp, "capture")
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), cfg, scfg,
+        FleetConfig(
+            replicas=1, metrics_dir=os.path.join(tmp, "m-oracle"),
+            capture_dir=cap_dir, min_queue_depth=64, verbose="none",
+        ),
+    )
+    for i, (b, m, x) in enumerate(reqs):
+        fleet.submit(b, mask=m, x_orig=x, key=f"k{i}")
+    fleet.close()
+    oracle = {
+        rec["key"]: rec["outcome"]["digest"]
+        for rec in cap.read_workload(cap_dir)
+        if rec.get("outcome")
+    }
+    assert len(oracle) == len(reqs)
+    # 2) two federated fleet PROCESSES; host0 wedges on an injected
+    # engine hang while holding leases, then is SIGKILLed whole
+    qdir = os.path.join(tmp, "q")
+    bank = os.path.join(tmp, "bank.npy")
+    np.save(bank, d)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn(i, extra=None):
+        e = dict(env)
+        e.update(extra or {})
+        return subprocess.Popen(
+            [
+                sys.executable, "-c",
+                _host_kill_child_code(
+                    qdir, bank, os.path.join(tmp, f"m-host{i}"),
+                    f"host{i}",
+                ),
+            ],
+            env=e, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+    p0 = spawn(0, {
+        "CCSC_FAULT_ENGINE_HANG_REQ": "3",
+        "CCSC_FAULT_ENGINE_HANG_S": "600",
+    })
+    fe = FederatedFrontend(
+        qdir, client="fe0",
+        metrics_dir=os.path.join(tmp, "m-frontend"), verbose="none",
+    )
+    p1 = None
+    try:
+        futs = [
+            fe.submit(b, mask=m, x_orig=x, key=f"fed{i}")
+            for i, (b, m, x) in enumerate(reqs)
+        ]
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            st = fe.queue.stats()
+            if st["results"] >= 1 and st["leased"] >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("host0 never reached the mid-attempt window")
+        os.kill(p0.pid, signal.SIGKILL)  # the whole fleet process
+        p0.wait()
+        p1 = spawn(1)
+        fe.seal()
+        results = [f.result(timeout=300) for f in futs]
+        assert p1.wait(timeout=300) == 0
+    finally:
+        if p1 is not None and p1.poll() is None:
+            p1.kill()
+            p1.wait()
+        fe.close()
+    # zero lost + bit parity vs the capture's recorded digests
+    assert len(results) == len(reqs)
+    for i, res in enumerate(results):
+        assert res.digest == oracle[f"k{i}"], (
+            f"request {i}: federated result diverged from the "
+            "capture oracle"
+        )
+    served_by = {res.host for res in results}
+    assert "host1" in served_by  # the survivor finished the stream
+    handed_off = [r for r in results if r.attempts > 1]
+    assert handed_off  # the SIGKILL really cost host0 ownerships
+    # 3) the full cross-host story, from the streams alone
+    events = obs.read_events(tmp, recursive=True)
+    cross = [
+        e for e in events
+        if e["type"] == "dqueue_requeue"
+        and e.get("from_host") == "host0"
+        and e.get("by_host") == "host1"
+    ]
+    assert cross  # survivor reaped the dead host's leases
+    traces = trace_util.assemble(events)
+    for res in results:
+        tr = traces[res.trace_id]
+        assert tr.complete, (
+            res.key, tr.orphans, tr.unparented,
+        )
+        attempts = tr.by_name("attempt")
+        assert len(attempts) == res.attempts
+        if res.attempts > 1:
+            # both ownerships visible: the dead host's attempt was
+            # written retrospectively by the reaper ('requeued'),
+            # the survivor's by its own delivery ('ok')
+            statuses = {s.status for s in attempts}
+            assert statuses == {"requeued", "ok"}
+            span_hosts = {
+                e.get("host")
+                for e in events
+                if e["type"] == "span_end"
+                and e.get("trace_id") == res.trace_id
+                and e.get("span") == "attempt"
+            }
+            assert {"host0", "host1"} <= span_hosts
+    # 4) the FEDERATION dashboard section renders the casualty
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import obs_report
+
+    text = obs_report.render(events, stale_after=120.0)
+    assert "FEDERATION" in text
+    assert "host0" in text and "host1" in text
+    assert "across hosts" in text
+
+
+def test_obs_report_federation_staleness(tmp_path):
+    """A SIGKILLed host shows up STALE in the FEDERATION liveness
+    column by the --stale-after watchdog rule, before its leases even
+    expire."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import obs_report
+
+    t0 = 1000.0
+    events = [
+        {"type": "fed_join", "t": t0, "host": "hA", "epoch": 1},
+        {"type": "fed_join", "t": t0, "host": "hB", "epoch": 1},
+        {"type": "fed_heartbeat", "t": t0 + 5, "host": "hA",
+         "epoch": 1, "served": 3, "leased": 1},
+        {"type": "fed_heartbeat", "t": t0 + 400, "host": "hB",
+         "epoch": 1, "served": 9, "leased": 0},
+        # hC left and was RESTARTED into a fresh epoch: the newer
+        # heartbeat must win over the old fed_leave (the supervised
+        # restart flow) — hC renders live, not left
+        {"type": "fed_join", "t": t0, "host": "hC", "epoch": 1},
+        {"type": "fed_leave", "t": t0 + 50, "host": "hC",
+         "served": 2},
+        {"type": "fed_join", "t": t0 + 60, "host": "hC", "epoch": 2},
+        {"type": "fed_heartbeat", "t": t0 + 400, "host": "hC",
+         "epoch": 2, "served": 0, "leased": 0},
+        {"type": "fed_join", "t": t0, "host": "hD", "epoch": 1},
+        {"type": "fed_leave", "t": t0 + 200, "host": "hD",
+         "served": 4},
+        {"type": "dqueue_submit", "t": t0, "key": "k"},
+    ]
+    text = obs_report.render(events, stale_after=120.0)
+    assert "FEDERATION" in text
+    line = lambda h: next(
+        ln for ln in text.splitlines() if f"host {h}" in ln
+    )
+    assert "STALE" in line("hA")
+    assert "live" in line("hB")
+    assert "live" in line("hC") and "left" not in line("hC")
+    assert "left" in line("hD")
