@@ -586,11 +586,19 @@ def append_serve_record(
     record to a normalized ledger row, shared by ``bench.py``'s
     CCSC_BENCH_SERVE arm and ``scripts/serve_bench.py`` so the two
     entry points cannot drift. No-op (None) when the ledger is
-    disarmed or the record is chip-less."""
+    disarmed or the record is chip-less.
+
+    A record carrying the bench's mesh arm
+    (``mesh_requests_per_sec``, CCSC_SERVE_MESH) appends a SECOND
+    row for that configuration: same chip/shape key, but the knob
+    dict gains the mesh shape and device count, so the knob digest —
+    the ledger's configuration key — separates mesh-serving history
+    from single-device history from day one, and ``perf_gate``
+    judges each against its own band."""
     chip = rec.get("chip") or rec.get("platform")
     if not enabled() or not chip:
         return None
-    return maybe_append(
+    out = maybe_append(
         chip=chip,
         kind="serve",
         workload="serve2d",
@@ -604,6 +612,33 @@ def append_serve_record(
         degraded=bool(degraded),
         source=source,
     )
+    if rec.get("mesh_requests_per_sec") is not None:
+        maybe_append(
+            chip=chip,
+            kind="serve",
+            workload="serve2d",
+            shape_key=rec.get("shape_key", ""),
+            # the mesh row keys by the same WORKLOAD knob dict as the
+            # default row plus the topology — symmetric vocabularies,
+            # so the two configurations differ by exactly mesh/
+            # devices. NB if the mesh arm ever gains tune support,
+            # its resolved solve arm (rec['mesh_knobs']) must join
+            # this dict, or a tuned mesh row would key identically
+            # to the untuned one it is not comparable with.
+            knobs=dict(
+                rec.get("knobs") or {},
+                mesh=rec.get("mesh"),
+                devices=rec.get("mesh_devices"),
+            ),
+            value=rec["mesh_requests_per_sec"],
+            unit="requests/sec",
+            git_sha=git_sha,
+            n_compiles=rec.get("n_compiles"),
+            peak_hbm_bytes=rec.get("peak_hbm_bytes"),
+            degraded=bool(degraded),
+            source=source,
+        )
+    return out
 
 
 # ---------------------------------------------------------------------

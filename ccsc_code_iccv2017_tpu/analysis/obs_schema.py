@@ -58,9 +58,14 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
                      "observed_ms"),
     "slo_histogram": _s("replica_id", "phase", "counts", "n"),
     "slo_profile": _s("replica_id", "trace_dir"),
-    # -- serving engine (serve.engine; replica_id stamped by _emit) --
-    "serve_warmup": _s("replica_id", "bucket", "warmup_s", "knobs"),
-    "serve_ready": _s("replica_id", "n_buckets", "warmup_s"),
+    # -- serving engine (serve.engine; replica_id stamped by _emit).
+    # ``devices``/``mesh`` are the replica's device topology (mesh
+    # engines: ServeConfig.mesh_shape) — obs_report's SERVING section
+    # and the mixed-fleet ceiling check read them back ----------------
+    "serve_warmup": _s("replica_id", "bucket", "warmup_s", "knobs",
+                       "devices"),
+    "serve_ready": _s("replica_id", "n_buckets", "warmup_s",
+                      "devices"),
     "serve_request": _s("replica_id", "trace_id", "bucket",
                         "latency_ms", "iters"),
     "serve_dispatch": _s("replica_id", "bucket", "n", "slots",

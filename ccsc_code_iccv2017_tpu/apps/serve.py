@@ -86,6 +86,19 @@ def build_parser() -> argparse.ArgumentParser:
         "full or when its oldest request has waited this long",
     )
     p.add_argument(
+        "--mesh", default=None, metavar="BATCH[xFREQ]",
+        help="serve each bucket from a device MESH "
+        "(ServeConfig.mesh_shape): the bucket's slots are sharded "
+        "over BATCH devices via shard_map (each device solves "
+        "slots/BATCH independent requests — same-bucket results "
+        "bit-identical to a single-device engine), optionally x FREQ "
+        "frequency-parallel devices per slot (e.g. '4' or '4x2'; "
+        "every bucket's slots must divide by BATCH). Default: the "
+        "CCSC_SERVE_MESH env knob, unset = single-device. With "
+        "--replicas every replica serves from its own mesh "
+        "(disjoint device slices while the pool lasts)",
+    )
+    p.add_argument(
         "--compile-cache", default=None,
         help="persistent XLA compilation cache dir (CCSC_COMPILE_CACHE "
         "env equivalent): warm engine restarts skip compilation",
@@ -212,11 +225,20 @@ def main(argv=None):
         track_objective=True,
         track_psnr=True,
     )
+    mesh_shape = None
+    if args.mesh is not None:
+        from ..serve.engine import parse_mesh_shape
+
+        try:
+            mesh_shape = parse_mesh_shape(args.mesh)
+        except ValueError as e:
+            raise SystemExit(f"--mesh: {e}")
     scfg = ServeConfig(
         buckets=_parse_buckets(args.bucket),
         max_wait_ms=args.max_wait_ms,
         compile_cache=args.compile_cache,
         aot_warmup=not args.no_aot,
+        mesh_shape=mesh_shape,
         metrics_dir=args.metrics_dir,
         slo_p50_ms=args.slo_p50_ms,
         slo_p99_ms=args.slo_p99_ms,
@@ -288,14 +310,22 @@ def main(argv=None):
         )
         print(
             f"fleet ready in {time.perf_counter() - t0:.2f}s "
-            f"({args.replicas} replica(s), {len(scfg.buckets)} "
+            f"({args.replicas} replica(s), {engine.total_devices} "
+            f"device(s), {len(scfg.buckets)} "
             f"bucket(s), queue ceiling {engine.queue_ceiling})"
         )
     else:
         engine = CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
         print(
             f"engine ready in {time.perf_counter() - t0:.2f}s "
-            f"({len(scfg.buckets)} bucket(s))"
+            f"({len(scfg.buckets)} bucket(s)"
+            + (
+                f", mesh {'x'.join(str(a) for a in engine.mesh_shape)}"
+                f" over {engine.devices} devices"
+                if engine.mesh_shape
+                else ""
+            )
+            + ")"
         )
         from ..serve.metricsd import MetricsD, resolve_endpoint
 

@@ -493,6 +493,24 @@ class ServeConfig:
     # the fleet records once at admission, so N replicas cannot
     # write N copies of the same stream.
     capture_dir: Optional[str] = None
+    # Device-mesh shape of every bucket program (the big-iron
+    # replica): (batch,) shards a bucket's slots over the mesh's
+    # first axis via shard_map — each device solves slots/batch
+    # independent n=1 requests, so same-bucket results stay
+    # bit-identical to the single-device engine (per-slot gamma /
+    # traces / tol stop are slot-local either way); (batch, freq)
+    # additionally shards the per-frequency solves of every slot
+    # over a second 'freq' axis (parallel.mesh.block_freq_mesh — the
+    # learner's DP x TP scheme). Every bucket's slots must divide by
+    # the batch axis (checked here, against the whole bucket table).
+    # None (default) = the CCSC_SERVE_MESH env knob, unset = a
+    # single-device engine (the historical program, bit-exact).
+    mesh_shape: Optional[Tuple[int, ...]] = None
+    # Explicit device indices (into jax.devices()) backing the mesh —
+    # prod(mesh_shape) entries. None = the first prod(mesh_shape)
+    # devices. A fleet with several mesh replicas in one process
+    # assigns disjoint slices through this field.
+    mesh_devices: Optional[Tuple[int, ...]] = None
 
     def __post_init__(self):
         for fname in ("slo_p50_ms", "slo_p99_ms", "slo_check_s"):
@@ -545,6 +563,70 @@ class ServeConfig:
         if self.max_wait_ms < 0:
             raise ValueError(
                 f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
+            )
+        if self.mesh_shape is not None:
+            # reject spec STRINGS before tuple coercion: iterating
+            # "12" yields characters, i.e. a silent (1, 2) mesh —
+            # the CLI/env surfaces parse specs, the config takes
+            # axis-size tuples only
+            if isinstance(self.mesh_shape, str):
+                raise ValueError(
+                    f"mesh_shape {self.mesh_shape!r} is a string — "
+                    "pass a tuple of axis sizes (e.g. (4, 2)); spec "
+                    "strings like '4x2' belong to --mesh / "
+                    "CCSC_SERVE_MESH"
+                )
+            try:
+                mesh = tuple(int(a) for a in self.mesh_shape)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"mesh_shape {self.mesh_shape!r} is not a tuple "
+                    "of axis sizes"
+                )
+            if mesh == ():
+                # () = explicitly single-device even when the
+                # CCSC_SERVE_MESH env knob is armed (the capture_dir
+                # "" convention) — the bench's default-vs-mesh
+                # comparison pins its baseline engine with this
+                object.__setattr__(self, "mesh_shape", ())
+                if self.mesh_devices is not None:
+                    raise ValueError(
+                        "mesh_devices without a mesh is meaningless"
+                    )
+            else:
+                if not 1 <= len(mesh) <= 2 or any(
+                    a < 1 for a in mesh
+                ):
+                    raise ValueError(
+                        f"mesh_shape must be (batch,) or "
+                        f"(batch, freq) with positive axes, got "
+                        f"{mesh}"
+                    )
+                object.__setattr__(self, "mesh_shape", mesh)
+                bad = [
+                    (s, sp) for s, sp in self.buckets if s % mesh[0]
+                ]
+                if bad:
+                    raise ValueError(
+                        f"mesh batch axis {mesh[0]} must divide "
+                        f"every bucket's slots; offending buckets "
+                        f"{bad} of {list(self.buckets)} — resize the "
+                        "buckets or the mesh"
+                    )
+                if self.mesh_devices is not None:
+                    devs = tuple(int(i) for i in self.mesh_devices)
+                    if len(devs) != math.prod(mesh) or any(
+                        i < 0 for i in devs
+                    ):
+                        raise ValueError(
+                            f"mesh_devices needs {math.prod(mesh)} "
+                            f"non-negative device indices for mesh "
+                            f"{mesh}, got {devs}"
+                        )
+                    object.__setattr__(self, "mesh_devices", devs)
+        elif self.mesh_devices is not None:
+            raise ValueError(
+                "mesh_devices without mesh_shape is meaningless"
             )
 
 
@@ -666,6 +748,18 @@ class FleetConfig:
     # idempotency key (a request and its outcome always land on the
     # same side). None = CCSC_CAPTURE_SAMPLE (default 1.0).
     capture_sample: Optional[float] = None
+    # Heterogeneous replica shapes: one entry per replica — a mesh
+    # shape tuple (the replica's engine shards its bucket programs
+    # over that many devices, ServeConfig.mesh_shape semantics) or
+    # None (a single-device replica). None (default) = every replica
+    # inherits ServeConfig.mesh_shape. The fleet assigns disjoint
+    # device slices when the pool is large enough, scales the derived
+    # admission ceiling by each replica's device count
+    # (utils.perfmodel.fleet_serving_bound), and counts mesh devices
+    # in capacity_hint (federation claim sizing).
+    replica_meshes: Optional[
+        Tuple[Optional[Tuple[int, ...]], ...]
+    ] = None
 
     def __post_init__(self):
         for fname in ("slo_p50_ms", "slo_p99_ms"):
@@ -742,4 +836,36 @@ class FleetConfig:
             raise ValueError(
                 f"degrade_max_it_factor must be in (0, 1], got "
                 f"{self.degrade_max_it_factor}"
+            )
+        if self.replica_meshes is not None:
+            if len(self.replica_meshes) != self.replicas:
+                raise ValueError(
+                    f"replica_meshes has {len(self.replica_meshes)} "
+                    f"entries for {self.replicas} replica(s) — one "
+                    "mesh shape (or None) per replica"
+                )
+            norm_meshes = []
+            for i, m in enumerate(self.replica_meshes):
+                if m is None:
+                    norm_meshes.append(None)
+                    continue
+                try:
+                    if isinstance(m, str):
+                        # "12" would iterate characters into (1, 2)
+                        raise TypeError(m)
+                    mesh = tuple(int(a) for a in m)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"replica_meshes[{i}] = {m!r} is not a tuple "
+                        "of axis sizes (use e.g. (2,) or (4, 2), not "
+                        "a bare int or a spec string)"
+                    )
+                if not 1 <= len(mesh) <= 2 or any(a < 1 for a in mesh):
+                    raise ValueError(
+                        f"replica_meshes[{i}] must be (batch,) or "
+                        f"(batch, freq) with positive axes, got {m!r}"
+                    )
+                norm_meshes.append(mesh)
+            object.__setattr__(
+                self, "replica_meshes", tuple(norm_meshes)
             )

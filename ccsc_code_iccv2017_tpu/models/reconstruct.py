@@ -209,12 +209,53 @@ def _build_plan_blur_jit(d, blur_psf, prob, cfg, fg):
     return _plan_arrays(d, prob, cfg, fg, blur_psf)
 
 
+def check_mesh_plan(
+    mesh_shape: Tuple[int, ...],
+    slots: int,
+    num_freq: int,
+    buckets=None,
+) -> None:
+    """Refuse a serving mesh that cannot shard this plan's program:
+    the batch axis must divide ``slots`` (the bucket's concurrent
+    request count — each device takes slots/batch whole n=1 solves)
+    and the optional second axis must divide the FFT domain's
+    frequency count. ``buckets`` (the engine's full (slots, spatial)
+    table, when known) makes the error actionable at the
+    configuration that caused it."""
+    mesh_shape = tuple(int(a) for a in mesh_shape)
+    blist = (
+        list(buckets) if buckets is not None else f"slots={slots}"
+    )
+    if len(mesh_shape) < 1 or len(mesh_shape) > 2:
+        raise ValueError(
+            f"serving mesh shape must be (batch,) or (batch, freq), "
+            f"got {mesh_shape}"
+        )
+    if slots % mesh_shape[0]:
+        raise ValueError(
+            f"mesh batch axis {mesh_shape[0]} does not divide the "
+            f"bucket's {slots} slot(s) — every bucket's slots must be "
+            f"a multiple of the batch axis (buckets: {blist}); "
+            "resize the buckets or the mesh"
+        )
+    if len(mesh_shape) > 1 and num_freq % mesh_shape[1]:
+        raise ValueError(
+            f"mesh freq axis {mesh_shape[1]} does not divide the "
+            f"plan's {num_freq} frequency bins (buckets: {blist}) — "
+            "pick a freq axis that divides the FFT domain (fft_pad "
+            "'pow2' helps) or drop the second mesh axis"
+        )
+
+
 def build_plan(
     d: jnp.ndarray,
     prob: "ReconstructionProblem",
     cfg: SolveConfig,
     data_spatial: Tuple[int, ...],
     blur_psf: Optional[jnp.ndarray] = None,
+    mesh_shape: Optional[Tuple[int, ...]] = None,
+    slots: Optional[int] = None,
+    buckets=None,
 ) -> ReconPlan:
     """Precompute a :class:`ReconPlan` for observations of spatial
     shape ``data_spatial`` (the request shape BEFORE psf padding).
@@ -224,7 +265,15 @@ def build_plan(
     the per-request program starts at the data-side constants instead
     of re-deriving the operator precompute. A plan built with
     ``blur_psf`` already composes the OTF — callers then pass
-    ``blur_psf=None`` to ``reconstruct``."""
+    ``blur_psf=None`` to ``reconstruct``.
+
+    ``mesh_shape``/``slots``/``buckets``: the serving-mesh contract
+    (serve.CodecEngine with ServeConfig.mesh_shape). The plan's
+    arrays are the same either way — spectra and solve factors are
+    replicated across the mesh — but an incompatible mesh (batch
+    axis not dividing the bucket's slots, freq axis not dividing the
+    FFT domain) is refused HERE, before any program compiles, with
+    the bucket table in the error."""
     from ..utils import validate
 
     validate.check_filters(d, prob.geom)
@@ -240,6 +289,11 @@ def build_plan(
         prob.geom, data_spatial, pad=prob.pad, fft_pad=cfg.fft_pad,
         fft_impl=cfg.fft_impl,
     )
+    if mesh_shape is not None:
+        check_mesh_plan(
+            mesh_shape, slots if slots is not None else 1,
+            fg.num_freq, buckets=buckets,
+        )
     if blur_psf is None:
         dhat_clean, dhat_solve, kern = _build_plan_jit(d, prob, cfg, fg)
     else:
@@ -361,9 +415,15 @@ def reconstruct(
     if plan is not None:
         if mesh is not None:
             raise ValueError(
-                "plan does not combine with mesh — plans pin one "
-                "unsharded program; shard by batching requests "
-                "through serve.CodecEngine instead"
+                "plan does not combine with mesh on this entry point "
+                "— reconstruct() shards by deriving the operator "
+                "precompute inside each shard. For a plan-backed "
+                "sharded program, serve through the mesh engine: "
+                "ServeConfig(mesh_shape=(batch[, freq])) (or "
+                "CCSC_SERVE_MESH / apps/serve.py --mesh) builds "
+                "shard_map'd bucket programs around this plan with "
+                "per-slot results bit-identical to the single-device "
+                "engine"
             )
         if blur_psf is not None:
             raise ValueError(
@@ -619,8 +679,6 @@ def _reconstruct_impl(
         fft_impl=cfg.fft_impl,
     )
     n = b.shape[0]
-    if plan is not None and freq_axis_name is not None:
-        raise ValueError("plan does not combine with frequency sharding")
 
     K = (
         plan.num_filters
@@ -692,6 +750,33 @@ def _reconstruct_impl(
         dhat_clean, dhat_solve, kern = (
             plan.dhat_clean, plan.dhat_solve, plan.kern,
         )
+        if freq_axis_name is not None:
+            # frequency sharding of a PLAN-backed solve (the mesh
+            # serving engine's (batch, freq) path): the plan holds the
+            # FULL per-frequency solve factors, replicated; each
+            # device slices out its own bins. Every kern field is
+            # per-frequency-independent (dinv elementwise in f, minv /
+            # minv_diag batched over f), so the sliced kern is bitwise
+            # the kern the unsharded solve uses at those bins — the
+            # bit-identity contract of the mesh engine rides on this.
+            def _fslice0(x):
+                idx = jax.lax.axis_index(freq_axis_name)
+                return jax.lax.dynamic_slice_in_dim(
+                    x, idx * f_local, f_local, axis=0
+                )
+
+            kern = freq_solvers.ZSolveKernel(
+                dhat=fslice(kern.dhat),
+                dinv=fslice(kern.dinv),
+                minv=(
+                    None if kern.minv is None else _fslice0(kern.minv)
+                ),
+                minv_diag=(
+                    None
+                    if kern.minv_diag is None
+                    else fslice(kern.minv_diag)
+                ),
+            )
     else:
         dhat_clean, dhat_solve, kern = _plan_arrays(
             d, prob, cfg, fg, blur_psf, fslice

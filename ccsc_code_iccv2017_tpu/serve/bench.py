@@ -29,7 +29,16 @@ outputs, isolates batching from bucketing), CCSC_COMPILE_CACHE
 [ServeConfig.tune] on the same stream and record
 tuned_requests_per_sec / speedup_tuned_vs_default / the resolved
 knob dict, the serving half of the autotune acceptance: tuned knobs
-must beat the f32/xla default at matching valid-region outputs).
+must beat the f32/xla default at matching valid-region outputs),
+CCSC_SERVE_MESH ("BATCH" or "BATCHxFREQ" — run a MESH engine
+[ServeConfig.mesh_shape: the bucket's slots sharded over a device
+mesh via shard_map] on the same stream through the same
+run_engine/max_rel_err protocol and record mesh_requests_per_sec /
+speedup_mesh_vs_default; the baseline/tuned engines pin
+mesh_shape=() so the env knob cannot leak into them. The mesh
+configuration lands in the perf ledger as its OWN knob-digest key —
+device count in the knob dict — so mesh-serving history accrues and
+gates separately from day one).
 """
 from __future__ import annotations
 
@@ -63,6 +72,18 @@ def run_serve_workload() -> Dict:
     max_it = _env.env_int("CCSC_SERVE_MAXIT")
     wait_ms = _env.env_float("CCSC_SERVE_WAIT_MS")
     homog = _env.env_flag("CCSC_SERVE_HOMOG")
+
+    # a malformed mesh spec is USER error, not environment shortage:
+    # fail HERE, before the expensive baseline/engine arms run —
+    # the same stance as apps/serve.py --mesh (only device shortage
+    # and divisibility, which depend on the environment, are
+    # recorded as mesh_skipped below)
+    mesh_spec = _env.env_str("CCSC_SERVE_MESH")
+    mesh_shape_req = None
+    if mesh_spec:
+        from .engine import parse_mesh_shape
+
+        mesh_shape_req = parse_mesh_shape(mesh_spec)  # raises on typo
 
     r = np.random.default_rng(0)
     d = r.normal(size=(k, sup, sup)).astype(np.float32)
@@ -160,6 +181,11 @@ def run_serve_workload() -> Dict:
         buckets=buckets, max_wait_ms=wait_ms, metrics_dir=metrics_dir,
         verbose="none",
         compile_cache=_env.env_str("CCSC_COMPILE_CACHE") or None,
+        # the baseline engine is PINNED single-device: with
+        # CCSC_SERVE_MESH armed for the mesh arm below, a
+        # None-mesh_shape baseline would silently become the very
+        # mesh engine it is the baseline for
+        mesh_shape=(),
     )
     eng_res, eng_rps, t_warmup, t_ready, _ = run_engine(scfg)
     max_rel = max_rel_err(eng_res)
@@ -212,6 +238,7 @@ def run_serve_workload() -> Dict:
             metrics_dir=metrics2, verbose="none",
             compile_cache=_env.env_str("CCSC_COMPILE_CACHE") or None,
             tune=tune_mode,
+            mesh_shape=(),  # tuned arm stays single-device too
         )
         res2, rps2, t_warm2, _, knobs2 = run_engine(scfg2)
         max_rel2 = max_rel_err(res2)
@@ -225,6 +252,64 @@ def run_serve_workload() -> Dict:
             "tuned_max_rel_err_vs_loop": round(max_rel2, 6),
             "tuned_event_stream": metrics2,
         }
+    # ---- the MESH engine on the same stream (CCSC_SERVE_MESH):
+    # same buckets, same requests, same run_engine/max_rel_err
+    # protocol — only ServeConfig.mesh_shape differs, so the record's
+    # default-vs-mesh gap is the measured value of sharding a
+    # bucket's slots over the device mesh. Skipped (with the reason
+    # recorded) when the visible device pool cannot back the mesh.
+    mesh_fields = {}
+    if mesh_shape_req is not None:
+        import math as _math
+
+        try:
+            mesh_shape = mesh_shape_req
+            need = _math.prod(mesh_shape)
+            if need > len(jax.devices()):
+                raise ValueError(
+                    f"mesh {mesh_spec} needs {need} device(s), "
+                    f"{len(jax.devices())} visible"
+                )
+            metrics3 = tempfile.mkdtemp(prefix="ccsc_serve_mesh_")
+            # inside the try: a mesh that fails the bucket
+            # divisibility check (ServeConfig refuses with the bucket
+            # table) must record mesh_skipped like any other
+            # unbackable mesh, not crash the bench after the baseline
+            # and tuned arms already ran
+            scfg3 = ServeConfig(
+                buckets=buckets, max_wait_ms=wait_ms,
+                metrics_dir=metrics3, verbose="none",
+                compile_cache=(
+                    _env.env_str("CCSC_COMPILE_CACHE") or None
+                ),
+                mesh_shape=mesh_shape,
+            )
+            # build-time refusals surface at engine construction,
+            # not config time: the freq axis is checked against the
+            # FFT domain's bin count only when build_plan derives it
+            # (models.reconstruct.check_mesh_plan) — still inside
+            # this try, so it records mesh_skipped like every other
+            # unbackable mesh instead of crashing the bench after
+            # the baseline and tuned arms already ran
+            res3, rps3, t_warm3, _, knobs3 = run_engine(scfg3)
+        except ValueError as e:
+            mesh_fields = {"mesh_skipped": str(e)}
+        else:
+            mesh_fields = {
+                "mesh": "x".join(str(a) for a in mesh_shape),
+                "mesh_devices": need,
+                "mesh_requests_per_sec": round(rps3, 4),
+                "speedup_mesh_vs_default": round(
+                    rps3 / eng_rps if eng_rps else 0.0, 3
+                ),
+                "mesh_max_rel_err_vs_loop": round(
+                    max_rel_err(res3), 6
+                ),
+                "mesh_warmup_s": round(t_warm3, 3),
+                "mesh_knobs": knobs3,
+                "mesh_event_stream": metrics3,
+            }
+
     from ..tune import store as tune_store
 
     return {
@@ -284,4 +369,5 @@ def run_serve_workload() -> Dict:
             "tune": tune_mode,
         },
         **tuned_fields,
+        **mesh_fields,
     }

@@ -85,6 +85,90 @@ def enable_compile_cache(path: Optional[str]) -> Optional[str]:
         return None
 
 
+def parse_mesh_shape(spec: str) -> Tuple[int, ...]:
+    """Parse a serving-mesh spec string — ``"BATCH"`` or
+    ``"BATCHxFREQ"`` (e.g. ``"8"``, ``"4x2"``) — into the
+    ServeConfig.mesh_shape tuple. Shared by the CCSC_SERVE_MESH env
+    fallback, ``apps/serve.py --mesh`` and the bench's mesh arm so
+    the spec grammar cannot drift between surfaces."""
+    # empty segments are NOT filtered: a truncated '4x' must refuse,
+    # not silently serve a (4,) batch-only mesh under the wrong
+    # ledger configuration
+    parts = spec.lower().replace("*", "x").split("x")
+    try:
+        shape = tuple(int(p) for p in parts)
+    except ValueError:
+        shape = ()
+    if not 1 <= len(shape) <= 2 or any(a < 1 for a in shape):
+        raise ValueError(
+            f"mesh spec {spec!r} is not BATCH or BATCHxFREQ with "
+            "positive integer axes (e.g. '8' or '4x2')"
+        )
+    return shape
+
+
+def _resolve_mesh(serve_cfg: ServeConfig):
+    """Resolve the engine's device mesh: ServeConfig.mesh_shape, else
+    the CCSC_SERVE_MESH env knob, else None (single device). Returns
+    ``(mesh, shape, note)`` — mesh is a jax Mesh (batch axis first,
+    'freq' second when 2-D, reusing parallel.mesh's builders), note a
+    console message when a non-strict resolution fell back. With
+    fewer visible devices than the mesh needs, CCSC_SERVE_MESH_STRICT
+    (default on) refuses with the forced-host-device recipe; 0 falls
+    back to a single-device engine instead of dying."""
+    import math
+
+    from ..utils import env as _envmod
+    from ..utils import validate
+
+    shape = serve_cfg.mesh_shape
+    if shape == ():
+        # explicitly single-device (the bench's baseline engine):
+        # the env knob must not re-arm it
+        return None, None, None
+    if shape is None:
+        spec = _envmod.env_str("CCSC_SERVE_MESH")
+        if not spec:
+            return None, None, None
+        try:
+            shape = parse_mesh_shape(spec)
+        except ValueError as e:
+            raise validate.CCSCInputError(str(e))
+    import jax
+
+    need = math.prod(shape)
+    devs = jax.devices()
+    if serve_cfg.mesh_devices is not None:
+        missing = [i for i in serve_cfg.mesh_devices if i >= len(devs)]
+        if missing:
+            raise validate.CCSCInputError(
+                f"mesh_devices {serve_cfg.mesh_devices} names device "
+                f"index(es) {missing} but only {len(devs)} device(s) "
+                "are visible"
+            )
+        devs = [devs[i] for i in serve_cfg.mesh_devices]
+    if len(devs) < need:
+        msg = (
+            f"serving mesh {shape} needs {need} device(s) but only "
+            f"{len(devs)} are visible — on CPU run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need}, shrink "
+            "the mesh, or set CCSC_SERVE_MESH_STRICT=0 to fall back "
+            "to a single-device engine"
+        )
+        if _envmod.env_flag("CCSC_SERVE_MESH_STRICT"):
+            raise validate.CCSCInputError(msg)
+        return None, None, f"serve: {msg}; serving single-device"
+    from ..parallel import mesh as mesh_mod
+
+    if len(shape) == 1:
+        mesh = mesh_mod.block_mesh(devices=devs[:need])
+    else:
+        mesh = mesh_mod.block_freq_mesh(
+            shape[0], shape[1], devices=devs[:need]
+        )
+    return mesh, shape, None
+
+
 class ServedResult(NamedTuple):
     """One request's result, cropped back to the request shape."""
 
@@ -248,6 +332,15 @@ class CodecEngine:
         if blur_psf is not None:
             validate.check_finite("blur_psf", blur_psf)
 
+        # device mesh (the big-iron replica): ServeConfig.mesh_shape
+        # or CCSC_SERVE_MESH shards every bucket program's slot axis
+        # (and optionally the per-frequency solves) over a mesh via
+        # shard_map — resolved BEFORE telemetry/tuning so the run
+        # meta and the tuned-knob key both carry the real topology
+        self._mesh, self._mesh_shape, mesh_note = _resolve_mesh(
+            serve_cfg
+        )
+
         # SLO layer (serve.slo): streaming latency histograms per
         # phase + declared targets, checked on the dispatch path; a
         # breach may arm a one-shot xprof capture of the next dispatch
@@ -278,17 +371,26 @@ class CodecEngine:
             # stream harvests them (once) — N replica monitors would
             # each record every sibling's compiles and cache hits
             compile_monitor=serve_cfg.replica_id is None,
+            mesh=self._mesh,
             buckets=[
                 {"slots": s, "spatial": list(sp)}
                 for s, sp in serve_cfg.buckets
             ],
             compile_cache=self.cache_dir,
+            # the replica's device topology, queryable from the
+            # stream alone (obs_report SERVING)
+            serve_devices=self.devices,
+            serve_mesh=(
+                list(self._mesh_shape) if self._mesh_shape else None
+            ),
             problem={
                 "pad": prob.pad,
                 "dirac": prob.dirac,
                 "data_term": prob.data_term,
             },
         )
+        if mesh_note:
+            self._run.console(mesh_note, tier="always")
 
         self._capture = None
         self._cap_seq = 0
@@ -320,13 +422,20 @@ class CodecEngine:
                     workload=tune_store.solve_workload(geom),
                     store=tune_store.TunedStore(serve_cfg.tune_store),
                     emit=self._run.event,
+                    # a mesh engine resolves under its own store key:
+                    # a single-device winner is a measurement of a
+                    # DIFFERENT program than the shard_map'd bucket
+                    mesh=self._mesh_shape,
                 )
                 self.cfg = cfg
             else:
                 self._tune_picked = None
             # the resolved knob dict every request is served under —
             # recorded per bucket warmup so the stream says which arm
-            # produced which program (obs_report SERVING section)
+            # produced which program (obs_report SERVING section).
+            # The device topology rides in it too: a mesh engine's
+            # serving records (and their perf-ledger knob digest) are
+            # a different configuration than a single-device engine's.
             from ..tune.space import arm_knob_dict
 
             self._knob_dict = dict(
@@ -334,6 +443,15 @@ class CodecEngine:
                 tune=serve_cfg.tune,
                 tuned=self._tune_picked is not None,
             )
+            if self._mesh_shape:
+                # only mesh engines carry the topology keys: a
+                # single-device engine's knob dict (and therefore its
+                # perf-ledger knob digest / history key) stays exactly
+                # the pre-mesh one
+                self._knob_dict["devices"] = self.devices
+                self._knob_dict["mesh"] = "x".join(
+                    str(a) for a in self._mesh_shape
+                )
             if serve_cfg.replica_id is None:
                 # standalone engines capture their own workload; a
                 # fleet replica's stream is captured ONCE at the
@@ -390,7 +508,12 @@ class CodecEngine:
             raise
 
     def _build(self, d, prob, cfg, serve_cfg, blur_psf):
-        from ..models.reconstruct import _reconstruct_impl, build_plan
+        from ..models.reconstruct import (
+            ReconResult,
+            ReconTrace,
+            _reconstruct_impl,
+            build_plan,
+        )
 
         import jax
         import jax.numpy as jnp
@@ -398,20 +521,63 @@ class CodecEngine:
         geom = self.geom
         self._jnp = jnp
         reduce_shape = geom.reduce_shape
+        mesh = self._mesh
+        has_freq = mesh is not None and "freq" in mesh.axis_names
+        nf = mesh.shape["freq"] if has_freq else 1
 
         def _slot(b1, m1, s1, x1, plan):
             # one request = one n=1 solve: per-request gamma,
             # objective/PSNR traces, and tol termination — the vmapped
             # while_loop freezes converged slots, so slot results are
-            # bit-identical to a standalone reconstruct() call
+            # bit-identical to a standalone reconstruct() call. On a
+            # 2-D mesh the slot's per-frequency solves additionally
+            # shard over the 'freq' axis (the plan's precomputed
+            # factors are sliced per device — same bits per bin).
             return _reconstruct_impl(
                 b1[None], None, prob, cfg, m1[None], s1[None], None,
                 x1[None], plan=plan,
+                freq_axis_name="freq" if has_freq else None,
+                num_freq_shards=nf,
             )
 
-        def _bucket_program(bb, mm, ss, xx, plan):
+        def _vmapped(bb, mm, ss, xx, plan):
             return jax.vmap(_slot, in_axes=(0, 0, 0, 0, None))(
                 bb, mm, ss, xx, plan
+            )
+
+        if mesh is None:
+            _bucket_program = _vmapped
+        else:
+            # the mesh bucket program: the slot axis sharded over the
+            # mesh's first axis via shard_map — each device runs the
+            # SAME vmap-of-independent-n=1-solves body over its
+            # slots/batch shard, with the plan (spectra + solve
+            # factors) replicated. No cross-slot collectives exist in
+            # the body, so per-slot results are bit-identical to the
+            # single-device program's (tests/test_serve_mesh.py); the
+            # optional 'freq' axis adds per-slot tensor parallelism
+            # with one tiled all_gather per iteration (the learner's
+            # block_freq_mesh scheme).
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import shard_map
+
+            axis = mesh.axis_names[0]
+            bs, rep = P(axis), P()
+            _bucket_program = shard_map(
+                _vmapped,
+                mesh=mesh,
+                in_specs=(bs, bs, bs, bs, rep),
+                # every result leaf carries the slot axis first
+                # (vmap), sharded like the inputs; traces are
+                # per-slot too, so nothing is replicated back
+                out_specs=ReconResult(
+                    bs, bs, ReconTrace(bs, bs, bs, bs)
+                ),
+                # the while_loop carry mixes varying (data-derived)
+                # and invarying (zero-init) components; skip vma
+                # tracking like the learner's sharded solver
+                check_vma=False,
             )
 
         # ---- per-bucket plans + AOT-compiled programs --------------
@@ -424,7 +590,15 @@ class CodecEngine:
         for slots, spatial in self._buckets:
             key = (slots, spatial)
             t0 = time.perf_counter()
-            plan = build_plan(d, prob, cfg, spatial, blur_psf=blur_psf)
+            plan = build_plan(
+                d, prob, cfg, spatial, blur_psf=blur_psf,
+                # mesh compatibility is refused at plan build — batch
+                # axis vs this bucket's slots, freq axis vs the FFT
+                # domain — with the whole bucket table in the error
+                mesh_shape=self._mesh_shape,
+                slots=slots,
+                buckets=self._buckets,
+            )
             self._plans[key] = plan
             fn = jax.jit(_bucket_program)
             if serve_cfg.aot_warmup:
@@ -441,6 +615,11 @@ class CodecEngine:
                 bucket=_bucket_name(slots, spatial),
                 aot=bool(serve_cfg.aot_warmup),
                 warmup_s=round(time.perf_counter() - t0, 4),
+                devices=self.devices,
+                mesh=(
+                    list(self._mesh_shape) if self._mesh_shape
+                    else None
+                ),
                 # the resolved knob dict, not just the bucket shape:
                 # the stream must say which arm this program serves
                 # under (a tuned engine and a default engine emit
@@ -453,11 +632,21 @@ class CodecEngine:
             n_buckets=len(self._buckets),
             warmup_s=round(time.perf_counter() - t_warm0, 4),
             persistent_cache_hits=mon.cache_hits if mon else None,
+            devices=self.devices,
+            mesh=(
+                list(self._mesh_shape) if self._mesh_shape else None
+            ),
             knobs=self._knob_dict,
         )
         self._run.console(
             f"serve: {len(self._buckets)} bucket(s) ready in "
             f"{time.perf_counter() - t_warm0:.2f}s"
+            + (
+                f" (mesh {'x'.join(str(a) for a in self._mesh_shape)}"
+                f", {self.devices} devices)"
+                if self._mesh_shape
+                else ""
+            )
             + (
                 f" (compile cache {self.cache_dir})"
                 if self.cache_dir
@@ -886,6 +1075,21 @@ class CodecEngine:
         more work. The engine may still be draining when this flips;
         ``close()`` from any thread blocks until the drain finishes."""
         return self._close_started
+
+    @property
+    def devices(self) -> int:
+        """Number of devices this engine's bucket programs execute
+        on (1 for a single-device engine) — the weight the fleet's
+        derived admission ceiling and ``capacity_hint`` scale by."""
+        return (
+            int(self._mesh.size) if self._mesh is not None else 1
+        )
+
+    @property
+    def mesh_shape(self) -> Optional[Tuple[int, ...]]:
+        """The resolved serving-mesh shape ((batch,) or
+        (batch, freq)), or None for a single-device engine."""
+        return self._mesh_shape
 
     @property
     def last_it_rate(self) -> float:

@@ -84,7 +84,13 @@ from ..utils import env as _env
 from ..utils import trace as trace_util
 from . import capture as _capture
 from . import slo as _slo
-from .engine import CodecEngine, ServedResult, _bucket_name, pick_bucket
+from .engine import (
+    CodecEngine,
+    ServedResult,
+    _bucket_name,
+    parse_mesh_shape,
+    pick_bucket,
+)
 
 __all__ = ["ServeFleet", "Overloaded", "RUNGS"]
 
@@ -213,6 +219,104 @@ class ServeFleet:
         self._total_slots = sum(s for s, _ in self.buckets)
         self._take_cap = max(s for s, _ in self.buckets)
 
+        # heterogeneous replica shapes (FleetConfig.replica_meshes:
+        # per-replica mesh shape or None; default = every replica
+        # inherits ServeConfig.mesh_shape, resolving the
+        # CCSC_SERVE_MESH env fallback HERE — N engines each
+        # resolving the knob themselves would all land on the same
+        # default device prefix while the capacity math counted them
+        # as distinct hardware). Entries are normalized to a concrete
+        # shape or () (the explicit single-device pin), so replica
+        # topology is frozen at fleet construction and restarts
+        # rebuild exactly it. Mesh replicas get DISJOINT device
+        # slices — a pool that cannot supply them is refused up
+        # front (CCSC_SERVE_MESH_STRICT, default on): overlapping
+        # slices would let capacity_hint / the derived admission
+        # ceiling credit devices that do not exist.
+        import math as _math
+
+        default_mesh = serve_cfg.mesh_shape
+        env_malformed = False
+        if default_mesh is None:
+            spec = _env.env_str("CCSC_SERVE_MESH")
+            if spec:
+                try:
+                    default_mesh = parse_mesh_shape(spec)
+                except ValueError:
+                    # keep the entries None (NOT the () pin) so each
+                    # engine's own resolution re-parses the malformed
+                    # spec and refuses with the named CCSCInputError
+                    # — a typo'd knob must error, never silently
+                    # serve at 1/prod(mesh) capacity
+                    env_malformed = True
+        if fleet_cfg.replica_meshes is not None:
+            self._replica_mesh = [
+                tuple(m) if m else () for m in fleet_cfg.replica_meshes
+            ]
+        elif env_malformed:
+            self._replica_mesh = [None] * fleet_cfg.replicas
+        else:
+            self._replica_mesh = [
+                tuple(default_mesh) if default_mesh else ()
+            ] * fleet_cfg.replicas
+        self._replica_devices: List[Optional[tuple]] = (
+            [None] * fleet_cfg.replicas
+        )
+        if any(m for m in self._replica_mesh):
+            import jax
+
+            # the allocation POOL: an operator-pinned
+            # ServeConfig.mesh_devices (e.g. steering the fleet off
+            # devices a colocated learner owns) is honored as the
+            # pool the slices are cut from — a standalone engine
+            # honors the pin, so moving to a fleet must not silently
+            # change which silicon serves
+            if serve_cfg.mesh_devices is not None:
+                pool = list(serve_cfg.mesh_devices)
+            else:
+                pool = list(range(len(jax.devices())))
+            off = 0
+            short: List[int] = []
+            for rid, shape in enumerate(self._replica_mesh):
+                if not shape:
+                    continue
+                need = _math.prod(shape)
+                if off + need <= len(pool):
+                    self._replica_devices[rid] = tuple(
+                        pool[off:off + need]
+                    )
+                    off += need
+                else:
+                    short.append(rid)
+            if short and _env.env_flag("CCSC_SERVE_MESH_STRICT"):
+                from ..utils import validate
+
+                total_need = sum(
+                    _math.prod(m)
+                    for m in self._replica_mesh
+                    if m
+                )
+                pool_desc = (
+                    f"the pinned mesh_devices pool {tuple(pool)}"
+                    if serve_cfg.mesh_devices is not None
+                    else f"the {len(pool)} visible device(s)"
+                )
+                raise validate.CCSCInputError(
+                    f"replica meshes "
+                    f"{[m or None for m in self._replica_mesh]} need "
+                    f"{total_need} device(s) for disjoint slices but "
+                    f"{pool_desc} cannot supply them (replica(s) "
+                    f"{short} left without a slice) — shrink the "
+                    "meshes or replica count, force more host "
+                    "devices (XLA_FLAGS=--xla_force_host_platform_"
+                    f"device_count={total_need} on CPU), or set "
+                    "CCSC_SERVE_MESH_STRICT=0 to let slices overlap "
+                    "(the admission ceiling then over-credits the "
+                    "shared devices)"
+                )
+            # non-strict: the short replicas fall back to the engine's
+            # default device prefix (overlapping a sibling)
+
         self._cv = threading.Condition()
         self._queue: Deque[_FleetRequest] = deque()
         self._index: Dict[str, _FleetRequest] = {}  # queued/assigned
@@ -296,6 +400,13 @@ class ServeFleet:
                 replica_id=None,
                 replicas=fleet_cfg.replicas,
                 queue_ceiling=self._ceiling,
+                # per-replica device topology: a mixed mesh /
+                # single-device fleet is readable from this one record
+                replica_devices=[
+                    rep.engine.devices if rep is not None else None
+                    for rep in self._replicas
+                ],
+                total_devices=self.total_devices,
                 ceiling_source=(
                     "explicit" if fleet_cfg.max_queue_depth
                     else "static_floor"
@@ -488,6 +599,15 @@ class ServeFleet:
             # replica engines never capture: the fleet records the
             # workload once at admission
             capture_dir=None,
+            # this replica's device topology (heterogeneous fleets:
+            # FleetConfig.replica_meshes; restarts reuse the same
+            # disjoint device slice)
+            mesh_shape=self._replica_mesh[rid],
+            mesh_devices=(
+                self._replica_devices[rid]
+                if self._replica_mesh[rid]
+                else None
+            ),
             metrics_dir=(
                 None if self.fleet_cfg.metrics_dir is None
                 else os.path.join(
@@ -1157,6 +1277,7 @@ class ServeFleet:
                         served=rep.served, inflight=len(rep.assigned),
                         queue_depth=depth,
                         restarts=self._restarts.get(rep.id, 0),
+                        devices=rep.engine.devices,
                     )
             # fleet-wide SLO check (serve.slo): submit->result
             # latency vs the declared targets, plus the periodic
@@ -1172,23 +1293,28 @@ class ServeFleet:
         live = [
             r for r in reps if r is not None and r.state == "live"
         ]
-        it_rate = max(
-            (r.engine.last_it_rate for r in live), default=0.0
-        )
-        if it_rate <= 0:
-            return
-        # the EFFECTIVE solve budget: rung 3 recycles replicas onto
-        # max_it x degrade_max_it_factor, which raises real request
-        # throughput — the ceiling and retry-after must credit the
-        # capacity the degrade bought, or admission keeps rejecting
-        # exactly the load the ladder degraded itself to carry
-        bound = perfmodel.serving_bound(
-            it_rate,
+        # per-replica bounds, device-count aware: each live replica
+        # contributes its OWN measured rate; an unmeasured one is
+        # credited at the best measured per-device rate times its
+        # device count (perfmodel.fleet_serving_bound) — a mesh
+        # replica is a multiple of a single-device replica's
+        # capacity, and a ceiling that counted replicas instead of
+        # devices would reject exactly the load the mesh bought.
+        # The EFFECTIVE solve budget still applies: rung 3 recycles
+        # replicas onto max_it x degrade_max_it_factor, which raises
+        # real request throughput.
+        bound = perfmodel.fleet_serving_bound(
+            [
+                (r.engine.last_it_rate, r.engine.devices)
+                for r in live
+            ],
             max(1, self._engine_cfg(self._degraded).max_it),
             self._total_slots,
             occupancy=1.0,
         )
-        self._bound_rps = bound["requests_per_sec"] * max(1, len(live))
+        if bound["measured"] == 0:
+            return
+        self._bound_rps = bound["requests_per_sec"]
         derived = max(
             self.fleet_cfg.min_queue_depth,
             int(self._bound_rps * self.fleet_cfg.max_queue_s),
@@ -1203,6 +1329,7 @@ class ServeFleet:
                 "fleet_ceiling", replica_id=None, ceiling=derived,
                 bound_requests_per_sec=round(self._bound_rps, 3),
                 live_replicas=len(live),
+                live_devices=sum(r.engine.devices for r in live),
                 source="serving_bound",
             )
 
@@ -1367,11 +1494,26 @@ class ServeFleet:
         return self._close_started
 
     @property
+    def total_devices(self) -> int:
+        """Devices across all replica engines (a single-device
+        replica counts 1, a mesh replica prod(mesh_shape))."""
+        return sum(
+            rep.engine.devices
+            for rep in self._replicas
+            if rep is not None
+        ) or self.fleet_cfg.replicas
+
+    @property
     def capacity_hint(self) -> int:
-        """Total concurrent request slots across replicas — the
+        """Total concurrent request capacity across replicas — the
         natural claim-batch bound for a drain worker feeding this
-        fleet from an external queue (serve.federation)."""
-        return self._total_slots * self.fleet_cfg.replicas
+        fleet from an external queue (serve.federation). Counts MESH
+        slots: a replica sharded over D devices turns a bucket
+        dispatch around ~D times faster, so it sustains ~D
+        single-device replicas' worth of claimed work — an
+        all-single-device fleet keeps the historical
+        slots x replicas value exactly."""
+        return self._total_slots * self.total_devices
 
     @property
     def queue_ceiling(self) -> int:
@@ -1591,6 +1733,12 @@ class ServeFleet:
                     "generation": r.generation,
                     "served": r.served,
                     "restarts": self._restarts.get(r.id, 0),
+                    "devices": r.engine.devices,
+                    "mesh": (
+                        list(r.engine.mesh_shape)
+                        if r.engine.mesh_shape
+                        else None
+                    ),
                 }
                 for r in self._replicas
             ]
@@ -1644,6 +1792,11 @@ class ServeFleet:
                 {},
             )
             knobs["replicas"] = len(self._replicas)
+            if self.total_devices > len(self._replicas):
+                # only a meshed fleet carries the topology key: an
+                # all-single-device fleet's knob digest (its ledger
+                # history key) stays exactly the pre-mesh one
+                knobs["total_devices"] = self.total_devices
             _spatial = max(
                 (sp for _s_, sp in self.buckets),
                 key=lambda sp: tuple(sp),
@@ -1758,6 +1911,7 @@ class ServeFleet:
                         generation=rep.generation, served=rep.served,
                         inflight=len(rep.assigned), queue_depth=depth,
                         restarts=self._restarts.get(rep.id, 0),
+                        devices=rep.engine.devices,
                         final=True,
                     )
                     for rep in self._replicas

@@ -373,10 +373,16 @@ def resolve_solve(
     store: Optional[store_mod.TunedStore] = None,
     emit: Optional[Callable] = None,
     guard=None,
+    mesh=None,
 ):
     """Resolve a SolveConfig under its ``tune`` mode (no-op for
     'off'). ``spatial`` is the observation spatial shape (a serving
-    engine passes its largest bucket). Returns (cfg, picked)."""
+    engine passes its largest bucket). ``mesh`` is the serving-mesh
+    shape when the caller's programs are shard_map'd
+    (ServeConfig.mesh_shape): it suffixes the store key so a
+    single-device winner is never blindly applied to a sharded
+    program — the mesh configuration sweeps and accrues its own
+    entries. Returns (cfg, picked)."""
     if cfg.tune == "off":
         return cfg, None
     store = store or store_mod.TunedStore()
@@ -385,11 +391,12 @@ def resolve_solve(
         k=geom.num_filters,
         support=geom.spatial_support,
         spatial=tuple(int(s) for s in spatial),
+        mesh=mesh,
     )
     if cfg.tune == "sweep":
         sweep_solve(
             cfg, geom, spatial, workload=workload, chip=chip,
-            store=store, emit=emit,
+            store=store, emit=emit, mesh=mesh,
         )
     new_cfg, picked, _ = _resolve(
         "solve", cfg, key, workload, chip, store, emit, guard
@@ -597,15 +604,21 @@ def sweep_solve(
     timer: Optional[Callable] = None,
     d=None,
     reps: int = 2,
+    mesh=None,
 ) -> store_mod.TunedStore:
-    """Solve-side sweep at one bucket shape (see sweep_learn)."""
+    """Solve-side sweep at one bucket shape (see sweep_learn).
+    ``mesh`` suffixes the store key like resolve_solve's — a sweep
+    for a sharded serving program ranks arms under its own key.
+    (The timing probe itself runs the single-program solve; the
+    numerics guard and the engine's own measured dispatch rate keep
+    a mesh-keyed arm honest.)"""
     emit = emit or _default_emit
     chip = chip or chip_now()
     store = store or store_mod.TunedStore()
     spatial = tuple(int(s) for s in spatial)
     key = store_mod.solve_shape_key(
         workload, k=geom.num_filters, support=geom.spatial_support,
-        spatial=spatial,
+        spatial=spatial, mesh=mesh,
     )
     if timer is None and d is None:
         import jax.numpy as jnp

@@ -98,15 +98,25 @@ def learn_shape_key(
     )
 
 
-def solve_shape_key(workload: str, *, k: int, support, spatial) -> str:
-    """Shape bucket of a reconstruction/serving problem."""
+def solve_shape_key(
+    workload: str, *, k: int, support, spatial, mesh=None
+) -> str:
+    """Shape bucket of a reconstruction/serving problem. ``mesh``
+    (a serving-mesh shape tuple, serve.CodecEngine) suffixes the key:
+    a sharded program is a DIFFERENT configuration — a knob that wins
+    on one device is not automatically the winner for a shard_map'd
+    bucket, so mesh engines accrue and resolve their own entries
+    instead of blindly inheriting single-device winners."""
     sup = "x".join(
         str(s) for s in (
             support if isinstance(support, (tuple, list)) else [support]
         )
     )
     sz = "x".join(str(_pow2_bucket(s)) for s in spatial)
-    return f"{workload}:k{k}:s{sup}:sz{sz}"
+    key = f"{workload}:k{k}:s{sup}:sz{sz}"
+    if mesh:
+        key += ":m" + "x".join(str(int(a)) for a in mesh)
+    return key
 
 
 def _key(chip: str, kind: str, shape_key: str) -> str:

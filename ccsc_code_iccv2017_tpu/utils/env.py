@@ -100,6 +100,17 @@ REGISTRY: Dict[str, Knob] = _knobs(
     ("CCSC_COMPILE_CACHE", "path", None, "serve.engine, tune.store",
      "persistent XLA compilation cache dir (warm restarts skip "
      "backend compiles)"),
+    ("CCSC_SERVE_MESH", "str", None,
+     "serve.engine, serve.bench, apps/serve.py",
+     "serving-mesh shape 'BATCH' or 'BATCHxFREQ' (e.g. '8', '4x2'): "
+     "every bucket program's slot axis is sharded over a device mesh "
+     "via shard_map (fallback of ServeConfig.mesh_shape; mesh_shape="
+     "() pins an engine single-device regardless); every bucket's "
+     "slots must divide by BATCH"),
+    ("CCSC_SERVE_MESH_STRICT", "flag", True, "serve.engine",
+     "refuse a serving mesh the visible device pool cannot back "
+     "(with the forced-host-device recipe in the error); 0 falls "
+     "back to a single-device engine with a console note instead"),
     # -- workload capture + replay (serve.capture, serve.replay) -----
     ("CCSC_CAPTURE_DIR", "path", None,
      "serve.capture, serve.fleet, serve.engine",

@@ -311,6 +311,49 @@ def serving_bound(
     }
 
 
+def fleet_serving_bound(
+    replicas,
+    iters_per_request: float,
+    slots: int,
+    occupancy: float = 1.0,
+) -> Dict[str, float]:
+    """Aggregate requests/sec bound of a HETEROGENEOUS serving fleet
+    (serve.ServeFleet with mesh and single-device replicas mixed).
+
+    ``replicas``: one ``(iters_per_sec, devices)`` pair per live
+    replica — its newest measured batched-solve iteration rate
+    (0.0 before any dispatch) and the device count of its bucket
+    programs (1 for a single-device engine, ``prod(mesh_shape)`` for
+    a mesh replica). Each replica contributes its own
+    :func:`serving_bound`; a replica with no measurement yet is
+    credited at the best measured PER-DEVICE rate times its own
+    device count — the device-count scaling that keeps a mixed
+    fleet's derived admission ceiling honest (a v5e-8 mesh replica
+    is ~8 single-device replicas of capacity, and crediting it as 1
+    would reject exactly the load it exists to carry).
+
+    ``{"requests_per_sec": 0.0, "measured": 0}`` until any replica
+    has measured — the caller keeps its static floor then."""
+    entries = [
+        (max(0.0, float(r)), max(1, int(d))) for r, d in replicas
+    ]
+    measured = [(r, d) for r, d in entries if r > 0]
+    if not measured:
+        return {"requests_per_sec": 0.0, "measured": 0}
+    per_dev = max(r / d for r, d in measured)
+    total = 0.0
+    for r, d in entries:
+        rate = r if r > 0 else per_dev * d
+        total += serving_bound(
+            rate, iters_per_request, slots, occupancy
+        )["requests_per_sec"]
+    return {
+        "requests_per_sec": total,
+        "measured": len(measured),
+        "per_device_iters_per_sec": per_dev,
+    }
+
+
 def utilization(
     cost: Dict[str, float], steps_per_sec: float, chip: Optional[str] = None
 ) -> Dict[str, float]:

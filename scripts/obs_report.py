@@ -525,6 +525,54 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
                 lines.append(
                     f"  served under  {json.dumps(w['knobs'])}"
                 )
+            # per-replica device topology (newest serve_ready per
+            # replica): a mesh replica serves its buckets from
+            # prod(mesh_shape) devices via shard_map
+            topo = {}
+            for e in warm:
+                topo[e.get("replica_id", 0)] = e
+            if len(topo) > 1 or any(
+                (t.get("devices") or 1) > 1 for t in topo.values()
+            ):
+                for rid in sorted(topo, key=lambda r: (r is None, r)):
+                    t = topo[rid]
+                    mesh = t.get("mesh")
+                    lines.append(
+                        f"  replica {rid}: "
+                        f"{t.get('devices') or 1} device(s)"
+                        + (
+                            "  mesh "
+                            + "x".join(str(a) for a in mesh)
+                            if mesh
+                            else "  single-device"
+                        )
+                    )
+            # mixed-fleet ceiling sanity: with mesh and single-device
+            # replicas in one fleet, the derived admission bound must
+            # credit each replica's device count
+            # (perfmodel.fleet_serving_bound) — live throughput
+            # EXCEEDING the derived bound by >20% means the ceiling
+            # math under-counted somebody's devices and is rejecting
+            # load the fleet demonstrably carries
+            dev_set = {t.get("devices") or 1 for t in topo.values()}
+            ceils = by.get("fleet_ceiling", [])
+            freq_evs = by.get("fleet_request", [])
+            if len(dev_set) > 1 and ceils and len(freq_evs) >= 2:
+                bound = ceils[-1].get("bound_requests_per_sec") or 0.0
+                ts = [e.get("t", 0.0) for e in freq_evs]
+                span = max(ts) - min(ts)
+                achieved = (
+                    (len(freq_evs) - 1) / span if span > 0 else 0.0
+                )
+                if bound > 0 and achieved > 1.2 * bound:
+                    lines.append(
+                        f"  CEILING MISMATCH  live throughput "
+                        f"{achieved:.2f} req/s exceeds the derived "
+                        f"bound {bound:.2f} req/s by >20% on a mixed "
+                        "mesh/single-device fleet — the admission "
+                        "ceiling is under-crediting device counts "
+                        "(utils.perfmodel.fleet_serving_bound)"
+                    )
         if summary and summary.get("persistent_cache_hits") is not None:
             lines.append(
                 f"  compile cache {summary['persistent_cache_hits']} "

@@ -71,6 +71,14 @@ def main(argv=None):
         "solve arms on this chip first, 'auto' applies the tuned "
         "store entry) and record the default-vs-tuned gap",
     )
+    ap.add_argument(
+        "--mesh", default=None, metavar="BATCH[xFREQ]",
+        help="also run a MESH engine on the same stream "
+        "(CCSC_SERVE_MESH; ServeConfig.mesh_shape — the bucket's "
+        "slots sharded over a device mesh via shard_map, e.g. '4' "
+        "or '4x2') and record the default-vs-mesh gap; on CPU run "
+        "under XLA_FLAGS=--xla_force_host_platform_device_count=N",
+    )
     args = ap.parse_args(argv)
     if args.requests is not None:
         os.environ["CCSC_SERVE_REQUESTS"] = str(args.requests)
@@ -78,6 +86,8 @@ def main(argv=None):
         os.environ["CCSC_SERVE_HOMOG"] = "1"
     if args.tune is not None:
         os.environ["CCSC_SERVE_TUNE"] = args.tune
+    if args.mesh is not None:
+        os.environ["CCSC_SERVE_MESH"] = args.mesh
 
     from ccsc_code_iccv2017_tpu.serve.bench import run_serve_workload
     from ccsc_code_iccv2017_tpu.utils import obs
@@ -117,6 +127,15 @@ def main(argv=None):
             f"max rel err vs loop {rec['tuned_max_rel_err_vs_loop']}) "
             f"under {rec['tuned_knobs']}"
         )
+    if "mesh_requests_per_sec" in rec:
+        print(
+            f"mesh engine ({rec['mesh']}, {rec['mesh_devices']} "
+            f"devices) {rec['mesh_requests_per_sec']} req/s "
+            f"({rec['speedup_mesh_vs_default']}x the default engine; "
+            f"max rel err vs loop {rec['mesh_max_rel_err_vs_loop']})"
+        )
+    elif rec.get("mesh_skipped"):
+        print(f"mesh arm skipped: {rec['mesh_skipped']}")
     return rec
 
 

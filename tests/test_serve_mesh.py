@@ -1,0 +1,762 @@
+"""Mesh-sharded serving replicas (ISSUE 14): a bucket's slots served
+from a device mesh via shard_map, not a single core.
+
+Contracts under test (all on the conftest's 8 forced host devices —
+the same virtual pod MULTICHIP_r05.json proved sharded-reconstruct
+parity on):
+
+- EXACT-BUCKET BIT-IDENTITY: a mesh engine's result at a bucket shape
+  equals the single-device engine's BITWISE — recon, trace values,
+  stopping iteration — for both (batch,) and (batch, freq) meshes
+  (each slot stays its own n=1 solve; the plan's per-frequency solve
+  factors are replicated and sliced per device);
+- padded-bucket requests match the exact-shape solve on the valid
+  region to the same boundary tolerance as the single-device engine;
+- ZERO compiles after warmup, from the obs stream, and the stream
+  records the replica's device topology (serve_ready devices/mesh);
+- actionable refusals: ServeConfig/build_plan refuse a mesh whose
+  batch axis does not divide a bucket's slots (bucket list in the
+  error); reconstruct(plan=..., mesh=...) points at the engine path;
+  a mesh the device pool cannot back names the forced-host-device
+  recipe (CCSC_SERVE_MESH_STRICT=0 falls back single-device);
+- FLEET: a mesh replica among single-device replicas — kill it
+  mid-stream; zero lost, results bit-identical, the casualty rejoins
+  on the same device slice; capacity_hint counts mesh devices and
+  the derived admission ceiling scales by per-replica device count
+  (utils.perfmodel.fleet_serving_bound);
+- LEDGER: the bench's mesh arm lands as its OWN knob-digest
+  configuration, and perf_gate judges an injected 0.5x record
+  against the mesh key's history (exit-1 class verdict).
+"""
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import (
+    FleetConfig,
+    ProblemGeom,
+    ServeConfig,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+    build_plan,
+    reconstruct,
+)
+from ccsc_code_iccv2017_tpu.serve import CodecEngine, ServeFleet
+from ccsc_code_iccv2017_tpu.utils import faults, obs
+from ccsc_code_iccv2017_tpu.utils.validate import CCSCInputError
+
+needs8 = pytest.mark.skipif(
+    jax.device_count() < 8,
+    reason="needs 8 (forced host) devices — run under XLA_FLAGS="
+    "--xla_force_host_platform_device_count=8",
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolation(monkeypatch):
+    for v in (
+        "CCSC_SERVE_MESH",
+        "CCSC_SERVE_MESH_STRICT",
+        "CCSC_FAULT_ENGINE_KILL_REQ",
+        "CCSC_FAULT_ENGINE_KILL_REPLICA",
+        "CCSC_WATCHDOG_MIN_S",
+        "CCSC_WATCHDOG_COMPILE_S",
+        "CCSC_PERF_LEDGER",
+    ):
+        monkeypatch.delenv(v, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _bank(k=6, s=5, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, s, s)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return jnp.asarray(d)
+
+
+def _cfg(**kw):
+    base = dict(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=8, tol=1e-4,
+        verbose="none", track_objective=True, track_psnr=True,
+    )
+    base.update(kw)
+    return SolveConfig(**base)
+
+
+def _req(size, seed=1, keep=0.5):
+    r = np.random.default_rng(seed)
+    x = r.random((size, size)).astype(np.float32)
+    m = (r.random((size, size)) < keep).astype(np.float32)
+    return x, m
+
+
+def _engine(d, cfg, buckets, tmp_path=None, **kw):
+    scfg = ServeConfig(
+        buckets=buckets,
+        max_wait_ms=kw.pop("max_wait_ms", 10.0),
+        metrics_dir=str(tmp_path) if tmp_path is not None else None,
+        verbose="none",
+        **kw,
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    return CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
+
+
+def _serve_all(eng, reqs):
+    futs = [
+        eng.submit(x * m, mask=m, x_orig=x) for x, m in reqs
+    ]
+    return [f.result(timeout=300) for f in futs]
+
+
+# ------------------------------------------------------- exact parity
+
+
+@needs8
+@pytest.mark.parametrize("mesh_shape", [(2,), (2, 2), (4, 2)])
+def test_mesh_engine_bit_identical_on_exact_buckets(mesh_shape):
+    """The tentpole contract: the shard_map'd bucket program returns
+    BITWISE the single-device program's results — per-slot recon,
+    objective/PSNR traces, and stopping iteration — for batch-only
+    and batch x freq meshes. (Shapes here keep >= 2 slots per device:
+    XLA's batch-1 specialization can round ~1 ulp differently when a
+    mesh leaves a lone slot on a device.)"""
+    d = _bank()
+    cfg = _cfg()
+    slots = 2 * mesh_shape[0]  # keep >= 2 slots per device
+    buckets = ((slots, (24, 24)),)
+    reqs = [_req(24, seed=100 + i) for i in range(slots)]
+    ref_eng = _engine(d, cfg, buckets, mesh_shape=())
+    try:
+        ref = _serve_all(ref_eng, reqs)
+    finally:
+        ref_eng.close()
+    eng = _engine(d, cfg, buckets, mesh_shape=mesh_shape)
+    try:
+        assert eng.devices == int(np.prod(mesh_shape))
+        assert eng.mesh_shape == mesh_shape
+        res = _serve_all(eng, reqs)
+    finally:
+        eng.close()
+    for a, b in zip(ref, res):
+        np.testing.assert_array_equal(b.recon, a.recon)
+        np.testing.assert_array_equal(
+            np.asarray(b.trace.obj_vals), np.asarray(a.trace.obj_vals)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(b.trace.psnr_vals),
+            np.asarray(a.trace.psnr_vals),
+        )
+        assert int(b.trace.num_iters) == int(a.trace.num_iters)
+
+
+@needs8
+def test_mesh_padded_bucket_matches_exact_shape_on_valid_region():
+    """A request smaller than its bucket on a mesh engine: the pad
+    region is mask-excluded exactly as on a single device, so the
+    valid-region result matches the exact-shape direct solve to
+    boundary tolerance."""
+    d = _bank()
+    cfg = _cfg(max_it=20)
+    eng = _engine(d, cfg, ((4, (32, 32)),), mesh_shape=(2,))
+    try:
+        x, m = _req(26, seed=3)
+        res = eng.reconstruct(x * m, mask=m)
+        assert res.bucket == "4@32x32"
+        assert res.recon.shape == (26, 26)
+    finally:
+        eng.close()
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    direct = reconstruct(
+        jnp.asarray((x * m)[None]), d, ReconstructionProblem(geom),
+        cfg, mask=jnp.asarray(m[None]),
+    )
+    ref = np.asarray(direct.recon[0])
+    rel = np.abs(res.recon - ref).max() / max(np.abs(ref).max(), 1e-9)
+    assert rel < 0.05, rel
+
+
+@needs8
+def test_mesh_zero_compiles_after_warmup_and_topology_in_stream(
+    tmp_path,
+):
+    """Zero-compile serving holds for the shard_map'd programs too,
+    asserted from the obs stream; serve_warmup/serve_ready record the
+    replica's device topology."""
+    d = _bank()
+    eng = _engine(
+        d, _cfg(), ((4, (24, 24)),), tmp_path=tmp_path,
+        mesh_shape=(2, 2),
+    )
+    try:
+        t_ready = time.time()
+        for seed in (1, 5, 9):
+            x, m = _req(24, seed=seed)
+            eng.reconstruct(x * m, mask=m)
+        x, m = _req(20, seed=11)  # padded into the same bucket
+        eng.reconstruct(x * m, mask=m)
+    finally:
+        eng.close()
+    events = obs.read_events(str(tmp_path))
+    compiles = [e for e in events if e.get("type") == "compile"]
+    assert compiles, "warmup must have recorded compile events"
+    after = [e for e in compiles if e["t"] > t_ready]
+    assert after == [], [e.get("fun_name") for e in after]
+    ready = next(e for e in events if e.get("type") == "serve_ready")
+    assert ready["devices"] == 4
+    assert ready["mesh"] == [2, 2]
+    warm = [e for e in events if e.get("type") == "serve_warmup"]
+    assert all(w["devices"] == 4 for w in warm)
+    # the knob dict carries the topology: the perf-ledger key of a
+    # mesh engine's records is its own configuration
+    assert ready["knobs"]["devices"] == 4
+    assert ready["knobs"]["mesh"] == "2x2"
+    meta = next(e for e in events if e.get("type") == "run_meta")
+    assert meta.get("serve_devices") == 4
+
+
+# ---------------------------------------------------------- refusals
+
+
+def test_serveconfig_refuses_non_dividing_mesh_with_bucket_list():
+    with pytest.raises(ValueError, match=r"divide.*\(3, \(16, 16\)\)"):
+        ServeConfig(
+            buckets=((4, (24, 24)), (3, (16, 16))), mesh_shape=(2,)
+        )
+    # () is the explicit single-device pin, always valid
+    scfg = ServeConfig(buckets=((3, (16, 16)),), mesh_shape=())
+    assert scfg.mesh_shape == ()
+    with pytest.raises(ValueError, match="mesh_devices"):
+        ServeConfig(
+            buckets=((2, (16, 16)),), mesh_shape=(2,),
+            mesh_devices=(0,),
+        )
+    # spec STRINGS are refused — "12" iterated as characters would
+    # silently become a (1, 2) mesh
+    with pytest.raises(ValueError, match="is a string"):
+        ServeConfig(buckets=((2, (16, 16)),), mesh_shape="12")
+
+
+def test_build_plan_refuses_incompatible_mesh():
+    d = _bank()
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    prob = ReconstructionProblem(geom)
+    cfg = _cfg()
+    buckets = ((3, (16, 16)),)
+    with pytest.raises(ValueError, match=r"batch axis 2.*\(3, \(16, 16\)\)"):
+        build_plan(
+            d, prob, cfg, (16, 16), mesh_shape=(2,), slots=3,
+            buckets=buckets,
+        )
+    # freq axis must divide the FFT domain's bin count
+    with pytest.raises(ValueError, match="freq axis 7"):
+        build_plan(
+            d, prob, cfg, (16, 16), mesh_shape=(2, 7), slots=2,
+        )
+    # a compatible mesh builds the SAME plan arrays (replicated)
+    p_mesh = build_plan(
+        d, prob, cfg, (16, 16), mesh_shape=(2,), slots=4,
+        buckets=((4, (16, 16)),),
+    )
+    p_plain = build_plan(d, prob, cfg, (16, 16))
+    np.testing.assert_array_equal(
+        np.asarray(p_mesh.kern.dinv), np.asarray(p_plain.kern.dinv)
+    )
+
+
+def test_reconstruct_plan_mesh_refusal_points_at_engine_path():
+    from ccsc_code_iccv2017_tpu.parallel.mesh import block_mesh
+
+    d = _bank()
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    prob = ReconstructionProblem(geom)
+    cfg = _cfg()
+    plan = build_plan(d, prob, cfg, (16, 16))
+    x, m = _req(16)
+    with pytest.raises(ValueError, match="mesh_shape"):
+        reconstruct(
+            jnp.asarray((x * m)[None] * np.ones((2, 1, 1), np.float32)),
+            d, prob, cfg, mask=jnp.asarray(np.stack([m, m])),
+            mesh=block_mesh(2), plan=plan,
+        )
+
+
+def test_mesh_strict_refusal_names_recipe_and_nonstrict_falls_back(
+    monkeypatch,
+):
+    d = _bank()
+    with pytest.raises(
+        CCSCInputError, match="xla_force_host_platform_device_count"
+    ):
+        _engine(d, _cfg(), ((64, (16, 16)),), mesh_shape=(64,))
+    monkeypatch.setenv("CCSC_SERVE_MESH_STRICT", "0")
+    eng = _engine(d, _cfg(), ((64, (16, 16)),), mesh_shape=(64,))
+    try:
+        assert eng.devices == 1  # fell back single-device
+        assert eng.mesh_shape is None
+        x, m = _req(16)
+        assert eng.reconstruct(x * m, mask=m).recon.shape == (16, 16)
+    finally:
+        eng.close()
+
+
+@needs8
+def test_env_mesh_resolution_and_off_sentinel(monkeypatch):
+    """CCSC_SERVE_MESH arms a None-mesh_shape engine; mesh_shape=()
+    pins single-device even with the knob set (the bench baseline's
+    contract)."""
+    monkeypatch.setenv("CCSC_SERVE_MESH", "2")
+    d = _bank()
+    eng = _engine(d, _cfg(max_it=4), ((2, (16, 16)),))
+    try:
+        assert eng.devices == 2
+        assert eng.mesh_shape == (2,)
+    finally:
+        eng.close()
+    eng = _engine(d, _cfg(max_it=4), ((2, (16, 16)),), mesh_shape=())
+    try:
+        assert eng.devices == 1
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- fleet: mixed shapes
+
+
+@needs8
+def test_fleet_mixed_mesh_chaos_kill_zero_lost_bit_identical(
+    tmp_path, monkeypatch,
+):
+    """One mesh replica among single-device replicas; the MESH
+    replica is killed mid-stream. Zero requests lost, every result
+    bit-identical to an unfaulted single engine, and the casualty
+    rejoins on its own device slice with the same topology."""
+    # kill on the FIRST taken request: the mesh replica's dispatch is
+    # the slower one on faked CPU devices, so its sibling can drain
+    # the short stream before it ever takes a second batch
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_KILL_REQ", "1")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_KILL_REPLICA", "0")
+    monkeypatch.setenv("CCSC_WATCHDOG_MIN_S", "0.4")
+    monkeypatch.setenv("CCSC_WATCHDOG_COMPILE_S", "0.4")
+    faults.reset()
+    d = _bank(k=4, s=3)
+    cfg = _cfg(max_it=4, tol=0.0, track_psnr=False)
+    buckets = ((4, (12, 12)),)
+    reqs = [_req(12, seed=200 + i) for i in range(10)]
+
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    ref_eng = CodecEngine(
+        d, ReconstructionProblem(geom), cfg,
+        ServeConfig(buckets=buckets, max_wait_ms=2.0, verbose="none"),
+    )
+    try:
+        futs = [ref_eng.submit(x * m, mask=m) for x, m in reqs]
+        ref = [f.result(timeout=180) for f in futs]
+    finally:
+        ref_eng.close()
+
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), cfg,
+        ServeConfig(buckets=buckets, max_wait_ms=2.0, verbose="none"),
+        FleetConfig(
+            replicas=2,
+            replica_meshes=((2,), None),
+            min_queue_depth=64, restart_backoff_s=0.05,
+            heartbeat_s=0.2, health_interval_s=0.05, verbose="none",
+            metrics_dir=str(tmp_path),
+        ),
+    )
+    try:
+        assert fleet.total_devices == 3  # 2 (mesh) + 1
+        assert fleet.capacity_hint == 4 * 3
+        futs = [
+            fleet.submit(x * m, mask=m, key=f"k{i}")
+            for i, (x, m) in enumerate(reqs)
+        ]
+        res = [f.result(timeout=300) for f in futs]
+        assert len(res) == 10
+        for i in range(10):
+            np.testing.assert_array_equal(res[i].recon, ref[i].recon)
+            assert int(res[i].trace.num_iters) == int(
+                ref[i].trace.num_iters
+            )
+        # the mesh casualty rejoins — with its mesh topology intact
+        deadline = time.monotonic() + 120
+        live = []
+        while time.monotonic() < deadline:
+            st = fleet.stats()
+            live = [
+                r for r in st["replicas"]
+                if r is not None and r["state"] == "live"
+            ]
+            if len(live) == 2:
+                break
+            time.sleep(0.05)
+        assert len(live) == 2, st["replicas"]
+        rep0 = next(r for r in live if r["replica"] == 0)
+        assert rep0["generation"] >= 1  # restarted
+        assert rep0["devices"] == 2 and rep0["mesh"] == [2]
+        rep1 = next(r for r in live if r["replica"] == 1)
+        assert rep1["devices"] == 1 and rep1["mesh"] is None
+    finally:
+        fleet.close()
+
+    events = obs.read_events(str(tmp_path), recursive=True)
+    dead = [e for e in events if e["type"] == "fleet_replica_dead"]
+    assert any(e["replica_id"] == 0 for e in dead)
+    # exactly-once delivery of the original keys
+    served = [
+        e["key"] for e in events if e["type"] == "fleet_request"
+    ]
+    assert sorted(served) == sorted(f"k{i}" for i in range(10))
+    # heartbeats carry the per-replica device count
+    hb_dev = {
+        e["replica_id"]: e.get("devices")
+        for e in events
+        if e["type"] == "fleet_heartbeat"
+    }
+    assert hb_dev.get(0) == 2 and hb_dev.get(1) == 1
+    start = next(e for e in events if e["type"] == "fleet_start")
+    assert start["replica_devices"] == [2, 1]
+    assert start["total_devices"] == 3
+
+
+@needs8
+def test_mixed_fleet_disjoint_device_slices():
+    """Two mesh replicas get disjoint device index slices; restarts
+    would reuse the same slice (the allocation is per replica id)."""
+    d = _bank(k=4, s=3)
+    cfg = _cfg(max_it=2, tol=0.0, track_psnr=False)
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), cfg,
+        ServeConfig(
+            buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none",
+        ),
+        FleetConfig(
+            replicas=3,
+            replica_meshes=((2,), (2, 2), None),
+            min_queue_depth=16, verbose="none",
+        ),
+    )
+    try:
+        assert fleet._replica_devices[0] == (0, 1)
+        assert fleet._replica_devices[1] == (2, 3, 4, 5)
+        assert fleet._replica_devices[2] is None
+        assert fleet.total_devices == 2 + 4 + 1
+        assert fleet.capacity_hint == 2 * 7
+    finally:
+        fleet.close()
+
+
+@needs8
+def test_fleet_resolves_env_mesh_once_with_disjoint_slices(
+    monkeypatch,
+):
+    """CCSC_SERVE_MESH armed with mesh_shape=None: the FLEET resolves
+    the knob once and hands each replica an explicit shape + a
+    disjoint device slice — N engines each resolving the env default
+    prefix themselves would overlap devices while the capacity math
+    counted them as distinct hardware."""
+    monkeypatch.setenv("CCSC_SERVE_MESH", "2")
+    d = _bank(k=4, s=3)
+    cfg = _cfg(max_it=2, tol=0.0, track_psnr=False)
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), cfg,
+        ServeConfig(
+            buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none",
+        ),
+        FleetConfig(replicas=2, min_queue_depth=16, verbose="none"),
+    )
+    try:
+        assert fleet._replica_mesh == [(2,), (2,)]
+        assert fleet._replica_devices == [(0, 1), (2, 3)]
+        assert fleet.total_devices == 4
+    finally:
+        fleet.close()
+
+
+@needs8
+def test_fleet_refuses_meshes_the_pool_cannot_back_disjointly(
+    monkeypatch,
+):
+    """More mesh devices than the pool holds: strict (default)
+    refuses at construction — overlapping slices would let the
+    admission ceiling credit devices that do not exist;
+    CCSC_SERVE_MESH_STRICT=0 builds with overlap instead."""
+    d = _bank(k=4, s=3)
+    cfg = _cfg(max_it=2, tol=0.0, track_psnr=False)
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    scfg = ServeConfig(
+        buckets=((4, (12, 12)),), max_wait_ms=2.0, verbose="none",
+    )
+    with pytest.raises(CCSCInputError, match="disjoint"):
+        ServeFleet(
+            d, ReconstructionProblem(geom), cfg, scfg,
+            FleetConfig(
+                replicas=3,
+                replica_meshes=((4,), (4,), (2,)),  # needs 10 of 8
+                min_queue_depth=16, verbose="none",
+            ),
+        )
+    monkeypatch.setenv("CCSC_SERVE_MESH_STRICT", "0")
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), cfg, scfg,
+        FleetConfig(
+            replicas=2,
+            replica_meshes=((4,), (2, 4)),  # needs 12 of 8
+            min_queue_depth=16, verbose="none",
+        ),
+    )
+    try:
+        assert fleet._replica_devices == [(0, 1, 2, 3), None]
+        x, m = _req(12)
+        assert fleet.reconstruct(
+            x * m, mask=m, timeout=180
+        ).recon.shape == (12, 12)
+    finally:
+        fleet.close()
+
+
+@needs8
+def test_fleet_honors_operator_pinned_mesh_devices():
+    """ServeConfig.mesh_devices is the operator's word on which
+    silicon serves (e.g. steering off a colocated learner's
+    devices): a 1-replica fleet slices from exactly that pool — a
+    standalone engine honors the pin, so the fleet must too — and a
+    fleet whose meshes the pinned pool cannot back disjointly is
+    refused naming the pool."""
+    d = _bank(k=4, s=3)
+    cfg = _cfg(max_it=2, tol=0.0, track_psnr=False)
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none",
+        mesh_shape=(2,), mesh_devices=(4, 5),
+    )
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), cfg, scfg,
+        FleetConfig(replicas=1, min_queue_depth=16, verbose="none"),
+    )
+    try:
+        assert fleet._replica_devices == [(4, 5)]
+    finally:
+        fleet.close()
+    with pytest.raises(CCSCInputError, match="pinned mesh_devices"):
+        ServeFleet(
+            d, ReconstructionProblem(geom), cfg, scfg,
+            FleetConfig(
+                replicas=2, min_queue_depth=16, verbose="none",
+            ),
+        )
+
+
+def test_fleet_malformed_env_mesh_errors_instead_of_silent_single(
+    monkeypatch,
+):
+    """A typo'd CCSC_SERVE_MESH must refuse fleet construction with
+    the named error — never silently fall back to single-device
+    replicas at a fraction of the intended capacity."""
+    monkeypatch.setenv("CCSC_SERVE_MESH", "8,2")
+    d = _bank(k=4, s=3)
+    cfg = _cfg(max_it=2, tol=0.0, track_psnr=False)
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    with pytest.raises(CCSCInputError, match="mesh spec"):
+        ServeFleet(
+            d, ReconstructionProblem(geom), cfg,
+            ServeConfig(
+                buckets=((2, (12, 12)),), max_wait_ms=2.0,
+                verbose="none",
+            ),
+            FleetConfig(replicas=2, min_queue_depth=16, verbose="none"),
+        )
+
+
+def test_fleetconfig_replica_meshes_validation():
+    with pytest.raises(ValueError, match="replica_meshes"):
+        FleetConfig(replicas=2, replica_meshes=((2,),))  # wrong len
+    with pytest.raises(ValueError, match="not a tuple of axis"):
+        FleetConfig(replicas=2, replica_meshes=(2, None))  # bare int
+    with pytest.raises(ValueError, match="not a tuple of axis"):
+        FleetConfig(replicas=1, replica_meshes=("2x2",))  # spec string
+    with pytest.raises(ValueError, match="not a tuple of axis"):
+        FleetConfig(replicas=1, replica_meshes=("12",))  # digit string
+    f = FleetConfig(replicas=2, replica_meshes=([2, 2], None))
+    assert f.replica_meshes == ((2, 2), None)
+
+
+def test_bench_refuses_malformed_mesh_spec_before_any_work(
+    monkeypatch,
+):
+    """A typo'd CCSC_SERVE_MESH fails the bench workload up front
+    (user error), instead of silently recording mesh_skipped after
+    the expensive baseline arms ran (environment shortage)."""
+    from ccsc_code_iccv2017_tpu.serve.bench import run_serve_workload
+
+    monkeypatch.setenv("CCSC_SERVE_MESH", "4x")
+    with pytest.raises(ValueError, match="mesh spec"):
+        run_serve_workload()
+
+
+def test_fleet_serving_bound_device_scaling():
+    """The admission math of a mixed fleet: each replica contributes
+    its own serving_bound; an unmeasured replica is credited at the
+    best measured PER-DEVICE rate times its own device count."""
+    from ccsc_code_iccv2017_tpu.utils import perfmodel
+
+    # mesh replica (8 devices) measured at 80 it/s; single-device
+    # replica unmeasured -> credited 10 it/s. slots=4, 20 it/request.
+    b = perfmodel.fleet_serving_bound(
+        [(80.0, 8), (0.0, 1)], iters_per_request=20, slots=4
+    )
+    assert b["measured"] == 1
+    assert b["per_device_iters_per_sec"] == pytest.approx(10.0)
+    assert b["requests_per_sec"] == pytest.approx(
+        (80.0 * 4 / 20) + (10.0 * 4 / 20)
+    )
+    # nothing measured -> the caller keeps its static floor
+    assert perfmodel.fleet_serving_bound(
+        [(0.0, 8), (0.0, 1)], 20, 4
+    ) == {"requests_per_sec": 0.0, "measured": 0}
+    # all-single-device fleets reproduce N x serving_bound exactly
+    b2 = perfmodel.fleet_serving_bound(
+        [(300.0, 1), (300.0, 1)], iters_per_request=30, slots=4
+    )
+    assert b2["requests_per_sec"] == pytest.approx(
+        2 * perfmodel.serving_bound(300.0, 30, 4)["requests_per_sec"]
+    )
+
+
+# ----------------------------------------------------- ledger + gate
+
+
+def test_mesh_serve_record_is_its_own_ledger_configuration(
+    tmp_path, monkeypatch,
+):
+    """append_serve_record with a mesh arm writes TWO rows — default
+    and mesh — under different knob digests, so each accrues its own
+    history; an injected 0.5x mesh record is judged a regression
+    against the mesh key's band (the perf_gate exit-1 contract)."""
+    from ccsc_code_iccv2017_tpu.analysis import ledger
+
+    path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("CCSC_PERF_LEDGER", path)
+    base = {
+        "chip": "cpu",
+        "shape_key": "solve2d:k32:s7x7:sz64x64",
+        "knobs": {"requests": 16, "slots": 4},
+        "n_compiles": 3,
+        "mesh": "4x2",
+        "mesh_devices": 8,
+    }
+    for v_def, v_mesh in ((2.0, 7.9), (2.05, 8.1), (1.98, 8.0)):
+        rec = dict(
+            base,
+            engine_requests_per_sec=v_def,
+            mesh_requests_per_sec=v_mesh,
+        )
+        assert ledger.append_serve_record(rec) is not None
+    rows = ledger.Ledger(path).read()
+    assert len(rows) == 6
+    keys = {ledger.record_key(r) for r in rows}
+    assert len(keys) == 2  # default + mesh configurations
+    mesh_rows = [
+        r for r in rows if (r.get("knobs") or {}).get("mesh") == "4x2"
+    ]
+    assert len(mesh_rows) == 3
+    assert all(
+        (r.get("knobs") or {}).get("devices") == 8 for r in mesh_rows
+    )
+    # gate: an injected 0.5x record under the MESH key regresses...
+    led = ledger.Ledger(path)
+    bad = ledger.normalize_record(
+        chip="cpu", kind="serve", workload="serve2d",
+        shape_key=base["shape_key"],
+        knobs=dict(base["knobs"], mesh="4x2", devices=8),
+        value=4.0, unit="requests/sec",
+    )
+    verdicts = ledger.gate(led, record=bad)
+    assert any(not v["ok"] for v in verdicts), verdicts
+    # ...while the same absolute value under the key's own history is
+    # fine for a record matching the band
+    good = dict(bad, value=8.05)
+    assert all(v["ok"] for v in ledger.gate(led, record=good))
+
+
+# -------------------------------------------------- obs_report render
+
+
+def _load_report():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report",
+        os.path.join(
+            os.path.dirname(__file__), "..", "scripts", "obs_report.py"
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_report_renders_topology_and_flags_ceiling_mismatch():
+    """SERVING renders the per-replica device shape; a mixed fleet
+    whose live throughput exceeds the derived bound by >20% gets the
+    CEILING MISMATCH flag (and an agreeing fleet does not)."""
+    report = _load_report()
+
+    def ev(t, type_, **f):
+        return dict(f, t=t, type=type_)
+
+    common = [
+        ev(0.0, "run_meta", algorithm="serve_fleet"),
+        ev(
+            1.0, "serve_ready", replica_id=0, n_buckets=1,
+            warmup_s=1.0, devices=4, mesh=[2, 2], knobs={},
+        ),
+        ev(
+            1.1, "serve_ready", replica_id=1, n_buckets=1,
+            warmup_s=1.0, devices=1, mesh=None, knobs={},
+        ),
+        ev(
+            2.0, "serve_request", replica_id=0, trace_id="t1",
+            bucket="4@24x24", latency_ms=10.0, iters=4, wait_ms=1.0,
+        ),
+    ]
+    # bound 1 req/s but 10 requests in ~1 s -> mismatch
+    fast = [
+        ev(
+            3.0, "fleet_ceiling", replica_id=None, ceiling=8,
+            bound_requests_per_sec=1.0, source="serving_bound",
+        ),
+    ] + [
+        ev(
+            4.0 + 0.1 * i, "fleet_request", replica_id=0,
+            trace_id=f"t{i}", key=f"k{i}", latency_ms=10.0,
+        )
+        for i in range(10)
+    ]
+    out = report.render(common + fast)
+    assert "replica 0: 4 device(s)  mesh 2x2" in out
+    assert "replica 1: 1 device(s)  single-device" in out
+    assert "CEILING MISMATCH" in out
+    # agreeing ceiling: no flag
+    ok = [
+        ev(
+            3.0, "fleet_ceiling", replica_id=None, ceiling=8,
+            bound_requests_per_sec=50.0, source="serving_bound",
+        ),
+    ] + fast[1:]
+    out2 = report.render(common + ok)
+    assert "CEILING MISMATCH" not in out2
+    assert "replica 0: 4 device(s)" in out2
