@@ -91,6 +91,22 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     "fleet_ceiling": _s("replica_id", "ceiling", "source"),
     "fleet_overload": _s("replica_id", "rung_from", "rung_to",
                          "queue_depth"),
+    # -- multi-tenant bank registry + tenancy (serve.registry,
+    # serve.tenancy, serve.engine, serve.fleet). bank_publish is the
+    # registry's durable-publication announcement; bank_swap is the
+    # zero-downtime cutover (old->new digest, replica_id None for the
+    # fleet-wide flip); bank_plan_build/evict are the per-bank plan
+    # LRU's accounting; tenant_reject is a per-tenant quota refusal
+    # (the bursting tenant's own Overloaded while other tenants'
+    # admissions hold) ------------------------------------------------
+    "bank_publish": _s("bank_id", "digest"),
+    "bank_swap": _s("replica_id", "bank_id", "old_digest",
+                    "new_digest"),
+    "bank_plan_build": _s("replica_id", "digest", "bucket",
+                          "build_s"),
+    "bank_plan_evict": _s("replica_id", "digest", "bucket"),
+    "tenant_reject": _s("replica_id", "tenant", "queue_depth",
+                        "quota"),
     # -- workload capture + replay (serve.capture, serve.replay).
     # capture_* events are session-scope (emitted by the recorder
     # through the fleet/engine emit wrapper); replay_* events live in

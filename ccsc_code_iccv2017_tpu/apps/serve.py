@@ -50,7 +50,49 @@ def build_parser() -> argparse.ArgumentParser:
     from ._dispatch import add_mat_layout_arg, add_obs_args, add_perf_args
 
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--filters", required=True, help=".mat/.npz filter bank")
+    p.add_argument(
+        "--filters", default=None,
+        help=".mat/.npz filter bank (or load one from a registry "
+        "with --bank-registry/--bank-id)",
+    )
+    p.add_argument(
+        "--bank-registry", default=None, metavar="DIR",
+        help="durable bank registry (serve.registry.BankRegistry): "
+        "--bank-id loads the served bank from it and --publish-bank "
+        "publishes more banks onto the engine/fleet for "
+        "bank-id-routed requests. Default: the CCSC_BANK_REGISTRY "
+        "env knob",
+    )
+    p.add_argument(
+        "--bank-id", default=None,
+        help="serve this registry bank as the default bank instead "
+        "of --filters (newest manifest wins — the registry's "
+        "hot-swap convention)",
+    )
+    p.add_argument(
+        "--publish-bank", action="append", default=None,
+        metavar="ID",
+        help="also publish this registry bank id onto the "
+        "engine/fleet (repeatable): requests carrying bank_id route "
+        "to it, and re-running with a re-published registry entry "
+        "hot-swaps it with zero downtime",
+    )
+    p.add_argument(
+        "--tenant", action="append", default=None, metavar="SPEC",
+        help="declare a serving tenant (repeatable; fleet mode): "
+        "NAME[:key=value,...] with keys bank, p50, p99, quota, "
+        "weight — e.g. 'mobile:bank=bank-mobile,p99=250,quota=16,"
+        "weight=2'. Tenants get weighted-fair admission, per-tenant "
+        "quotas (explicit Overloaded refusals for a bursting tenant "
+        "only), and per-tenant SLO histograms (serve.tenancy)",
+    )
+    p.add_argument(
+        "--request-tenant", default=None, metavar="NAME",
+        help="submit this CLI's own request stream under the named "
+        "declared tenant (it then routes to the tenant's bank and "
+        "counts against its quota and SLO histogram); default: "
+        "untenanted traffic",
+    )
     src = p.add_mutually_exclusive_group()
     src.add_argument("--data", help="serve every image in this folder")
     src.add_argument(
@@ -206,7 +248,51 @@ def main(argv=None):
             "one of --data, --stdin or --federate is required"
         )
 
-    d = load_filters_2d(args.filters)
+    # bank source: an explicit filter file, or the durable registry
+    # (serve.registry) — the registry's newest manifest wins, which
+    # is how a re-published bank reaches a restarted server
+    from ..serve.registry import BankRegistry, resolve_registry_dir
+
+    reg_dir = resolve_registry_dir(args.bank_registry)
+    registry = None
+    if args.bank_id or args.publish_bank:
+        if not reg_dir:
+            raise SystemExit(
+                "--bank-id/--publish-bank need a registry: pass "
+                "--bank-registry DIR or set CCSC_BANK_REGISTRY"
+            )
+    if reg_dir:
+        registry = BankRegistry(reg_dir)
+    if args.bank_id:
+        d, manifest = registry.load(args.bank_id)
+        from ..serve.registry import render_manifest
+
+        print(f"serving registry bank {render_manifest(manifest)}")
+    elif args.filters:
+        d = load_filters_2d(args.filters)
+    else:
+        raise SystemExit(
+            "one of --filters or --bank-registry + --bank-id is "
+            "required"
+        )
+    tenants = None
+    if args.tenant:
+        from ..serve.tenancy import parse_tenant_spec
+
+        try:
+            tenants = tuple(
+                parse_tenant_spec(s) for s in args.tenant
+            )
+        except ValueError as e:
+            raise SystemExit(f"--tenant: {e}")
+    if args.request_tenant is not None and not (
+        tenants
+        and any(s.tenant == args.request_tenant for s in tenants)
+    ):
+        raise SystemExit(
+            f"--request-tenant {args.request_tenant!r} must name a "
+            "tenant declared with --tenant"
+        )
     geom = ProblemGeom(d.shape[1:], d.shape[0])
     from ..utils import validate
 
@@ -256,6 +342,11 @@ def main(argv=None):
         # from the shared queue, results go back into it durably
         from ..serve.federation import FederatedHost
 
+        if args.publish_bank:
+            raise SystemExit(
+                "--publish-bank is not supported in --federate mode "
+                "yet (the queue protocol carries no bank ids)"
+            )
         host = FederatedHost(
             federate_dir,
             d,
@@ -271,6 +362,7 @@ def main(argv=None):
                 metricsd_port=args.metricsd_port,
                 metricsd_snapshot=args.metricsd_snapshot,
                 capture_dir=args.capture_dir,
+                tenants=tenants,
             ),
             host=args.host_id,
             metrics_dir=args.metrics_dir,
@@ -291,7 +383,13 @@ def main(argv=None):
             f"left the pool"
         )
         return host.served
-    fleet_mode = args.replicas > 1 or args.max_queue_depth is not None
+    fleet_mode = (
+        args.replicas > 1
+        or args.max_queue_depth is not None
+        # declared tenants need the fleet's admission layer (quotas,
+        # weighted-fair lanes, per-tenant SLOs live there)
+        or tenants is not None
+    )
     metricsd = None  # standalone-engine endpoint (the fleet owns its own)
     t0 = time.perf_counter()
     if fleet_mode:
@@ -306,6 +404,7 @@ def main(argv=None):
                 metricsd_port=args.metricsd_port,
                 metricsd_snapshot=args.metricsd_snapshot,
                 capture_dir=args.capture_dir,
+                tenants=tenants,
             ),
         )
         print(
@@ -361,6 +460,17 @@ def main(argv=None):
                     + (f", snapshot {snap}" if snap else "")
                 )
 
+    if args.publish_bank:
+        # multi-bank serving: publish the named registry banks onto
+        # the engine/fleet — bank_id-routed requests (and a later
+        # re-publish under a new digest) hot-swap with zero downtime
+        from ..serve.registry import render_manifest as _render_man
+
+        for bid in args.publish_bank:
+            arr, man = registry.load(bid)
+            engine.publish_bank(bid, arr, tenant=man.get("tenant"))
+            print(f"published {_render_man(man)}")
+
     rng = np.random.default_rng(args.seed)
     n_skipped = 0
     n_overloaded = 0
@@ -373,7 +483,8 @@ def main(argv=None):
         while True:
             try:
                 fut = engine.submit(
-                    x * mask, mask=mask, smooth_init=sm, x_orig=x
+                    x * mask, mask=mask, smooth_init=sm, x_orig=x,
+                    tenant=args.request_tenant,
                 )
             except Overloaded as e:
                 # explicit backpressure: the fleet told us how long

@@ -631,6 +631,63 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One serving tenant's declared contract (serve.tenancy): which
+    bank its requests route to by default, its latency SLO targets,
+    its admission quota, and its weighted-fair share.
+
+    - ``tenant``: the tenant name requests carry (``submit(...,
+      tenant=...)``).
+    - ``bank_id``: default bank this tenant's requests route to when
+      the request names none (serve.registry ids). None = the fleet's
+      pinned default bank.
+    - ``slo_p50_ms`` / ``slo_p99_ms``: declared per-tenant
+      submit->result latency targets, checked by the tenant's own
+      streaming histogram (serve.slo.TenantSlos) — breaches emit
+      ``slo_breach`` events carrying the tenant name. None = no
+      target declared for that quantile (NO env fallback here: a
+      fleet-wide CCSC_SLO_* knob must not silently become every
+      tenant's contract).
+    - ``quota``: max requests this tenant may hold QUEUED at once;
+      admission past it is an explicit ``Overloaded`` refusal
+      (``tenant_reject``) while other tenants keep being admitted.
+      None = derived from the fleet ceiling x weight share x
+      ``CCSC_TENANT_QUOTA_FRAC``.
+    - ``weight``: weighted-fair dequeue share (a weight-2 tenant is
+      served twice as often as a weight-1 tenant when both have work
+      queued).
+    """
+
+    tenant: str
+    bank_id: Optional[str] = None
+    slo_p50_ms: Optional[float] = None
+    slo_p99_ms: Optional[float] = None
+    quota: Optional[int] = None
+    weight: float = 1.0
+
+    def __post_init__(self):
+        if not self.tenant or not isinstance(self.tenant, str):
+            raise ValueError(
+                f"tenant must be a non-empty string, got "
+                f"{self.tenant!r}"
+            )
+        for fname in ("slo_p50_ms", "slo_p99_ms"):
+            v = getattr(self, fname)
+            if v is not None and v <= 0:
+                raise ValueError(
+                    f"{fname} must be > 0 when set, got {v}"
+                )
+        if self.quota is not None and self.quota < 1:
+            raise ValueError(
+                f"quota must be >= 1 when set, got {self.quota}"
+            )
+        if not self.weight > 0:
+            raise ValueError(
+                f"weight must be > 0, got {self.weight}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Configuration of the fault-tolerant serving fleet
     (serve.ServeFleet) — N replicated :class:`~serve.CodecEngine`\\ s
@@ -760,6 +817,13 @@ class FleetConfig:
     replica_meshes: Optional[
         Tuple[Optional[Tuple[int, ...]], ...]
     ] = None
+    # Declared tenants (serve.tenancy): per-tenant bank routing,
+    # latency SLO targets, admission quotas, and weighted-fair
+    # dequeue shares. None (default) = the untenanted fleet — one
+    # queue, the fleet-wide SLO, the historical behavior exactly.
+    # With tenants declared, submit(..., tenant=...) must name one of
+    # them (or None for untenanted traffic).
+    tenants: Optional[Tuple[TenantSpec, ...]] = None
 
     def __post_init__(self):
         for fname in ("slo_p50_ms", "slo_p99_ms"):
@@ -868,4 +932,24 @@ class FleetConfig:
                 norm_meshes.append(mesh)
             object.__setattr__(
                 self, "replica_meshes", tuple(norm_meshes)
+            )
+        if self.tenants is not None:
+            norm_tenants = []
+            for i, spec in enumerate(self.tenants):
+                if not isinstance(spec, TenantSpec):
+                    raise ValueError(
+                        f"tenants[{i}] = {spec!r} is not a TenantSpec"
+                    )
+                norm_tenants.append(spec)
+            names = [s.tenant for s in norm_tenants]
+            if len(names) != len(set(names)):
+                dupes = sorted(
+                    n for n in set(names) if names.count(n) > 1
+                )
+                raise ValueError(
+                    f"duplicate tenant name(s) {dupes} — one "
+                    "TenantSpec per tenant"
+                )
+            object.__setattr__(
+                self, "tenants", tuple(norm_tenants)
             )

@@ -21,6 +21,15 @@ instrument. :class:`DurableQueue` (serve.dqueue) and
 (serve.federation) take the same contracts cross-host: fleets in
 separate processes drain one shared file-lease queue, and a
 whole-host SIGKILL is just an expired lease the survivors reap.
+:class:`BankRegistry` (serve.registry) and the tenancy layer
+(serve.tenancy) make the stack multi-tenant: durable bank manifests,
+request routing by bank id with a per-bank plan LRU, zero-downtime
+hot-swap of a republished bank (``publish_bank`` — in-flight
+requests finish on the old plan, the cutover is a ``bank_swap``
+event), per-tenant SLO histograms (serve.slo.TenantSlos), and
+weighted-fair admission with per-tenant quotas so one tenant's burst
+gets its own ``Overloaded`` rejections while other tenants' latency
+bands hold.
 """
 from .capture import WorkloadRecorder  # noqa: F401
 from .dqueue import DurableQueue  # noqa: F401
@@ -37,5 +46,12 @@ from .federation import (  # noqa: F401
 )
 from .fleet import Overloaded, ServeFleet  # noqa: F401
 from .metricsd import MetricsD  # noqa: F401
+from .registry import BankRegistry, PlanCache, bank_digest  # noqa: F401
 from .replay import ReplayDriver, generate_diurnal  # noqa: F401
-from .slo import Histogram, SloMonitor  # noqa: F401
+from .slo import Histogram, SloMonitor, TenantSlos  # noqa: F401
+from .tenancy import (  # noqa: F401
+    TenantSpec,
+    TenantTable,
+    WeightedFairScheduler,
+    parse_tenant_spec,
+)

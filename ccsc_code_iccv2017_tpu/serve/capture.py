@@ -306,12 +306,17 @@ class WorkloadRecorder:
         bucket: Optional[str] = None,
         solve: Optional[Dict[str, Any]] = None,
         t_rel: Optional[float] = None,
+        bank_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> None:
         """Record one ADMITTED request: relative arrival time, identity
-        (idempotency key + trace id), shape/bucket, solve params, and
-        the four payload arrays content-addressed into the store.
-        ``t_rel`` overrides the wall-clock arrival offset — synthetic
-        generators stamp curve time, not generation time.
+        (idempotency key + trace id), shape/bucket, solve params,
+        multi-tenant routing (``bank_id``/``tenant`` — so a
+        mixed-tenant capture replays each request against ITS bank,
+        per-bank digest parity intact), and the four payload arrays
+        content-addressed into the store. ``t_rel`` overrides the
+        wall-clock arrival offset — synthetic generators stamp curve
+        time, not generation time.
 
         NEVER raises: the recorder sits on the serving hot path
         (fleet ``submit``/``_deliver``, the engine worker loop), and
@@ -339,6 +344,8 @@ class WorkloadRecorder:
                 ),
                 "spatial": list(np.shape(b)),
                 "bucket": bucket,
+                "bank_id": bank_id,
+                "tenant": tenant,
                 "b": self._store_payload(b),
                 "mask": self._store_payload(mask),
                 "smooth_init": self._store_payload(smooth_init),

@@ -13,7 +13,13 @@ OTF — re-derived inside the jit, and (c) one dispatch per request.
    everything that depends only on the operator out of the request
    path; the engine builds one plan per shape bucket at startup and
    every request reuses it (the solver-plan pattern of MPAX/JAX-AMG,
-   PAPERS.md).
+   PAPERS.md). Plans live in a digest-keyed LRU (serve.registry
+   PlanCache) so one engine serves MANY banks: requests route by
+   ``bank_id``, bind their bank's ``d_digest`` at admission, and
+   ``publish_bank`` hot-swaps a bank id to a new digest with zero
+   downtime — plans are stored digest-canonical, so every
+   same-geometry bank shares the bucket's ONE compiled program and a
+   swap rebuilds a plan, never a program.
 2. **Shape buckets + AOT warmup** — a small configured set of
    (slots, spatial) bucket shapes; requests are padded to the next
    bucket with the padding excluded through the existing mask path
@@ -200,6 +206,14 @@ class _Pending:
     spatial: Tuple[int, ...]
     future: Future
     t_submit: float
+    # multi-tenant routing (serve.registry / serve.tenancy): the bank
+    # DIGEST this request was bound to at admission — a hot-swap
+    # republishing the bank id mid-queue must not retarget already
+    # admitted requests — plus the request-carried identities for
+    # telemetry and capture
+    digest: str = ""
+    bank_id: Optional[str] = None
+    tenant: Optional[str] = None
     # request-level tracing (utils.trace): every request carries a
     # trace_id; parent_span is the fleet's ownership span when this
     # engine is a replica (the engine's dispatch/solve spans nest
@@ -581,11 +595,30 @@ class CodecEngine:
             )
 
         # ---- per-bucket plans + AOT-compiled programs --------------
+        # Multi-bank serving (serve.registry): plans live in a
+        # digest-keyed LRU (evict-and-rebuild on miss), the bank
+        # bytes are retained for rebuilds, and requests bind a digest
+        # at admission via the bank_id route table. The compiled
+        # bucket PROGRAM is shared across banks — plans are stored
+        # with the digest canonicalized out of the pytree aux data
+        # (the reconstruct(plan=...) jit-cache discipline), so a
+        # hot-swap republishing a bank id rebuilds a plan, never a
+        # program.
+        from . import registry as _registry
+
         self._buckets: List[Tuple[int, Tuple[int, ...]]] = list(
             serve_cfg.buckets
         )
-        self._plans: Dict[Tuple, object] = {}
-        self._compiled: Dict[Tuple, object] = {}
+        self._plan_cfg = cfg
+        self._blur_psf = blur_psf
+        self._build_plan = build_plan
+        default_digest = _registry.bank_digest(d)
+        self._banks: Dict[str, object] = {default_digest: d}
+        self._routes: Dict[Optional[str], str] = {
+            None: default_digest
+        }
+        self._plan_cache = _registry.PlanCache()
+        self._programs: Dict[Tuple, object] = {}
         t_warm0 = time.perf_counter()
         for slots, spatial in self._buckets:
             key = (slots, spatial)
@@ -599,23 +632,27 @@ class CodecEngine:
                 slots=slots,
                 buckets=self._buckets,
             )
-            self._plans[key] = plan
+            # digest-canonical storage: all same-geometry banks share
+            # one compiled program per bucket (aux-data equality)
+            plan = dataclasses.replace(plan, d_digest="")
+            self._plan_cache.put(default_digest, key, plan)
             fn = jax.jit(_bucket_program)
             if serve_cfg.aot_warmup:
                 shp = jax.ShapeDtypeStruct(
                     (slots, *reduce_shape, *spatial), jnp.float32
                 )
-                self._compiled[key] = fn.lower(
+                self._programs[key] = fn.lower(
                     shp, shp, shp, shp, plan
                 ).compile()
             else:
-                self._compiled[key] = fn
+                self._programs[key] = fn
             self._emit(
                 "serve_warmup",
                 bucket=_bucket_name(slots, spatial),
                 aot=bool(serve_cfg.aot_warmup),
                 warmup_s=round(time.perf_counter() - t0, 4),
                 devices=self.devices,
+                digest=default_digest,
                 mesh=(
                     list(self._mesh_shape) if self._mesh_shape
                     else None
@@ -658,10 +695,19 @@ class CodecEngine:
         # ---- micro-batch queue -------------------------------------
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # keyed (bucket_key, digest): one bank's batch rides one
+        # dispatch against one plan; lanes appear lazily as banks
+        # receive traffic (bounded by banks x buckets)
         self._pending: Dict[Tuple, List[_Pending]] = {
-            k: [] for k in self._plans
+            ((s, sp), default_digest): [] for s, sp in self._buckets
         }
         self._n_pending = 0
+        # digest of the batch the worker is CURRENTLY dispatching
+        # (set under the lock at pop, cleared after the dispatch):
+        # retire_bank must refuse it — the worker fetches the plan
+        # after releasing the queue lock, and a retire in that window
+        # would fail the whole batch
+        self._dispatch_digest: Optional[str] = None
         self._closed = False
         # live flush deadline (set_max_wait_ms): the fleet's overload
         # ladder sheds micro-batch waiting without rebuilding engines
@@ -695,22 +741,32 @@ class CodecEngine:
 
     def submit(
         self, b, mask=None, smooth_init=None, x_orig=None,
+        bank_id: Optional[str] = None,
+        tenant: Optional[str] = None,
         _validated: bool = False,
         _trace: Optional[Tuple[str, Optional[str]]] = None,
+        _digest: Optional[str] = None,
     ) -> "Future[ServedResult]":
         """Enqueue one observation [*reduce, *spatial] (no batch axis);
         returns a Future resolving to :class:`ServedResult`. Only the
         cheap per-request checks run here (utils.validate
         check_serve_request) — the operator was validated at
-        construction. ``_validated`` is fleet-internal: the fleet runs
-        the identical checks (including the O(N) finiteness scans) at
-        admission and canonicalizes the arrays to float32, so its
-        dispatch — and every requeue retry — must not pay them again
-        per ownership. ``_trace`` is the fleet's span context
-        ``(trace_id, parent_span_id)``: the engine's dispatch/solve
-        spans nest under the fleet's ownership span so a request's
-        story survives replica handoffs; a standalone submit gets a
-        fresh trace_id and the engine emits the root span itself."""
+        construction. ``bank_id`` routes the request to a published
+        bank (:meth:`add_bank` / :meth:`publish_bank`; None = the
+        engine's default bank); the request binds that bank's DIGEST
+        here, so a concurrent hot-swap never retargets admitted work.
+        ``tenant`` rides through to telemetry and capture.
+        ``_validated`` is fleet-internal: the fleet runs the identical
+        checks (including the O(N) finiteness scans) at admission and
+        canonicalizes the arrays to float32, so its dispatch — and
+        every requeue retry — must not pay them again per ownership.
+        ``_trace`` is the fleet's span context ``(trace_id,
+        parent_span_id)``: the engine's dispatch/solve spans nest
+        under the fleet's ownership span so a request's story survives
+        replica handoffs; a standalone submit gets a fresh trace_id
+        and the engine emits the root span itself. ``_digest`` is the
+        fleet's admission-time digest binding — the fleet owns the
+        routing table, the engine just serves the named plan."""
         from ..utils import validate
 
         if not _validated:
@@ -740,6 +796,8 @@ class CodecEngine:
             spatial=spatial,
             future=Future(),
             t_submit=time.perf_counter(),
+            bank_id=bank_id,
+            tenant=tenant,
             trace_id=trace_id,
             parent_span=parent_span,
             own_root=own_root,
@@ -747,10 +805,31 @@ class CodecEngine:
         with self._cv:
             if self._closed or self._close_started:
                 raise RuntimeError("engine is closed")
+            # digest binds UNDER the queue lock: publish_bank flips
+            # routes and retires stale digests under the same lock,
+            # so an admission can never bind a digest a concurrent
+            # retire just dropped
+            if _digest is not None:
+                digest = _digest
+                if digest not in self._banks:
+                    raise validate.CCSCInputError(
+                        f"bank digest {digest!r} is not published on "
+                        "this engine — publish the bank (add_bank) "
+                        "before routing requests to it"
+                    )
+            else:
+                digest = self._routes.get(bank_id)
+                if digest is None:
+                    raise validate.CCSCInputError(
+                        f"unknown bank id {bank_id!r} — published: "
+                        f"{sorted(k for k in self._routes if k)} "
+                        "(default bank routes as bank_id=None)"
+                    )
+            p.digest = digest
             if self._capture is not None:
                 self._cap_seq += 1
                 p.cap_key = f"{self._cap_prefix}-{self._cap_seq:08d}"
-            self._pending[key].append(p)
+            self._pending.setdefault((key, digest), []).append(p)
             self._n_pending += 1
             self._cv.notify()
         if self._capture is not None and p.cap_key is not None:
@@ -760,16 +839,20 @@ class CodecEngine:
                 p.cap_key, trace_id, p.b, mask=p.mask,
                 smooth_init=p.smooth_init, x_orig=p.x_orig,
                 bucket=_bucket_name(*key),
+                bank_id=bank_id, tenant=tenant,
             )
         return p.future
 
     def reconstruct(
         self, b, mask=None, smooth_init=None, x_orig=None,
+        bank_id: Optional[str] = None,
+        tenant: Optional[str] = None,
         timeout: Optional[float] = None,
     ) -> ServedResult:
         """Synchronous submit-and-wait."""
         return self.submit(
-            b, mask=mask, smooth_init=smooth_init, x_orig=x_orig
+            b, mask=mask, smooth_init=smooth_init, x_orig=x_orig,
+            bank_id=bank_id, tenant=tenant,
         ).result(timeout=timeout)
 
     def serve_many(self, requests, timeout=None) -> List[ServedResult]:
@@ -804,16 +887,20 @@ class CodecEngine:
                 else:
                     key = None
                     for k, lst in self._pending.items():
-                        if lst and len(lst) >= k[0]:
-                            key = k  # a full bucket flushes immediately
+                        # k = ((slots, spatial), digest): a full
+                        # bank-lane flushes immediately
+                        if lst and len(lst) >= k[0][0]:
+                            key = k
                             break
                     if key is None:
                         self._cv.wait(timeout=ot + max_wait - now)
                         continue
-                batch = self._pending[key][: key[0]]
-                self._pending[key] = self._pending[key][key[0]:]
+                slots_k = key[0][0]
+                batch = self._pending[key][:slots_k]
+                self._pending[key] = self._pending[key][slots_k:]
                 self._n_pending -= len(batch)
                 depth_after = self._n_pending
+                self._dispatch_digest = key[1]
             # transition futures to RUNNING; a client-cancelled request
             # is dropped HERE — set_result on a cancelled Future raises
             # InvalidStateError, which would poison its batch siblings
@@ -830,15 +917,24 @@ class CodecEngine:
                     if not p.future.done():
                         p.future.set_exception(e)
                 self._emit("serve_error", error=str(e)[:300])
+            finally:
+                with self._cv:
+                    self._dispatch_digest = None
 
     def _dispatch(self, key, batch: List[_Pending], depth_after: int):
         from ..models.reconstruct import ReconTrace
         from ..utils import perfmodel
 
         jnp = self._jnp
-        slots, spatial = key
+        bkey, digest = key
+        slots, spatial = bkey
         geom = self.geom
         name = _bucket_name(slots, spatial)
+        # plan fetch BEFORE the batch canvas fills: an evicted plan
+        # rebuilds here (evict-and-rebuild — a jitted build, never an
+        # XLA recompile), and a rebuild failure fails this batch's
+        # futures cleanly via the worker's surfacing path
+        plan = self._plan_for(digest, bkey)
         t0 = time.perf_counter()
 
         shape = (slots, *geom.reduce_shape, *spatial)
@@ -873,9 +969,9 @@ class CodecEngine:
             ctx = contextlib.nullcontext()
         try:
             with ctx:
-                out = self._compiled[key](
+                out = self._programs[bkey](
                     jnp.asarray(bb), jnp.asarray(mm), jnp.asarray(ss),
-                    jnp.asarray(xx), self._plans[key],
+                    jnp.asarray(xx), plan,
                 )
                 iters = np.asarray(out.trace.num_iters)  # the fence
         finally:
@@ -973,6 +1069,8 @@ class CodecEngine:
                 latency_ms=round(latency * 1e3, 3),
                 iters=n_it,
                 psnr=final_psnr,
+                bank_id=p.bank_id,
+                tenant=p.tenant,
             )
             if self._capture is not None and p.cap_key is not None:
                 self._capture.record_outcome(
@@ -998,6 +1096,7 @@ class CodecEngine:
         self._emit(
             "serve_dispatch",
             bucket=name,
+            digest=digest,
             n=len(batch),
             slots=slots,
             occupancy=round(occ, 4),
@@ -1061,6 +1160,10 @@ class CodecEngine:
             "gauges": {
                 "queue_depth": depth,
                 "mean_occupancy": round(st["mean_occupancy"], 4),
+                # routed bank COUNT (the fleet gauge's semantics —
+                # the two surfaces must agree), not retained digests
+                "banks": len(self._routes),
+                "plan_cache_bytes": self._plan_cache.total_bytes,
             },
             "histograms": [
                 ("latency_ms", {"phase": sn["phase"]}, sn)
@@ -1097,6 +1200,189 @@ class CodecEngine:
         before any dispatch) — the ``perfmodel.serving_bound`` input
         the fleet's derived admission ceiling is computed from."""
         return self._last_it_rate
+
+    # -- multi-bank serving (serve.registry) ---------------------------
+    def _plan_for(self, digest: str, bkey) -> object:
+        """The plan serving ``(digest, bucket)``: LRU hit, or
+        evict-and-rebuild from the retained bank bytes — a jitted
+        ``build_plan`` call, never an XLA recompile (the compiled
+        bucket program is digest-canonical and shared across banks)."""
+        plan = self._plan_cache.get(digest, bkey)
+        if plan is not None:
+            return plan
+        d = self._banks.get(digest)
+        if d is None:
+            raise RuntimeError(
+                f"bank digest {digest} has no retained bytes on "
+                "this engine — publish the bank before routing "
+                "requests to it"
+            )
+        return self._install_plan(digest, bkey, d)
+
+    def _install_plan(self, digest: str, bkey, d) -> object:
+        """Build one bucket's plan for one bank and insert it into
+        the LRU (digests with queued work pinned against eviction).
+        Runs on whatever thread needs the plan — the publishing
+        caller for a hot-swap (off the hot path), the worker for a
+        rebuild-on-miss."""
+        t0 = time.perf_counter()
+        slots, spatial = bkey
+        plan = self._build_plan(
+            d, self.prob, self._plan_cfg, spatial,
+            blur_psf=self._blur_psf,
+            mesh_shape=self._mesh_shape, slots=slots,
+            buckets=self._buckets,
+        )
+        plan = dataclasses.replace(plan, d_digest="")
+        with self._cv:
+            pin = {
+                lane[1]
+                for lane, lst in self._pending.items() if lst
+            }
+        evicted = self._plan_cache.put(digest, bkey, plan, pin=pin)
+        self._emit(
+            "bank_plan_build",
+            digest=digest,
+            bucket=_bucket_name(slots, spatial),
+            build_s=round(time.perf_counter() - t0, 4),
+            plan_bytes=self._plan_cache.total_bytes,
+        )
+        for ev_digest, ev_bkey in evicted:
+            self._emit(
+                "bank_plan_evict",
+                digest=ev_digest,
+                bucket=_bucket_name(*ev_bkey),
+                plan_bytes=self._plan_cache.total_bytes,
+            )
+        return plan
+
+    def add_bank(self, d, blur_psf=None) -> str:
+        """Register a bank's bytes and build+warm its per-bucket
+        plans WITHOUT touching any route — the make-servable half of
+        a hot-swap, safe to run while traffic flows (plan builds are
+        jitted, the compiled programs are shared). Idempotent per
+        digest. Returns the bank's ``d_digest``. ``blur_psf`` must
+        match the engine's pinned blur (plans compose it)."""
+        from ..utils import validate
+
+        from . import registry as _registry
+
+        if blur_psf is not None:
+            raise validate.CCSCInputError(
+                "add_bank serves the engine's pinned blur operator — "
+                "per-bank blur PSFs are not supported (build a "
+                "second engine)"
+            )
+        validate.check_filters(d, self.geom)
+        digest = _registry.bank_digest(d)
+        with self._cv:
+            if self._close_started:
+                raise RuntimeError("engine is closed")
+            known = digest in self._banks
+            self._banks[digest] = d
+        if not known:
+            for slots, spatial in self._buckets:
+                self._install_plan(digest, (slots, spatial), d)
+        return digest
+
+    def publish_bank(
+        self, bank_id: Optional[str], d,
+        tenant: Optional[str] = None,
+    ) -> Tuple[Optional[str], str]:
+        """Zero-downtime hot-swap: make ``d`` servable (plans built
+        and warmed off the hot path), then atomically route
+        ``bank_id`` (None = the engine's DEFAULT bank) to the new
+        digest. In-flight and queued requests bound the old digest at
+        admission and finish on the old plan; admissions after the
+        flip serve the new one. The cutover is visible in the stream
+        as a ``bank_swap`` carrying both digests. Returns
+        ``(old_digest, new_digest)``."""
+        digest = self.add_bank(d)
+        with self._cv:
+            if self._close_started:
+                raise RuntimeError("engine is closed")
+            old = self._routes.get(bank_id)
+            self._routes[bank_id] = digest
+            stale = [
+                dg for dg in self._banks
+                if dg not in self._routes.values()
+            ]
+        self._emit(
+            "bank_swap",
+            bank_id=bank_id,
+            old_digest=old,
+            new_digest=digest,
+            tenant=tenant,
+        )
+        # memory-bounding sweep: superseded digests (this swap's old
+        # one AND any earlier leftover a prior attempt could not
+        # retire) are dropped once nothing references them —
+        # in-flight/queued requests that bound them still finish
+        # (retire_bank refuses while they do; the next publish
+        # retries)
+        for dg in stale:
+            self.retire_bank(dg)
+        return old, digest
+
+    def retire_bank(self, digest: str) -> bool:
+        """Drop one digest's retained bytes, cached plans, and empty
+        queue lanes — the memory-bounding half of hot-swap (a fleet
+        republishing continuously must not accumulate every
+        superseded bank forever). REFUSED (returns False) while the
+        digest is still referenced: routed by any bank id, queued in
+        any lane, or mid-dispatch — a retire must never fail a
+        request that already bound the digest. Returns True when the
+        digest is gone."""
+        with self._cv:
+            if digest in self._routes.values():
+                return False
+            if self._dispatch_digest == digest:
+                return False
+            if any(
+                lane[1] == digest and lst
+                for lane, lst in self._pending.items()
+            ):
+                return False
+            self._banks.pop(digest, None)
+            for lane in [
+                ln for ln in self._pending if ln[1] == digest
+            ]:
+                del self._pending[lane]
+        for _dg, ev_bkey in self._plan_cache.drop_digest(digest):
+            self._emit(
+                "bank_plan_evict",
+                digest=digest,
+                bucket=_bucket_name(*ev_bkey),
+                plan_bytes=self._plan_cache.total_bytes,
+                retired=True,
+            )
+        return True
+
+    @property
+    def bank_ids(self) -> List[str]:
+        """Published bank ids (the default bank routes as None and is
+        not listed)."""
+        with self._cv:
+            return sorted(k for k in self._routes if k is not None)
+
+    def bank_digest(self, bank_id: Optional[str] = None) -> str:
+        """The digest ``bank_id`` currently routes to (None = the
+        default bank)."""
+        from ..utils import validate
+
+        with self._cv:
+            digest = self._routes.get(bank_id)
+        if digest is None:
+            raise validate.CCSCInputError(
+                f"unknown bank id {bank_id!r}"
+            )
+        return digest
+
+    def plan_cache_stats(self) -> Dict[str, object]:
+        """The plan LRU's accounting (serve.registry.PlanCache):
+        entry count, byte budget vs use, hit/miss/eviction counters,
+        and the measured HBM watermark sampled at builds."""
+        return self._plan_cache.stats()
 
     def set_max_wait_ms(self, ms: float) -> None:
         """Retarget the micro-batch flush deadline live (overload
@@ -1137,6 +1423,9 @@ class CodecEngine:
                     "smooth_init": p.smooth_init,
                     "x_orig": p.x_orig,
                     "future": p.future,
+                    "bank_id": p.bank_id,
+                    "tenant": p.tenant,
+                    "digest": p.digest,
                 }
             )
         if taken:

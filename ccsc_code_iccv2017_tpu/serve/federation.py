@@ -676,6 +676,11 @@ class FederatedHost:
 
     # -- heartbeat + reaper --------------------------------------------
     def _beat_loop(self) -> None:
+        # first beat IMMEDIATELY, not one cadence in: a host that
+        # joins, drains a short queue, and leaves inside a single
+        # heartbeat_s window must still be visible in the stream (and
+        # to per-host liveness) as having been alive at all
+        self._beat_once()
         while not self._stop_beat.wait(self.heartbeat_s):
             self._beat_once()
 

@@ -65,6 +65,19 @@ replica — including after a mid-stream handoff — is bit-identical to
 a single unfaulted engine's serve of the same request (the chaos
 parity contract of tests/test_fleet.py). Only rung 3 trades solve
 budget for latency, and it announces itself in the stream.
+
+Multi-tenancy (serve.registry / serve.tenancy): ``submit`` routes by
+``bank_id`` (explicit, or the tenant's declared default) and binds
+the bank's DIGEST at admission; ``publish_bank`` hot-swaps a bank id
+to a new digest with zero downtime (staggered per-replica plan
+builds, one atomic route flip, a ``bank_swap`` event with both
+digests — in-flight requests finish on their admission-time plan).
+With ``FleetConfig.tenants`` declared, the front queue becomes
+weighted-fair per-tenant lanes, admission enforces per-tenant quotas
+(``tenant_reject`` + :class:`Overloaded` for the bursting tenant
+only), and each tenant's submit->result latency streams into its own
+SLO histogram judged against its own declared targets
+(serve.slo.TenantSlos).
 """
 from __future__ import annotations
 
@@ -83,7 +96,10 @@ from ..config import FleetConfig, ServeConfig, SolveConfig
 from ..utils import env as _env
 from ..utils import trace as trace_util
 from . import capture as _capture
+from . import metricsd as _metricsd_mod
+from . import registry as _registry
 from . import slo as _slo
+from . import tenancy as _tenancy
 from .engine import (
     CodecEngine,
     ServedResult,
@@ -123,6 +139,16 @@ class _FleetRequest:
     future: Future
     t_submit: float
     attempts: int = 0  # ownerships so far (incremented at take)
+    # -- multi-tenant routing (serve.registry / serve.tenancy): the
+    # tenant the request was admitted under (its weighted-fair lane,
+    # quota and SLO accounting), the effective bank id, and the bank
+    # DIGEST bound at admission — a hot-swap republishing the bank id
+    # mid-queue must never retarget already-admitted requests, and a
+    # requeued casualty re-serves against the SAME digest on any
+    # replica (every replica retains every published bank's plans)
+    tenant: Optional[str] = None
+    bank_id: Optional[str] = None
+    digest: str = ""
     # -- request-level tracing (utils.trace). The span context RIDES
     # the request through every requeue, so one trace survives
     # replica kills/restarts: root_span covers submit->resolution,
@@ -318,7 +344,26 @@ class ServeFleet:
             # default device prefix (overlapping a sibling)
 
         self._cv = threading.Condition()
-        self._queue: Deque[_FleetRequest] = deque()
+        # multi-tenant admission (serve.tenancy): declared tenants
+        # get their own weighted-fair lanes, quotas, and SLO
+        # monitors; with no tenants declared the scheduler degrades
+        # to the historical single FIFO exactly
+        self._tenants = _tenancy.TenantTable(fleet_cfg.tenants)
+        self._queue = _tenancy.WeightedFairScheduler(self._tenants)
+        self._tenant_slos = _slo.TenantSlos(fleet_cfg.tenants)
+        self._tenant_delivered: Dict[str, int] = {}
+        self._tenant_rejects: Dict[str, int] = {}
+        # bank routing (serve.registry): bank_id -> digest, flipped
+        # atomically by publish_bank (the fleet-wide hot-swap);
+        # retained bank bytes let a restarted replica republish every
+        # bank before it takes work
+        default_digest = _registry.bank_digest(d)
+        self._bank_routes: Dict[Optional[str], str] = {
+            None: default_digest
+        }
+        self._bank_arrays: Dict[str, np.ndarray] = {
+            default_digest: np.asarray(d)
+        }
         self._index: Dict[str, _FleetRequest] = {}  # queued/assigned
         # served / failed idempotency keys, BOUNDED to the newest
         # FleetConfig.key_window each (insertion order = eviction
@@ -569,14 +614,30 @@ class ServeFleet:
                     if r is not None and r.state == "live"
                 ),
                 "overload_rung": self._rung,
+                "banks": len(self._bank_routes),
             }
+            # per-tenant labeled series: the shared constructor
+            # (serve.metricsd.tenant_labeled_counters) keeps this
+            # live surface and the stream-derived snapshot identical
+            labeled = _metricsd_mod.tenant_labeled_counters(
+                self._tenant_delivered, self._tenant_rejects
+            )
+        hists = [
+            ("latency_ms", {"phase": sn["phase"]}, sn)
+            for sn in self._slo.raw_snapshots()
+        ] + [
+            (
+                "latency_ms",
+                {"phase": sn["phase"], "tenant": sn["tenant"]},
+                sn,
+            )
+            for sn in self._tenant_slos.raw_snapshots()
+        ]
         return {
             "counters": counters,
             "gauges": gauges,
-            "histograms": [
-                ("latency_ms", {"phase": sn["phase"]}, sn)
-                for sn in self._slo.raw_snapshots()
-            ],
+            "labeled_counters": labeled,
+            "histograms": hists,
         }
 
     # -- replica lifecycle ---------------------------------------------
@@ -619,6 +680,15 @@ class ServeFleet:
             self._d, self._prob, self._engine_cfg(degraded), scfg,
             blur_psf=self._blur_psf,
         )
+        # republish every known bank onto the fresh engine: a
+        # restarted replica must be able to serve a requeued request
+        # bound to ANY published digest (add_bank is idempotent for
+        # the engine's own default bank, and the extra plan builds
+        # ride the jitted build_plan cache — no XLA recompiles)
+        with self._cv:
+            extra_banks = list(self._bank_arrays.values())
+        for arr in extra_banks:
+            engine.add_bank(arr)
         if self._rung >= 1:
             # a replica (re)built while the ladder is shedding must
             # inherit the shed micro-batch deadline, not wait out the
@@ -1001,6 +1071,10 @@ class ServeFleet:
                 self._index.pop(req.key, None)
                 self._latencies.append(lat)
                 self._n_delivered += 1
+                if req.tenant is not None:
+                    self._tenant_delivered[req.tenant] = (
+                        self._tenant_delivered.get(req.tenant, 0) + 1
+                    )
                 rep.served += 1
                 # claim the open spans under the lock: a racing
                 # requeue/close path can then never double-end them
@@ -1027,6 +1101,9 @@ class ServeFleet:
             )
             return
         self._slo.observe("total", lat * 1e3)
+        # the tenant's OWN histogram: per-tenant p50/p99 vs declared
+        # targets, untouched by other tenants' bursts
+        self._tenant_slos.observe(req.tenant, lat * 1e3)
         try:
             req.future.set_result(res)
         except InvalidStateError:
@@ -1058,6 +1135,7 @@ class ServeFleet:
             key=req.key, attempts=req.attempts, bucket=res.bucket,
             latency_ms=round(lat * 1e3, 3),
             requeued=req.attempts > 1,
+            tenant=req.tenant, bank_id=req.bank_id,
         )
         if self._capture is not None:
             # outcome digest pairs the delivered bytes with the
@@ -1160,7 +1238,7 @@ class ServeFleet:
         return batch
 
     def _process(self, rep: _Replica, batch: List[_FleetRequest]) -> None:
-        from ..utils import faults
+        from ..utils import faults, validate
 
         seq0 = rep.req_seq - len(batch)
         stalls_before = rep.watchdog.stalls
@@ -1180,24 +1258,47 @@ class ServeFleet:
                         f"injected engine kill on replica {rep.id} "
                         f"(request #{s})"
                     )
-            futs = [
+            def _submit_to_engine(r):
                 # _validated: admission already ran the full request
                 # checks and canonicalized the arrays — no second
                 # finiteness scan per ownership. _trace threads the
                 # span context: the engine's dispatch/solve spans
                 # nest under THIS ownership span, in the replica's
                 # own stream
-                rep.engine.submit(
+                return rep.engine.submit(
                     r.b, mask=r.mask, smooth_init=r.smooth_init,
-                    x_orig=r.x_orig, _validated=True,
+                    x_orig=r.x_orig,
+                    bank_id=r.bank_id, tenant=r.tenant,
+                    _validated=True,
                     _trace=(
                         (r.trace_id, r.attempt_span)
                         if r.trace_id is not None
                         else None
                     ),
+                    # the ADMISSION-TIME digest, not the engine's
+                    # current route: a hot-swap between admission and
+                    # ownership must not retarget this request
+                    _digest=r.digest or None,
                 )
-                for r in batch
-            ]
+
+            futs = []
+            for r in batch:
+                try:
+                    futs.append(_submit_to_engine(r))
+                except validate.CCSCInputError:
+                    # a replica registered concurrently with a
+                    # publish_bank rollout can miss the new bank
+                    # (spawned after the rollout's replica snapshot,
+                    # snapshot of _bank_arrays taken before the
+                    # publish landed): heal from the fleet's
+                    # retained bytes and retry — a routing gap must
+                    # never read as a replica death
+                    with self._cv:
+                        arr = self._bank_arrays.get(r.digest)
+                    if arr is None:
+                        raise
+                    rep.engine.add_bank(arr)
+                    futs.append(_submit_to_engine(r))
             results = [f.result(timeout=600.0) for f in futs]
         finally:
             rep.watchdog.disarm()
@@ -1287,6 +1388,14 @@ class ServeFleet:
             for br in breaches:
                 self._emit("slo_breach", replica_id=None, **br)
             for sn in snaps:
+                self._emit("slo_histogram", replica_id=None, **sn)
+            # per-TENANT SLO checks: each declared tenant's own
+            # histogram vs its own declared band — the records carry
+            # the tenant name (obs_report TENANTS)
+            t_breaches, t_snaps = self._tenant_slos.tick(now)
+            for br in t_breaches:
+                self._emit("slo_breach", replica_id=None, **br)
+            for sn in t_snaps:
                 self._emit("slo_histogram", replica_id=None, **sn)
 
     def _update_ceiling(self, perfmodel, reps) -> None:
@@ -1528,6 +1637,8 @@ class ServeFleet:
     def submit(
         self, b, mask=None, smooth_init=None, x_orig=None,
         key: Optional[str] = None,
+        bank_id: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> "Future[ServedResult]":
         """Enqueue one observation; returns a Future of
         :class:`~.engine.ServedResult`.
@@ -1537,9 +1648,17 @@ class ServeFleet:
         returns the SAME future; a key that was already delivered —
         or already failed — is refused (at-most-once delivery and
         exactly-once-or-error: a key resolves once, ever; the fleet
-        does not cache results). Raises :class:`Overloaded` at the
-        admission ceiling and ``CCSCInputError`` for malformed
-        requests."""
+        does not cache results). ``tenant`` names a declared
+        :class:`~..config.TenantSpec` (admission then rides that
+        tenant's weighted-fair lane, quota, and SLO histogram; an
+        unknown name is refused — a typo must not silently bypass its
+        quota). ``bank_id`` routes to a published bank (explicit id >
+        the tenant's declared default > the fleet's pinned bank); the
+        request binds that bank's DIGEST here, so a concurrent
+        hot-swap never retargets admitted work. Raises
+        :class:`Overloaded` at the admission ceiling OR the tenant's
+        quota (a ``tenant_reject`` — other tenants keep being
+        admitted) and ``CCSCInputError`` for malformed requests."""
         from ..utils import validate
 
         if self._close_started:
@@ -1548,6 +1667,8 @@ class ServeFleet:
             b, self.geom, mask=mask, smooth_init=smooth_init,
             x_orig=x_orig,
         )
+        self._tenants.check(tenant)
+        eff_bank = self._tenants.route(tenant, bank_id)
         spatial = tuple(
             int(s) for s in np.shape(b)[self.geom.ndim_reduce:]
         )
@@ -1565,6 +1686,7 @@ class ServeFleet:
         xorig32 = to32(x_orig)
         wall0 = time.time()  # span clock: admission starts here
         reject = None
+        treject = None
         with self._cv:
             if self._close_started:
                 raise RuntimeError("fleet is closed")
@@ -1591,7 +1713,37 @@ class ServeFleet:
                         "(exactly-once-or-error: the key is spent; "
                         "retry under a fresh key)"
                     )
+            # bank digest binds UNDER the lock: publish_bank flips
+            # the route under the same lock, so an admission can
+            # never observe a torn route table
+            digest = self._bank_routes.get(eff_bank)
+            if digest is None:
+                raise validate.CCSCInputError(
+                    f"unknown bank id {eff_bank!r} — published: "
+                    f"{sorted(k for k in self._bank_routes if k)} "
+                    "(the fleet's pinned bank routes as "
+                    "bank_id=None; publish_bank adds more)"
+                )
             depth = len(self._queue)
+            # per-tenant quota FIRST (the more specific refusal): a
+            # bursting tenant gets its own Overloaded while other
+            # tenants' admissions — and the shared queue capacity —
+            # are untouched
+            tq = self._tenants.quota(tenant, self._ceiling)
+            if tq is not None and self._queue.depth_of(tenant) >= tq:
+                self._tenant_rejects[tenant] = (
+                    self._tenant_rejects.get(tenant, 0) + 1
+                )
+                retry = (
+                    max(self._queue.depth_of(tenant), 1)
+                    / self._bound_rps
+                    if self._bound_rps > 0
+                    else 1.0
+                )
+                retry = min(max(retry, 0.05), 60.0)
+                treject = (
+                    tenant, self._queue.depth_of(tenant), tq, retry
+                )
             # rung 2 IS the reject rung: admission stays shut while
             # the ladder holds it, even once the queue dips back under
             # the hard ceiling — FleetConfig.reject_exit (the monitor's
@@ -1600,7 +1752,7 @@ class ServeFleet:
             # degraded (faster) solve budget is what the fleet trades
             # for serving under sustained pressure, so only the hard
             # ceiling gates it there.
-            if depth >= self._ceiling or self._rung == 2:
+            elif depth >= self._ceiling or self._rung == 2:
                 self._n_rejected += 1
                 retry = (
                     max(depth, 1) / self._bound_rps
@@ -1634,6 +1786,9 @@ class ServeFleet:
                     x_orig=xorig32,
                     future=Future(),
                     t_submit=time.perf_counter(),
+                    tenant=tenant,
+                    bank_id=eff_bank,
+                    digest=digest,
                     # span ids are assigned UNDER the lock (cheap id
                     # generation, no I/O) so a worker that takes this
                     # request immediately already sees them; the
@@ -1651,6 +1806,22 @@ class ServeFleet:
                 # the instant we release
                 qspan = req.queue_span
                 self._cv.notify_all()
+        if treject is not None:
+            t_name, t_depth, t_quota, retry = treject
+            jitter = _env.env_float("CCSC_FED_RETRY_JITTER") or 0.0
+            if jitter > 0:
+                retry *= 1.0 + random.random() * jitter
+            self._emit(
+                "tenant_reject", replica_id=None,
+                tenant=t_name, queue_depth=t_depth, quota=t_quota,
+                retry_after_s=round(retry, 3),
+            )
+            raise Overloaded(
+                f"tenant {t_name!r} is at its admission quota "
+                f"({t_depth}/{t_quota} queued); retry after "
+                f"~{retry:.2f}s (other tenants are unaffected)",
+                retry_after_s=retry,
+            )
         if reject is not None:
             depth, ceiling, rung, retry = reject
             # jitter the retry hint (CCSC_FED_RETRY_JITTER): N
@@ -1699,24 +1870,158 @@ class ServeFleet:
                 req.key, req.trace_id, b32, mask=mask32,
                 smooth_init=smooth32, x_orig=xorig32,
                 bucket=_bucket_name(bslots, bsp),
+                bank_id=eff_bank, tenant=tenant,
             )
         return req.future
 
     def reconstruct(
         self, b, mask=None, smooth_init=None, x_orig=None,
-        key: Optional[str] = None, timeout: Optional[float] = None,
+        key: Optional[str] = None,
+        bank_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+        timeout: Optional[float] = None,
     ) -> ServedResult:
         """Synchronous submit-and-wait."""
         return self.submit(
             b, mask=mask, smooth_init=smooth_init, x_orig=x_orig,
-            key=key,
+            key=key, bank_id=bank_id, tenant=tenant,
         ).result(timeout=timeout)
 
     def serve_many(self, requests, timeout=None) -> List[ServedResult]:
         """Submit an iterable of request dicts (keys b/mask/
-        smooth_init/x_orig/key) and wait for all results, in order."""
+        smooth_init/x_orig/key/bank_id/tenant) and wait for all
+        results, in order."""
         futs = [self.submit(**req) for req in requests]
         return [f.result(timeout=timeout) for f in futs]
+
+    # -- multi-tenant bank publication (serve.registry) ----------------
+    def publish_bank(
+        self, bank_id: Optional[str], d,
+        tenant: Optional[str] = None,
+    ) -> Tuple[Optional[str], str]:
+        """Fleet-wide zero-downtime hot-swap: make ``d`` servable on
+        EVERY replica, then atomically route ``bank_id`` (None = the
+        fleet's pinned default bank) to the new digest.
+
+        The rollout is STAGGERED — one replica's plans build at a
+        time (``CCSC_BANK_SWAP_STAGGER_S`` spacing), the rung-3
+        staggered-recycle discipline applied to publication — so the
+        plan-build burst is bounded and serving capacity never dips:
+        plan builds are jitted (no XLA recompile; the compiled bucket
+        programs are digest-canonical and shared) and traffic keeps
+        flowing on the old digest throughout. Requests admitted
+        before the flip bound the OLD digest and finish on it; the
+        first admission after the flip serves the new one. The
+        cutover is one ``bank_swap`` event carrying both digests.
+
+        A replica that dies mid-rollout is fine: its restart
+        republishes every retained bank before taking work
+        (``_spawn_replica``), and requeued requests re-serve against
+        their admission-time digest on any survivor. Returns
+        ``(old_digest, new_digest)``."""
+        from ..utils import validate
+
+        if self._close_started:
+            raise RuntimeError("fleet is closed")
+        validate.check_filters(d, self.geom)
+        digest = _registry.bank_digest(d)
+        arr = np.asarray(d)
+        with self._cv:
+            if self._close_started:
+                raise RuntimeError("fleet is closed")
+            # retained bytes FIRST: any replica restarting from here
+            # on republishes the new bank before taking work
+            self._bank_arrays[digest] = arr
+            old = self._bank_routes.get(bank_id)
+            reps = [
+                rep for rep in self._replicas
+                if rep is not None and not rep.retired
+            ]
+        stagger = _env.env_float("CCSC_BANK_SWAP_STAGGER_S") or 0.0
+        for i, rep in enumerate(reps):
+            if i and stagger > 0 and self._closing.wait(stagger):
+                raise RuntimeError("fleet closed mid-publish")
+            try:
+                rep.engine.add_bank(arr)
+            except RuntimeError:
+                # a replica that closed under us (crash handoff in
+                # flight): its replacement republishes from
+                # _bank_arrays, so the rollout still completes
+                continue
+        with self._cv:
+            if self._close_started:
+                raise RuntimeError("fleet is closed")
+            self._bank_routes[bank_id] = digest
+        self._emit(
+            "bank_swap", replica_id=None,
+            bank_id=bank_id, old_digest=old, new_digest=digest,
+            tenant=tenant, replicas=len(reps),
+        )
+        self._run.console(
+            f"fleet: bank {bank_id if bank_id else '<default>'} "
+            f"hot-swapped {old} -> {digest} across {len(reps)} "
+            "replica(s)",
+            tier="brief",
+        )
+        self._retire_stale_banks()
+        return old, digest
+
+    def _retire_stale_banks(self) -> None:
+        """Memory-bounding sweep after a route flip: drop superseded
+        digests NOTHING references anymore — not routed by any bank
+        id, not bound by any queued or assigned request (those finish
+        on their admission-time plan; the next publish retries the
+        sweep). A fleet republishing a refreshed bank continuously
+        must not accumulate every superseded copy forever."""
+        with self._cv:
+            routed = set(self._bank_routes.values())
+            bound = {r.digest for r in self._queue if r.digest}
+            for rep in self._replicas:
+                if rep is not None:
+                    bound.update(
+                        r.digest for r in rep.assigned if r.digest
+                    )
+            stale = [
+                dg for dg in self._bank_arrays
+                if dg not in routed and dg not in bound
+            ]
+            for dg in stale:
+                del self._bank_arrays[dg]
+            reps = [
+                rep for rep in self._replicas
+                if rep is not None and not rep.retired
+            ]
+        for dg in stale:
+            for rep in reps:
+                # best-effort: an engine still referencing the digest
+                # locally refuses and keeps its copy; nothing can
+                # bind the digest again, so that copy is the last
+                try:
+                    rep.engine.retire_bank(dg)
+                except Exception:
+                    pass
+
+    @property
+    def bank_ids(self) -> List[str]:
+        """Published bank ids (the pinned default bank routes as
+        None and is not listed)."""
+        with self._cv:
+            return sorted(
+                k for k in self._bank_routes if k is not None
+            )
+
+    def bank_digest(self, bank_id: Optional[str] = None) -> str:
+        """The digest ``bank_id`` currently routes to (None = the
+        fleet's pinned default bank)."""
+        from ..utils import validate
+
+        with self._cv:
+            digest = self._bank_routes.get(bank_id)
+        if digest is None:
+            raise validate.CCSCInputError(
+                f"unknown bank id {bank_id!r}"
+            )
+        return digest
 
     def stats(self) -> Dict[str, object]:
         """Fleet aggregates: delivery counts, latency percentiles,
@@ -1760,6 +2065,23 @@ class ServeFleet:
                 self._slo.percentile("total", 0.99)
             ),
             "replicas": reps,
+            "tenants": {
+                t: {
+                    "delivered": self._tenant_delivered.get(t, 0),
+                    "rejected": self._tenant_rejects.get(t, 0),
+                    "p50_latency_s": _ms_to_s(
+                        self._tenant_slos.percentile(t, 0.50)
+                    ),
+                    "p99_latency_s": _ms_to_s(
+                        self._tenant_slos.percentile(t, 0.99)
+                    ),
+                }
+                for t in self._tenants.names()
+            },
+            "banks": {
+                (bid if bid is not None else "<default>"): dg
+                for bid, dg in self._bank_routes.items()
+            },
         }
 
     def _ledger_append(self, st: Dict[str, object]) -> None:
@@ -2003,9 +2325,13 @@ class ServeFleet:
                 # closing histogram flush: the stream always ends
                 # with one complete fleet-wide slo_histogram per
                 # phase (offline percentile recomputation — the
-                # acceptance contract of the SLO layer)
+                # acceptance contract of the SLO layer), plus one
+                # per declared tenant (the TENANTS report's source)
                 _breaches, snaps = self._slo.final()
                 for sn in snaps:
+                    self._emit("slo_histogram", replica_id=None, **sn)
+                _t_breaches, t_snaps = self._tenant_slos.final()
+                for sn in t_snaps:
                     self._emit("slo_histogram", replica_id=None, **sn)
             if not self._run.closed:
                 st = self.stats()

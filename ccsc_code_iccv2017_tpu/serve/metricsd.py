@@ -54,9 +54,13 @@ __all__ = [
     "parse_snapshot_stamp",
     "render_prometheus",
     "resolve_endpoint",
+    "tenant_labeled_counters",
 ]
 
 _PREFIX = "ccsc"
+# exposition format version, stamped into every snapshot/scrape:
+# 2 = per-tenant labeled counter series (serve.tenancy) added
+SNAPSHOT_FORMAT = 2
 
 
 def resolve_endpoint(
@@ -81,6 +85,23 @@ def resolve_endpoint(
     if snap is None and metrics_dir:
         snap = os.path.join(metrics_dir, "metrics.prom")
     return int(port), snap
+
+
+def tenant_labeled_counters(
+    delivered: Dict[str, int], rejected: Dict[str, int]
+) -> List[Tuple[str, Dict[str, object], int]]:
+    """The ONE construction of the per-tenant labeled counter series
+    from {tenant: count} maps — shared by the fleet's live
+    ``metrics()`` and the stream-derived :class:`StreamMetrics`, so
+    the HTTP endpoint and a scrape-less snapshot can never render
+    different series names or label shapes for the same state."""
+    return [
+        ("tenant_requests_total", {"tenant": t}, delivered[t])
+        for t in sorted(delivered)
+    ] + [
+        ("tenant_rejected_total", {"tenant": t}, rejected[t])
+        for t in sorted(rejected)
+    ]
 
 
 def _fmt(v) -> str:
@@ -108,11 +129,14 @@ def render_prometheus(metrics: Dict, prefix: str = _PREFIX) -> str:
     """Render the shared metrics-dict shape:
 
     ``{"counters": {name: value}, "gauges": {name: value},
+    "labeled_counters": [(name, labels_dict, value), ...],
     "histograms": [(name, labels_dict, slo-snapshot-dict), ...]}``
 
     as Prometheus text exposition (one stable, sorted rendering — the
     HTTP endpoint and the snapshot file emit identical bytes for
-    identical state)."""
+    identical state). ``labeled_counters`` is the per-tenant series
+    surface (``tenant``/``bank_id`` labels, serve.tenancy): one TYPE
+    line per metric name, one sample per label set."""
     lines: List[str] = []
     for kind in ("counters", "gauges"):
         ptype = "counter" if kind == "counters" else "gauge"
@@ -120,6 +144,16 @@ def render_prometheus(metrics: Dict, prefix: str = _PREFIX) -> str:
             full = f"{prefix}_{name}"
             lines.append(f"# TYPE {full} {ptype}")
             lines.append(f"{full} {_fmt(metrics[kind][name])}")
+    seen_labeled = set()
+    for name, labels, value in sorted(
+        metrics.get("labeled_counters") or (),
+        key=lambda row: (row[0], sorted((row[1] or {}).items())),
+    ):
+        full = f"{prefix}_{name}"
+        if full not in seen_labeled:
+            seen_labeled.add(full)
+            lines.append(f"# TYPE {full} counter")
+        lines.append(f"{full}{_labels(labels)} {_fmt(value)}")
     seen_types = set()
     for name, labels, snap in metrics.get("histograms") or ():
         full = f"{prefix}_{name}"
@@ -218,7 +252,11 @@ class StreamMetrics:
         # double-count every early delivery)
         self._n_fleet_req = 0
         self._n_serve_req = 0
-        self._hists: Dict[Tuple[str, object], Dict] = {}
+        # per-tenant folds (serve.tenancy): delivered and
+        # quota-rejected counts, rendered as labeled counter series
+        self._tenant_req: Dict[str, int] = {}
+        self._tenant_rej: Dict[str, int] = {}
+        self._hists: Dict[Tuple[str, object, object], Dict] = {}
         self._lock = threading.Lock()
 
     def _is_fleet_dir(self) -> bool:
@@ -240,6 +278,11 @@ class StreamMetrics:
                 if kind == "fleet_request":
                     self._fleet_mode = True
                     self._n_fleet_req += 1
+                    t = rec.get("tenant")
+                    if t:
+                        self._tenant_req[t] = (
+                            self._tenant_req.get(t, 0) + 1
+                        )
                 elif kind == "serve_request":
                     self._n_serve_req += 1
                 elif kind == "serve_dispatch":
@@ -250,6 +293,12 @@ class StreamMetrics:
                     )
                 elif kind == "fleet_admission_reject":
                     self._counters["rejected_total"] += 1
+                elif kind == "tenant_reject":
+                    t = rec.get("tenant")
+                    if t:
+                        self._tenant_rej[t] = (
+                            self._tenant_rej.get(t, 0) + 1
+                        )
                 elif kind == "fleet_duplicate_suppressed":
                     self._counters["duplicates_suppressed_total"] += 1
                 elif kind == "slo_breach":
@@ -258,15 +307,18 @@ class StreamMetrics:
                     key = (
                         str(rec.get("phase", "total")),
                         rec.get("replica_id"),
+                        rec.get("tenant"),
                     )
                     self._hists[key] = rec
             hists = []
-            for (phase, rid), rec in sorted(
+            for (phase, rid, tenant), rec in sorted(
                 self._hists.items(), key=lambda kv: str(kv[0])
             ):
                 labels = {"phase": phase}
                 if rid is not None:
                     labels["replica"] = rid
+                if tenant is not None:
+                    labels["tenant"] = tenant
                 hists.append(("latency_ms", labels, rec))
             counters = dict(self._counters)
             counters["requests_total"] = (
@@ -274,9 +326,13 @@ class StreamMetrics:
                 if self._fleet_mode
                 else self._n_serve_req
             )
+            labeled = tenant_labeled_counters(
+                self._tenant_req, self._tenant_rej
+            )
             return {
                 "counters": counters,
                 "gauges": {},
+                "labeled_counters": labeled,
                 "histograms": hists,
             }
 
@@ -361,6 +417,12 @@ class MetricsD:
             self._last_body = body
             self._last_change = now
         stamp = [
+            # snapshot-format version stamp: readers that care about
+            # the exposition shape (format 2 added labeled per-tenant
+            # counter series) can branch on it; parse_snapshot_stamp
+            # ignores it — the freshness contract is unchanged
+            "# TYPE ccsc_snapshot_format gauge",
+            f"ccsc_snapshot_format {SNAPSHOT_FORMAT}",
             "# TYPE ccsc_snapshot_timestamp_seconds gauge",
             f"ccsc_snapshot_timestamp_seconds {_fmt(now)}",
             "# TYPE ccsc_snapshot_age_seconds gauge",

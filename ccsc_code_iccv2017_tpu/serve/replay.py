@@ -191,7 +191,8 @@ class ReplayDriver:
                 run.close(status="ok")
 
     def _submit_one(
-        self, target, rkey, arrays, is_fleet, overloaded_cls
+        self, target, rkey, arrays, is_fleet, overloaded_cls,
+        bank_id=None, tenant=None,
     ):
         """Submit with explicit-backpressure retries; returns
         (future, n_overload_backoffs, t_submit). Admission refusals
@@ -200,11 +201,16 @@ class ReplayDriver:
         ``rkey`` is a replay-unique key, NOT the recorded one: a
         multi-session capture legitimately repeats idempotency keys
         (auto-keys restart per fleet), and resubmitting a spent key
-        would be refused. ``t_submit`` is taken after the last
-        refusal, so backoff sleeps never inflate the replayed
-        latency — the recorded side only ever measures admitted
-        submit->delivery, and the comparison must too."""
+        would be refused. ``bank_id``/``tenant`` are the RECORDED
+        routing identities: a mixed-tenant capture replays each
+        request against its own bank (per-bank digest parity) under
+        its own tenant accounting — the replay target must have the
+        same banks published and tenants declared. ``t_submit`` is
+        taken after the last refusal, so backoff sleeps never inflate
+        the replayed latency — the recorded side only ever measures
+        admitted submit->delivery, and the comparison must too."""
         n_over = 0
+        route = {"bank_id": bank_id, "tenant": tenant}
         while True:
             t_sub = time.perf_counter()
             try:
@@ -216,6 +222,7 @@ class ReplayDriver:
                             smooth_init=arrays["smooth_init"],
                             x_orig=arrays["x_orig"],
                             key=rkey,
+                            **route,
                         ),
                         n_over,
                         t_sub,
@@ -226,6 +233,7 @@ class ReplayDriver:
                         mask=arrays["mask"],
                         smooth_init=arrays["smooth_init"],
                         x_orig=arrays["x_orig"],
+                        **route,
                     ),
                     n_over,
                     t_sub,
@@ -257,6 +265,8 @@ class ReplayDriver:
             fut, n_over, t_sub = self._submit_one(
                 target, f"replay-{i:06d}", arrays, is_fleet,
                 overloaded_cls,
+                bank_id=req.get("bank_id"),
+                tenant=req.get("tenant"),
             )
             n_overloaded += n_over
             if mode == "closed":
@@ -306,6 +316,8 @@ class ReplayDriver:
                 "replay_request",
                 key=req["key"],
                 status=status,
+                tenant=req.get("tenant"),
+                bank_id=req.get("bank_id"),
                 latency_ms=round(lat_ms, 3),
                 recorded_latency_ms=(
                     None if out is None else out.get("latency_ms")

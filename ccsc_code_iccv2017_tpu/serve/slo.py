@@ -46,6 +46,7 @@ from ..utils import env as _env
 __all__ = [
     "Histogram",
     "SloMonitor",
+    "TenantSlos",
     "DEFAULT_BOUNDS_MS",
     "default_bounds",
     "resolve_targets",
@@ -310,3 +311,100 @@ class SloMonitor:
         consume a pending breach trigger)."""
         with self._lock:
             return self._snapshots_locked()
+
+
+class TenantSlos:
+    """Per-TENANT latency SLO monitors (the multi-tenant face of
+    :class:`SloMonitor`): one monitor per declared
+    :class:`~..config.TenantSpec`, each judging its OWN declared
+    p50/p99 targets against its own streaming histogram — one
+    tenant's burst cannot move another tenant's quantiles, which is
+    what makes "the other tenant's latency band held" a measurable
+    claim rather than a fleet-average guess.
+
+    Targets come from the spec ONLY (no CCSC_SLO_* env fallback here:
+    a fleet-wide knob must not silently become every tenant's
+    contract). Every record returned by ``tick``/``final``/
+    ``raw_snapshots`` carries the ``tenant`` name, and snapshots also
+    carry the declared targets (``target_p50_ms``/``target_p99_ms``)
+    so a stream reader can judge "within band" offline without the
+    fleet config in hand. Untenanted traffic (tenant None) and
+    unknown tenants are ignored — the fleet-wide monitor owns them.
+    Thread-safe via the per-monitor locks; same caller-emits
+    discipline as :class:`SloMonitor`.
+    """
+
+    def __init__(self, specs=None, check_s: Optional[float] = None,
+                 bounds: Sequence[float] = DEFAULT_BOUNDS_MS):
+        self._mons: Dict[str, SloMonitor] = {}
+        self.targets: Dict[str, Dict[float, float]] = {}
+        for spec in specs or ():
+            targets: Dict[float, float] = {}
+            if spec.slo_p50_ms is not None and spec.slo_p50_ms > 0:
+                targets[0.50] = float(spec.slo_p50_ms)
+            if spec.slo_p99_ms is not None and spec.slo_p99_ms > 0:
+                targets[0.99] = float(spec.slo_p99_ms)
+            self.targets[spec.tenant] = targets
+            self._mons[spec.tenant] = SloMonitor(
+                targets, check_s=check_s, bounds=bounds
+            )
+
+    def __bool__(self) -> bool:
+        return bool(self._mons)
+
+    def observe(self, tenant: Optional[str], ms: float) -> None:
+        mon = self._mons.get(tenant) if tenant is not None else None
+        if mon is not None:
+            mon.observe(SloMonitor.TARGET_PHASE, ms)
+
+    def percentile(
+        self, tenant: str, q: float
+    ) -> Optional[float]:
+        mon = self._mons.get(tenant)
+        if mon is None:
+            return None
+        return mon.percentile(SloMonitor.TARGET_PHASE, q)
+
+    def n(self, tenant: str) -> int:
+        mon = self._mons.get(tenant)
+        return mon.n(SloMonitor.TARGET_PHASE) if mon else 0
+
+    def _stamp(self, tenant: str, recs: List[Dict]) -> List[Dict]:
+        t = self.targets.get(tenant, {})
+        for rec in recs:
+            rec["tenant"] = tenant
+            if "counts" in rec:  # histogram snapshots carry the
+                # declared band so offline readers judge them alone
+                rec["target_p50_ms"] = t.get(0.50)
+                rec["target_p99_ms"] = t.get(0.99)
+        return recs
+
+    def tick(
+        self, now: Optional[float] = None
+    ) -> Tuple[List[Dict], List[Dict]]:
+        breaches: List[Dict] = []
+        snaps: List[Dict] = []
+        for tenant in sorted(self._mons):
+            br, sn = self._mons[tenant].tick(now)
+            breaches.extend(self._stamp(tenant, br))
+            snaps.extend(self._stamp(tenant, sn))
+        return breaches, snaps
+
+    def final(self) -> Tuple[List[Dict], List[Dict]]:
+        breaches: List[Dict] = []
+        snaps: List[Dict] = []
+        for tenant in sorted(self._mons):
+            br, sn = self._mons[tenant].final()
+            breaches.extend(self._stamp(tenant, br))
+            snaps.extend(self._stamp(tenant, sn))
+        return breaches, snaps
+
+    def raw_snapshots(self) -> List[Dict]:
+        out: List[Dict] = []
+        for tenant in sorted(self._mons):
+            out.extend(
+                self._stamp(
+                    tenant, self._mons[tenant].raw_snapshots()
+                )
+            )
+        return out

@@ -111,6 +111,25 @@ REGISTRY: Dict[str, Knob] = _knobs(
      "refuse a serving mesh the visible device pool cannot back "
      "(with the forced-host-device recipe in the error); 0 falls "
      "back to a single-device engine with a console note instead"),
+    # -- multi-tenant bank registry + tenancy (serve.registry,
+    # serve.tenancy, serve.engine, serve.fleet) ----------------------
+    ("CCSC_BANK_REGISTRY", "path", None,
+     "serve.registry, apps/serve.py",
+     "durable bank-registry directory (manifest.jsonl + "
+     "content-addressed banks/): --bank-registry / "
+     "BankRegistry(path) fall back to it; unset = no registry"),
+    ("CCSC_BANK_PLAN_CACHE_MB", "float", 256.0, "serve.registry",
+     "byte budget (MB) of the per-bank ReconPlan LRU (PlanCache): "
+     "past it, least-recently-used plans are evicted and rebuilt on "
+     "their next request (digests with queued work are pinned)"),
+    ("CCSC_BANK_SWAP_STAGGER_S", "float", 0.0, "serve.fleet",
+     "delay between per-replica plan publishes during a fleet-wide "
+     "bank hot-swap rollout (the staggered-recycle discipline: bound "
+     "the concurrent plan-build burst; 0 = publish back-to-back)"),
+    ("CCSC_TENANT_QUOTA_FRAC", "float", 0.5, "serve.tenancy",
+     "default per-tenant admission quota as a fraction of (queue "
+     "ceiling x the tenant's weight share) when TenantSpec.quota is "
+     "not declared"),
     # -- workload capture + replay (serve.capture, serve.replay) -----
     ("CCSC_CAPTURE_DIR", "path", None,
      "serve.capture, serve.fleet, serve.engine",

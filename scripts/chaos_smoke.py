@@ -35,6 +35,15 @@ restores them):
                       outcome — faults must not leak into the served
                       bytes, and the capture must be a faithful
                       oracle
+  bank_swap           zero-downtime hot-swap under fire: a 2-replica
+                      fleet serves sustained two-tenant traffic while
+                      one tenant's bank is republished under a new
+                      digest (serve.registry) AND a replica kill
+                      fault fires mid-swap — zero lost requests, the
+                      cutover visible as a bank_swap event with both
+                      digests, pre-swap results bit-identical to a
+                      fresh old-bank engine and post-swap results to
+                      a fresh new-bank engine
   host_kill           (script mode only) whole-host chaos: 2 federated
                       fleet PROCESSES drain a shared file-lease queue
                       (serve.dqueue / serve.federation); one is
@@ -413,6 +422,157 @@ def scenario_replay_parity():
     )
 
 
+def scenario_bank_swap():
+    """Zero-downtime hot-swap under fire: a 2-replica fleet serves
+    sustained two-tenant traffic; mid-stream, tenant beta's bank is
+    republished under a new digest WHILE a replica kill fault fires.
+    Must hold: zero lost requests, the cutover visible as a
+    fleet-scope ``bank_swap`` with both digests, every pre-swap beta
+    result bit-identical to a fresh old-bank engine, every post-swap
+    beta result bit-identical to a fresh new-bank engine, and tenant
+    alpha's results untouched throughout."""
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import (
+        FleetConfig,
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+        TenantSpec,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import CodecEngine, ServeFleet
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    def bank(seed):
+        r = np.random.default_rng(seed)
+        d = r.normal(size=(4, 3, 3)).astype(np.float32)
+        d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+        return d
+
+    d_alpha, d_beta0, d_beta1 = bank(0), bank(1), bank(2)
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_objective=True,
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    tenants = (
+        TenantSpec(tenant="alpha", bank_id="bank-alpha"),
+        TenantSpec(tenant="beta", bank_id="bank-beta"),
+    )
+    r = np.random.default_rng(3)
+    reqs = []
+    for _ in range(8):
+        x = r.random((12, 12)).astype(np.float32)
+        m = (r.random((12, 12)) < 0.5).astype(np.float32)
+        reqs.append((x * m, m))
+    tenant_of = lambda i: "alpha" if i % 2 == 0 else "beta"
+    with tempfile.TemporaryDirectory() as mdir:
+        with _fault(
+            CCSC_FAULT_ENGINE_KILL_REQ=2,
+            CCSC_FAULT_ENGINE_KILL_REPLICA="0",
+        ):
+            fleet = ServeFleet(
+                d_alpha, ReconstructionProblem(geom), cfg, scfg,
+                FleetConfig(
+                    replicas=2, metrics_dir=mdir, min_queue_depth=64,
+                    restart_backoff_s=0.05, verbose="none",
+                    tenants=tenants,
+                ),
+            )
+            fleet.publish_bank("bank-alpha", d_alpha)
+            fleet.publish_bank("bank-beta", d_beta0)
+            pre = [
+                fleet.submit(b, mask=m, tenant=tenant_of(i),
+                             key=f"pre{i}")
+                for i, (b, m) in enumerate(reqs)
+            ]
+            # the hot-swap lands while the pre-batch is in flight and
+            # the kill fault is armed — the republished digest must
+            # not retarget admitted work, and the casualty's requeues
+            # must still serve their admission-time digest
+            old_dg, new_dg = fleet.publish_bank(
+                "bank-beta", d_beta1, tenant="beta"
+            )
+            post = [
+                fleet.submit(b, mask=m, tenant=tenant_of(i),
+                             key=f"post{i}")
+                for i, (b, m) in enumerate(reqs)
+            ]
+            pre_r = [f.result(timeout=180) for f in pre]
+            post_r = [f.result(timeout=180) for f in post]
+            fleet.close()
+        events = obs.read_events(mdir, recursive=True)
+        dead = [
+            e for e in events if e["type"] == "fleet_replica_dead"
+        ]
+        swaps = [
+            e for e in events
+            if e["type"] == "bank_swap"
+            and e.get("replica_id") is None
+            and e.get("bank_id") == "bank-beta"
+            and e.get("old_digest") == old_dg
+            and e.get("new_digest") == new_dg
+            and e.get("old_digest") is not None
+        ]
+
+    # bit-parity oracles: fresh single-bank engines
+    def oracle(d, items):
+        eng = CodecEngine(
+            d, ReconstructionProblem(geom), cfg, scfg
+        )
+        try:
+            return [eng.reconstruct(b, mask=m) for b, m in items]
+        finally:
+            eng.close()
+
+    alpha_items = [reqs[i] for i in range(8) if i % 2 == 0]
+    beta_items = [reqs[i] for i in range(8) if i % 2 == 1]
+    o_alpha = oracle(d_alpha, alpha_items)
+    o_beta0 = oracle(d_beta0, beta_items)
+    o_beta1 = oracle(d_beta1, beta_items)
+    alpha_ok = all(
+        np.array_equal(got.recon, want.recon)
+        for got, want in zip(
+            [pre_r[i] for i in range(8) if i % 2 == 0]
+            + [post_r[i] for i in range(8) if i % 2 == 0],
+            o_alpha + o_alpha,
+        )
+    )
+    beta_pre_ok = all(
+        np.array_equal(got.recon, want.recon)
+        for got, want in zip(
+            [pre_r[i] for i in range(8) if i % 2 == 1], o_beta0
+        )
+    )
+    beta_post_ok = all(
+        np.array_equal(got.recon, want.recon)
+        for got, want in zip(
+            [post_r[i] for i in range(8) if i % 2 == 1], o_beta1
+        )
+    )
+    ok = (
+        len(pre_r) == 8
+        and len(post_r) == 8
+        and len(dead) == 1
+        and len(swaps) == 1
+        and alpha_ok
+        and beta_pre_ok
+        and beta_post_ok
+    )
+    return ok, (
+        f"served={len(pre_r) + len(post_r)}/16, dead={len(dead)}, "
+        f"swap={old_dg}->{new_dg} (events={len(swaps)}), "
+        f"alpha_parity={alpha_ok}, beta_pre={beta_pre_ok}, "
+        f"beta_post={beta_post_ok}"
+    )
+
+
 def _host_kill_child_code(qdir, bank_path, mdir, host_id):
     """Source of one federated host process (shared by the chaos
     scenario and tests/test_federation.py): join the pool at qdir,
@@ -686,6 +846,7 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
         "hang_watchdog": scenario_hang_watchdog,
         "fleet_kill": scenario_fleet_kill,
         "replay_parity": scenario_replay_parity,
+        "bank_swap": scenario_bank_swap,
     }
     if subprocess_scenarios:
         scenarios["host_kill"] = scenario_host_kill

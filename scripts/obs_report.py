@@ -583,13 +583,18 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
     shists = by.get("slo_histogram", [])
     sbreach = by.get("slo_breach", [])
     sprof = by.get("slo_profile", [])
-    if shists or sbreach:
+    # tenant-stamped records belong to the TENANTS section below —
+    # mixed into the fleet-wide keys here, a tenant's (smaller, later)
+    # histogram would silently overwrite the 'total [fleet]' row
+    fleet_hists = [h for h in shists if not h.get("tenant")]
+    fleet_breach = [b for b in sbreach if not b.get("tenant")]
+    if fleet_hists or fleet_breach:
         lines.append(_section("SLO"))
         # newest snapshot per (phase, scope): histograms are
         # cumulative, so the last record IS the run's distribution —
         # percentiles recomputed offline from the stream alone
         newest = {}
-        for h in shists:
+        for h in fleet_hists:
             newest[(h.get("phase"), h.get("replica_id"))] = h
         for (phase, rid), h in sorted(
             newest.items(), key=lambda kv: (str(kv[0][0]), str(kv[0][1]))
@@ -604,9 +609,9 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
                 f"{f(hist.percentile(0.99))} ms  max "
                 f"{hist.max_ms:.1f} ms"
             )
-        if sbreach:
-            lines.append(f"  breaches      {len(sbreach)}")
-            for b in sbreach[-5:]:
+        if fleet_breach:
+            lines.append(f"  breaches      {len(fleet_breach)}")
+            for b in fleet_breach[-5:]:
                 lines.append(
                     f"    {_fmt_ts(b['t'])}  p"
                     f"{int(100 * b.get('quantile', 0))} "
@@ -617,6 +622,86 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
             lines.append(
                 f"  xprof capture {p.get('trace_dir')} (armed by an "
                 "SLO breach; scripts/xprof_report.py attributes it)"
+            )
+
+    # -- TENANTS: per-tenant latency vs declared targets, quota
+    # rejections, and bank swap history (serve.tenancy /
+    # serve.registry) ------------------------------------------------
+    t_hists = [h for h in shists if h.get("tenant")]
+    t_rejects = by.get("tenant_reject", [])
+    swaps = by.get("bank_swap", [])
+    pubs = by.get("bank_publish", [])
+    if t_hists or t_rejects or swaps or pubs:
+        lines.append(_section("TENANTS"))
+        newest_t = {}
+        for h in t_hists:
+            newest_t[h["tenant"]] = h  # cumulative: last wins
+        t_breached = {
+            b.get("tenant")
+            for b in sbreach
+            if b.get("tenant")
+        }
+        for tenant in sorted(newest_t):
+            h = newest_t[tenant]
+            hist = _slo.from_snapshot(h)
+
+            def _vs(q, target):
+                v = hist.percentile(q)
+                if v is None:
+                    return "—"
+                s = f"{v:.1f} ms"
+                if target:
+                    s += (
+                        f" > target {target:g}"
+                        if v > target
+                        else f" (target {target:g})"
+                    )
+                return s
+
+            flag = "  <-- SLO BREACHED" if tenant in t_breached else ""
+            lines.append(
+                f"  {tenant:<12} n={hist.n}  p50 "
+                f"{_vs(0.50, h.get('target_p50_ms'))}  p99 "
+                f"{_vs(0.99, h.get('target_p99_ms'))}{flag}"
+            )
+        if t_rejects:
+            per_rej = {}
+            for e in t_rejects:
+                agg = per_rej.setdefault(
+                    e.get("tenant", "?"), {"n": 0, "quota": None}
+                )
+                agg["n"] += 1
+                agg["quota"] = e.get("quota")
+            for tenant in sorted(per_rej):
+                agg = per_rej[tenant]
+                lines.append(
+                    f"  rejections    {tenant}: {agg['n']} quota "
+                    f"refusal(s) (quota {agg['quota']}) — explicit "
+                    "Overloaded, other tenants unaffected"
+                )
+        for p_ in pubs:
+            lines.append(
+                f"  published     {_fmt_ts(p_['t'])}  "
+                f"{p_.get('bank_id')} @ {p_.get('digest')}"
+                + (
+                    f" (tenant {p_['tenant']})"
+                    if p_.get("tenant") else ""
+                )
+            )
+        for s in swaps:
+            scope = (
+                "fleet" if s.get("replica_id") is None
+                else f"replica {s['replica_id']}"
+            )
+            lines.append(
+                f"  bank swap     {_fmt_ts(s['t'])}  "
+                f"{s.get('bank_id') or '<default>'}: "
+                f"{s.get('old_digest') or '(first publish)'} -> "
+                f"{s.get('new_digest')}  [{scope}]"
+                + (
+                    f" (tenant {s['tenant']})"
+                    if s.get("tenant") else ""
+                )
             )
 
     # -- SNAPSHOT: metrics.prom freshness (serve.metricsd stamp) -----
@@ -846,7 +931,8 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
                  "fleet_replica_dead",
                  "fleet_replica_restart", "fleet_replica_ready",
                  "fleet_replica_abandoned", "fleet_requeue",
-                 "fleet_overload", "fed_join", "fed_leave",
+                 "fleet_overload", "bank_swap", "tenant_reject",
+                 "fed_join", "fed_leave",
                  "dqueue_requeue", "dqueue_failed"):
         for e in by.get(kind, []):
             n_ev += 1
