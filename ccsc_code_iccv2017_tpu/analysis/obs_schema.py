@@ -63,9 +63,21 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     # engines: ServeConfig.mesh_shape) — obs_report's SERVING section
     # and the mixed-fleet ceiling check read them back ----------------
     "serve_warmup": _s("replica_id", "bucket", "warmup_s", "knobs",
-                       "devices"),
+                       "devices", "source"),
     "serve_ready": _s("replica_id", "n_buckets", "warmup_s",
                       "devices"),
+    # -- compiled-artifact store + staged warmup (serve.artifacts,
+    # serve.engine). artifact_fetch/publish announce store traffic
+    # with a per-call status (hit/miss/chip_mismatch/... resp.
+    # won/lost/exists/repair); warmup_stage is the per-bucket staged
+    # timeline (ready_s since warmup start, source = fetched |
+    # compiled | cache-hit | lazy); bucket_cold is the staged
+    # admission refusal (engine- or fleet-scope, so no forced
+    # replica_id — the engine's _emit stamps one anyway) -------------
+    "artifact_fetch": _s("key", "status"),
+    "artifact_publish": _s("key", "status"),
+    "warmup_stage": _s("bucket", "stage", "source", "ready_s"),
+    "bucket_cold": _s("bucket", "retry_after_s"),
     "serve_request": _s("replica_id", "trace_id", "bucket",
                         "latency_ms", "iters"),
     "serve_dispatch": _s("replica_id", "bucket", "n", "slots",

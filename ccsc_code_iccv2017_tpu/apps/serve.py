@@ -165,6 +165,20 @@ def build_parser() -> argparse.ArgumentParser:
         "first use)",
     )
     p.add_argument(
+        "--artifact-store", default=None,
+        help="shared compiled-artifact store dir (serve.artifacts; "
+        "CCSC_ARTIFACT_STORE env equivalent): warmup fetches "
+        "AOT-serialized bucket executables published by other hosts "
+        "instead of compiling, and publishes what it had to compile",
+    )
+    p.add_argument(
+        "--staged-warmup", action="store_true",
+        help="serve the hottest bucket as soon as its program is "
+        "ready while cold buckets build/fetch in the background "
+        "(submits to cold buckets get a BucketCold retry-after "
+        "refusal; default: CCSC_SERVE_STAGED env)",
+    )
+    p.add_argument(
         "--slo-p50-ms", type=float, default=None,
         help="declared p50 submit->result latency target in ms "
         "(serve.slo): breaches emit slo_breach obs events live "
@@ -230,7 +244,7 @@ def main(argv=None):
     from ..data.images import load_image_list
     from ..data.native import smooth_fill_batch
     from ..models.reconstruct import ReconstructionProblem
-    from ..serve import CodecEngine, Overloaded, ServeFleet
+    from ..serve import BucketCold, CodecEngine, Overloaded, ServeFleet
     from ..utils.io_mat import load_filters_2d
 
     from ..utils import env as _env
@@ -334,6 +348,10 @@ def main(argv=None):
         tune=args.tune,
         tune_store=args.tune_store,
         capture_dir=args.capture_dir,
+        artifact_store=args.artifact_store,
+        # the flag arms staged warmup; absent, ServeConfig falls back
+        # to the CCSC_SERVE_STAGED env knob
+        staged_warmup=True if args.staged_warmup else None,
     )
     if args.replicas < 1:
         raise SystemExit("--replicas must be >= 1")
@@ -486,7 +504,7 @@ def main(argv=None):
                     x * mask, mask=mask, smooth_init=sm, x_orig=x,
                     tenant=args.request_tenant,
                 )
-            except Overloaded as e:
+            except (Overloaded, BucketCold) as e:
                 # explicit backpressure: the fleet told us how long
                 # to back off — honor the (already jittered,
                 # CCSC_FED_RETRY_JITTER) hint instead of dropping the
@@ -494,14 +512,21 @@ def main(argv=None):
                 # refusals: a hint computed at the admission ceiling
                 # describes the queue as it was, and N producers
                 # re-colliding on it forever is the thundering herd
-                # the jitter + escalation exist to break up
+                # the jitter + escalation exist to break up. A
+                # BucketCold refusal (staged warmup still building
+                # this bucket's program) rides the same backoff.
                 n_overloaded += 1
                 consec += 1
                 delay = min(
                     e.retry_after_s * (2 ** min(consec - 1, 5)), 60.0
                 )
+                why = (
+                    "bucket cold"
+                    if isinstance(e, BucketCold)
+                    else "overloaded"
+                )
                 print(
-                    f"  {label}: overloaded, retrying in "
+                    f"  {label}: {why}, retrying in "
                     f"{delay:.2f}s"
                 )
                 time.sleep(delay)

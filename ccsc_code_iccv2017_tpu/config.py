@@ -511,6 +511,29 @@ class ServeConfig:
     # devices. A fleet with several mesh replicas in one process
     # assigns disjoint slices through this field.
     mesh_devices: Optional[Tuple[int, ...]] = None
+    # Compiled-artifact store (serve.artifacts): directory of
+    # AOT-serialized bucket executables shared between hosts. At
+    # warmup the engine FETCHES each bucket's program (keyed by
+    # program fingerprint x chip x mesh) instead of compiling, and
+    # publishes what it had to live-compile so the next joining host
+    # doesn't. None = CCSC_ARTIFACT_STORE env; "" = explicitly off.
+    artifact_store: Optional[str] = None
+    # Staged warmup: serve the hottest bucket as soon as its program
+    # is ready while the remaining buckets build/fetch in a
+    # background thread — submits to a not-yet-warm bucket get a
+    # BucketCold retry-after refusal instead of the whole engine
+    # blocking until every program exists. None = CCSC_SERVE_STAGED
+    # env (default off: blocking warmup, the historical behavior).
+    staged_warmup: Optional[bool] = None
+    # Explicit hot-to-cold bucket order for staged warmup, as bucket
+    # labels ("slots@HxW"). Unlisted buckets follow in volume order.
+    # None = rank by capture frequency (warm_rank_capture) else
+    # configured volume order.
+    warm_order: Optional[Tuple[str, ...]] = None
+    # Workload-capture directory (serve.capture) to rank buckets by
+    # measured request frequency when no warm_order is declared.
+    # None = CCSC_WARM_RANK_CAPTURE env; "" = explicitly off.
+    warm_rank_capture: Optional[str] = None
 
     def __post_init__(self):
         for fname in ("slo_p50_ms", "slo_p99_ms", "slo_check_s"):
@@ -563,6 +586,18 @@ class ServeConfig:
         if self.max_wait_ms < 0:
             raise ValueError(
                 f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
+            )
+        if self.warm_order is not None:
+            if isinstance(self.warm_order, str):
+                raise ValueError(
+                    f"warm_order {self.warm_order!r} is a string — "
+                    "pass a tuple of bucket labels like "
+                    "('8@32x32', '4@16x16')"
+                )
+            object.__setattr__(
+                self,
+                "warm_order",
+                tuple(str(n) for n in self.warm_order),
             )
         if self.mesh_shape is not None:
             # reject spec STRINGS before tuple coercion: iterating

@@ -29,11 +29,28 @@ requests finish on the old plan, the cutover is a ``bank_swap``
 event), per-tenant SLO histograms (serve.slo.TenantSlos), and
 weighted-fair admission with per-tenant quotas so one tenant's burst
 gets its own ``Overloaded`` rejections while other tenants' latency
-bands hold.
+bands hold. :class:`ArtifactStore` (serve.artifacts) is the
+pre-warmed-elasticity layer: a shared content-addressed store of
+AOT-serialized bucket executables keyed by program fingerprint x
+chip x mesh, so a joining host FETCHES its programs instead of
+compiling them, and staged warmup (ServeConfig.staged_warmup) serves
+the hottest bucket the moment its program is ready — cold buckets
+build in the background behind explicit :class:`BucketCold`
+retry-after refusals.
 """
+from .artifacts import (  # noqa: F401
+    ArtifactStore,
+    artifact_key,
+    deserialize_program,
+    program_fingerprint,
+    rank_buckets,
+    resolve_artifact_dir,
+    serialize_program,
+)
 from .capture import WorkloadRecorder  # noqa: F401
 from .dqueue import DurableQueue  # noqa: F401
 from .engine import (  # noqa: F401
+    BucketCold,
     CodecEngine,
     ServedResult,
     enable_compile_cache,

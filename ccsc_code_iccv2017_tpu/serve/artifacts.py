@@ -1,0 +1,451 @@
+"""Compiled-program artifact store: content-addressed AOT executables.
+
+Production scale multiplies compiles — every (bucket x mesh x knob arm
+x chip) pair is its own XLA program, and a freshly scaled host pays
+full AOT warmup for every bucket before serving a request. The
+per-process persistent XLA cache (``CCSC_COMPILE_CACHE``) only fixes
+warm RESTARTS of the same machine; a NEW host starts cold. This module
+is the compiled-program analog of the bank registry
+(:mod:`.registry`): the program, not the process, is the unit of reuse
+(the MPAX jit-cache fleet pattern, PAPERS.md 2412.09734).
+
+- :func:`program_fingerprint` — content identity of one bucket
+  program: bucket shape, problem geometry, the engine's RESOLVED knob
+  dict (solve arm + tune + mesh topology), the plan's pytree structure
+  and leaf avals (a structural change — blur OTF present, bf16
+  factors — is a different program even under identical knobs), and
+  the jax version (serialized executables do not cross jax releases).
+- :func:`artifact_key` — the store key: fingerprint x chip kind x
+  mesh shape. A v5e executable must never be offered to a CPU host —
+  cross-chip fetches are REFUSED, mirroring the tuned-store stance.
+- :class:`ArtifactStore` — durable store with the registry's
+  discipline: one ``manifest.jsonl`` appended line-per-record and read
+  with the ``analysis.ledger`` torn-tail stance (a torn or truncated
+  record reads as ABSENT, never as an error), payloads under
+  ``programs/<key>.bin`` written tmp + ``os.link`` (O_EXCL first-wins:
+  exactly one of N concurrent publishers links the payload and appends
+  the manifest record; losers discard). Fetch re-verifies the payload
+  sha against the manifest — a truncated or hand-edited artifact reads
+  as absent and the engine falls back to live compile, then
+  re-publishes (the repair path replaces the corrupt payload
+  atomically).
+- :func:`serialize_program` / :func:`deserialize_program` — the AOT
+  executable wire format (``jax.experimental.serialize_executable`` +
+  the arg/result treedefs in one self-describing blob).
+- :func:`rank_buckets` — the staged-warmup ordering: declared order
+  first, else request frequency from a workload capture
+  (serve.capture), else the configured volume order. The hottest
+  bucket's program is built/fetched FIRST so a joining host serves it
+  while cold buckets warm in the background.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import pickle
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils import env as _env
+from ..utils import obs as _obs
+
+__all__ = [
+    "ArtifactStore",
+    "artifact_key",
+    "bucket_label",
+    "deserialize_program",
+    "program_fingerprint",
+    "rank_buckets",
+    "resolve_artifact_dir",
+    "serialize_program",
+]
+
+_MANIFEST_NAME = "manifest.jsonl"
+_PROGRAM_DIR = "programs"
+_SCHEMA = 1
+# payload blob schema: bumped whenever the pickle layout changes so a
+# reader can refuse a future format instead of mis-parsing it
+_PAYLOAD_SCHEMA = 1
+
+
+def resolve_artifact_dir(explicit: Optional[str]) -> Optional[str]:
+    """The one resolution chain for the artifact-store location: an
+    explicit path wins, ``""`` is explicitly off (even with the env
+    knob armed), else ``CCSC_ARTIFACT_STORE``, else no store (None) —
+    the ``resolve_registry_dir`` convention."""
+    if explicit == "":
+        return None
+    return explicit or _env.env_str("CCSC_ARTIFACT_STORE") or None
+
+
+def bucket_label(slots: int, spatial: Sequence[int]) -> str:
+    """The engine's bucket naming (``"slots@HxW"``) without importing
+    the engine (this module must stay import-light for tooling)."""
+    return f"{int(slots)}@" + "x".join(str(int(s)) for s in spatial)
+
+
+def _mesh_token(mesh_shape: Optional[Sequence[int]]) -> str:
+    if not mesh_shape:
+        return "single"
+    return "mesh" + "x".join(str(int(a)) for a in mesh_shape)
+
+
+def program_fingerprint(
+    *,
+    bucket: Tuple[int, Tuple[int, ...]],
+    geom,
+    problem: Optional[Dict[str, Any]] = None,
+    knobs: Optional[Dict[str, Any]] = None,
+    mesh_shape: Optional[Sequence[int]] = None,
+    plan=None,
+) -> str:
+    """Content identity of one bucket program (sha256, first 20 hex).
+
+    Everything that changes the LOWERED program must be in here:
+    bucket shape, geometry, the problem's static solve structure, the
+    resolved knob dict (the serving engine's ``_knob_dict`` — solve
+    arm, tune resolution, mesh topology), and — when a built plan is
+    given — the plan pytree's STRUCTURE and leaf avals: a plan with a
+    blur OTF leaf, or bf16 solve factors, lowers to a different
+    executable than one without, even under an identical knob dict.
+    The jax version is folded in because serialized executables do not
+    cross releases (deserialization refuses them anyway; the version
+    in the key just keeps incompatible artifacts from colliding)."""
+    import jax
+
+    slots, spatial = bucket
+    desc: Dict[str, Any] = {
+        "schema": _SCHEMA,
+        "jax": jax.__version__,
+        "bucket": [int(slots), [int(s) for s in spatial]],
+        "geom": {
+            "num_filters": int(geom.num_filters),
+            "spatial_support": list(geom.spatial_support),
+            "reduce_shape": list(geom.reduce_shape),
+        },
+        "problem": dict(problem or {}),
+        "knobs": dict(knobs or {}),
+        "mesh": list(mesh_shape) if mesh_shape else None,
+    }
+    if plan is not None:
+        desc["plan_tree"] = str(jax.tree_util.tree_structure(plan))
+        desc["plan_avals"] = [
+            [list(getattr(leaf, "shape", ())),
+             str(getattr(leaf, "dtype", type(leaf).__name__))]
+            for leaf in jax.tree_util.tree_leaves(plan)
+        ]
+    blob = json.dumps(desc, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:20]
+
+
+def artifact_key(
+    fingerprint: str,
+    chip: str,
+    mesh_shape: Optional[Sequence[int]] = None,
+) -> str:
+    """The store key: one program fingerprint on one chip kind and
+    mesh shape. Human-readable on purpose — ``ls programs/`` answers
+    "what is cached for which chip" without parsing the manifest."""
+    return f"{chip}-{_mesh_token(mesh_shape)}-{fingerprint}"
+
+
+def serialize_program(compiled) -> bytes:
+    """One self-describing blob for an AOT-compiled executable:
+    the ``jax.experimental.serialize_executable`` payload plus the
+    arg/result treedefs the loader needs (all picklable — treedef aux
+    data is digest-canonicalized strings/ints by the time a bucket
+    program is lowered)."""
+    from jax.experimental import serialize_executable as _se
+
+    payload, in_tree, out_tree = _se.serialize(compiled)
+    return pickle.dumps(
+        (_PAYLOAD_SCHEMA, payload, in_tree, out_tree),
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+
+
+def deserialize_program(blob: bytes):
+    """Load a serialized bucket program back into a callable
+    executable — no trace, no XLA compile. Raises on a foreign or
+    torn blob (the caller treats that as a miss and live-compiles)."""
+    from jax.experimental import serialize_executable as _se
+
+    ver, payload, in_tree, out_tree = pickle.loads(blob)
+    if ver != _PAYLOAD_SCHEMA:
+        raise ValueError(
+            f"artifact payload schema {ver} != {_PAYLOAD_SCHEMA}"
+        )
+    return _se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+class ArtifactStore:
+    """Durable content-addressed store of serialized bucket programs.
+
+    Concurrency discipline (hosts share a filesystem, nothing else):
+
+    - payloads: written to a pid/thread-suffixed tmp file, then
+      ``os.link``\\ ed into place — the link either creates the final
+      name (this publisher WON) or raises ``FileExistsError`` (a
+      concurrent publisher won; discard). ``os.replace`` fallback for
+      filesystems without hard links.
+    - manifest: one flushed JSONL line per publish through
+      ``utils.obs.EventWriter`` (torn-tail terminated on open); reads
+      via ``read_events`` drop torn/corrupt lines — a killed publisher
+      leaves an absent record, never a poisoned store.
+    - repair: a publish whose payload DIFFERS from the bytes already
+      on disk (a corrupt artifact a fetch just refused) atomically
+      replaces them and appends a fresh manifest record — newest
+      record wins on read, so the store heals forward.
+
+    ``emit`` is an optional obs-event callable (``run.event``-shaped):
+    every publish is then announced as an ``artifact_publish`` event.
+    """
+
+    def __init__(self, path: str, emit=None):
+        self.path = path
+        self._emit = emit
+        self._lock = threading.Lock()
+        os.makedirs(os.path.join(path, _PROGRAM_DIR), exist_ok=True)
+        self._seq = max(
+            (int(r.get("seq", 0)) for r in self._read_manifest()),
+            default=0,
+        )
+        self._writer = _obs.EventWriter(
+            os.path.join(path, _MANIFEST_NAME)
+        )
+
+    # -- read side ----------------------------------------------------
+    def _read_manifest(self) -> List[Dict[str, Any]]:
+        return [
+            r
+            for r in _obs.read_events(
+                os.path.join(self.path, _MANIFEST_NAME)
+            )
+            if r.get("key") and r.get("sha256")
+        ]
+
+    def keys(self) -> List[str]:
+        """Every artifact key ever published, insertion order."""
+        seen: Dict[str, None] = {}
+        for rec in self._read_manifest():
+            seen.setdefault(rec["key"], None)
+        return list(seen)
+
+    def resolve(self, key: str) -> Optional[Dict[str, Any]]:
+        """The NEWEST manifest record for ``key`` (a repair republish
+        supersedes the record of the corrupt payload it replaced), or
+        None."""
+        newest = None
+        for rec in self._read_manifest():
+            if rec["key"] == key:
+                newest = rec
+        return newest
+
+    def fetch(
+        self,
+        key: str,
+        *,
+        fingerprint: Optional[str] = None,
+        chip: Optional[str] = None,
+    ) -> Tuple[Optional[bytes], str]:
+        """The verified payload for ``key``, or ``(None, reason)``.
+
+        Refusals — all read as a MISS by the caller, which then
+        live-compiles (and republishes, healing the store):
+
+        - ``miss``: no durable manifest record (includes a torn one);
+        - ``chip_mismatch`` / ``fingerprint_mismatch``: the record is
+          for a different chip kind or program identity than asked —
+          a foreign executable must never be loaded;
+        - ``version_skew``: published under a different jax release;
+        - ``missing_payload`` / ``corrupt``: payload unreadable, or
+          its bytes drifted from the manifest sha (truncation, torn
+          write, hand edit).
+        """
+        rec = self.resolve(key)
+        if rec is None:
+            return None, "miss"
+        if chip is not None and rec.get("chip") != chip:
+            return None, "chip_mismatch"
+        if (
+            fingerprint is not None
+            and rec.get("fingerprint") != fingerprint
+        ):
+            return None, "fingerprint_mismatch"
+        import jax
+
+        if rec.get("jax") != jax.__version__:
+            return None, "version_skew"
+        try:
+            with open(
+                os.path.join(self.path, rec["path"]), "rb"
+            ) as f:
+                blob = f.read()
+        except OSError:
+            return None, "missing_payload"
+        if hashlib.sha256(blob).hexdigest() != rec["sha256"]:
+            return None, "corrupt"
+        return blob, "hit"
+
+    # -- write side ---------------------------------------------------
+    def publish(
+        self,
+        key: str,
+        payload: bytes,
+        *,
+        fingerprint: str,
+        chip: str,
+        mesh_shape: Optional[Sequence[int]] = None,
+        bucket: Optional[str] = None,
+        **meta,
+    ) -> Tuple[Optional[Dict[str, Any]], str]:
+        """Durably publish one serialized program. Returns
+        ``(manifest_record, status)`` with status one of:
+
+        - ``won``: this call linked the payload in and appended the
+          manifest record (exactly one of N concurrent publishers);
+        - ``lost``: a concurrent publisher linked first — payload
+          discarded, their record (possibly not yet durable) wins;
+        - ``exists``: identical bytes already stored — deduped, no new
+          record;
+        - ``repair``: the on-disk payload differed (corrupt store) —
+          replaced atomically and re-recorded.
+        """
+        import jax
+
+        sha = hashlib.sha256(payload).hexdigest()
+        rel = os.path.join(_PROGRAM_DIR, f"{key}.bin")
+        fpath = os.path.join(self.path, rel)
+        status = "won"
+        if os.path.exists(fpath):
+            existing = None
+            with contextlib.suppress(OSError):
+                with open(fpath, "rb") as f:
+                    existing = hashlib.sha256(f.read()).hexdigest()
+            if existing == sha:
+                status = "exists"
+            else:
+                status = "repair"
+        if status != "exists":
+            tmp = (
+                fpath
+                + f".tmp.{os.getpid()}.{threading.get_ident()}"
+            )
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            if status == "won":
+                try:
+                    # O_EXCL discipline: the link either creates the
+                    # final name or a concurrent publisher beat us
+                    os.link(tmp, fpath)
+                except FileExistsError:
+                    status = "lost"
+                except OSError:  # pragma: no cover - no-hardlink fs
+                    os.replace(tmp, fpath)
+                    tmp = None
+                if tmp:
+                    with contextlib.suppress(OSError):
+                        os.unlink(tmp)
+            else:
+                os.replace(tmp, fpath)
+        rec: Optional[Dict[str, Any]]
+        if status in ("won", "repair"):
+            rec = dict(
+                schema=_SCHEMA,
+                key=str(key),
+                fingerprint=str(fingerprint),
+                chip=str(chip),
+                mesh=list(mesh_shape) if mesh_shape else None,
+                bucket=bucket,
+                jax=jax.__version__,
+                sha256=sha,
+                size=len(payload),
+                path=rel,
+                host=socket.gethostname(),
+                pid=os.getpid(),
+                t=time.time(),
+                **meta,
+            )
+            with self._lock:
+                self._seq += 1
+                rec["seq"] = self._seq
+                self._writer.write(dict(rec))
+        else:
+            rec = self.resolve(key)
+        if self._emit is not None:
+            self._emit(
+                "artifact_publish",
+                key=str(key),
+                status=status,
+                bucket=bucket,
+                chip=str(chip),
+                size=len(payload),
+                store=self.path,
+            )
+        return rec, status
+
+    def close(self) -> None:
+        with self._lock:
+            self._writer.close()
+
+    def __enter__(self) -> "ArtifactStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def rank_buckets(
+    buckets: Sequence[Tuple[int, Tuple[int, ...]]],
+    declared: Optional[Sequence[str]] = None,
+    capture_dir: Optional[str] = None,
+) -> List[Tuple[int, Tuple[int, ...]]]:
+    """Hot-to-cold ordering of a bucket table for staged warmup.
+
+    ``declared`` (bucket labels, ``"slots@HxW"``) wins — an operator
+    who knows the traffic shape states it; labels must name configured
+    buckets (a typo must not silently demote the hot bucket), and
+    unlisted buckets follow in configured (volume) order. Else, when
+    ``capture_dir`` holds a workload capture (serve.capture), buckets
+    are ranked by recorded request frequency — the measured
+    distribution of the traffic the engine is about to serve. Else the
+    configured volume order stands (smallest first — also the
+    cheapest program to build, so time-to-first-serveable is minimized
+    even without traffic knowledge)."""
+    from ..utils import validate
+
+    table = list(buckets)
+    labels = {bucket_label(s, sp): (s, sp) for s, sp in table}
+    if declared:
+        order: List[Tuple[int, Tuple[int, ...]]] = []
+        for name in declared:
+            if name not in labels:
+                raise validate.CCSCInputError(
+                    f"warm_order names bucket {name!r} which is not "
+                    f"configured — buckets: {sorted(labels)}"
+                )
+            key = labels[name]
+            if key not in order:
+                order.append(key)
+        order.extend(k for k in table if k not in order)
+        return order
+    if capture_dir:
+        from . import capture as _capture
+
+        counts: Dict[str, int] = {}
+        for rec in _capture.read_workload(capture_dir):
+            name = rec.get("bucket")
+            if name:
+                counts[name] = counts.get(name, 0) + 1
+        if counts:
+            return sorted(
+                table,
+                key=lambda k: -counts.get(bucket_label(*k), 0),
+            )
+    return table

@@ -58,6 +58,14 @@ from ..utils import trace as trace_util
 from . import slo as _slo
 
 
+# the directory the process-wide XLA cache is actually latched to:
+# the cache is a PROCESS singleton, so the first enable_compile_cache
+# wins and later calls with a different path must say so out loud —
+# an engine believing it warmed cache B while every artifact landed
+# in cache A is a silent cold-restart regression
+_compile_cache_path: Optional[str] = None
+
+
 def enable_compile_cache(path: Optional[str]) -> Optional[str]:
     """Point XLA's persistent compilation cache at ``path`` (resolving
     None through the CCSC_COMPILE_CACHE env var) so identical programs
@@ -65,12 +73,33 @@ def enable_compile_cache(path: Optional[str]) -> Optional[str]:
     warm-restart half of the serving cold-start story. Returns the
     directory actually enabled, or None. Thresholds are zeroed so the
     small bucket programs qualify; best-effort (an unsupported backend
-    just keeps compiling)."""
+    just keeps compiling).
+
+    The cache is per-process and latched: the first enabled path
+    stays in force for the process lifetime. A second call with the
+    SAME path is a cheap no-op; a second call with a DIFFERENT path
+    keeps the first and warns via the obs console with both paths —
+    never a silent no-op (the second engine must know its compiles
+    are landing in the first engine's cache)."""
+    global _compile_cache_path
     from ..utils import env as _env
+    from ..utils import obs as _obs_mod
 
     path = path or _env.env_str("CCSC_COMPILE_CACHE") or None
     if not path:
         return None
+    if _compile_cache_path is not None:
+        if os.path.abspath(path) != os.path.abspath(
+            _compile_cache_path
+        ):
+            _obs_mod.console(
+                "serve: compile cache already latched to "
+                f"{_compile_cache_path!r} for this process — ignoring "
+                f"the new path {path!r} (the XLA cache is per-process; "
+                "compiles keep landing in the first directory)",
+                tier="always",
+            )
+        return _compile_cache_path
     import jax
 
     try:
@@ -86,6 +115,7 @@ def enable_compile_cache(path: Optional[str]) -> Optional[str]:
         from jax._src import compilation_cache as _cc
 
         _cc.reset_cache()
+        _compile_cache_path = path
         return path
     except Exception:  # pragma: no cover - backend without cache support
         return None
@@ -173,6 +203,27 @@ def _resolve_mesh(serve_cfg: ServeConfig):
             shape[0], shape[1], devices=devs[:need]
         )
     return mesh, shape, None
+
+
+class BucketCold(RuntimeError):
+    """Admission refusal for a bucket whose program is still
+    building/fetching under STAGED warmup (ServeConfig.staged_warmup):
+    the engine is live and serving its warm buckets — only this
+    bucket isn't ready yet. Carries ``retry_after_s`` like the
+    fleet's ``Overloaded`` (the client backs off and resubmits; the
+    federation layer defers the item instead of failing it).
+    Deliberately NOT an Overloaded subclass: the engine must not
+    import the fleet, and the two refusals mean different things — an
+    overloaded fleet has too much work, a cold bucket has a program
+    in flight."""
+
+    def __init__(self, bucket: str, retry_after_s: float):
+        super().__init__(
+            f"bucket {bucket} is still warming (staged warmup) — "
+            f"retry in {retry_after_s:.2f}s"
+        )
+        self.bucket = bucket
+        self.retry_after_s = float(retry_after_s)
 
 
 class ServedResult(NamedTuple):
@@ -594,6 +645,13 @@ class CodecEngine:
                 check_vma=False,
             )
 
+        # the jitted program carries a STABLE name so the compile
+        # monitor's events are filterable by program: "a warm-store
+        # startup performed ZERO bucket compiles" is asserted from
+        # the obs stream by matching fun_name against this
+        with contextlib.suppress(AttributeError):
+            _bucket_program.__name__ = "ccsc_bucket_program"
+
         # ---- per-bucket plans + AOT-compiled programs --------------
         # Multi-bank serving (serve.registry): plans live in a
         # digest-keyed LRU (evict-and-rebuild on miss), the bank
@@ -604,6 +662,9 @@ class CodecEngine:
         # (the reconstruct(plan=...) jit-cache discipline), so a
         # hot-swap republishing a bank id rebuilds a plan, never a
         # program.
+        from ..utils import env as _envmod
+        from ..utils import perfmodel as _perfmodel
+        from . import artifacts as _artifacts
         from . import registry as _registry
 
         self._buckets: List[Tuple[int, Tuple[int, ...]]] = list(
@@ -617,82 +678,15 @@ class CodecEngine:
         self._routes: Dict[Optional[str], str] = {
             None: default_digest
         }
+        self._default_digest = default_digest
         self._plan_cache = _registry.PlanCache()
         self._programs: Dict[Tuple, object] = {}
-        t_warm0 = time.perf_counter()
-        for slots, spatial in self._buckets:
-            key = (slots, spatial)
-            t0 = time.perf_counter()
-            plan = build_plan(
-                d, prob, cfg, spatial, blur_psf=blur_psf,
-                # mesh compatibility is refused at plan build — batch
-                # axis vs this bucket's slots, freq axis vs the FFT
-                # domain — with the whole bucket table in the error
-                mesh_shape=self._mesh_shape,
-                slots=slots,
-                buckets=self._buckets,
-            )
-            # digest-canonical storage: all same-geometry banks share
-            # one compiled program per bucket (aux-data equality)
-            plan = dataclasses.replace(plan, d_digest="")
-            self._plan_cache.put(default_digest, key, plan)
-            fn = jax.jit(_bucket_program)
-            if serve_cfg.aot_warmup:
-                shp = jax.ShapeDtypeStruct(
-                    (slots, *reduce_shape, *spatial), jnp.float32
-                )
-                self._programs[key] = fn.lower(
-                    shp, shp, shp, shp, plan
-                ).compile()
-            else:
-                self._programs[key] = fn
-            self._emit(
-                "serve_warmup",
-                bucket=_bucket_name(slots, spatial),
-                aot=bool(serve_cfg.aot_warmup),
-                warmup_s=round(time.perf_counter() - t0, 4),
-                devices=self.devices,
-                digest=default_digest,
-                mesh=(
-                    list(self._mesh_shape) if self._mesh_shape
-                    else None
-                ),
-                # the resolved knob dict, not just the bucket shape:
-                # the stream must say which arm this program serves
-                # under (a tuned engine and a default engine emit
-                # otherwise-identical warmup events)
-                knobs=self._knob_dict,
-            )
-        mon = self._run.compile_monitor
-        self._emit(
-            "serve_ready",
-            n_buckets=len(self._buckets),
-            warmup_s=round(time.perf_counter() - t_warm0, 4),
-            persistent_cache_hits=mon.cache_hits if mon else None,
-            devices=self.devices,
-            mesh=(
-                list(self._mesh_shape) if self._mesh_shape else None
-            ),
-            knobs=self._knob_dict,
-        )
-        self._run.console(
-            f"serve: {len(self._buckets)} bucket(s) ready in "
-            f"{time.perf_counter() - t_warm0:.2f}s"
-            + (
-                f" (mesh {'x'.join(str(a) for a in self._mesh_shape)}"
-                f", {self.devices} devices)"
-                if self._mesh_shape
-                else ""
-            )
-            + (
-                f" (compile cache {self.cache_dir})"
-                if self.cache_dir
-                else ""
-            ),
-            tier="brief",
-        )
+        self._bucket_program_fn = _bucket_program
 
-        # ---- micro-batch queue -------------------------------------
+        # ---- micro-batch queue (BEFORE warmup: under staged warmup
+        # the engine serves its hottest bucket while cold programs
+        # still build, so the queue and worker must already exist
+        # when the first bucket comes warm) --------------------------
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         # keyed (bucket_key, digest): one bank's batch rides one
@@ -719,6 +713,362 @@ class CodecEngine:
             target=self._work_loop, name="ccsc-serve", daemon=True
         )
         self._worker.start()
+
+        # ---- staged warmup + compiled-artifact store ---------------
+        # Pre-warmed elasticity (serve.artifacts): each bucket's
+        # program is FETCHED from the shared artifact store (keyed by
+        # program fingerprint x chip x mesh) instead of compiled when
+        # a matching executable exists, and whatever had to be
+        # live-compiled is published back so the next joining host
+        # fetches it. Staged mode warms hot-to-cold and returns from
+        # the constructor after the FIRST bucket is serveable; the
+        # rest build/fetch in a background thread while submits to
+        # still-cold buckets get a BucketCold retry-after refusal.
+        self._chip = _perfmodel.detect_chip()
+        staged = serve_cfg.staged_warmup
+        if staged is None:
+            staged = _envmod.env_flag("CCSC_SERVE_STAGED")
+        # lazy engines (aot_warmup off) have nothing to stage: every
+        # bucket is "warm" immediately and compiles on first use
+        self._staged = bool(staged) and bool(serve_cfg.aot_warmup)
+        store_dir = _artifacts.resolve_artifact_dir(
+            serve_cfg.artifact_store
+        )
+        self._artifacts = (
+            _artifacts.ArtifactStore(store_dir, emit=self._emit)
+            if store_dir and serve_cfg.aot_warmup
+            else None
+        )
+        self._artifact_publish = _envmod.env_flag(
+            "CCSC_ARTIFACT_PUBLISH"
+        )
+        rank_dir = serve_cfg.warm_rank_capture
+        if rank_dir == "":
+            rank_dir = None
+        else:
+            rank_dir = (
+                rank_dir
+                or _envmod.env_str("CCSC_WARM_RANK_CAPTURE")
+                or None
+            )
+        self._warm_order = _artifacts.rank_buckets(
+            self._buckets,
+            declared=serve_cfg.warm_order,
+            capture_dir=rank_dir,
+        )
+        self._warm: set = set()
+        self._stage_s: List[float] = []
+        self._warm_t0 = time.perf_counter()
+        self._first_ready_s: Optional[float] = None
+        self._n_fetched = 0
+        self._n_compiled = 0
+        self._warm_error: Optional[BaseException] = None
+        self._warm_stop = threading.Event()
+        self._warm_thread: Optional[threading.Thread] = None
+        self._cold_retry_floor = _envmod.env_float(
+            "CCSC_BUCKET_COLD_RETRY_S"
+        )
+        self._cold_emit_t: Dict[Tuple, float] = {}
+
+        n_stages = len(self._warm_order)
+        # the hottest bucket warms SYNCHRONOUSLY — a constructed
+        # engine can always serve SOMETHING
+        self._warm_bucket(self._warm_order[0], 1, n_stages)
+        if self._staged and n_stages > 1:
+            self._warm_thread = threading.Thread(
+                target=self._warm_loop,
+                name="ccsc-serve-warmup",
+                daemon=True,
+            )
+            self._warm_thread.start()
+        else:
+            for i, key in enumerate(self._warm_order[1:], start=2):
+                self._warm_bucket(key, i, n_stages)
+            self._finish_warmup()
+
+    def _warm_loop(self):
+        """Background half of staged warmup: build/fetch the cold
+        buckets hot-to-cold while the engine is already serving. A
+        failed stage poisons only the REMAINING cold buckets (their
+        submits fail fast instead of retrying forever); everything
+        already warm keeps serving."""
+        n_stages = len(self._warm_order)
+        for i, key in enumerate(self._warm_order[1:], start=2):
+            if self._warm_stop.is_set():
+                return
+            try:
+                self._warm_bucket(key, i, n_stages)
+            except BaseException as e:
+                self._warm_error = e
+                self._emit(
+                    "serve_error",
+                    error=(
+                        "staged warmup failed at bucket "
+                        f"{_bucket_name(*key)}: {e}"
+                    )[:300],
+                )
+                self._run.console(
+                    "serve: staged warmup FAILED at bucket "
+                    f"{_bucket_name(*key)} — cold buckets will refuse "
+                    f"requests: {e}",
+                    tier="always",
+                )
+                return
+        self._finish_warmup()
+
+    def _warm_bucket(self, key, stage: int, n_stages: int):
+        """Make ONE bucket serveable: build its plan, then fetch its
+        AOT executable from the artifact store (or live-compile and
+        publish), install it, and mark the bucket warm. Emits
+        ``artifact_fetch`` / ``serve_warmup`` / ``warmup_stage`` with
+        the per-bucket source: fetched | compiled | cache-hit (the
+        persistent XLA cache satisfied the compile) | lazy."""
+        import jax
+
+        jnp = self._jnp
+        serve_cfg = self.serve_cfg
+        slots, spatial = key
+        name = _bucket_name(slots, spatial)
+        t0 = time.perf_counter()
+        plan = self._build_plan(
+            self._banks[self._default_digest],
+            self.prob,
+            self._plan_cfg,
+            spatial,
+            blur_psf=self._blur_psf,
+            # mesh compatibility is refused at plan build — batch
+            # axis vs this bucket's slots, freq axis vs the FFT
+            # domain — with the whole bucket table in the error
+            mesh_shape=self._mesh_shape,
+            slots=slots,
+            buckets=self._buckets,
+        )
+        # digest-canonical storage: all same-geometry banks share
+        # one compiled program per bucket (aux-data equality)
+        plan = dataclasses.replace(plan, d_digest="")
+        self._plan_cache.put(self._default_digest, key, plan)
+
+        from . import artifacts as _artifacts
+
+        program = None
+        source = "lazy"
+        fetch_s = None
+        compile_s = None
+        fp = akey = None
+        if serve_cfg.aot_warmup and self._artifacts is not None:
+            fp = _artifacts.program_fingerprint(
+                bucket=(slots, spatial),
+                geom=self.geom,
+                problem={
+                    "pad": self.prob.pad,
+                    "dirac": self.prob.dirac,
+                    "data_term": self.prob.data_term,
+                },
+                knobs=self._knob_dict,
+                mesh_shape=self._mesh_shape,
+                plan=plan,
+            )
+            akey = _artifacts.artifact_key(
+                fp, self._chip, self._mesh_shape
+            )
+            tf = time.perf_counter()
+            blob, status = self._artifacts.fetch(
+                akey, fingerprint=fp, chip=self._chip
+            )
+            if blob is not None:
+                try:
+                    program = _artifacts.deserialize_program(blob)
+                    source = "fetched"
+                    self._n_fetched += 1
+                except Exception:
+                    # a foreign/torn executable must never serve:
+                    # fall back to live compile (which republishes,
+                    # healing the store)
+                    program = None
+                    status = "deserialize_error"
+            fetch_s = round(time.perf_counter() - tf, 4)
+            self._emit(
+                "artifact_fetch",
+                key=akey,
+                status=status,
+                bucket=name,
+                fetch_s=fetch_s,
+                store=self._artifacts.path,
+            )
+        if program is None and serve_cfg.aot_warmup:
+            fn = jax.jit(self._bucket_program_fn)
+            shp = jax.ShapeDtypeStruct(
+                (slots, *self.geom.reduce_shape, *spatial),
+                jnp.float32,
+            )
+            mon = self._run.compile_monitor
+            hits0 = mon.cache_hits if mon else 0
+            tc = time.perf_counter()
+            program = fn.lower(shp, shp, shp, shp, plan).compile()
+            compile_s = round(time.perf_counter() - tc, 4)
+            # "cache-hit": the persistent XLA cache satisfied the
+            # backend compile — a warm RESTART, distinct from both a
+            # store fetch and a true cold compile in the stream
+            source = (
+                "cache-hit"
+                if mon and mon.cache_hits > hits0
+                else "compiled"
+            )
+            if source == "compiled":
+                self._n_compiled += 1
+            if self._artifacts is not None and self._artifact_publish:
+                try:
+                    payload = _artifacts.serialize_program(program)
+                    self._artifacts.publish(
+                        akey,
+                        payload,
+                        fingerprint=fp,
+                        chip=self._chip,
+                        mesh_shape=self._mesh_shape,
+                        bucket=name,
+                    )
+                except Exception as e:
+                    # best-effort: a store that cannot serialize this
+                    # backend's executable must not fail warmup
+                    self._run.console(
+                        f"serve: artifact publish failed for {name}: "
+                        f"{e}",
+                        tier="always",
+                    )
+        elif program is None:
+            program = jax.jit(self._bucket_program_fn)
+        dt = time.perf_counter() - t0
+        with self._cv:
+            self._programs[key] = program
+            self._warm.add(key)
+            ready_s = time.perf_counter() - self._warm_t0
+            if self._first_ready_s is None:
+                self._first_ready_s = ready_s
+            self._stage_s.append(dt)
+            self._cv.notify_all()
+        self._emit(
+            "serve_warmup",
+            bucket=name,
+            aot=bool(serve_cfg.aot_warmup),
+            source=source,
+            warmup_s=round(dt, 4),
+            fetch_s=fetch_s,
+            compile_s=compile_s,
+            devices=self.devices,
+            digest=self._default_digest,
+            mesh=(
+                list(self._mesh_shape) if self._mesh_shape
+                else None
+            ),
+            # the resolved knob dict, not just the bucket shape:
+            # the stream must say which arm this program serves
+            # under (a tuned engine and a default engine emit
+            # otherwise-identical warmup events)
+            knobs=self._knob_dict,
+        )
+        self._emit(
+            "warmup_stage",
+            bucket=name,
+            stage=stage,
+            n_stages=n_stages,
+            source=source,
+            ready_s=round(ready_s, 4),
+        )
+
+    def _finish_warmup(self):
+        """Close out warmup (both modes): the ``serve_ready`` event +
+        console line, and the join-to-first-request perf-ledger
+        record — the elasticity quantity ``perf_gate`` holds steady
+        per (chip, mesh, bucket-set)."""
+        mon = self._run.compile_monitor
+        total = time.perf_counter() - self._warm_t0
+        first = (
+            self._first_ready_s
+            if self._first_ready_s is not None
+            else total
+        )
+        self._emit(
+            "serve_ready",
+            n_buckets=len(self._buckets),
+            warmup_s=round(total, 4),
+            first_ready_s=round(first, 4),
+            staged=self._staged,
+            n_fetched=self._n_fetched,
+            n_compiled=self._n_compiled,
+            persistent_cache_hits=mon.cache_hits if mon else None,
+            devices=self.devices,
+            mesh=(
+                list(self._mesh_shape) if self._mesh_shape else None
+            ),
+            knobs=self._knob_dict,
+        )
+        self._run.console(
+            f"serve: {len(self._buckets)} bucket(s) ready in "
+            f"{total:.2f}s (first serveable {first:.2f}s, "
+            f"{self._n_fetched} fetched, {self._n_compiled} compiled)"
+            + (
+                f" (mesh {'x'.join(str(a) for a in self._mesh_shape)}"
+                f", {self.devices} devices)"
+                if self._mesh_shape
+                else ""
+            )
+            + (
+                f" (compile cache {self.cache_dir})"
+                if self.cache_dir
+                else ""
+            )
+            + (
+                f" (artifact store {self._artifacts.path})"
+                if self._artifacts is not None
+                else ""
+            ),
+            tier="brief",
+        )
+        # join-to-first-request as a ledger configuration: replica 0
+        # (or a standalone engine) records once per startup — N
+        # replicas must not append N copies of the same join. Lazy
+        # engines skip it: "first serveable" without a program built
+        # is not the elasticity quantity.
+        if (
+            self.serve_cfg.replica_id in (None, 0)
+            and self.serve_cfg.aot_warmup
+        ):
+            from ..analysis import ledger as _ledger
+
+            try:
+                _ledger.append_warmup_record(
+                    chip=self._chip,
+                    buckets=self._buckets,
+                    join_s=first,
+                    mesh_shape=self._mesh_shape,
+                    knobs=self._knob_dict,
+                    staged=self._staged,
+                    artifact_store=self._artifacts is not None,
+                    n_compiled=self._n_compiled,
+                )
+            except Exception as e:  # pragma: no cover - ledger I/O
+                self._run.console(
+                    f"serve: warmup ledger append failed: {e}",
+                    tier="always",
+                )
+
+    def bucket_warm(self, key) -> bool:
+        """Is ``key``'s (slots, spatial) program installed and
+        serveable? The fleet's admission boundary asks this before
+        queueing work for a replica set that is still staging."""
+        slots, spatial = key
+        key = (int(slots), tuple(int(s) for s in spatial))
+        with self._cv:
+            return key in self._warm
+
+    def warmup_eta_s(self) -> float:
+        """Retry-after hint for a cold bucket: the mean measured
+        per-stage warmup time so far, floored by
+        CCSC_BUCKET_COLD_RETRY_S."""
+        with self._cv:
+            stages = list(self._stage_s)
+        eta = (sum(stages) / len(stages)) if stages else 0.0
+        return max(float(self._cold_retry_floor), eta)
 
     # ------------------------------------------------------------------
     def _emit(self, type_: str, **fields) -> None:
@@ -802,36 +1152,70 @@ class CodecEngine:
             parent_span=parent_span,
             own_root=own_root,
         )
+        cold_retry: Optional[float] = None
         with self._cv:
             if self._closed or self._close_started:
                 raise RuntimeError("engine is closed")
-            # digest binds UNDER the queue lock: publish_bank flips
-            # routes and retires stale digests under the same lock,
-            # so an admission can never bind a digest a concurrent
-            # retire just dropped
-            if _digest is not None:
-                digest = _digest
-                if digest not in self._banks:
-                    raise validate.CCSCInputError(
-                        f"bank digest {digest!r} is not published on "
-                        "this engine — publish the bank (add_bank) "
-                        "before routing requests to it"
+            if key not in self._warm:
+                # staged warmup: THIS bucket's program is still
+                # building/fetching — refuse only it (retry-after),
+                # never block the whole engine. A failed warmup
+                # poisons the remaining cold buckets instead: their
+                # requests must fail fast, not retry forever.
+                if self._warm_error is not None:
+                    raise RuntimeError(
+                        f"bucket {_bucket_name(*key)} will never "
+                        "warm — staged warmup failed: "
+                        f"{self._warm_error}"
                     )
+                stages = self._stage_s
+                cold_retry = max(
+                    float(self._cold_retry_floor),
+                    (sum(stages) / len(stages)) if stages else 0.0,
+                )
             else:
-                digest = self._routes.get(bank_id)
-                if digest is None:
-                    raise validate.CCSCInputError(
-                        f"unknown bank id {bank_id!r} — published: "
-                        f"{sorted(k for k in self._routes if k)} "
-                        "(default bank routes as bank_id=None)"
+                # digest binds UNDER the queue lock: publish_bank
+                # flips routes and retires stale digests under the
+                # same lock, so an admission can never bind a digest
+                # a concurrent retire just dropped
+                if _digest is not None:
+                    digest = _digest
+                    if digest not in self._banks:
+                        raise validate.CCSCInputError(
+                            f"bank digest {digest!r} is not published "
+                            "on this engine — publish the bank "
+                            "(add_bank) before routing requests to it"
+                        )
+                else:
+                    digest = self._routes.get(bank_id)
+                    if digest is None:
+                        raise validate.CCSCInputError(
+                            f"unknown bank id {bank_id!r} — "
+                            "published: "
+                            f"{sorted(k for k in self._routes if k)} "
+                            "(default bank routes as bank_id=None)"
+                        )
+                p.digest = digest
+                if self._capture is not None:
+                    self._cap_seq += 1
+                    p.cap_key = (
+                        f"{self._cap_prefix}-{self._cap_seq:08d}"
                     )
-            p.digest = digest
-            if self._capture is not None:
-                self._cap_seq += 1
-                p.cap_key = f"{self._cap_prefix}-{self._cap_seq:08d}"
-            self._pending.setdefault((key, digest), []).append(p)
-            self._n_pending += 1
-            self._cv.notify()
+                self._pending.setdefault((key, digest), []).append(p)
+                self._n_pending += 1
+                self._cv.notify()
+        if cold_retry is not None:
+            # emit OUTSIDE the queue lock, rate-limited per bucket —
+            # a tight client retry loop must not flood the stream
+            now = time.monotonic()
+            if now - self._cold_emit_t.get(key, 0.0) >= 1.0:
+                self._cold_emit_t[key] = now
+                self._emit(
+                    "bucket_cold",
+                    bucket=_bucket_name(*key),
+                    retry_after_s=round(cold_retry, 3),
+                )
+            raise BucketCold(_bucket_name(*key), cold_retry)
         if self._capture is not None and p.cap_key is not None:
             # record OUTSIDE the queue lock: sha256 + the segment
             # append must not serialize submitters against dispatch
@@ -1455,6 +1839,21 @@ class CodecEngine:
             # attribute is getattr-guarded here
             run = getattr(self, "_run", None)
             cv = getattr(self, "_cv", None)
+            # stop staged warmup first: the background thread checks
+            # the stop event between stages, so a close during a long
+            # cold build waits at most one stage out
+            ws = getattr(self, "_warm_stop", None)
+            if ws is not None:
+                ws.set()
+            if getattr(self, "_warm_thread", None) is not None:
+                while self._warm_thread.is_alive():
+                    self._warm_thread.join(timeout=60)
+                    if self._warm_thread.is_alive() and run is not None:
+                        run.console(
+                            "serve: close() waiting on an in-flight "
+                            "warmup stage",
+                            tier="always",
+                        )
             if cv is not None:
                 with cv:
                     self._closed = True
@@ -1473,6 +1872,12 @@ class CodecEngine:
                             "dispatch to drain",
                             tier="always",
                         )
+            store = getattr(self, "_artifacts", None)
+            if store is not None:
+                # warmup thread is joined above, so no publish races
+                # the manifest writer close
+                with contextlib.suppress(Exception):
+                    store.close()
             cap = getattr(self, "_capture", None)
             if cap is not None:
                 # seal the capture (meta.json counters + the

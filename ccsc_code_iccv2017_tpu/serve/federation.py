@@ -56,7 +56,7 @@ import numpy as np
 from ..utils import env as _env
 from ..utils import trace as trace_util
 from .dqueue import DurableQueue
-from .fleet import Overloaded, ServeFleet
+from .fleet import BucketCold, Overloaded, ServeFleet
 
 __all__ = ["FederatedHost", "FederatedFrontend", "FederatedResult"]
 
@@ -606,9 +606,13 @@ class FederatedHost:
                 x_orig=arrays["x_orig"],
                 key=fkey,
             )
-        except Overloaded as e:
+        except (Overloaded, BucketCold) as e:
             # explicit backpressure: hold OUR lease (heartbeats keep
-            # it live) and re-offer after the jittered hint
+            # it live) and re-offer after the jittered hint. A
+            # BucketCold host (staged warmup still building this
+            # bucket's program) defers exactly like an overloaded
+            # one — the request is fine, the host just isn't ready
+            # for THAT bucket yet
             self._deferred.append(
                 (time.monotonic() + e.retry_after_s, item)
             )

@@ -101,6 +101,7 @@ from . import registry as _registry
 from . import slo as _slo
 from . import tenancy as _tenancy
 from .engine import (
+    BucketCold,
     CodecEngine,
     ServedResult,
     _bucket_name,
@@ -108,7 +109,7 @@ from .engine import (
     pick_bucket,
 )
 
-__all__ = ["ServeFleet", "Overloaded", "RUNGS"]
+__all__ = ["ServeFleet", "Overloaded", "BucketCold", "RUNGS"]
 
 # the overload ladder, least to most drastic
 RUNGS = ("normal", "shed_batching", "reject", "degrade")
@@ -1634,6 +1635,32 @@ class ServeFleet:
     def overload_rung(self) -> str:
         return RUNGS[self._rung]
 
+    def _cold_eta(self, bkey) -> Optional[float]:
+        """None when some LIVE replica already serves ``bkey``'s
+        program — or no live replica exists to ask (the dead-fleet
+        refusals own that path) — else the smallest warmup ETA across
+        the staging replicas: the bucket is cold fleet-wide and the
+        caller should back off that long."""
+        with self._cv:
+            engines = [
+                rep.engine
+                for rep in self._replicas
+                if rep is not None
+                and rep.state == "live"
+                and rep.engine is not None
+            ]
+        etas = []
+        for eng in engines:
+            try:
+                if eng.bucket_warm(bkey):
+                    return None
+                etas.append(eng.warmup_eta_s())
+            except Exception:
+                # a replica mid-death answers nothing — its casualty
+                # handling is the watchdog's job, not admission's
+                continue
+        return min(etas) if etas else None
+
     def submit(
         self, b, mask=None, smooth_init=None, x_orig=None,
         key: Optional[str] = None,
@@ -1658,7 +1685,10 @@ class ServeFleet:
         hot-swap never retargets admitted work. Raises
         :class:`Overloaded` at the admission ceiling OR the tenant's
         quota (a ``tenant_reject`` — other tenants keep being
-        admitted) and ``CCSCInputError`` for malformed requests."""
+        admitted), :class:`~.engine.BucketCold` while no live replica
+        has warmed the request's bucket yet (staged warmup — carries
+        the same ``retry_after_s`` backoff contract), and
+        ``CCSCInputError`` for malformed requests."""
         from ..utils import validate
 
         if self._close_started:
@@ -1675,6 +1705,22 @@ class ServeFleet:
         # oversize refusal, pre-queue (the picked bucket also names
         # the capture record's expected program)
         bslots, bsp = pick_bucket(self.buckets, spatial)
+        # staged-warmup admission (serve.engine BucketCold): when NO
+        # live replica has this bucket's program installed yet, refuse
+        # just this bucket with a retry hint — the fleet keeps serving
+        # its warm buckets while replicas stage. Checked BEFORE the
+        # canonicalizing copies: a refused request must stay cheap.
+        cold_eta = self._cold_eta((bslots, bsp))
+        if cold_eta is not None:
+            jitter = _env.env_float("CCSC_FED_RETRY_JITTER") or 0.0
+            if jitter > 0:
+                cold_eta *= 1.0 + random.random() * jitter
+            self._emit(
+                "bucket_cold", replica_id=None,
+                bucket=_bucket_name(bslots, bsp),
+                retry_after_s=round(cold_eta, 3),
+            )
+            raise BucketCold(_bucket_name(bslots, bsp), cold_eta)
         # canonicalize OUTSIDE the fleet lock: four potentially-large
         # array copies per request must not serialize every submitter
         # against the workers' _take/_deliver — nothing here reads

@@ -151,7 +151,7 @@ class ReplayDriver:
         ``'open'`` (recorded arrival clock) or ``'closed'`` (submit
         on completion)."""
         from ..utils import obs as _obs
-        from .fleet import Overloaded
+        from .fleet import BucketCold, Overloaded
 
         import os as _os
 
@@ -184,7 +184,10 @@ class ReplayDriver:
         try:
             return self._replay_inner(
                 target, run, speed, mode, timeout_s, is_fleet,
-                Overloaded,
+                # both explicit-backpressure refusals retry the same
+                # way: an overloaded queue and a still-staging bucket
+                # each carry a retry_after_s hint
+                (Overloaded, BucketCold),
             )
         finally:
             if not run.closed:

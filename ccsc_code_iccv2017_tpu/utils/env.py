@@ -111,6 +111,35 @@ REGISTRY: Dict[str, Knob] = _knobs(
      "refuse a serving mesh the visible device pool cannot back "
      "(with the forced-host-device recipe in the error); 0 falls "
      "back to a single-device engine with a console note instead"),
+    # -- compiled-artifact store + staged warmup (serve.artifacts,
+    # serve.engine) ---------------------------------------------------
+    ("CCSC_ARTIFACT_STORE", "path", None,
+     "serve.artifacts, serve.engine, apps/serve.py",
+     "shared compiled-artifact store directory (manifest.jsonl + "
+     "content-addressed programs/ of AOT-serialized bucket "
+     "executables): warmup fetches instead of compiling and "
+     "publishes what it compiled (fallback of "
+     "ServeConfig.artifact_store; unset = no store)"),
+    ("CCSC_ARTIFACT_PUBLISH", "flag", True, "serve.engine",
+     "publish live-compiled bucket programs back into the artifact "
+     "store so the next joining host fetches them (0 = fetch-only "
+     "consumer)"),
+    ("CCSC_SERVE_STAGED", "flag", False,
+     "serve.engine, apps/serve.py",
+     "staged warmup: serve the hottest bucket as soon as its program "
+     "is ready while cold buckets build/fetch in a background thread "
+     "(submits to cold buckets get a BucketCold retry-after refusal; "
+     "fallback of ServeConfig.staged_warmup)"),
+    ("CCSC_WARM_RANK_CAPTURE", "path", None, "serve.engine",
+     "workload-capture directory used to rank buckets hot-to-cold "
+     "by recorded request frequency for staged warmup (fallback of "
+     "ServeConfig.warm_rank_capture; unset = configured volume "
+     "order)"),
+    ("CCSC_BUCKET_COLD_RETRY_S", "float", 0.5,
+     "serve.engine, serve.fleet",
+     "floor of the BucketCold retry-after hint in seconds while a "
+     "bucket's program is still building/fetching (the measured "
+     "per-stage warmup time raises it)"),
     # -- multi-tenant bank registry + tenancy (serve.registry,
     # serve.tenancy, serve.engine, serve.fleet) ----------------------
     ("CCSC_BANK_REGISTRY", "path", None,

@@ -53,6 +53,15 @@ restores them):
                       requests, and every delivered result is
                       bit-identical to the capture oracle's recorded
                       outcome digests (serve.capture)
+  scale_up            (script mode only) pre-warmed elasticity under
+                      fire: while a saturating request stream drains
+                      through one federated host, a SECOND host joins
+                      mid-stream from a warm compiled-artifact store
+                      (serve.artifacts) with staged warmup on — its
+                      hot bucket is FETCHED (not compiled), it serves
+                      its first request before its coldest bucket
+                      finishes building in the background, p99 stays
+                      bounded, and zero requests are lost
   sigterm_subprocess  (script mode only) the same against a real child
                       process: exit code 0 + valid checkpoint
   supervise_restart   (script mode only) scripts/supervise.py restarts
@@ -751,6 +760,207 @@ def scenario_host_kill():
     )
 
 
+def _scale_up_child_code(qdir, bank_path, mdir, host_id, store=None,
+                         staged=False):
+    """Source of one federated host process for the scale_up scenario:
+    two shape buckets; the joining host additionally points at the
+    shared artifact store with staged warmup on, its declared-hot
+    bucket first in the warm order."""
+    extra = ""
+    if store is not None:
+        extra = (
+            f"artifact_store={store!r}, staged_warmup=True,\n"
+            f"                   warm_order=('2@12x12',),"
+        )
+    return f"""
+import numpy as np
+from ccsc_code_iccv2017_tpu.config import (
+    FleetConfig, ProblemGeom, ServeConfig, SolveConfig)
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem)
+from ccsc_code_iccv2017_tpu.serve.federation import FederatedHost
+d = np.load({bank_path!r})
+geom = ProblemGeom((3, 3), 4)
+cfg = SolveConfig(lambda_residual=5.0, lambda_prior=0.3, max_it=3,
+                  tol=0.0, verbose="none", track_psnr=True,
+                  track_objective=True)
+scfg = ServeConfig(buckets=((2, (12, 12)), (2, (16, 16))),
+                   max_wait_ms=2.0, verbose="none", {extra})
+host = FederatedHost(
+    {qdir!r}, d, ReconstructionProblem(geom), cfg, scfg,
+    FleetConfig(replicas=1, min_queue_depth=64,
+                restart_backoff_s=0.05, verbose="none"),
+    host={host_id!r}, metrics_dir={mdir!r},
+    heartbeat_s=0.2, ttl_s=1.5, skew_s=0.3, verbose="none",
+)
+print("JOINED", flush=True)
+while not host.serve_until_sealed(timeout=5.0):
+    pass
+host.close()
+"""
+
+
+def scenario_scale_up():
+    import threading
+    import time
+
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import (
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import CodecEngine
+    from ccsc_code_iccv2017_tpu.serve.federation import (
+        FederatedFrontend,
+    )
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    r = np.random.default_rng(0)
+    d = r.normal(size=(4, 3, 3)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_psnr=True, track_objective=True,
+    )
+    with tempfile.TemporaryDirectory() as root:
+        store = os.path.join(root, "artifacts")
+        # 1) pre-warm the store with the HOT bucket only: a throwaway
+        # engine warms 12x12 and publishes its AOT executable. The
+        # cold 16x16 bucket is deliberately NOT published, so the
+        # joining host exercises both paths — hot fetched, cold
+        # live-compiled in the background — and the "first request
+        # before coldest bucket ready" ordering has a real compile
+        # window to land in rather than a millisecond fetch race.
+        eng = CodecEngine(
+            d, ReconstructionProblem(geom), cfg,
+            ServeConfig(
+                buckets=((2, (12, 12)),), max_wait_ms=2.0,
+                artifact_store=store, verbose="none",
+            ),
+        )
+        eng.close()
+        # 2) host1 serves a sustained two-bucket stream the old way
+        # (blocking warmup, no store); mid-stream host2 joins FROM
+        # the warm store with staged warmup on
+        qdir = os.path.join(root, "q")
+        bank = os.path.join(root, "bank.npy")
+        np.save(bank, d)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+
+        def _spawn(i, **kw):
+            return subprocess.Popen(
+                [
+                    sys.executable, "-c",
+                    _scale_up_child_code(
+                        qdir, bank,
+                        os.path.join(root, f"m-host{i}"),
+                        f"host{i}", **kw,
+                    ),
+                ],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+
+        p1 = _spawn(1)
+        fe = FederatedFrontend(
+            qdir, client="fe0",
+            metrics_dir=os.path.join(root, "m-frontend"),
+            verbose="none",
+        )
+        # pre-built payload pool (the pump thread must not share the
+        # parent rng); mostly hot-bucket 12x12, every 6th 16x16
+        pool = []
+        for shape in ((12, 12), (16, 16)):
+            x = r.random(shape).astype(np.float32)
+            m = (r.random(shape) < 0.5).astype(np.float32)
+            pool.append((x * m, m, x))
+        lat = {}
+        served_host2 = threading.Event()
+        stop = threading.Event()
+        futs = []
+
+        def _done(key, t0):
+            def cb(f):
+                lat[key] = time.monotonic() - t0
+                with contextlib.suppress(Exception):
+                    if f.result().host == "host2":
+                        served_host2.set()
+            return cb
+
+        def _pump():
+            i = 0
+            while not stop.is_set() and i < 1500:
+                b, m, x = pool[1 if i % 6 == 5 else 0]
+                fut = fe.submit(b, mask=m, x_orig=x, key=f"s{i}")
+                fut.add_done_callback(_done(f"s{i}", time.monotonic()))
+                futs.append(fut)
+                i += 1
+                time.sleep(0.02)
+
+        pump = threading.Thread(target=_pump, daemon=True)
+        pump.start()
+        # wait until the stream is live (host1 serving), then join
+        # host2 from the warm store mid-stream
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and not lat:
+            time.sleep(0.05)
+        p2 = _spawn(2, store=store, staged=True)
+        served_host2.wait(timeout=240)
+        stop.set()
+        pump.join(timeout=30)
+        fe.seal()
+        results = [f.result(timeout=300) for f in futs]
+        rc1 = p1.wait(timeout=300)
+        rc2 = p2.wait(timeout=300)
+        fe.close()
+        served_by = {res.host for res in results}
+        lats = sorted(lat.values())
+        p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+        # 3) the joining host's obs stream carries the proof: hot
+        # bucket FETCHED from the store, first request served while
+        # the cold bucket was still building
+        ev = obs.read_events(
+            os.path.join(root, "m-host2"), recursive=True
+        )
+        warm = {
+            e["bucket"]: e["source"] for e in ev
+            if e["type"] == "serve_warmup"
+        }
+        stages = [e for e in ev if e["type"] == "warmup_stage"]
+        reqs = [e for e in ev if e["type"] == "serve_request"]
+        cold_ready_t = max((e["t"] for e in stages), default=0.0)
+        first_req_t = min(
+            (e["t"] for e in reqs), default=float("inf")
+        )
+        hot_fetched = warm.get("2@12x12") == "fetched"
+        early_serve = first_req_t < cold_ready_t
+        ok = (
+            len(results) == len(futs)
+            and "host2" in served_by
+            and hot_fetched
+            and len(stages) == 2
+            and early_serve
+            and p99 < 60.0
+            and rc1 == 0
+            and rc2 == 0
+        )
+    return ok, (
+        f"served={len(results)}/{len(futs)}, hosts={sorted(served_by)}, "
+        f"hot_source={warm.get('2@12x12')}, stages={len(stages)}, "
+        f"first_req_before_cold_ready={early_serve}, "
+        f"p99={p99:.2f}s, rc1={rc1}, rc2={rc2}"
+    )
+
+
 def scenario_supervise_restart():
     import json
 
@@ -850,6 +1060,7 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
     }
     if subprocess_scenarios:
         scenarios["host_kill"] = scenario_host_kill
+        scenarios["scale_up"] = scenario_scale_up
         scenarios["sigterm_subprocess"] = scenario_sigterm_subprocess
         scenarios["supervise_restart"] = scenario_supervise_restart
     if only is not None:

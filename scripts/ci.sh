@@ -14,6 +14,10 @@
 #       the pallas-mesh replication gap) — are tolerated, mirroring
 #       the driver's "no worse than the seed" rule; anything NOT on
 #       that list fails the stage.
+#   25  scripts/warmup_smoke.py failed: the compiled-artifact-store
+#       warm startup recompiled a bucket program (or failed to
+#       publish/fetch/append its kind=warmup ledger record) — the
+#       pre-warmed-elasticity contract (serve.artifacts) is broken
 #   30  scripts/perf_gate.py judged a regression against the durable
 #       perf ledger (skipped silently when no ledger file exists yet
 #       — a young repo must not fail CI on an empty history)
@@ -69,6 +73,9 @@ if [ -n "${CCSC_CI_DEVICES:-}" ]; then
         -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
         || exit 20
 fi
+
+echo "== ci: 2c/3 warmup leg (scripts/warmup_smoke.py: cold-vs-warm artifact-store startup)"
+JAX_PLATFORMS=cpu python scripts/warmup_smoke.py || exit 25
 
 echo "== ci: 3/3 perf regression gate (scripts/perf_gate.py)"
 # resolve the same ledger path perf_gate would; gate only when a

@@ -580,6 +580,77 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
                 "miss(es) over the run"
             )
 
+    stages = by.get("warmup_stage", [])
+    afetch = by.get("artifact_fetch", [])
+    apub = by.get("artifact_publish", [])
+    bcold = by.get("bucket_cold", [])
+    if stages or afetch or apub:
+        lines.append(_section("WARMUP"))
+        # per-bucket ready timeline (hot-to-cold staged order):
+        # when each program became serveable, and from where
+        for st in stages:
+            lines.append(
+                f"  stage {st.get('stage')}/{st.get('n_stages')}  "
+                f"{st.get('bucket', '?'):<14} ready at "
+                f"{st.get('ready_s', 0.0):7.3f}s  "
+                f"[{st.get('source', '?')}]"
+            )
+        n_src = {}
+        for st in stages:
+            n_src[st.get("source", "?")] = (
+                n_src.get(st.get("source", "?"), 0) + 1
+            )
+        if n_src:
+            lines.append(
+                "  sources       "
+                + ", ".join(
+                    f"{n_src[s]} {s}" for s in sorted(n_src)
+                )
+            )
+        ready = by.get("serve_ready", [])
+        newest_ready = ready[-1] if ready else None
+        if newest_ready and newest_ready.get(
+            "first_ready_s"
+        ) is not None:
+            w = newest_ready
+            lines.append(
+                f"  join->first-request "
+                f"{w['first_ready_s']}s (all "
+                f"{w.get('n_buckets')} bucket(s) in "
+                f"{w.get('warmup_s')}s"
+                + (", staged" if w.get("staged") else ", blocking")
+                + ")"
+            )
+        if afetch:
+            n_st = {}
+            for e in afetch:
+                n_st[e.get("status", "?")] = (
+                    n_st.get(e.get("status", "?"), 0) + 1
+                )
+            lines.append(
+                "  store fetches "
+                + ", ".join(
+                    f"{n_st[s]} {s}" for s in sorted(n_st)
+                )
+            )
+        if apub:
+            n_st = {}
+            for e in apub:
+                n_st[e.get("status", "?")] = (
+                    n_st.get(e.get("status", "?"), 0) + 1
+                )
+            lines.append(
+                "  store publishes "
+                + ", ".join(
+                    f"{n_st[s]} {s}" for s in sorted(n_st)
+                )
+            )
+        if bcold:
+            lines.append(
+                f"  cold refusals {len(bcold)} (bucket_cold "
+                "retry-after admissions while staging)"
+            )
+
     shists = by.get("slo_histogram", [])
     sbreach = by.get("slo_breach", [])
     sprof = by.get("slo_profile", [])
@@ -933,7 +1004,9 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
                  "fleet_replica_abandoned", "fleet_requeue",
                  "fleet_overload", "bank_swap", "tenant_reject",
                  "fed_join", "fed_leave",
-                 "dqueue_requeue", "dqueue_failed"):
+                 "dqueue_requeue", "dqueue_failed",
+                 "artifact_fetch", "artifact_publish",
+                 "warmup_stage", "bucket_cold"):
         for e in by.get(kind, []):
             n_ev += 1
             detail = {

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Warmup smoke: cold-vs-warm compiled-artifact-store startup, end to
+end, in one process tree — the CI proof that pre-warmed elasticity
+(serve.artifacts) actually skips XLA, not just that the store
+round-trips bytes.
+
+Two child engine startups against the SAME store directory:
+
+  cold   fresh store: every bucket program is live-compiled and its
+         AOT-serialized executable published (artifact_publish won)
+  warm   a NEW process on the now-populated store: every bucket
+         program is fetched + deserialized (artifact_fetch hit,
+         serve_warmup source=fetched) and the obs stream carries
+         ZERO backend-compile events for the bucket program
+         (fun_name ccsc_bucket_program) — the assertion is read from
+         the CompileMonitor events in the metrics stream, not from
+         wall-clock deltas, so a fast machine cannot fake it
+
+Both runs serve one request (the fetched executable must actually
+execute, not just deserialize) and append a ``kind=warmup`` perf-
+ledger record (CCSC_PERF_LEDGER armed to a scratch file); the warm
+record must carry ``n_compiles=0``.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/warmup_smoke.py
+
+Exit 0 iff every assertion holds. scripts/ci.sh runs this as its
+warmup leg (exit code 25 on failure).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _child_code(store, mdir):
+    """One engine startup: two tiny buckets, artifact store armed,
+    one served request, startup seconds on stdout as JSON."""
+    return f"""
+import json, time
+t0 = time.monotonic()
+import numpy as np
+from ccsc_code_iccv2017_tpu.config import (
+    ProblemGeom, ServeConfig, SolveConfig)
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem)
+from ccsc_code_iccv2017_tpu.serve import CodecEngine
+r = np.random.default_rng(0)
+d = r.normal(size=(4, 3, 3)).astype(np.float32)
+d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+cfg = SolveConfig(lambda_residual=5.0, lambda_prior=0.3, max_it=3,
+                  tol=0.0, verbose="none", track_psnr=True,
+                  track_objective=True)
+eng = CodecEngine(
+    d, ReconstructionProblem(ProblemGeom((3, 3), 4)), cfg,
+    ServeConfig(buckets=((2, (12, 12)), (2, (16, 16))),
+                max_wait_ms=2.0, artifact_store={store!r},
+                metrics_dir={mdir!r}, verbose="none"),
+)
+startup_s = time.monotonic() - t0
+x = r.random((12, 12)).astype(np.float32)
+m = (r.random((12, 12)) < 0.5).astype(np.float32)
+res = eng.submit(x * m, mask=m, x_orig=x).result(timeout=180)
+eng.close()
+print(json.dumps({{"startup_s": startup_s,
+                   "psnr": float(res.psnr or 0.0)}}), flush=True)
+"""
+
+
+def _run_child(store, mdir, env):
+    p = subprocess.run(
+        [sys.executable, "-c", _child_code(store, mdir)],
+        capture_output=True, text=True, env=env, timeout=480,
+    )
+    if p.returncode != 0:
+        print(p.stdout)
+        print(p.stderr, file=sys.stderr)
+        raise RuntimeError(f"child engine failed (rc={p.returncode})")
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def _bucket_compiles(events):
+    """Backend-compile events attributable to the bucket program (the
+    engine names it ccsc_bucket_program for exactly this filter)."""
+    return [
+        e for e in events
+        if e["type"] == "compile" and e.get("kind") == "compile"
+        and "ccsc_bucket_program" in (e.get("fun_name") or "")
+    ]
+
+
+def main() -> int:
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    checks = []
+
+    def check(name, ok, detail=""):
+        checks.append(ok)
+        print(f"[{'PASS' if ok else 'FAIL'}] {name}"
+              + (f": {detail}" if detail else ""))
+
+    with tempfile.TemporaryDirectory() as root:
+        store = os.path.join(root, "artifacts")
+        ledger = os.path.join(root, "ledger.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   CCSC_PERF_LEDGER=ledger)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        # any ambient persistent XLA cache would let the warm run
+        # "cheat" with cache-hit compiles — the point is the store
+        env.pop("CCSC_COMPILE_CACHE", None)
+
+        cold = _run_child(store, os.path.join(root, "m-cold"), env)
+        warm = _run_child(store, os.path.join(root, "m-warm"), env)
+
+        cev = obs.read_events(os.path.join(root, "m-cold"),
+                              recursive=True)
+        wev = obs.read_events(os.path.join(root, "m-warm"),
+                              recursive=True)
+
+        pubs = [e for e in cev if e["type"] == "artifact_publish"
+                and e.get("status") in ("won", "repair")]
+        check("cold run publishes both bucket executables",
+              len(pubs) == 2, f"published={len(pubs)}")
+        check("cold run live-compiles the bucket program",
+              len(_bucket_compiles(cev)) >= 1,
+              f"bucket_compiles={len(_bucket_compiles(cev))}")
+
+        wcomp = _bucket_compiles(wev)
+        check("warm run performs ZERO bucket-program XLA compiles",
+              len(wcomp) == 0, f"bucket_compiles={len(wcomp)}")
+        fetches = [e for e in wev if e["type"] == "artifact_fetch"]
+        check("warm run fetches every bucket from the store",
+              len(fetches) == 2
+              and all(e.get("status") == "hit" for e in fetches),
+              f"statuses={[e.get('status') for e in fetches]}")
+        sources = [e.get("source") for e in wev
+                   if e["type"] == "serve_warmup"]
+        check("warm run warms every bucket from fetched artifacts",
+              sources and all(s == "fetched" for s in sources),
+              f"sources={sources}")
+        ready = [e for e in wev if e["type"] == "serve_ready"]
+        check("warm serve_ready reports n_compiled=0",
+              len(ready) == 1 and ready[0].get("n_compiled") == 0,
+              f"serve_ready={[(e.get('n_fetched'), e.get('n_compiled')) for e in ready]}")
+        check("warm run serves a real request off the fetched "
+              "executable", warm.get("psnr", 0.0) > 0.0,
+              f"psnr={warm.get('psnr'):.2f}")
+
+        recs = []
+        if os.path.exists(ledger):
+            with open(ledger) as f:
+                recs = [json.loads(ln) for ln in f
+                        if ln.strip()]
+        wrecs = [r for r in recs if r.get("kind") == "warmup"]
+        check("both startups append kind=warmup ledger records",
+              len(wrecs) == 2, f"warmup_records={len(wrecs)}")
+        check("warm ledger record carries n_compiles=0",
+              bool(wrecs) and wrecs[-1].get("n_compiles") == 0,
+              f"n_compiles={[r.get('n_compiles') for r in wrecs]}")
+
+        print(f"cold startup {cold['startup_s']:.2f}s -> warm startup "
+              f"{warm['startup_s']:.2f}s "
+              f"({cold['startup_s'] / max(warm['startup_s'], 1e-9):.1f}x)")
+    n_fail = sum(1 for ok in checks if not ok)
+    print(f"{len(checks) - n_fail}/{len(checks)} warmup checks passed")
+    return 1 if n_fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,354 @@
+"""The compiled-program artifact store (serve.artifacts): durable
+content-addressed AOT executables and the staged-warmup ordering.
+
+Contracts under test (ISSUE 16):
+- serialize/deserialize round-trips a real AOT-compiled executable
+  (no retrace, no recompile) and refuses a foreign payload schema;
+- a torn or truncated manifest line reads as ABSENT, never as an
+  error or a poisoned record — the registry/ledger stance;
+- N concurrent publishers of one key: exactly one WINS (O_EXCL
+  link), one manifest record, payload intact;
+- cross-chip and cross-fingerprint fetches are REFUSED, as is a
+  record published under a different jax release (newest record
+  wins, so a skewed republish shadows a good one — and is refused);
+- a corrupt payload (truncation, hand edit) reads as absent and the
+  serving engine falls back to LIVE COMPILE, then republishes — the
+  repair path heals the store for the next joiner;
+- a second engine on a warm store fetches every bucket program and
+  performs ZERO bucket-program XLA compiles, serving bit-identical
+  results;
+- rank_buckets: declared order wins (typos refused), capture
+  frequency next, configured order last.
+"""
+import json
+import os
+import pickle
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.config import (
+    ProblemGeom,
+    ServeConfig,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+)
+from ccsc_code_iccv2017_tpu.serve import CodecEngine
+from ccsc_code_iccv2017_tpu.serve import artifacts as arts
+from ccsc_code_iccv2017_tpu.utils import obs
+from ccsc_code_iccv2017_tpu.utils.validate import CCSCInputError
+
+
+def _blob(seed=0, n=2048):
+    return np.random.default_rng(seed).bytes(n)
+
+
+def _store(tmp_path, name="store"):
+    return arts.ArtifactStore(str(tmp_path / name))
+
+
+def _publish(store, key="cpu-single-aaaa", payload=None, **kw):
+    kw.setdefault("fingerprint", "aaaa")
+    kw.setdefault("chip", "cpu")
+    return store.publish(key, payload or _blob(), **kw)
+
+
+# --------------------------------------------------------------------
+# wire format
+# --------------------------------------------------------------------
+
+
+def test_serialize_roundtrip_executes_without_recompile():
+    """A deserialized executable is the same program: same bytes out,
+    and the load path never enters jax.jit (no trace, no compile)."""
+
+    def f(a, b):
+        return a * 2.0 + b
+
+    x = jnp.arange(8.0, dtype=jnp.float32)
+    y = jnp.ones((8,), jnp.float32)
+    compiled = jax.jit(f).lower(x, y).compile()
+    blob = arts.serialize_program(compiled)
+    loaded = arts.deserialize_program(blob)
+    np.testing.assert_array_equal(
+        np.asarray(loaded(x, y)), np.asarray(compiled(x, y))
+    )
+
+
+def test_deserialize_refuses_foreign_payload_schema():
+    junk = pickle.dumps((999, b"", None, None))
+    with pytest.raises(ValueError, match="payload schema"):
+        arts.deserialize_program(junk)
+
+
+def test_fingerprint_sensitivity():
+    """Anything that changes the lowered program changes the
+    fingerprint; a fresh computation of the same identity matches."""
+    geom = ProblemGeom((3, 3), 4)
+    base = dict(bucket=(2, (12, 12)), geom=geom,
+                knobs={"arm": "f32"})
+    fp = arts.program_fingerprint(**base)
+    assert fp == arts.program_fingerprint(**base)
+    assert fp != arts.program_fingerprint(
+        **dict(base, bucket=(4, (12, 12))))
+    assert fp != arts.program_fingerprint(
+        **dict(base, knobs={"arm": "bf16"}))
+    assert fp != arts.program_fingerprint(
+        **dict(base, mesh_shape=(2, 4)))
+    key = arts.artifact_key(fp, "cpu")
+    assert key != arts.artifact_key(fp, "tpu-v5e")
+    assert key != arts.artifact_key(fp, "cpu", (2, 4))
+
+
+# --------------------------------------------------------------------
+# store durability
+# --------------------------------------------------------------------
+
+
+def test_publish_fetch_roundtrip(tmp_path):
+    st = _store(tmp_path)
+    payload = _blob()
+    rec, status = _publish(st, payload=payload, bucket="2@12x12")
+    assert status == "won" and rec["key"] == "cpu-single-aaaa"
+    got, how = st.fetch(
+        "cpu-single-aaaa", fingerprint="aaaa", chip="cpu"
+    )
+    assert how == "hit" and got == payload
+    assert st.keys() == ["cpu-single-aaaa"]
+    st.close()
+
+
+def test_torn_manifest_line_reads_as_absent(tmp_path):
+    """A publisher killed mid-append leaves a torn JSONL tail: the
+    record it was writing is ABSENT; every earlier record survives."""
+    st = _store(tmp_path)
+    _publish(st, payload=_blob())
+    st.close()
+    man = tmp_path / "store" / "manifest.jsonl"
+    whole = man.read_bytes()
+    # a second record, torn mid-line (no newline, truncated JSON)
+    torn = json.dumps({"key": "cpu-single-bbbb", "sha256": "x" * 64})
+    man.write_bytes(whole + torn[: len(torn) // 2].encode())
+    st2 = _store(tmp_path)
+    assert st2.keys() == ["cpu-single-aaaa"]
+    assert st2.resolve("cpu-single-bbbb") is None
+    assert st2.fetch("cpu-single-bbbb")[1] == "miss"
+    # the good record still fetches
+    assert st2.fetch("cpu-single-aaaa")[1] == "hit"
+    # and the store writes on top of the torn tail without poisoning
+    _publish(st2, key="cpu-single-cccc", fingerprint="cccc")
+    st2.close()
+    st3 = _store(tmp_path)
+    assert st3.fetch("cpu-single-cccc")[1] == "hit"
+    st3.close()
+
+
+def test_concurrent_publish_exactly_one_winner(tmp_path):
+    st = _store(tmp_path)
+    payload = _blob()
+    statuses = []
+    lock = threading.Lock()
+    start = threading.Barrier(8)
+
+    def pub():
+        start.wait()
+        _rec, status = _publish(st, payload=payload)
+        with lock:
+            statuses.append(status)
+
+    ts = [threading.Thread(target=pub) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert statuses.count("won") == 1, statuses
+    assert all(s in ("won", "lost", "exists") for s in statuses)
+    # one manifest record, payload intact, no tmp droppings
+    assert len(st._read_manifest()) == 1
+    assert st.fetch("cpu-single-aaaa")[0] == payload
+    pdir = tmp_path / "store" / "programs"
+    assert [p.name for p in pdir.iterdir()] == ["cpu-single-aaaa.bin"]
+    st.close()
+
+
+def test_foreign_artifact_refused(tmp_path):
+    """Wrong chip, wrong fingerprint, wrong jax release: all read as
+    a miss — a foreign executable must never be loaded."""
+    st = _store(tmp_path)
+    _publish(st)
+    assert st.fetch(
+        "cpu-single-aaaa", fingerprint="aaaa", chip="tpu-v5e"
+    )[1] == "chip_mismatch"
+    assert st.fetch(
+        "cpu-single-aaaa", fingerprint="ffff", chip="cpu"
+    )[1] == "fingerprint_mismatch"
+    # a NEWER record under a skewed jax release shadows the good one
+    # (newest wins) and is refused — the caller live-compiles and the
+    # republish heals the key
+    rec = st.resolve("cpu-single-aaaa")
+    skew = dict(rec, jax="0.0.0", seq=rec["seq"] + 1)
+    with open(tmp_path / "store" / "manifest.jsonl", "a") as f:
+        f.write(json.dumps(skew) + "\n")
+    st2 = _store(tmp_path)
+    assert st2.fetch(
+        "cpu-single-aaaa", fingerprint="aaaa", chip="cpu"
+    )[1] == "version_skew"
+    st2.close()
+    st.close()
+
+
+def test_missing_and_corrupt_payload_read_as_absent(tmp_path):
+    st = _store(tmp_path)
+    payload = _blob()
+    _publish(st, payload=payload)
+    ppath = tmp_path / "store" / "programs" / "cpu-single-aaaa.bin"
+    # truncation = corrupt (sha re-verified on every fetch)
+    ppath.write_bytes(payload[: len(payload) // 2])
+    assert st.fetch("cpu-single-aaaa")[1] == "corrupt"
+    os.unlink(ppath)
+    assert st.fetch("cpu-single-aaaa")[1] == "missing_payload"
+    # repair: republishing the true bytes heals the key
+    _rec, status = _publish(st, payload=payload)
+    assert status in ("won", "repair")
+    assert st.fetch("cpu-single-aaaa") == (payload, "hit")
+    st.close()
+
+
+# --------------------------------------------------------------------
+# staged-warmup ordering
+# --------------------------------------------------------------------
+
+
+def test_rank_buckets_declared_order_wins():
+    table = [(2, (12, 12)), (4, (16, 16)), (2, (24, 24))]
+    order = arts.rank_buckets(table, declared=["2@24x24"])
+    assert order == [(2, (24, 24)), (2, (12, 12)), (4, (16, 16))]
+    with pytest.raises(CCSCInputError, match="not.*configured"):
+        arts.rank_buckets(table, declared=["2@99x99"])
+    # no declaration, no capture: configured order stands
+    assert arts.rank_buckets(table) == table
+
+
+# --------------------------------------------------------------------
+# engine integration: fetch-instead-of-compile + self-healing
+# --------------------------------------------------------------------
+
+
+def _bank(k=4, s=3, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, s, s)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return jnp.asarray(d)
+
+
+def _cfg():
+    return SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none", track_objective=True, track_psnr=True,
+    )
+
+
+def _engine(d, store, mdir, buckets=((2, (12, 12)),)):
+    scfg = ServeConfig(
+        buckets=buckets, max_wait_ms=2.0, artifact_store=str(store),
+        metrics_dir=str(mdir), verbose="none",
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    return CodecEngine(d, ReconstructionProblem(geom), _cfg(), scfg)
+
+
+def _serve_one(eng, seed=1):
+    r = np.random.default_rng(seed)
+    x = r.random((12, 12)).astype(np.float32)
+    m = (r.random((12, 12)) < 0.5).astype(np.float32)
+    return eng.submit(x * m, mask=m, x_orig=x).result(timeout=120)
+
+
+def _bucket_compiles(events):
+    return [
+        e for e in events
+        if e["type"] == "compile" and e.get("kind") == "compile"
+        and "ccsc_bucket_program" in (e.get("fun_name") or "")
+    ]
+
+
+def test_warm_store_engine_fetches_and_never_compiles(tmp_path):
+    """The elasticity contract end-to-end in one process: engine A
+    publishes, engine B fetches — zero bucket-program compiles in
+    B's obs stream, bit-identical served bytes."""
+    d = _bank()
+    store = tmp_path / "store"
+    e1 = _engine(d, store, tmp_path / "m1")
+    try:
+        r1 = _serve_one(e1)
+    finally:
+        e1.close()
+    ev1 = obs.read_events(str(tmp_path / "m1"), recursive=True)
+    assert [
+        e["status"] for e in ev1 if e["type"] == "artifact_publish"
+    ] == ["won"]
+    assert len(_bucket_compiles(ev1)) == 1
+
+    e2 = _engine(d, store, tmp_path / "m2")
+    try:
+        r2 = _serve_one(e2)
+    finally:
+        e2.close()
+    ev2 = obs.read_events(str(tmp_path / "m2"), recursive=True)
+    assert [
+        e["status"] for e in ev2 if e["type"] == "artifact_fetch"
+    ] == ["hit"]
+    warm = [e for e in ev2 if e["type"] == "serve_warmup"]
+    assert [e["source"] for e in warm] == ["fetched"]
+    assert _bucket_compiles(ev2) == []
+    ready = [e for e in ev2 if e["type"] == "serve_ready"]
+    assert ready[-1]["n_fetched"] == 1
+    assert ready[-1]["n_compiled"] == 0
+    np.testing.assert_array_equal(
+        np.asarray(r1.recon), np.asarray(r2.recon)
+    )
+
+
+def test_corrupt_artifact_falls_back_to_live_compile_and_heals(
+    tmp_path,
+):
+    """A corrupt stored executable must cost availability nothing:
+    the joining engine refuses it (sha), live-compiles, REPUBLISHES
+    (repair) — and the next joiner fetches clean."""
+    d = _bank()
+    store = tmp_path / "store"
+    e1 = _engine(d, store, tmp_path / "m1")
+    e1.close()
+    (bin_path,) = (store / "programs").iterdir()
+    good = bin_path.read_bytes()
+    bin_path.write_bytes(b"garbage" + good[: len(good) // 3])
+
+    e2 = _engine(d, store, tmp_path / "m2")
+    try:
+        res = _serve_one(e2)
+    finally:
+        e2.close()
+    assert res.recon.shape == (12, 12)
+    ev2 = obs.read_events(str(tmp_path / "m2"), recursive=True)
+    assert [
+        e["status"] for e in ev2 if e["type"] == "artifact_fetch"
+    ] == ["corrupt"]
+    warm = [e for e in ev2 if e["type"] == "serve_warmup"]
+    assert [e["source"] for e in warm] == ["compiled"]
+    assert [
+        e["status"] for e in ev2 if e["type"] == "artifact_publish"
+    ] == ["repair"]
+
+    # healed: the third joiner fetches
+    e3 = _engine(d, store, tmp_path / "m3")
+    e3.close()
+    ev3 = obs.read_events(str(tmp_path / "m3"), recursive=True)
+    assert [
+        e["status"] for e in ev3 if e["type"] == "artifact_fetch"
+    ] == ["hit"]
+    assert _bucket_compiles(ev3) == []

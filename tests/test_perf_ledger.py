@@ -265,6 +265,44 @@ def test_gate_cli_nonzero_on_injected_slowdown(tmp_path):
     assert payload["n_regressions"] == 1
 
 
+def test_gate_cli_nonzero_on_warmup_slowdown(tmp_path, monkeypatch):
+    """The elasticity SLO is a gated configuration (ISSUE 16): serve
+    warmup appends ``kind=warmup`` records (value = warm starts per
+    second, so slower joins = smaller values under the higher-is-
+    better gate), and an injected 2x join-time slowdown must make
+    perf_gate exit 1."""
+    led_path = str(tmp_path / "led.jsonl")
+    monkeypatch.setenv("CCSC_PERF_LEDGER", led_path)
+    buckets = ((2, (16, 16)), (2, (32, 32)))
+    for i in range(6):
+        rec = ledger_mod.append_warmup_record(
+            chip="cpu", buckets=buckets, join_s=0.5 + 0.01 * i,
+            staged=True, artifact_store=True, n_compiled=0,
+        )
+        assert rec is not None and rec["kind"] == "warmup"
+        assert rec["unit"] == "warm_starts/sec"
+    out = _gate_cli("--ledger", led_path)
+    assert out.returncode == 0, out.stdout + out.stderr
+    # a 2x slower join halves warm_starts/sec -> REGRESSION
+    ledger_mod.append_warmup_record(
+        chip="cpu", buckets=buckets, join_s=1.04,
+        staged=True, artifact_store=True, n_compiled=0,
+    )
+    out = _gate_cli("--ledger", led_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "REGRESSION" in out.stdout
+    # the warmup knobs are part of the gate key: a BLOCKING-warmup
+    # record (different configuration) does not collide with the
+    # staged history
+    blocking = ledger_mod.append_warmup_record(
+        chip="cpu", buckets=buckets, join_s=2.0,
+        staged=False, artifact_store=False, n_compiled=2,
+    )
+    assert ledger_mod.record_key(blocking) != ledger_mod.record_key(
+        rec
+    )
+
+
 # --------------------------------------------------------------------
 # memwatch: the fake-memory_stats poller + OOM forensics
 # --------------------------------------------------------------------
@@ -707,8 +745,13 @@ def test_fleet_close_appends_serve_record(tmp_path, monkeypatch):
     fleet.submit(b=x * m, mask=m, key="q0").result(timeout=300)
     fleet.close()
     recs = ledger_mod.Ledger(led_path).read()
-    assert len(recs) == 1
-    rec = recs[0]
+    # the session appends exactly TWO records: the engine's warmup
+    # configuration (ISSUE 16: join time is a gated SLO) and the
+    # fleet's serve-throughput record at close
+    assert [r["kind"] for r in recs] == ["warmup", "serve"]
+    wrec = recs[0]
+    assert wrec["unit"] == "warm_starts/sec" and wrec["value"] > 0
+    rec = recs[1]
     assert rec["kind"] == "serve" and rec["chip"] == "cpu"
     assert rec["workload"] == "solve2d"
     assert rec["shape_key"] == "solve2d:k4:s5x5:sz16x16"

@@ -14,6 +14,7 @@ Contracts under test (ISSUE 5):
 - per-request validation is the cheap subset (shape/non-finite), the
   bank checks having run once at engine construction.
 """
+import os
 import time
 
 import jax.numpy as jnp
@@ -509,3 +510,101 @@ def test_engine_soak_mixed_stream(tmp_path):
     st = eng.stats()
     assert st["n_requests"] == 24
     assert 0 < st["mean_occupancy"] <= 1.0
+
+
+# --------------------------------------------------------------------
+# staged warmup + compile-cache latch (ISSUE 16)
+# --------------------------------------------------------------------
+
+
+def test_staged_warmup_serves_hot_bucket_while_cold_builds(tmp_path):
+    """Staged engine contract: the constructor returns as soon as the
+    DECLARED-hot bucket's program is ready; a request for the still-
+    cold bucket is refused with BucketCold (retry-after, not an
+    error), the hot bucket serves immediately, and the cold bucket
+    warms in the background and then serves."""
+    from ccsc_code_iccv2017_tpu.serve import BucketCold
+
+    d = _bank(k=4, s=3)
+    cfg = _cfg(max_it=3, tol=0.0)
+    eng = _engine(
+        d, cfg, ((2, (48, 48)), (2, (16, 16))), tmp_path,
+        staged_warmup=True, warm_order=("2@16x16",),
+    )
+    try:
+        # constructor returned => hot bucket ready; the big cold
+        # bucket is still compiling on the background thread
+        assert eng.bucket_warm((2, (16, 16)))
+        xc, mc = _req(48, seed=3)
+        if not eng.bucket_warm((2, (48, 48))):
+            with pytest.raises(BucketCold) as exc:
+                eng.submit(xc * mc, mask=mc, x_orig=xc)
+            assert exc.value.bucket == "2@48x48"
+            assert exc.value.retry_after_s > 0
+        # the hot bucket serves while the cold one builds
+        x, m = _req(16, seed=2)
+        res = eng.submit(x * m, mask=m, x_orig=x).result(timeout=120)
+        assert res.bucket == "2@16x16"
+        # the cold bucket finishes warming and then serves
+        deadline = time.time() + 180
+        while not eng.bucket_warm((2, (48, 48))):
+            assert time.time() < deadline, "cold bucket never warmed"
+            time.sleep(0.05)
+        resc = eng.submit(
+            xc * mc, mask=mc, x_orig=xc
+        ).result(timeout=120)
+        assert resc.bucket == "2@48x48"
+    finally:
+        eng.close()
+    events = obs.read_events(str(tmp_path))
+    stages = [e for e in events if e["type"] == "warmup_stage"]
+    assert [e["stage"] for e in stages] == [1, 2]
+    assert stages[0]["bucket"] == "2@16x16"
+    ready = [e for e in events if e["type"] == "serve_ready"]
+    assert ready[-1]["staged"] is True
+    assert ready[-1]["first_ready_s"] <= ready[-1]["warmup_s"]
+
+
+def test_staged_warm_order_typo_refused():
+    d = _bank(k=4, s=3)
+    with pytest.raises(CCSCInputError, match="not.*configured"):
+        _engine(
+            d, _cfg(max_it=3), ((2, (16, 16)),),
+            staged_warmup=True, warm_order=("2@99x99",),
+        )
+
+
+def test_enable_compile_cache_latch_warns_on_different_path():
+    """The per-process XLA cache latch: a SECOND enable call with a
+    DIFFERENT path must warn on the obs console (tier=always) and
+    keep the first path — silently honoring it would split compiles
+    across two directories. Subprocess: the latch is process-global
+    by design, so an in-process test would poison every other test's
+    compile accounting."""
+    import subprocess
+    import sys
+
+    code = """
+import os, sys, tempfile
+from ccsc_code_iccv2017_tpu.serve import enable_compile_cache
+a = tempfile.mkdtemp(prefix="cc-a-")
+b = tempfile.mkdtemp(prefix="cc-b-")
+p1 = enable_compile_cache(a)
+assert p1 == a, p1
+p2 = enable_compile_cache(b)
+assert p2 == a, p2
+p3 = enable_compile_cache(a)  # same path: silent, still latched
+assert p3 == a, p3
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True,
+        text=True, env=env, timeout=240,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    warns = [
+        ln for ln in p.stdout.splitlines()
+        if "already latched" in ln
+    ]
+    assert len(warns) == 1, p.stdout
+    assert "ignoring the new path" in warns[0]
