@@ -12,6 +12,7 @@ from .config import (
     GEOM_3D,
     GEOM_HYPERSPECTRAL,
     GEOM_LIGHTFIELD,
+    ControllerConfig,
     FleetConfig,
     LearnConfig,
     ProblemGeom,

@@ -103,6 +103,23 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     "fleet_ceiling": _s("replica_id", "ceiling", "source"),
     "fleet_overload": _s("replica_id", "rung_from", "rung_to",
                          "queue_depth"),
+    # -- live elasticity (serve.fleet.set_replica_count): fleet_scale
+    # announces a target change (grow or shrink); fleet_replica_retired
+    # marks a slot drained-then-retired (scale-down), as opposed to
+    # dead/abandoned ------------------------------------------------
+    "fleet_scale": _s("replica_id", "from_n", "to_n", "reason"),
+    "fleet_replica_retired": _s("replica_id", "reason"),
+    # -- capacity controller (serve.controller). Every decision event
+    # carries the sensor ``snapshot`` dict that justified it so
+    # obs_report can replay why capacity moved. ctrl_decision is the
+    # intent, ctrl_scale/ctrl_brownout the actuation outcomes,
+    # ctrl_holdoff a wanted-but-suppressed action (stale sensors,
+    # cooldown, breaker open, bounds, HBM veto) ----------------------
+    "ctrl_decision": _s("replica_id", "action", "reason", "snapshot"),
+    "ctrl_scale": _s("replica_id", "direction", "from_n", "to_n",
+                     "ok"),
+    "ctrl_brownout": _s("replica_id", "on", "reason"),
+    "ctrl_holdoff": _s("replica_id", "reason"),
     # -- multi-tenant bank registry + tenancy (serve.registry,
     # serve.tenancy, serve.engine, serve.fleet). bank_publish is the
     # registry's durable-publication announcement; bank_swap is the

@@ -17,7 +17,17 @@ fleet instead (serve.ServeFleet): N engine replicas behind one front
 queue, health-driven requeue of a crashed/stalled replica's requests,
 and admission control — an Overloaded refusal here backs off for the
 fleet's (jittered) retry-after hint with exponential escalation on
-consecutive refusals and resubmits.
+consecutive same-class refusals (ResubmitBackoff: BucketCold and
+Overloaded escalate independently) and resubmits.
+
+--min-replicas/--max-replicas replace a static --replicas pin with
+the SLO-feedback capacity controller (serve.controller): the fleet
+grows toward the ceiling under sustained queue pressure or SLO
+breach — new replicas warm from the artifact store and join the
+admission ceiling once past BucketCold — shrinks back at the trough
+by drain-then-retire, and browns out (the degrade rung) before any
+shed. The controller is strictly advisory: killing it mid-scale
+leaves the fleet serving exactly as configured.
 
 --federate DIR joins the cross-host pool instead (serve.federation):
 this process runs its fleet as a drain worker against the shared
@@ -44,6 +54,44 @@ import sys
 import time
 
 import numpy as np
+
+
+class ResubmitBackoff:
+    """Escalating backoff for the resubmit loop, with SEPARATE
+    consecutive-refusal counters per refusal class: ``BucketCold``
+    (staged warmup still building a bucket's program — routine and
+    transient while the capacity controller grows the fleet) and
+    ``Overloaded`` (the admission ceiling) escalate independently, so
+    a cold-bucket refusal during scale-up cannot inflate the overload
+    backoff into minute-long sleeps (and vice versa). Each refusal
+    honors the fleet's own (jittered) ``retry_after_s`` hint, doubled
+    per consecutive same-class refusal up to ``2**MAX_DOUBLINGS`` and
+    capped at ``CAP_S``."""
+
+    CAP_S = 60.0
+    MAX_DOUBLINGS = 5
+
+    def __init__(self):
+        self._consec: dict = {}
+
+    def delay_for(self, exc) -> float:
+        """Record one refusal and return how long to sleep before
+        resubmitting. ``exc`` must carry ``retry_after_s``."""
+        kind = type(exc).__name__
+        n = self._consec.get(kind, 0) + 1
+        self._consec[kind] = n
+        return min(
+            float(exc.retry_after_s)
+            * (2 ** min(n - 1, self.MAX_DOUBLINGS)),
+            self.CAP_S,
+        )
+
+    def consec(self, kind: str) -> int:
+        return self._consec.get(kind, 0)
+
+    def reset(self) -> None:
+        """An admitted request clears all escalation."""
+        self._consec.clear()
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -152,6 +200,20 @@ def build_parser() -> argparse.ArgumentParser:
         "crashed or stalled replica, idempotent delivery, admission "
         "control with a predictable overload ladder. 1 (default) = a "
         "single bare engine",
+    )
+    p.add_argument(
+        "--min-replicas", type=int, default=None,
+        help="run the SLO-feedback capacity controller "
+        "(serve.controller) over the fleet with this replica floor: "
+        "the fleet grows toward --max-replicas under sustained queue "
+        "pressure or SLO breach (new replicas warm from the artifact "
+        "store) and shrinks back at the trough via drain-then-retire."
+        " Replaces a static --replicas pin; implies the fleet path",
+    )
+    p.add_argument(
+        "--max-replicas", type=int, default=None,
+        help="replica ceiling for the capacity controller (see "
+        "--min-replicas; both must be given together)",
     )
     p.add_argument(
         "--max-queue-depth", type=int, default=None,
@@ -355,7 +417,29 @@ def main(argv=None):
     )
     if args.replicas < 1:
         raise SystemExit("--replicas must be >= 1")
+    if (args.min_replicas is None) != (args.max_replicas is None):
+        raise SystemExit(
+            "--min-replicas and --max-replicas must be given together"
+        )
+    ctrl_bounds = None
+    if args.min_replicas is not None:
+        if (
+            args.min_replicas < 1
+            or args.max_replicas < args.min_replicas
+        ):
+            raise SystemExit(
+                "need 1 <= --min-replicas <= --max-replicas, got "
+                f"{args.min_replicas}..{args.max_replicas}"
+            )
+        ctrl_bounds = (args.min_replicas, args.max_replicas)
     if federate_dir is not None:
+        if ctrl_bounds is not None:
+            raise SystemExit(
+                "--min-replicas/--max-replicas are not supported in "
+                "--federate mode (the controller manages a local "
+                "fleet; host-level elasticity is "
+                "serve.FederatedHostPool)"
+            )
         # federated host mode: no local data source — requests come
         # from the shared queue, results go back into it durably
         from ..serve.federation import FederatedHost
@@ -407,14 +491,25 @@ def main(argv=None):
         # declared tenants need the fleet's admission layer (quotas,
         # weighted-fair lanes, per-tenant SLOs live there)
         or tenants is not None
+        # the capacity controller is a fleet actuator
+        or ctrl_bounds is not None
     )
+    # controller-managed fleets start at the floor (the controller
+    # grows from there on pressure); an explicit --replicas inside
+    # the bounds is honored as the starting point
+    n_replicas = args.replicas
+    if ctrl_bounds is not None:
+        n_replicas = min(
+            max(n_replicas, ctrl_bounds[0]), ctrl_bounds[1]
+        )
     metricsd = None  # standalone-engine endpoint (the fleet owns its own)
+    ctrl = None
     t0 = time.perf_counter()
     if fleet_mode:
         engine = ServeFleet(
             d, ReconstructionProblem(geom), cfg, scfg,
             FleetConfig(
-                replicas=args.replicas,
+                replicas=n_replicas,
                 max_queue_depth=args.max_queue_depth,
                 metrics_dir=args.metrics_dir,
                 slo_p50_ms=args.slo_p50_ms,
@@ -427,10 +522,28 @@ def main(argv=None):
         )
         print(
             f"fleet ready in {time.perf_counter() - t0:.2f}s "
-            f"({args.replicas} replica(s), {engine.total_devices} "
+            f"({n_replicas} replica(s), {engine.total_devices} "
             f"device(s), {len(scfg.buckets)} "
             f"bucket(s), queue ceiling {engine.queue_ceiling})"
         )
+        if ctrl_bounds is not None:
+            from .. import ControllerConfig
+            from ..serve.controller import CapacityController
+            from ..utils.memwatch import MemWatch
+
+            ctrl = CapacityController(
+                engine,
+                ControllerConfig(
+                    min_replicas=ctrl_bounds[0],
+                    max_replicas=ctrl_bounds[1],
+                ),
+                memwatch=MemWatch(),
+            ).start()
+            print(
+                "capacity controller active "
+                f"({ctrl_bounds[0]}..{ctrl_bounds[1]} replicas, "
+                f"tick {ctrl.interval_s}s)"
+            )
     else:
         engine = CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
         print(
@@ -497,7 +610,7 @@ def main(argv=None):
         nonlocal n_skipped, n_overloaded
         mask = (rng.random(x.shape) < args.keep).astype(np.float32)
         sm = smooth_fill_batch(x[None], mask[None])[0]
-        consec = 0
+        backoff = ResubmitBackoff()
         while True:
             try:
                 fut = engine.submit(
@@ -509,17 +622,16 @@ def main(argv=None):
                 # to back off — honor the (already jittered,
                 # CCSC_FED_RETRY_JITTER) hint instead of dropping the
                 # request, escalating exponentially on CONSECUTIVE
-                # refusals: a hint computed at the admission ceiling
-                # describes the queue as it was, and N producers
-                # re-colliding on it forever is the thundering herd
-                # the jitter + escalation exist to break up. A
-                # BucketCold refusal (staged warmup still building
-                # this bucket's program) rides the same backoff.
+                # same-class refusals: a hint computed at the
+                # admission ceiling describes the queue as it was,
+                # and N producers re-colliding on it forever is the
+                # thundering herd the jitter + escalation exist to
+                # break up. BucketCold (staged warmup still building
+                # this bucket's program — routine mid-scale-up) rides
+                # its OWN counter so a cold bucket never inflates the
+                # overload backoff (ResubmitBackoff).
                 n_overloaded += 1
-                consec += 1
-                delay = min(
-                    e.retry_after_s * (2 ** min(consec - 1, 5)), 60.0
-                )
+                delay = backoff.delay_for(e)
                 why = (
                     "bucket cold"
                     if isinstance(e, BucketCold)
@@ -616,7 +728,11 @@ def main(argv=None):
     finally:
         # the engine must always close (flushes queued dispatches,
         # writes the telemetry summary) — even when a mid-stream
-        # failure aborts the submit loop
+        # failure aborts the submit loop. The controller stops FIRST:
+        # it is advisory, so stopping it changes nothing about the
+        # fleet, but a scale decision racing the close would be noise
+        if ctrl is not None:
+            ctrl.close()
         if metricsd is not None:
             metricsd.stop()
         engine.close()
@@ -628,7 +744,7 @@ def main(argv=None):
     if fleet_mode and stats["n_requests"]:
         print(
             f"{stats['n_requests']} requests over "
-            f"{args.replicas} replica(s), "
+            f"{engine.replica_target} replica(s), "
             f"{stats['n_requeued']} requeued, "
             f"{n_overloaded} overload backoff(s), p50 "
             f"{stats['p50_latency_s'] * 1e3:.1f} ms, p99 "

@@ -36,7 +36,15 @@ chip x mesh, so a joining host FETCHES its programs instead of
 compiling them, and staged warmup (ServeConfig.staged_warmup) serves
 the hottest bucket the moment its program is ready — cold buckets
 build in the background behind explicit :class:`BucketCold`
-retry-after refusals.
+retry-after refusals. :class:`CapacityController` (serve.controller)
+closes the capacity loop: a strictly-advisory control plane reading
+one sensor snapshot per tick (queue depth vs the derived ceiling,
+SLO p99, warmup ETAs, HBM watermark) and driving
+``ServeFleet.set_replica_count`` grow/shrink, the brownout rung, and
+:class:`FederatedHostPool` host spin-up/down — with hysteresis,
+cooldowns, fail-safe stale-sensor holdoffs, and a stuck-actuator
+circuit breaker, so its death leaves the fleet serving exactly as
+configured.
 """
 from .artifacts import (  # noqa: F401
     ArtifactStore,
@@ -48,6 +56,7 @@ from .artifacts import (  # noqa: F401
     serialize_program,
 )
 from .capture import WorkloadRecorder  # noqa: F401
+from .controller import CapacityController  # noqa: F401
 from .dqueue import DurableQueue  # noqa: F401
 from .engine import (  # noqa: F401
     BucketCold,
@@ -59,6 +68,7 @@ from .engine import (  # noqa: F401
 from .federation import (  # noqa: F401
     FederatedFrontend,
     FederatedHost,
+    FederatedHostPool,
     FederatedResult,
 )
 from .fleet import Overloaded, ServeFleet  # noqa: F401

@@ -58,7 +58,12 @@ from ..utils import trace as trace_util
 from .dqueue import DurableQueue
 from .fleet import BucketCold, Overloaded, ServeFleet
 
-__all__ = ["FederatedHost", "FederatedFrontend", "FederatedResult"]
+__all__ = [
+    "FederatedHost",
+    "FederatedHostPool",
+    "FederatedFrontend",
+    "FederatedResult",
+]
 
 
 class FederatedResult(NamedTuple):
@@ -776,6 +781,148 @@ class FederatedHost:
                 n_failed=self.n_failed,
                 released=released,
             )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FederatedHostPool:
+    """The coarse-grain elasticity actuator: N in-process
+    :class:`FederatedHost`\\ s draining ONE durable queue, grown and
+    shrunk one host at a time (serve.controller's ``hosts`` actuator,
+    ISSUE 17).
+
+    ``grow()`` constructs a full host — its own fleet, its own obs
+    stream under ``metrics_dir/host-NN`` — which joins the queue with
+    a fresh epoch and starts draining immediately (warmed from the
+    artifact store when ``serve_cfg.artifact_store`` is set, so a
+    grown host fetches instead of compiling). ``shrink()`` retires
+    the newest host through its clean ``close()``: unserved leases
+    are RELEASED back to the queue for the survivors — scale-down
+    never loses work, the same drain-then-retire contract as
+    ``ServeFleet.set_replica_count``. All mutation is serialized
+    under one lock; the pool holds no state a restarted controller
+    could disagree with (``n_hosts`` IS the state)."""
+
+    def __init__(
+        self,
+        queue_dir: str,
+        d,
+        prob,
+        cfg,
+        serve_cfg,
+        fleet_cfg,
+        blur_psf=None,
+        metrics_dir: Optional[str] = None,
+        verbose: str = "brief",
+        host_prefix: Optional[str] = None,
+        **host_kw,
+    ):
+        self.queue_dir = queue_dir
+        self._factory_args = (d, prob, cfg, serve_cfg, fleet_cfg)
+        self._blur_psf = blur_psf
+        self._metrics_dir = metrics_dir
+        self._verbose = verbose
+        self._host_prefix = host_prefix or _default_host()
+        self._host_kw = dict(host_kw)
+        self._hosts: List[FederatedHost] = []
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._closed = False
+
+    @property
+    def n_hosts(self) -> int:
+        with self._lock:
+            return len(self._hosts)
+
+    @property
+    def hosts(self) -> List[FederatedHost]:
+        with self._lock:
+            return list(self._hosts)
+
+    def grow(self) -> str:
+        """Spin one more host up against the queue; returns its host
+        id. Raises on a closed pool — the controller's actuator
+        ladder turns that into a failed invocation, never a crash."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("host pool is closed")
+            hid = self._next_id
+            self._next_id += 1
+        name = f"{self._host_prefix}-{hid}"
+        mdir = (
+            os.path.join(self._metrics_dir, f"host-{hid:02d}")
+            if self._metrics_dir is not None
+            else None
+        )
+        d, prob, cfg, serve_cfg, fleet_cfg = self._factory_args
+        host = FederatedHost(
+            self.queue_dir, d, prob, cfg, serve_cfg, fleet_cfg,
+            blur_psf=self._blur_psf, host=name, metrics_dir=mdir,
+            verbose=self._verbose, **self._host_kw,
+        )
+        stillborn = False
+        with self._lock:
+            if self._closed:
+                # lost the race with close(): retire immediately,
+                # leases go straight back to the queue
+                stillborn = True
+            else:
+                self._hosts.append(host)
+        if stillborn:
+            host.close()
+            raise RuntimeError("host pool is closed")
+        return name
+
+    def shrink(self) -> str:
+        """Retire the newest host (clean leave: finish in-flight,
+        release unserved leases, ``fed_leave``); returns its host id.
+        The caller owns the floor — the controller never calls this
+        below its ``min_hosts`` bound."""
+        with self._lock:
+            if not self._hosts:
+                raise RuntimeError("host pool is empty")
+            host = self._hosts.pop()
+        try:
+            host.close()
+        except Exception:
+            # the host is out of the pool either way; its leases are
+            # reaped by the survivors' heartbeat reaper
+            pass
+        return host.host
+
+    def serve_until_sealed(
+        self, timeout: Optional[float] = None
+    ) -> bool:
+        """Block until every current host drained the sealed queue
+        (or the timeout elapsed). Hosts grown mid-wait are NOT
+        awaited — the caller owns quiescence ordering."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        for host in self.hosts:
+            left = (
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+            if not host.serve_until_sealed(left):
+                return False
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            hosts, self._hosts = self._hosts, []
+        for host in reversed(hosts):
+            try:
+                host.close()
+            except Exception:
+                pass
 
     def __enter__(self):
         return self

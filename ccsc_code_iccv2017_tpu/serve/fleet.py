@@ -286,9 +286,21 @@ class ServeFleet:
             self._replica_mesh = [
                 tuple(default_mesh) if default_mesh else ()
             ] * fleet_cfg.replicas
+        # the shape a replica GROWN past the startup set inherits
+        # (set_replica_count): the same default every startup replica
+        # would get — None propagates the malformed-spec refusal
+        self._default_mesh_entry = (
+            None if env_malformed
+            else (tuple(default_mesh) if default_mesh else ())
+        )
         self._replica_devices: List[Optional[tuple]] = (
             [None] * fleet_cfg.replicas
         )
+        # device-slice allocation survives growth: the pool and the
+        # high-water offset persist so a replica grown later still
+        # gets a DISJOINT slice (or the strict refusal)
+        self._mesh_pool: Optional[List[int]] = None
+        self._mesh_off = 0
         if any(m for m in self._replica_mesh):
             import jax
 
@@ -302,6 +314,7 @@ class ServeFleet:
                 pool = list(serve_cfg.mesh_devices)
             else:
                 pool = list(range(len(jax.devices())))
+            self._mesh_pool = pool
             off = 0
             short: List[int] = []
             for rid, shape in enumerate(self._replica_mesh):
@@ -315,6 +328,7 @@ class ServeFleet:
                     off += need
                 else:
                     short.append(rid)
+            self._mesh_off = off
             if short and _env.env_flag("CCSC_SERVE_MESH_STRICT"):
                 from ..utils import validate
 
@@ -396,7 +410,26 @@ class ServeFleet:
         self._replicas: List[Optional[_Replica]] = [None] * (
             fleet_cfg.replicas
         )
+        # -- elasticity (serve.controller / set_replica_count): the
+        # fleet's replica count is a TARGET, not a constant. The list
+        # above only ever grows; a slot retired by scale-down lands in
+        # _scaled_down (excluded from capacity math and the dead-fleet
+        # checks) until a later grow resurrects it. _slot_gen remembers
+        # the last generation a drained slot served at, so a
+        # resurrection keeps the per-slot generation monotonic (the
+        # recycle walker's replacement test relies on it).
+        self._replica_target = fleet_cfg.replicas
+        self._scaled_down: set = set()
+        self._slot_gen: Dict[int, int] = {}
+        # gauges a CapacityController publishes through the fleet's
+        # metrics surface (metricsd renders ccsc_ctrl_*); the breaker
+        # gauge exists (closed) even with no controller attached
+        self._ctrl_gauges: Dict[str, float] = {"ctrl_breaker_open": 0}
         self._degraded = False
+        # controller-driven brownout (set_brownout): holds the
+        # degraded solve budget independent of the overload ladder —
+        # a rung-0 restore must not undo it
+        self._brownout = False
         self._recycling = False
         self._rung = 0
         self._rung2_since: Optional[float] = None
@@ -607,16 +640,23 @@ class ServeFleet:
                 "duplicates_suppressed_total": self._n_duplicates,
                 "failed_total": self._n_failed,
             }
+            n_live = sum(
+                1 for r in self._replicas
+                if r is not None and r.state == "live"
+            )
             gauges = {
                 "queue_depth": len(self._queue),
                 "queue_ceiling": self._ceiling,
-                "live_replicas": sum(
-                    1 for r in self._replicas
-                    if r is not None and r.state == "live"
-                ),
+                "live_replicas": n_live,
+                # controller-facing names: ccsc_replicas_live is the
+                # autoscaling dashboard's canonical series (the
+                # legacy live_replicas key is kept for old scrapes)
+                "replicas_live": n_live,
+                "replica_target": self._replica_target,
                 "overload_rung": self._rung,
                 "banks": len(self._bank_routes),
             }
+            gauges.update(self._ctrl_gauges)
             # per-tenant labeled series: the shared constructor
             # (serve.metricsd.tenant_labeled_counters) keeps this
             # live surface and the stream-derived snapshot identical
@@ -754,6 +794,10 @@ class ServeFleet:
         # suppressed by the idempotency set, and it closes its engine
         # on the way out
         self._schedule_restart(rep)
+        # satellite fix (ISSUE 17): the stall just removed live
+        # capacity — recompute the derived admission ceiling at the
+        # transition instead of waiting out the monitor's hysteresis
+        self._refresh_ceiling(force=True)
 
     def _on_replica_death(self, rep: _Replica, exc: BaseException) -> None:
         with self._cv:
@@ -797,6 +841,10 @@ class ServeFleet:
         except Exception:
             pass
         self._schedule_restart(rep)
+        # satellite fix (ISSUE 17): a dead replica stops contributing
+        # capacity right now — the ceiling must follow at the
+        # transition, not at the next hysteresis crossing
+        self._refresh_ceiling(force=True)
 
     def _schedule_restart(self, rep: _Replica, charge: bool = True) -> None:
         """``charge=False`` for ladder recycles: a rung transition is
@@ -806,15 +854,32 @@ class ServeFleet:
         with self._cv:
             if self._close_started:
                 return
-            n = self._restarts.get(rep.id, 0)
-            if not charge:
-                attempt = 1
-            elif n >= self.fleet_cfg.max_restarts:
-                self._abandoned.add(rep.id)
-                exhausted = True
+            if rep.id in self._scaled_down:
+                # the slot was retired by scale-down while this
+                # casualty was in flight — drop it instead of
+                # respawning capacity the controller just removed
+                self._slot_gen[rep.id] = rep.generation
+                if self._replicas[rep.id] is rep:
+                    self._replicas[rep.id] = None
+                scaled = True
             else:
-                self._restarts[rep.id] = n + 1
-                attempt = n + 1
+                scaled = False
+                n = self._restarts.get(rep.id, 0)
+                if not charge:
+                    attempt = 1
+                elif n >= self.fleet_cfg.max_restarts:
+                    self._abandoned.add(rep.id)
+                    exhausted = True
+                else:
+                    self._restarts[rep.id] = n + 1
+                    attempt = n + 1
+        if scaled:
+            self._emit(
+                "fleet_replica_retired", replica_id=rep.id,
+                reason="scale_down",
+            )
+            self._refresh_ceiling(force=True)
+            return
         if exhausted:
             self._emit(
                 "fleet_replica_abandoned", replica_id=rep.id,
@@ -827,6 +892,11 @@ class ServeFleet:
                 tier="always",
             )
             self._fail_if_no_capacity()
+            # satellite fix (ISSUE 17): a half-dead fleet must stop
+            # over-admitting NOW, not at the next monitor hysteresis
+            # crossing — recompute the derived ceiling on the
+            # abandon transition and emit on any change
+            self._refresh_ceiling(force=True)
             return
         t = threading.Thread(
             target=self._restart, args=(rep, attempt),
@@ -852,6 +922,23 @@ class ServeFleet:
             return
         if self._close_started:
             return
+        with self._cv:
+            if old.id in self._scaled_down:
+                # scale-down landed during the backoff: the slot is
+                # retired, do not rebuild capacity for it
+                self._slot_gen[old.id] = old.generation
+                if self._replicas[old.id] is old:
+                    self._replicas[old.id] = None
+                scaled = True
+            else:
+                scaled = False
+        if scaled:
+            self._emit(
+                "fleet_replica_retired", replica_id=old.id,
+                reason="scale_down",
+            )
+            self._refresh_ceiling(force=True)
+            return
         self._emit(
             "fleet_replica_restart", replica_id=old.id,
             attempt=attempt, degraded=self._degraded,
@@ -869,13 +956,20 @@ class ServeFleet:
             self._schedule_restart(old)
             return
         with self._cv:
-            closing = self._close_started
+            closing = (
+                self._close_started or old.id in self._scaled_down
+            )
             if not closing:
                 self._replicas[old.id] = rep
                 self._cv.notify_all()
+            elif old.id in self._scaled_down:
+                self._slot_gen[old.id] = rep.generation
+                if self._replicas[old.id] is old:
+                    self._replicas[old.id] = None
         if closing:
-            # close() raced the rebuild and will never see this
-            # replica — release it here instead of leaking the engine
+            # close() (or a scale-down) raced the rebuild and will
+            # never see this replica — release it here instead of
+            # leaking the engine
             rep.retired = True
             try:
                 rep.watchdog.stop()
@@ -889,6 +983,9 @@ class ServeFleet:
             warm=bool(rep.engine.cache_dir),
             degraded=self._degraded,
         )
+        # satellite fix (ISSUE 17): a rejoin changes live capacity —
+        # recompute the derived ceiling at the transition
+        self._refresh_ceiling(force=True)
 
     def _fail_if_no_capacity(self) -> None:
         """Called (NOT under self._cv) when a replica is abandoned: if
@@ -907,7 +1004,8 @@ class ServeFleet:
         with self._cv:
             alive = any(
                 rid not in self._abandoned
-                for rid in range(self.fleet_cfg.replicas)
+                and rid not in self._scaled_down
+                for rid in range(len(self._replicas))
             )
             if alive:
                 return
@@ -1337,7 +1435,8 @@ class ServeFleet:
         # batch was taken.
         with self._cv:
             recycle = rep.state == "recycling" and not rep.reaped
-            if recycle:
+            draining = rep.state == "draining" and not rep.reaped
+            if recycle or draining:
                 rep.reaped = True
         if recycle:
             # normally nothing is in flight here (_take stopped before
@@ -1345,6 +1444,11 @@ class ServeFleet:
             # one), but the handoff contract is uniform: whoever
             # claims `reaped` requeues whatever is left
             self._requeue_from(rep, reason="recycle")
+        elif draining:
+            # scale-down: drain-then-retire, never a kill — leftovers
+            # (normally none; _take stopped before another batch) go
+            # back to the FRONT of the queue for the survivors
+            self._requeue_from(rep, reason="scale_down")
         if rep.retired:
             try:
                 rep.engine.close()
@@ -1352,6 +1456,20 @@ class ServeFleet:
                 pass
         if recycle:
             self._schedule_restart(rep, charge=False)
+        elif draining:
+            # no replacement is scheduled: the slot empties and the
+            # capacity math (ceiling, dead-fleet checks, devices)
+            # follows the new target immediately
+            with self._cv:
+                rep.state = "stopped"
+                self._slot_gen[rep.id] = rep.generation
+                if self._replicas[rep.id] is rep:
+                    self._replicas[rep.id] = None
+            self._emit(
+                "fleet_replica_retired", replica_id=rep.id,
+                reason="scale_down",
+            )
+            self._refresh_ceiling(force=True)
 
     # -- monitor: heartbeats, ceiling, overload ladder ------------------
     def _monitor_loop(self) -> None:
@@ -1399,9 +1517,46 @@ class ServeFleet:
             for sn in t_snaps:
                 self._emit("slo_histogram", replica_id=None, **sn)
 
-    def _update_ceiling(self, perfmodel, reps) -> None:
+    def _refresh_ceiling(self, force: bool = False) -> None:
+        """Recompute the derived admission ceiling NOW (satellite fix,
+        ISSUE 17): called at every replica lifecycle transition —
+        retire, rejoin, abandon, scale — so a half-dead fleet stops
+        over-admitting at the transition instead of at the monitor's
+        next 1.5x hysteresis crossing. ``force`` emits
+        ``fleet_ceiling`` on ANY change, bypassing the hysteresis
+        band (which exists to quiet steady-state jitter, not to
+        delay capacity news)."""
+        if (
+            self.fleet_cfg.max_queue_depth is not None
+            or self._close_started
+        ):
+            return
+        from ..utils import perfmodel
+
+        with self._cv:
+            reps = list(self._replicas)
+        self._update_ceiling(perfmodel, reps, force=force)
+
+    def _replica_warm(self, rep: _Replica) -> bool:
+        """Every declared bucket's program installed and serveable on
+        this replica's engine. A replica staging its warmup
+        (ServeConfig.staged_warmup) is LIVE for the buckets it has,
+        but the capacity math must not credit it at full rate until
+        it is past BucketCold everywhere — the scale-up admission
+        gate of serve.controller."""
+        try:
+            return all(
+                rep.engine.bucket_warm((s, sp))
+                for s, sp in self.buckets
+            )
+        except Exception:
+            return False
+
+    def _update_ceiling(self, perfmodel, reps, force=False) -> None:
         live = [
-            r for r in reps if r is not None and r.state == "live"
+            r for r in reps
+            if r is not None and r.state == "live"
+            and self._replica_warm(r)
         ]
         # per-replica bounds, device-count aware: each live replica
         # contributes its OWN measured rate; an unmeasured one is
@@ -1430,9 +1585,11 @@ class ServeFleet:
             int(self._bound_rps * self.fleet_cfg.max_queue_s),
         )
         old = self._ceiling
-        if not self._ceiling_derived or derived > 1.5 * old or (
-            derived < old / 1.5
-        ):
+        hysteresis = (
+            not self._ceiling_derived or derived > 1.5 * old
+            or derived < old / 1.5
+        )
+        if hysteresis or (force and derived != old):
             self._ceiling = derived
             self._ceiling_derived = True
             self._emit(
@@ -1481,7 +1638,7 @@ class ServeFleet:
                 max_it=self._engine_cfg(True).max_it,
             )
             self._start_recycle()
-        elif rung == 0 and self._degraded:
+        elif rung == 0 and self._degraded and not self._brownout:
             self._degraded = False
             self._emit(
                 "degrade", replica_id=None, rung="serve_restore",
@@ -1611,7 +1768,7 @@ class ServeFleet:
             rep.engine.devices
             for rep in self._replicas
             if rep is not None
-        ) or self.fleet_cfg.replicas
+        ) or max(1, self._replica_target)
 
     @property
     def capacity_hint(self) -> int:
@@ -1634,6 +1791,261 @@ class ServeFleet:
     @property
     def overload_rung(self) -> str:
         return RUNGS[self._rung]
+
+    # -- elasticity: the control plane's actuators ----------------------
+    @property
+    def replica_target(self) -> int:
+        """The replica count the fleet is currently converging to —
+        the single source of truth a (re)started CapacityController
+        reconciles from: the controller holds NO durable state of its
+        own, so its death or restart can never disagree with the
+        data plane about how much capacity exists."""
+        return self._replica_target
+
+    def set_replica_count(self, n: int, reason: str = "manual") -> Dict[str, int]:
+        """Live grow/shrink to ``n`` replicas (the fine-grain
+        elasticity actuator, ISSUE 17). Strictly a data-plane
+        operation: callers (serve.controller, an operator REPL) are
+        advisory.
+
+        Grow spawns fresh replicas onto the next free device slices,
+        warmed from the artifact store when one is configured
+        (``ServeConfig.artifact_store`` — fetch instead of compile);
+        a grown replica is admitted into the derived ceiling only
+        once every bucket is past ``BucketCold``
+        (``_replica_warm`` gates ``_update_ceiling``). Shrink is
+        drain-then-retire, never a kill: the highest-id replicas stop
+        taking work, finish their in-flight batch, requeue any
+        leftovers to the FRONT of the queue, and release their
+        engines — zero lost requests by construction. Returns
+        ``{"from_n", "to_n"}``; raises ``CCSCInputError`` for n < 1
+        and ``RuntimeError`` on a closed fleet (or a strict device
+        pool that cannot supply another disjoint slice)."""
+        import math as _math
+
+        from ..utils import validate
+
+        n = int(n)
+        if n < 1:
+            raise validate.CCSCInputError(
+                f"replica count must be >= 1, got {n}"
+            )
+        if self._close_started:
+            raise RuntimeError("fleet is closed")
+        spawn: List[int] = []
+        with self._cv:
+            if self._close_started:
+                raise RuntimeError("fleet is closed")
+            cur = self._replica_target
+            if n == cur:
+                return {"from_n": cur, "to_n": n}
+            if n > cur:
+                add = n - cur
+                # resurrect drained slots first (their device slice
+                # is already reserved), then append fresh ones
+                for rid in sorted(self._scaled_down):
+                    if add == 0:
+                        break
+                    if self._replicas[rid] is None:
+                        self._scaled_down.discard(rid)
+                        self._restarts.pop(rid, None)
+                        self._abandoned.discard(rid)
+                        spawn.append(rid)
+                        add -= 1
+                while add > 0:
+                    rid = len(self._replicas)
+                    entry = self._default_mesh_entry
+                    devices = None
+                    if entry:
+                        if self._mesh_pool is None:
+                            import jax
+
+                            self._mesh_pool = (
+                                list(self.serve_cfg.mesh_devices)
+                                if self.serve_cfg.mesh_devices
+                                is not None
+                                else list(range(len(jax.devices())))
+                            )
+                        need = _math.prod(entry)
+                        pool = self._mesh_pool
+                        if self._mesh_off + need <= len(pool):
+                            devices = tuple(
+                                pool[self._mesh_off:
+                                     self._mesh_off + need]
+                            )
+                            self._mesh_off += need
+                        elif _env.env_flag("CCSC_SERVE_MESH_STRICT"):
+                            # roll back: nothing spawned yet, so the
+                            # resurrected slots return to the drained
+                            # set and the target stays where it was
+                            for r2 in spawn:
+                                self._scaled_down.add(r2)
+                            raise RuntimeError(
+                                f"cannot grow to {n} replicas: the "
+                                f"device pool ({len(pool)} device(s),"
+                                f" {self._mesh_off} allocated) has no"
+                                f" disjoint {entry} slice left — "
+                                "shrink the mesh, free devices, or "
+                                "set CCSC_SERVE_MESH_STRICT=0"
+                            )
+                    self._replicas.append(None)
+                    self._replica_mesh.append(entry)
+                    self._replica_devices.append(devices)
+                    spawn.append(rid)
+                    add -= 1
+                self._replica_target = n
+            else:
+                shed = cur - n
+                for rid in range(len(self._replicas) - 1, -1, -1):
+                    if shed == 0:
+                        break
+                    if rid in self._scaled_down:
+                        continue
+                    self._scaled_down.add(rid)
+                    shed -= 1
+                    rep = self._replicas[rid]
+                    if rep is not None and not rep.retired:
+                        # drain-then-retire: _take stops handing this
+                        # worker batches; its clean exit requeues
+                        # leftovers and empties the slot. An already-
+                        # retired slot (recycle/restart in flight)
+                        # is dropped by the _scaled_down guards in
+                        # _schedule_restart/_restart instead.
+                        rep.retired = True
+                        rep.state = "draining"
+                self._replica_target = n
+                self._cv.notify_all()
+        self._emit(
+            "fleet_scale", replica_id=None, from_n=cur, to_n=n,
+            reason=reason,
+        )
+        self._run.console(
+            f"fleet: scaling {cur} -> {n} replica(s) ({reason})",
+            tier="brief",
+        )
+        for rid in spawn:
+            gen = self._slot_gen.get(rid, -1) + 1
+            try:
+                rep = self._spawn_replica(
+                    rid, generation=gen, degraded=self._degraded
+                )
+            except BaseException:
+                # a failed grow must not leave a husk slot the
+                # dead-fleet checks count as coming back
+                with self._cv:
+                    self._scaled_down.add(rid)
+                    self._replica_target -= 1
+                raise
+            with self._cv:
+                closing = (
+                    self._close_started or rid in self._scaled_down
+                )
+                if not closing:
+                    self._replicas[rid] = rep
+                    self._cv.notify_all()
+            if closing:
+                rep.retired = True
+                try:
+                    rep.watchdog.stop()
+                except Exception:
+                    pass
+                rep.engine.close()
+                continue
+            self._emit(
+                "fleet_replica_ready", replica_id=rid,
+                generation=gen,
+                warm=bool(rep.engine.cache_dir),
+                degraded=self._degraded,
+            )
+        self._refresh_ceiling(force=True)
+        return {"from_n": cur, "to_n": n}
+
+    def set_brownout(self, on: bool, reason: str = "controller") -> bool:
+        """Drive the degrade rung directly (the controller's brownout
+        actuator): ``on`` recycles replicas onto the reduced
+        ``max_it x degrade_max_it_factor`` solve budget WITHOUT
+        waiting for the overload ladder's rung-3 escalation — trade
+        solve quality for throughput BEFORE any shed. ``off``
+        restores the full budget unless the ladder itself holds
+        rung 3. Idempotent; returns whether the call changed
+        state."""
+        with self._cv:
+            if self._close_started:
+                raise RuntimeError("fleet is closed")
+            if on == self._brownout:
+                return False
+            self._brownout = on
+            if on:
+                changed = not self._degraded
+                self._degraded = True
+            else:
+                # the ladder still demands degrade at rung 3 — the
+                # brownout flag releases, the budget stays down
+                changed = self._degraded and self._rung < 3
+                if changed:
+                    self._degraded = False
+        if on and changed:
+            self._emit(
+                "degrade", replica_id=None, rung="serve_max_it",
+                stage="brownout",
+                max_it=self._engine_cfg(True).max_it,
+            )
+            self._start_recycle()
+        elif not on and changed:
+            self._emit(
+                "degrade", replica_id=None, rung="serve_restore",
+                stage="brownout", max_it=self.cfg.max_it,
+            )
+            self._start_recycle()
+        return True
+
+    @property
+    def brownout(self) -> bool:
+        return self._brownout
+
+    def set_ctrl_gauge(self, name: str, value: float) -> None:
+        """Publish a controller gauge through the fleet's metrics
+        surface (rendered as ``ccsc_<name>`` by serve.metricsd)."""
+        with self._cv:
+            self._ctrl_gauges[name] = value
+
+    def control_snapshot(self) -> Dict[str, object]:
+        """One consistent sensor read for the control plane
+        (serve.controller): queue depth vs ceiling, rung, live/warm
+        replica counts vs target, SLO percentiles vs declared
+        targets, serving bound, and the fleet-wide warmup ETA.
+        Carries its own wall-clock ``t`` — the controller's
+        staleness detector compares against it and fails safe."""
+        with self._cv:
+            depth = len(self._queue)
+            live = [
+                r for r in self._replicas
+                if r is not None and r.state == "live"
+            ]
+            snap = {
+                "t": time.time(),
+                "queue_depth": depth,
+                "ceiling": self._ceiling,
+                "rung": self._rung,
+                "live_replicas": len(live),
+                "replica_target": self._replica_target,
+                "abandoned": len(self._abandoned),
+                "bound_rps": round(self._bound_rps, 3),
+                "brownout": self._brownout,
+            }
+        snap["warm_replicas"] = sum(
+            1 for r in live if self._replica_warm(r)
+        )
+        etas = []
+        for s, sp in self.buckets:
+            eta = self._cold_eta((s, sp))
+            if eta is not None:
+                etas.append(eta)
+        snap["warmup_eta_s"] = round(max(etas), 3) if etas else 0.0
+        p99 = self._slo.percentile("total", 0.99)
+        snap["p99_ms"] = None if p99 is None else round(p99, 3)
+        snap["slo_p99_target_ms"] = self.fleet_cfg.slo_p99_ms
+        return snap
 
     def _cold_eta(self, bkey) -> Optional[float]:
         """None when some LIVE replica already serves ``bkey``'s
@@ -1736,10 +2148,14 @@ class ServeFleet:
         with self._cv:
             if self._close_started:
                 raise RuntimeError("fleet is closed")
-            if len(self._abandoned) >= self.fleet_cfg.replicas:
-                # every replica's restart budget is exhausted — no
-                # worker will ever take this request, so an accepted
-                # future could never resolve
+            if not any(
+                rid not in self._abandoned
+                and rid not in self._scaled_down
+                for rid in range(len(self._replicas))
+            ):
+                # every non-scaled-down replica's restart budget is
+                # exhausted — no worker will ever take this request,
+                # so an accepted future could never resolve
                 raise RuntimeError(
                     "fleet has no live replicas left (restart budgets "
                     "exhausted)"
@@ -2159,8 +2575,11 @@ class ServeFleet:
                 ),
                 {},
             )
-            knobs["replicas"] = len(self._replicas)
-            if self.total_devices > len(self._replicas):
+            n_reps = sum(
+                1 for rep in self._replicas if rep is not None
+            ) or self._replica_target
+            knobs["replicas"] = n_reps
+            if self.total_devices > n_reps:
                 # only a meshed fleet carries the topology key: an
                 # all-single-device fleet's knob digest (its ledger
                 # history key) stays exactly the pre-mesh one

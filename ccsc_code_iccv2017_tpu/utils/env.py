@@ -310,9 +310,61 @@ REGISTRY: Dict[str, Knob] = _knobs(
      "utils.faults",
      "comma list of replica ids armed for the hang fault (unset = "
      "all)"),
+    ("CCSC_FAULT_CTRL_SENSOR_BLACKOUT", "int", None, "utils.faults",
+     "blind the capacity controller's sensors starting at its k-th "
+     "tick (1-based); telemetry reads as stale for the blackout "
+     "window"),
+    ("CCSC_FAULT_CTRL_BLACKOUT_S", "float", 3.0, "utils.faults",
+     "sensor-blackout fault duration in seconds"),
+    ("CCSC_FAULT_CTRL_ACT_HANG", "int", None, "utils.faults",
+     "hang the controller's next k actuator invocations (each sleeps "
+     "CCSC_FAULT_CTRL_ACT_HANG_S inside the timeout guard)"),
+    ("CCSC_FAULT_CTRL_ACT_HANG_S", "float", 3600.0, "utils.faults",
+     "actuator hang-fault sleep duration"),
+    ("CCSC_FAULT_CTRL_CRASH_SCALE", "flag", False, "utils.faults",
+     "crash the controller thread between a scale decision and its "
+     "actuation (fires once per process/state dir)"),
     ("CCSC_FAULT_STATE_DIR", "path", None, "utils.faults",
      "cross-restart fire-once marker dir (supervise.py exports the "
      "metrics dir)"),
+    # -- capacity controller (serve.controller) ----------------------
+    ("CCSC_CTRL_INTERVAL_S", "float", 0.5, "serve.controller",
+     "control-loop tick interval in seconds (fallback of "
+     "ControllerConfig.interval_s)"),
+    ("CCSC_CTRL_HIGH_FRAC", "float", 0.8, "serve.controller",
+     "queue-depth/ceiling fraction above which scale-up pressure "
+     "registers"),
+    ("CCSC_CTRL_LOW_FRAC", "float", 0.2, "serve.controller",
+     "queue-depth/ceiling fraction below which scale-down is "
+     "considered (only with SLO green and ladder at rung 0)"),
+    ("CCSC_CTRL_SUSTAIN", "int", 3, "serve.controller",
+     "consecutive ticks a pressure signal must persist before the "
+     "controller acts (flap guard)"),
+    ("CCSC_CTRL_COOLDOWN_S", "float", 10.0, "serve.controller",
+     "per-actuator cooldown after a successful invocation"),
+    ("CCSC_CTRL_STALE_S", "float", 5.0, "serve.controller",
+     "sensor snapshot age beyond which telemetry is stale (fail "
+     "safe: hold state, never scale down)"),
+    ("CCSC_CTRL_ACT_TIMEOUT_S", "float", 30.0, "serve.controller",
+     "single actuator invocation timeout"),
+    ("CCSC_CTRL_ACT_RETRIES", "int", 2, "serve.controller",
+     "actuator retries after the first failed/timed-out invocation"),
+    ("CCSC_CTRL_ACT_BACKOFF_S", "float", 0.5, "serve.controller",
+     "actuator retry backoff base (doubles per retry)"),
+    ("CCSC_CTRL_BREAKER_AFTER", "int", 3, "serve.controller",
+     "consecutive exhausted actuator invocations that open the "
+     "stuck-actuator circuit breaker"),
+    ("CCSC_CTRL_BREAKER_RESET_S", "float", 60.0, "serve.controller",
+     "circuit-breaker open duration before a half-open retry"),
+    ("CCSC_CTRL_BROWNOUT_FRAC", "float", 0.9, "serve.controller",
+     "queue-depth/ceiling fraction that engages the brownout rung "
+     "(degrade ladder) before any shed"),
+    ("CCSC_CTRL_BROWNOUT_EXIT_FRAC", "float", 0.5, "serve.controller",
+     "queue-depth/ceiling fraction below which brownout releases "
+     "(hysteresis band with CCSC_CTRL_BROWNOUT_FRAC)"),
+    ("CCSC_CTRL_HBM_LIMIT_MB", "float", 0.0, "serve.controller",
+     "measured HBM watermark above which scale-up is vetoed "
+     "(0 = no HBM veto)"),
     # -- serve bench workload (serve.bench) --------------------------
     ("CCSC_SERVE_REQUESTS", "int", 16, "serve.bench",
      "bench stream length"),

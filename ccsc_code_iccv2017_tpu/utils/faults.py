@@ -39,6 +39,15 @@ points, so a test (or ``scripts/chaos_smoke.py`` /
   These fire at most once PER REPLICA (marker
   ``fault-fired-engine_kill-r<id>.json``), so a restarted casualty
   rejoins clean instead of re-dying forever.
+- control-plane faults (serve.controller, ISSUE 17):
+  ``CCSC_FAULT_CTRL_SENSOR_BLACKOUT=k`` blanks the controller's
+  sensor read from its k-th tick for ``CCSC_FAULT_CTRL_BLACKOUT_S``
+  seconds (fail-safe holdoff provable), ``CCSC_FAULT_CTRL_ACT_HANG=n``
+  wedges the first n actuator invocations for
+  ``CCSC_FAULT_CTRL_ACT_HANG_S`` seconds each (timeout/retry/circuit-
+  breaker ladder provable), and ``CCSC_FAULT_CTRL_CRASH_SCALE=1``
+  kills the control loop between a scale decision and its actuation
+  (the fleet-serves-exactly-as-configured invariant provable).
 
 Every fault fires AT MOST ONCE per run. Within a process that is a
 set in memory; ACROSS supervisor restarts the consumption must
@@ -74,6 +83,9 @@ __all__ = [
     "hang_tick",
     "engine_kill_request",
     "engine_hang_request",
+    "ctrl_sensor_blackout",
+    "ctrl_actuator_hang",
+    "ctrl_crash_mid_scale",
     "reset",
 ]
 
@@ -282,8 +294,85 @@ def engine_hang_request(replica_id: int, req_seq: int) -> float:
     return dur
 
 
+# -- control-plane fault points (serve.controller, ISSUE 17) ----------
+# in-process episode state: the blackout's wall-clock window and the
+# remaining armed actuator hangs (reset() clears both)
+_blackout_until: Optional[float] = None
+_act_hangs_left: Optional[int] = None
+
+
+def ctrl_sensor_blackout(tick: int) -> bool:
+    """Controller sensor-blackout fault: True while the control
+    plane's sensor read must come back empty. Armed by
+    ``CCSC_FAULT_CTRL_SENSOR_BLACKOUT=k`` (1-based controller tick):
+    from tick ``k`` the blackout holds for
+    ``CCSC_FAULT_CTRL_BLACKOUT_S`` wall seconds (default 3), then
+    clears and never re-fires. The controller under test must fail
+    SAFE — hold state, emit ``ctrl_holdoff``, and never scale
+    *down* on missing telemetry."""
+    global _blackout_until
+    k = _env_int("CCSC_FAULT_CTRL_SENSOR_BLACKOUT")
+    if k is None:
+        return False
+    if _blackout_until is not None:
+        return time.monotonic() < _blackout_until
+    if tick < k or _fired_before("ctrl_blackout"):
+        return False
+    dur = _env.env_float("CCSC_FAULT_CTRL_BLACKOUT_S")
+    _blackout_until = time.monotonic() + dur
+    _mark_fired("ctrl_blackout", tick=int(tick), duration_s=dur)
+    return True
+
+
+def ctrl_actuator_hang() -> float:
+    """Seconds an actuator invocation should wedge — queried INSIDE
+    the controller's timeout-guarded actuator worker, never on a
+    data-plane thread, so the hang exercises the timeout/retry/
+    circuit-breaker ladder without touching serving.
+    ``CCSC_FAULT_CTRL_ACT_HANG=n`` arms the first ``n`` invocations
+    to sleep ``CCSC_FAULT_CTRL_ACT_HANG_S`` seconds each (default
+    3600): n spanning the retry budget is how a chaos schedule
+    proves the breaker OPENS instead of the first retry healing."""
+    global _act_hangs_left
+    n = _env_int("CCSC_FAULT_CTRL_ACT_HANG")
+    if n is None:
+        return 0.0
+    if _act_hangs_left is None:
+        if _fired_before("ctrl_act_hang"):
+            return 0.0
+        _act_hangs_left = int(n)
+    if _act_hangs_left <= 0:
+        return 0.0
+    dur = _env.env_float("CCSC_FAULT_CTRL_ACT_HANG_S")
+    if _act_hangs_left == int(n):
+        # marked on the FIRST armed invocation (the controller's
+        # actuator thread may never return from the sleep)
+        _mark_fired("ctrl_act_hang", n=int(n), sleep_s=dur)
+    _act_hangs_left -= 1
+    return dur
+
+
+def ctrl_crash_mid_scale() -> bool:
+    """True exactly once when armed (``CCSC_FAULT_CTRL_CRASH_SCALE``
+    truthy): the controller raises ``InjectedFault`` after COMMITTING
+    to a scale decision but before invoking the actuator — the
+    control loop dies mid-scale. The hard invariant under test: the
+    data plane keeps serving exactly as configured, and a restarted
+    controller reconciles from ``ServeFleet.replica_target`` (live
+    state, not controller memory)."""
+    if not _env.env_flag("CCSC_FAULT_CTRL_CRASH_SCALE"):
+        return False
+    if _fired_before("ctrl_crash_scale"):
+        return False
+    _mark_fired("ctrl_crash_scale")
+    return True
+
+
 def reset() -> None:
     """Re-arm all in-process fault points (test isolation). On-disk
     fire-once markers are per fault state dir and belong to the test's
     tmp directory lifecycle."""
+    global _blackout_until, _act_hangs_left
     _fired.clear()
+    _blackout_until = None
+    _act_hangs_left = None

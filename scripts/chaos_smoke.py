@@ -62,6 +62,15 @@ restores them):
                       its first request before its coldest bucket
                       finishes building in the background, p99 stays
                       bounded, and zero requests are lost
+  autoscale           (script mode only) self-driving capacity under
+                      a diurnal replay (serve.controller): the
+                      controller browns out and grows 1 -> 2 at the
+                      peak (the grown replica warming FROM the
+                      artifact store), shrinks back at the trough —
+                      zero lost, bounded p99 — then an injected
+                      sensor blackout holds capacity (never a blind
+                      scale-down) and a wedged actuator opens the
+                      circuit breaker while the queue still drains
   sigterm_subprocess  (script mode only) the same against a real child
                       process: exit code 0 + valid checkpoint
   supervise_restart   (script mode only) scripts/supervise.py restarts
@@ -961,6 +970,228 @@ def scenario_scale_up():
     )
 
 
+def scenario_autoscale():
+    """Self-driving capacity end-to-end (ISSUE 17 acceptance): replay
+    the synthetic diurnal curve (serve.replay.generate_diurnal)
+    against a 1-replica fleet while a live CapacityController owns
+    capacity. At the peak the controller must brown out and grow to 2
+    replicas — the grown replica warming FROM the compiled-artifact
+    store, not compiling — and at the trough shrink back to 1, with
+    zero lost requests and bounded p99. Then two injected control-
+    plane faults against the same fleet: a sensor blackout while
+    scale-down pressure is live (must hold — ``ctrl_holdoff`` and
+    NEVER a blind scale-down, then reconcile once sensors return),
+    and a wedged actuator under scale-up pressure (must open the
+    circuit breaker — failed ``ctrl_scale`` then
+    ``breaker_open:scale_up`` holdoffs — while the data plane keeps
+    serving every queued request)."""
+    import time
+
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import (
+        ControllerConfig,
+        FleetConfig,
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import (
+        CapacityController,
+        Overloaded,
+        ServeFleet,
+    )
+    from ccsc_code_iccv2017_tpu.serve.replay import (
+        ReplayDriver,
+        generate_diurnal,
+    )
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    r = np.random.default_rng(0)
+    d = r.normal(size=(4, 3, 3)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    geom = ProblemGeom((3, 3), 4)
+    # heavy enough that ONE replica's throughput (~25 req/s on CPU)
+    # sits below the diurnal PEAK (~38 req/s) but above its mean —
+    # the peak genuinely saturates, the trough genuinely idles
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=400, tol=0.0,
+        verbose="none", track_psnr=True, track_objective=True,
+    )
+    with tempfile.TemporaryDirectory() as root:
+        cap = generate_diurnal(
+            os.path.join(root, "capture"), n_requests=120,
+            duration_s=6.0, spatial=(24, 24), amp=0.9, seed=0,
+        )
+        store = os.path.join(root, "artifacts")
+        mdir = os.path.join(root, "m-serve")
+        scfg = ServeConfig(
+            buckets=((2, (24, 24)),), max_wait_ms=2.0,
+            verbose="none", artifact_store=store,
+        )
+        # explicit tiny ceiling: queue pressure (frac of 4) is the
+        # controller's scale signal, independent of rate measurement
+        fleet = ServeFleet(
+            d, ReconstructionProblem(geom), cfg, scfg,
+            FleetConfig(
+                replicas=1, metrics_dir=mdir, max_queue_depth=4,
+                restart_backoff_s=0.05, verbose="none",
+            ),
+        )
+        ctrl = CapacityController(
+            fleet,
+            ControllerConfig(
+                min_replicas=1, max_replicas=2, interval_s=0.05,
+                high_frac=0.5, low_frac=0.1, sustain=2,
+                cooldown_s=1.0, stale_s=10.0, act_timeout_s=180.0,
+                act_retries=0, act_backoff_s=0.05, breaker_after=3,
+                breaker_reset_s=30.0, brownout_frac=0.75,
+                brownout_exit_frac=0.1,
+            ),
+        ).start()
+        try:
+            rep = ReplayDriver(
+                cap, metrics_dir=os.path.join(root, "m-replay"),
+                verbose="none",
+            ).replay(fleet, speed=1.0, mode="open", timeout_s=600)
+            # the trough: the controller drains back to the floor
+            # (the brownout release + shrink each recycle an engine,
+            # so allow real compile time)
+            deadline = time.monotonic() + 120
+            while (
+                time.monotonic() < deadline
+                and fleet.replica_target > 1
+            ):
+                time.sleep(0.05)
+            trough_target = fleet.replica_target
+        finally:
+            ctrl.close()
+        ev = obs.read_events(mdir, recursive=True)
+        ups = [
+            e for e in ev
+            if e["type"] == "ctrl_scale"
+            and e["direction"] == "up" and e["ok"]
+        ]
+        downs = [
+            e for e in ev
+            if e["type"] == "ctrl_scale"
+            and e["direction"] == "down" and e["ok"]
+        ]
+        brown_on = [
+            e for e in ev
+            if e["type"] == "ctrl_brownout" and e["on"] and e["ok"]
+        ]
+        brown_off = [
+            e for e in ev
+            if e["type"] == "ctrl_brownout"
+            and not e["on"] and e["ok"]
+        ]
+        fetched = [
+            e for e in ev
+            if e["type"] == "serve_warmup"
+            and e.get("source") == "fetched"
+        ]
+
+        # -- fault leg A: sensor blackout while scale-down pressure
+        # is live. Deterministic single-step drive (no loop thread).
+        fleet.set_replica_count(2, reason="chaos_setup")
+        ch_cfg = ControllerConfig(
+            min_replicas=1, max_replicas=2, interval_s=0.05,
+            high_frac=0.5, low_frac=0.2, sustain=1, cooldown_s=0.05,
+            stale_s=10.0, act_timeout_s=0.5, act_retries=0,
+            act_backoff_s=0.01, breaker_after=2, breaker_reset_s=60.0,
+            brownout_frac=1.5, brownout_exit_frac=0.01,
+        )
+        ctrl2 = CapacityController(fleet, ch_cfg)
+        with _fault(
+            CCSC_FAULT_CTRL_SENSOR_BLACKOUT=1,
+            CCSC_FAULT_CTRL_BLACKOUT_S="120",
+        ):
+            for _ in range(4):
+                ctrl2.step()  # idle fleet: would shrink if it could see
+                time.sleep(0.06)
+            blind_held = fleet.replica_target == 2
+        ctrl2.step()  # sensors restored: the shrink happens NOW
+        recovered = fleet.replica_target == 1
+        ctrl2.close()
+
+        # -- fault leg B: wedged actuator under real scale-up
+        # pressure -> circuit breaker; the queue still drains
+        def _burst(lo, hi):
+            out = []
+            for i in range(lo, hi):
+                x = r.random((24, 24)).astype(np.float32)
+                m = (r.random((24, 24)) < 0.5).astype(np.float32)
+                try:
+                    out.append(
+                        fleet.submit(x * m, mask=m, key=f"hang{i}")
+                    )
+                except Overloaded:
+                    pass
+            return out
+
+        with _fault(
+            CCSC_FAULT_CTRL_ACT_HANG=2,
+            CCSC_FAULT_CTRL_ACT_HANG_S="600",
+        ):
+            ctrl3 = CapacityController(fleet, ch_cfg)
+            futs = _burst(0, 4)
+            ctrl3.step()  # attempt 1 wedges -> timeout -> failed
+            futs += _burst(4, 8)  # keep the pressure on
+            ctrl3.step()  # attempt 2 wedges -> breaker OPEN
+            futs += _burst(8, 12)
+            ctrl3.step()  # refused at the breaker -> ctrl_holdoff
+            n_hang_served = len(
+                [f.result(timeout=300) for f in futs]
+            )
+            ctrl3.close()
+        held_at_1 = fleet.replica_target == 1
+        st = fleet.stats()
+        fleet.close()
+
+        ev = obs.read_events(mdir, recursive=True)
+        holds = {
+            e["reason"] for e in ev if e["type"] == "ctrl_holdoff"
+        }
+        failed_scales = [
+            e for e in ev
+            if e["type"] == "ctrl_scale" and not e["ok"]
+        ]
+        ok = (
+            rep["n_replayed"] == 120
+            and rep["n_lost"] == 0
+            and rep["replayed_p99_ms"] is not None
+            and rep["replayed_p99_ms"] < 120_000
+            and len(ups) >= 1
+            and len(downs) >= 1
+            and trough_target == 1
+            and len(brown_on) >= 1
+            and len(brown_off) >= 1
+            and len(fetched) >= 1
+            and blind_held
+            and recovered
+            and "sensor_stale" in holds
+            and len(failed_scales) >= 2
+            and "breaker_open:scale_up" in holds
+            and held_at_1
+            and len(futs) == n_hang_served
+            and st["n_failed"] == 0
+        )
+    return ok, (
+        f"replayed={rep['n_replayed']}, lost={rep['n_lost']}, "
+        f"p99={rep['replayed_p99_ms']}ms, ups={len(ups)}, "
+        f"downs={len(downs)}, brownout={len(brown_on)}on/"
+        f"{len(brown_off)}off, store_fetches={len(fetched)}, "
+        f"blackout_held={blind_held}, reconciled={recovered}, "
+        f"breaker_failed_scales={len(failed_scales)}, "
+        f"holdoffs={sorted(holds)}, "
+        f"hang_served={n_hang_served}/{len(futs)}"
+    )
+
+
 def scenario_supervise_restart():
     import json
 
@@ -1061,6 +1292,9 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
     if subprocess_scenarios:
         scenarios["host_kill"] = scenario_host_kill
         scenarios["scale_up"] = scenario_scale_up
+        # in-process but ~a minute of wall clock (a full diurnal
+        # replay): script mode only, same as the subprocess scenarios
+        scenarios["autoscale"] = scenario_autoscale
         scenarios["sigterm_subprocess"] = scenario_sigterm_subprocess
         scenarios["supervise_restart"] = scenario_supervise_restart
     if only is not None:
@@ -1077,7 +1311,22 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
 
 
 def main(argv=None) -> int:
-    results = run(subprocess_scenarios=True)
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="end-to-end chaos scenarios (exit 0 iff all pass)"
+    )
+    ap.add_argument(
+        "--only", nargs="+", metavar="SCENARIO", default=None,
+        help="restrict to the named scenario(s) — e.g. the ci.sh "
+        "autoscale stage runs '--only autoscale'",
+    )
+    args = ap.parse_args(argv)
+    results = run(subprocess_scenarios=True, only=args.only)
+    if args.only and len(results) < len(set(args.only)):
+        missing = set(args.only) - set(results)
+        print(f"unknown scenario(s): {sorted(missing)}")
+        return 2
     failed = [k for k, (ok, _) in results.items() if not ok]
     print(
         f"{len(results) - len(failed)}/{len(results)} chaos scenarios passed"

@@ -18,6 +18,11 @@
 #       warm startup recompiled a bucket program (or failed to
 #       publish/fetch/append its kind=warmup ledger record) — the
 #       pre-warmed-elasticity contract (serve.artifacts) is broken
+#   26  the autoscale chaos leg failed (scripts/chaos_smoke.py
+#       --only autoscale): the capacity controller did not grow at
+#       the diurnal peak / shrink at the trough / brown out, lost a
+#       request, or an injected sensor blackout or wedged actuator
+#       broke the fail-safe contract (serve.controller)
 #   30  scripts/perf_gate.py judged a regression against the durable
 #       perf ledger (skipped silently when no ledger file exists yet
 #       — a young repo must not fail CI on an empty history)
@@ -76,6 +81,9 @@ fi
 
 echo "== ci: 2c/3 warmup leg (scripts/warmup_smoke.py: cold-vs-warm artifact-store startup)"
 JAX_PLATFORMS=cpu python scripts/warmup_smoke.py || exit 25
+
+echo "== ci: 2d/3 autoscale leg (scripts/chaos_smoke.py --only autoscale: diurnal replay under the capacity controller)"
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --only autoscale || exit 26
 
 echo "== ci: 3/3 perf regression gate (scripts/perf_gate.py)"
 # resolve the same ledger path perf_gate would; gate only when a

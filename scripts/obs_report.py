@@ -395,6 +395,112 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
         if n_served:
             lines.append(f"  delivered     {n_served} request(s)")
 
+    # -- CONTROLLER: the capacity control plane (serve.controller).
+    # Every ctrl_decision carries the sensor snapshot that justified
+    # it, so this section can replay WHY capacity moved: the decision
+    # timeline, the replica-count sparkline over fleet_scale events,
+    # actuation outcomes, holdoffs by reason, and breaker state.
+    decisions = by.get("ctrl_decision", [])
+    scales = by.get("ctrl_scale", [])
+    brownouts = by.get("ctrl_brownout", [])
+    holdoffs = by.get("ctrl_holdoff", [])
+    if decisions or scales or brownouts or holdoffs:
+        lines.append(_section("CONTROLLER"))
+        # replica-count sparkline: the fleet's target over time
+        # (fleet_start anchor + every fleet_scale transition)
+        counts = []
+        if fstart:
+            counts.append(fstart[-1].get("replicas") or 0)
+        for e in by.get("fleet_scale", []):
+            if e.get("to_n") is not None:
+                counts.append(e["to_n"])
+        if counts:
+            blocks = "▁▂▃▄▅▆▇█"
+            lo, hi = min(counts), max(counts)
+            span = max(1, hi - lo)
+            spark = "".join(
+                blocks[
+                    min(
+                        len(blocks) - 1,
+                        (c - lo) * (len(blocks) - 1) // span,
+                    )
+                ]
+                for c in counts
+            )
+            lines.append(
+                f"  replicas      {spark}  ({lo}..{hi}, now "
+                f"{counts[-1]})"
+            )
+        ok_scales = [s for s in scales if s.get("ok")]
+        failed_scales = [s for s in scales if not s.get("ok")]
+        if scales:
+            ups = sum(
+                1 for s in ok_scales if s.get("direction") == "up"
+            )
+            downs = sum(
+                1 for s in ok_scales if s.get("direction") == "down"
+            )
+            lines.append(
+                f"  scaling       {ups} up, {downs} down"
+                + (
+                    f", {len(failed_scales)} FAILED actuation(s)"
+                    if failed_scales
+                    else ""
+                )
+            )
+        if brownouts:
+            n_on = sum(1 for b in brownouts if b.get("on"))
+            last = brownouts[-1]
+            lines.append(
+                f"  brownout      {n_on} engagement(s), now "
+                + ("ON" if last.get("on") else "off")
+                + f" ({last.get('reason')})"
+            )
+        if holdoffs:
+            by_reason = {}
+            for h in holdoffs:
+                r = str(h.get("reason"))
+                by_reason[r] = by_reason.get(r, 0) + 1
+            parts = ", ".join(
+                f"{r} x{n}"
+                for r, n in sorted(
+                    by_reason.items(), key=lambda kv: -kv[1]
+                )
+            )
+            lines.append(f"  holdoffs      {len(holdoffs)} ({parts})")
+            n_breaker = sum(
+                n for r, n in by_reason.items()
+                if r.startswith("breaker_open")
+            )
+            if n_breaker:
+                lines.append(
+                    f"  breaker       OPENED (suppressed {n_breaker} "
+                    "invocation(s)) — see fault_fired/ctrl timeline"
+                )
+        # decision timeline: the newest few, each with the sensor
+        # snapshot that justified it
+        for d in decisions[-8:]:
+            snap = d.get("snapshot") or {}
+            depth = snap.get("queue_depth")
+            ceil = snap.get("ceiling")
+            p99 = snap.get("p99_ms")
+            lines.append(
+                f"  {_fmt_ts(d.get('t', 0.0))}  {d.get('action'):<13}"
+                f" {d.get('reason'):<18} depth {depth}/{ceil}"
+                + (f", p99 {p99}ms" if p99 is not None else "")
+                + (
+                    f", {snap.get('live_replicas')} live"
+                    f"/{snap.get('replica_target')} target"
+                    if snap.get("replica_target") is not None
+                    else ""
+                )
+            )
+        if len(decisions) > 8:
+            lines.append(
+                f"  ({len(decisions) - 8} earlier decision(s) not "
+                "shown)"
+            )
+
     # -- FEDERATION: the cross-host pool over the durable file-lease
     # queue (serve.dqueue / serve.federation). Per-host liveness uses
     # the SAME staleness rule as HOSTS/FLEET (--stale-after): a host

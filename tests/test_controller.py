@@ -1,0 +1,556 @@
+"""The SLO-feedback capacity controller (serve.controller) — the
+fail-safety contracts of ISSUE 17:
+
+- sensor blackout / stale telemetry -> holdoff, NEVER a scale-down
+  (fail safe on blind sensors);
+- actuator hang -> timeout/retry ladder -> circuit breaker OPEN +
+  ``ctrl_holdoff`` + the ``ctrl_breaker_open`` gauge, while the data
+  plane never blocks;
+- controller crash mid-scale (CCSC_FAULT_CTRL_CRASH_SCALE) -> the
+  fleet keeps serving exactly as configured, and a RESTARTED
+  controller reconciles from live state (``fleet.replica_target``),
+  not from controller memory;
+- flap guard: an oscillating load never reaches the ``sustain``
+  streak, so the controller holds still;
+- hysteresis brownout: the degrade rung engages at ``brownout_frac``
+  and releases below ``brownout_exit_frac``;
+- bounds reconciliation, at-max/at-min holdoffs, the HBM scale-up
+  veto, and coarse-grain host-pool scaling.
+
+Everything here drives a FakeFleet — the controller is strictly
+advisory, so its entire contract is visible through the actuator
+calls it makes and the ``ctrl_*`` events it emits. The real-fleet
+elasticity actuators (``set_replica_count`` grow/shrink, the ceiling
+recompute on lifecycle transitions) are covered in test_fleet.py,
+and the end-to-end diurnal acceptance in scripts/chaos_smoke.py.
+
+Also here: ``apps.serve.ResubmitBackoff`` — the satellite fix
+splitting BucketCold vs Overloaded escalation counters.
+"""
+import time
+
+import pytest
+
+from ccsc_code_iccv2017_tpu.apps.serve import ResubmitBackoff
+from ccsc_code_iccv2017_tpu.config import ControllerConfig
+from ccsc_code_iccv2017_tpu.serve.controller import CapacityController
+from ccsc_code_iccv2017_tpu.serve.engine import BucketCold
+from ccsc_code_iccv2017_tpu.serve.fleet import Overloaded
+from ccsc_code_iccv2017_tpu.utils import faults, obs
+
+
+@pytest.fixture(autouse=True)
+def _ctrl_isolation(monkeypatch):
+    for v in (
+        "CCSC_FAULT_CTRL_SENSOR_BLACKOUT",
+        "CCSC_FAULT_CTRL_BLACKOUT_S",
+        "CCSC_FAULT_CTRL_ACT_HANG",
+        "CCSC_FAULT_CTRL_ACT_HANG_S",
+        "CCSC_FAULT_CTRL_CRASH_SCALE",
+        "CCSC_FAULT_STATE_DIR",
+    ):
+        monkeypatch.delenv(v, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeFleet:
+    """The controller's entire world: one sensor (control_snapshot)
+    and three actuators, each call recorded. Emits through a REAL obs
+    run so the ctrl_* event contract is exercised end to end."""
+
+    def __init__(self, run, target=1):
+        self._run = run
+        self._target = target
+        self._brownout = False
+        self.scale_calls = []
+        self.brownout_calls = []
+        self.gauges = {}
+        self.fail_snapshot = False
+        self.stale_age_s = 0.0
+        self.snap = dict(
+            queue_depth=0,
+            ceiling=10,
+            rung=0,
+            live_replicas=target,
+            abandoned=0,
+            bound_rps=5.0,
+            warm_replicas=target,
+            warmup_eta_s=0.0,
+            p99_ms=None,
+            slo_p99_target_ms=None,
+        )
+
+    @property
+    def replica_target(self):
+        return self._target
+
+    def control_snapshot(self):
+        if self.fail_snapshot:
+            raise RuntimeError("sensors down")
+        s = dict(self.snap)
+        s["t"] = time.time() - self.stale_age_s
+        s["replica_target"] = self._target
+        s["brownout"] = self._brownout
+        return s
+
+    def set_replica_count(self, n, reason="manual"):
+        old = self._target
+        self._target = n
+        self.scale_calls.append((old, n, reason))
+        return {"from_n": old, "to_n": n}
+
+    def set_brownout(self, on, reason="controller"):
+        self.brownout_calls.append((on, reason))
+        changed = on != self._brownout
+        self._brownout = on
+        return changed
+
+    def set_ctrl_gauge(self, name, value):
+        self.gauges[name] = value
+
+
+def _cfg(**kw):
+    base = dict(
+        min_replicas=1,
+        max_replicas=3,
+        interval_s=0.01,
+        high_frac=0.8,
+        low_frac=0.2,
+        sustain=2,
+        cooldown_s=0.05,
+        stale_s=5.0,
+        act_timeout_s=0.25,
+        act_retries=0,
+        act_backoff_s=0.01,
+        breaker_after=2,
+        breaker_reset_s=0.5,
+        # out of the way unless a test targets brownout
+        brownout_frac=1.4,
+        brownout_exit_frac=0.05,
+        hbm_limit_mb=0.0,
+    )
+    base.update(kw)
+    return ControllerConfig(**base)
+
+
+@pytest.fixture
+def run(tmp_path):
+    r = obs.start_run(
+        str(tmp_path), algorithm="ctrl_test", verbose="none"
+    )
+    yield r
+    if not r.closed:
+        r.close(status="ok")
+
+
+def _events(tmp_path, type_=None):
+    ev = obs.read_events(str(tmp_path))
+    return [e for e in ev if type_ is None or e["type"] == type_]
+
+
+# -- the happy control loop ----------------------------------------------
+
+
+def test_scale_up_on_sustained_pressure(run, tmp_path):
+    fleet = FakeFleet(run, target=1)
+    ctrl = CapacityController(fleet, _cfg())
+    fleet.snap["queue_depth"] = 9  # frac 0.9 >= high_frac
+    ctrl.step()
+    assert fleet.scale_calls == []  # one tick is not sustained
+    ctrl.step()
+    assert fleet.scale_calls == [(1, 2, "controller:queue_pressure")]
+    decs = _events(tmp_path, "ctrl_decision")
+    assert decs and decs[-1]["action"] == "scale_up"
+    assert decs[-1]["snapshot"]["queue_depth"] == 9
+    scales = _events(tmp_path, "ctrl_scale")
+    assert scales[-1]["direction"] == "up"
+    assert scales[-1]["ok"] is True
+    assert (scales[-1]["from_n"], scales[-1]["to_n"]) == (1, 2)
+
+
+def test_scale_up_on_slo_breach(run, tmp_path):
+    fleet = FakeFleet(run, target=1)
+    ctrl = CapacityController(fleet, _cfg())
+    fleet.snap.update(
+        queue_depth=1, p99_ms=250.0, slo_p99_target_ms=100.0
+    )
+    ctrl.step()
+    ctrl.step()
+    assert fleet.scale_calls == [(1, 2, "controller:slo_breach")]
+
+
+def test_scale_down_needs_green_everything(run, tmp_path):
+    fleet = FakeFleet(run, target=2)
+    ctrl = CapacityController(fleet, _cfg())
+    # idle queue but the overload ladder is not at rung 0: hold
+    fleet.snap.update(queue_depth=0, rung=1)
+    for _ in range(5):
+        ctrl.step()
+    assert fleet.scale_calls == []
+    # ladder green now -> drain down after the sustain streak
+    fleet.snap["rung"] = 0
+    ctrl.step()
+    ctrl.step()
+    assert fleet.scale_calls == [(2, 1, "controller:idle_capacity")]
+
+
+def test_cooldown_suppresses_back_to_back_scaling(run, tmp_path):
+    fleet = FakeFleet(run, target=1)
+    ctrl = CapacityController(fleet, _cfg(cooldown_s=30.0))
+    fleet.snap["queue_depth"] = 9
+    for _ in range(6):
+        ctrl.step()
+    # one scale, then the cooldown holds even under live pressure
+    assert fleet.scale_calls == [(1, 2, "controller:queue_pressure")]
+    holds = _events(tmp_path, "ctrl_holdoff")
+    assert any(h["reason"] == "cooldown:scale_up" for h in holds)
+
+
+def test_flap_guard_oscillating_load(run, tmp_path):
+    """A load oscillating between the bands every tick never builds a
+    ``sustain`` streak — the controller must hold perfectly still."""
+    fleet = FakeFleet(run, target=2)
+    ctrl = CapacityController(fleet, _cfg(sustain=3))
+    for i in range(18):
+        fleet.snap["queue_depth"] = 9 if i % 2 == 0 else 0
+        ctrl.step()
+    assert fleet.scale_calls == []
+    assert fleet.brownout_calls == []
+    assert _events(tmp_path, "ctrl_scale") == []
+
+
+def test_bounds_holdoffs_and_reconcile(run, tmp_path):
+    fleet = FakeFleet(run, target=3)
+    ctrl = CapacityController(fleet, _cfg())
+    fleet.snap["queue_depth"] = 10  # pressure at max_replicas
+    for _ in range(3):
+        ctrl.step()
+    assert fleet.scale_calls == []
+    holds = _events(tmp_path, "ctrl_holdoff")
+    assert any(h["reason"] == "at_max_replicas" for h in holds)
+    # a fleet below the configured floor is corrected immediately
+    # (reconciliation, no streak needed)
+    fleet2 = FakeFleet(run, target=1)
+    ctrl2 = CapacityController(fleet2, _cfg(min_replicas=2))
+    fleet2.snap["queue_depth"] = 5  # mid-band: no pressure either way
+    ctrl2.step()
+    assert fleet2.scale_calls == [
+        (1, 2, "controller:reconcile_bounds")
+    ]
+
+
+# -- fail-safe sensors ---------------------------------------------------
+
+
+def test_sensor_blackout_holds_and_never_scales_down(
+    run, tmp_path, monkeypatch
+):
+    """Chaos: CCSC_FAULT_CTRL_SENSOR_BLACKOUT blinds the sensor read.
+    The fleet is idle (scale-down would be wanted with live
+    telemetry) — the controller must emit ctrl_holdoff and hold."""
+    monkeypatch.setenv("CCSC_FAULT_CTRL_SENSOR_BLACKOUT", "1")
+    monkeypatch.setenv("CCSC_FAULT_CTRL_BLACKOUT_S", "60")
+    faults.reset()
+    fleet = FakeFleet(run, target=2)
+    ctrl = CapacityController(fleet, _cfg(sustain=1))
+    fleet.snap["queue_depth"] = 0  # down pressure, if it could see
+    for _ in range(6):
+        ctrl.step()
+    assert fleet.scale_calls == []
+    assert fleet.brownout_calls == []
+    holds = _events(tmp_path, "ctrl_holdoff")
+    assert holds and all(
+        h["reason"] == "sensor_stale" for h in holds
+    )
+    assert any(
+        e["fault"] == "ctrl_blackout"
+        for e in _events(tmp_path, "fault_fired")
+    )
+
+
+def test_stale_snapshot_fails_safe(run, tmp_path):
+    """Telemetry older than stale_s is as blind as no telemetry."""
+    fleet = FakeFleet(run, target=2)
+    ctrl = CapacityController(fleet, _cfg(sustain=2, stale_s=1.0))
+    fleet.snap["queue_depth"] = 0
+    fleet.stale_age_s = 30.0
+    for _ in range(4):
+        ctrl.step()
+    assert fleet.scale_calls == []
+    assert any(
+        h["reason"] == "sensor_stale"
+        for h in _events(tmp_path, "ctrl_holdoff")
+    )
+    # sensors return: pressure must RE-sustain from zero (streaks
+    # were reset) before anything moves
+    fleet.stale_age_s = 0.0
+    ctrl.step()
+    assert fleet.scale_calls == []
+    ctrl.step()
+    assert fleet.scale_calls == [(2, 1, "controller:idle_capacity")]
+
+
+def test_snapshot_exception_fails_safe(run, tmp_path):
+    fleet = FakeFleet(run, target=2)
+    ctrl = CapacityController(fleet, _cfg(sustain=1))
+    fleet.snap["queue_depth"] = 0
+    fleet.fail_snapshot = True
+    for _ in range(3):
+        ctrl.step()
+    assert fleet.scale_calls == []
+    assert any(
+        h["reason"] == "sensor_stale"
+        for h in _events(tmp_path, "ctrl_holdoff")
+    )
+
+
+# -- stuck actuators -----------------------------------------------------
+
+
+def test_actuator_hang_opens_breaker(run, tmp_path, monkeypatch):
+    """Chaos: every actuator invocation wedges (the hang count spans
+    the whole retry budget). The timeout ladder must fail each
+    invocation, the breaker must OPEN after breaker_after exhausted
+    invocations, further attempts are refused with ctrl_holdoff, and
+    the ctrl_breaker_open gauge goes to 1 — while the data plane
+    (here: the recorded actuator calls) never completed a scale."""
+    monkeypatch.setenv("CCSC_FAULT_CTRL_ACT_HANG", "10")
+    monkeypatch.setenv("CCSC_FAULT_CTRL_ACT_HANG_S", "3600")
+    faults.reset()
+    fleet = FakeFleet(run, target=1)
+    ctrl = CapacityController(
+        fleet,
+        _cfg(sustain=1, act_timeout_s=0.1, cooldown_s=0.0001),
+    )
+    fleet.snap["queue_depth"] = 9
+    ctrl.step()  # invocation 1: hangs -> timeout -> failed
+    ctrl.step()  # invocation 2: hangs -> breaker opens
+    ctrl.step()  # refused at the breaker, no invocation
+    assert fleet.scale_calls == []  # the hung fn never ran to completion
+    assert fleet.gauges.get("ctrl_breaker_open") == 1.0
+    scales = _events(tmp_path, "ctrl_scale")
+    assert scales and all(s["ok"] is False for s in scales)
+    assert any(
+        h["reason"] == "breaker_open:scale_up"
+        for h in _events(tmp_path, "ctrl_holdoff")
+    )
+    assert any(
+        e["fault"] == "ctrl_act_hang"
+        for e in _events(tmp_path, "fault_fired")
+    )
+
+
+def test_breaker_half_opens_after_reset(run, tmp_path, monkeypatch):
+    monkeypatch.setenv("CCSC_FAULT_CTRL_ACT_HANG", "2")
+    faults.reset()
+    fleet = FakeFleet(run, target=1)
+    ctrl = CapacityController(
+        fleet,
+        _cfg(
+            sustain=1, act_timeout_s=0.1, cooldown_s=0.0001,
+            breaker_reset_s=0.2,
+        ),
+    )
+    fleet.snap["queue_depth"] = 9
+    ctrl.step()
+    ctrl.step()  # breaker open now, both hang charges spent
+    assert fleet.gauges.get("ctrl_breaker_open") == 1.0
+    time.sleep(0.25)  # past breaker_reset_s: half-open probe allowed
+    ctrl.step()
+    assert fleet.scale_calls == [(1, 2, "controller:queue_pressure")]
+    assert fleet.gauges.get("ctrl_breaker_open") == 0.0
+
+
+# -- controller death ----------------------------------------------------
+
+
+def test_crash_mid_scale_leaves_fleet_as_configured(
+    run, tmp_path, monkeypatch
+):
+    """Chaos: the controller dies BETWEEN committing to a scale
+    decision and invoking the actuator. Hard invariant: the fleet's
+    configuration is untouched. A restarted controller then
+    reconciles from fleet.replica_target and completes the scale."""
+    monkeypatch.setenv("CCSC_FAULT_CTRL_CRASH_SCALE", "1")
+    faults.reset()
+    fleet = FakeFleet(run, target=1)
+    ctrl = CapacityController(fleet, _cfg(sustain=1)).start()
+    fleet.snap["queue_depth"] = 9
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not ctrl.died:
+        time.sleep(0.01)
+    assert ctrl.died  # the loop thread is gone
+    assert not ctrl.alive
+    # the fleet serves exactly as configured: no actuation happened
+    assert fleet.scale_calls == []
+    assert fleet.replica_target == 1
+    # the decision WAS committed (emitted) before the crash — the
+    # stream shows intent, the fleet shows no mutation
+    assert _events(tmp_path, "ctrl_decision")
+    ctrl.close()
+
+    # restart: a fresh controller holds no memory of the dead one —
+    # it re-reads live state and the still-live pressure re-sustains
+    ctrl2 = CapacityController(fleet, _cfg(sustain=1))
+    ctrl2.step()
+    assert fleet.scale_calls == [(1, 2, "controller:queue_pressure")]
+    assert fleet.replica_target == 2
+    ctrl2.close()
+
+
+def test_close_is_advisory(run, tmp_path):
+    fleet = FakeFleet(run, target=2)
+    fleet.snap["queue_depth"] = 5  # mid-band: no pressure either way
+    ctrl = CapacityController(fleet, _cfg()).start()
+    assert ctrl.alive
+    ctrl.close()
+    assert not ctrl.alive
+    assert fleet.scale_calls == []
+    assert fleet.replica_target == 2
+
+
+# -- brownout ------------------------------------------------------------
+
+
+def test_brownout_hysteresis(run, tmp_path):
+    fleet = FakeFleet(run, target=1)
+    ctrl = CapacityController(
+        fleet,
+        _cfg(
+            brownout_frac=0.9, brownout_exit_frac=0.3,
+            cooldown_s=0.01, high_frac=1.45, sustain=50,
+        ),
+    )
+    fleet.snap["queue_depth"] = 9  # frac 0.9: engage
+    ctrl.step()
+    assert fleet.brownout_calls == [(True, "controller")]
+    bo = _events(tmp_path, "ctrl_brownout")
+    assert bo[-1]["on"] is True
+    # inside the band (0.3 < 0.5 < 0.9): no release, no re-engage
+    fleet.snap["queue_depth"] = 5
+    time.sleep(0.02)
+    ctrl.step()
+    assert len(fleet.brownout_calls) == 1
+    # below the exit: release
+    fleet.snap["queue_depth"] = 2
+    time.sleep(0.02)
+    ctrl.step()
+    assert fleet.brownout_calls[-1] == (False, "controller")
+    bo = _events(tmp_path, "ctrl_brownout")
+    assert bo[-1]["on"] is False
+
+
+# -- scale-up vetos ------------------------------------------------------
+
+
+class FakeMemWatch:
+    def __init__(self, peak_mb):
+        self._peak = int(peak_mb * 2**20)
+
+    def sample(self):
+        return self._peak
+
+    @property
+    def peak_bytes(self):
+        return self._peak
+
+
+def test_hbm_watermark_vetoes_scale_up(run, tmp_path):
+    fleet = FakeFleet(run, target=1)
+    ctrl = CapacityController(
+        fleet,
+        _cfg(sustain=1, hbm_limit_mb=100.0),
+        memwatch=FakeMemWatch(peak_mb=200.0),
+    )
+    fleet.snap["queue_depth"] = 9
+    for _ in range(3):
+        ctrl.step()
+    assert fleet.scale_calls == []
+    assert any(
+        h["reason"] == "hbm_watermark"
+        for h in _events(tmp_path, "ctrl_holdoff")
+    )
+
+
+# -- coarse-grain host scaling -------------------------------------------
+
+
+class FakePool:
+    def __init__(self, n=1):
+        self.n_hosts = n
+        self.calls = []
+
+    def grow(self):
+        self.n_hosts += 1
+        self.calls.append("grow")
+        return f"host-{self.n_hosts}"
+
+    def shrink(self):
+        self.n_hosts -= 1
+        self.calls.append("shrink")
+        return f"host-{self.n_hosts + 1}"
+
+
+def test_host_pool_scales_when_replicas_pinned(run, tmp_path):
+    pool = FakePool(n=1)
+    fleet = FakeFleet(run, target=2)
+    ctrl = CapacityController(
+        fleet,
+        _cfg(
+            min_replicas=2, max_replicas=2, sustain=1,
+            min_hosts=1, max_hosts=2, cooldown_s=0.0001,
+        ),
+        host_pool=pool,
+    )
+    fleet.snap["queue_depth"] = 9  # replicas pinned -> host axis
+    ctrl.step()
+    assert pool.calls == ["grow"]
+    scales = _events(tmp_path, "ctrl_scale")
+    assert scales[-1]["direction"] == "host_up"
+    assert (scales[-1]["from_n"], scales[-1]["to_n"]) == (1, 2)
+    # trough: replicas already at min -> hosts shrink back to floor
+    fleet.snap["queue_depth"] = 0
+    time.sleep(0.01)
+    ctrl.step()
+    assert pool.calls == ["grow", "shrink"]
+    assert pool.n_hosts == 1
+
+
+# -- the resubmit backoff split (apps.serve satellite) -------------------
+
+
+def test_resubmit_backoff_tracks_classes_separately():
+    """Interleaved BucketCold and Overloaded refusals escalate on
+    SEPARATE counters: a cold bucket during scale-up must not
+    inflate the overload backoff (the pre-fix single counter gave
+    the 5th interleaved refusal a 16x multiplier; split counters
+    give each class its own doubling)."""
+    bo = ResubmitBackoff()
+    cold = BucketCold("64x64", 1.0)
+    over = Overloaded("queue full", 1.0)
+    assert bo.delay_for(over) == 1.0
+    assert bo.delay_for(cold) == 1.0  # NOT 2.0: its own counter
+    assert bo.delay_for(over) == 2.0
+    assert bo.delay_for(cold) == 2.0
+    assert bo.delay_for(over) == 4.0
+    assert bo.delay_for(cold) == 4.0
+    assert bo.consec("Overloaded") == 3
+    assert bo.consec("BucketCold") == 3
+    # an admitted request clears all escalation
+    bo.reset()
+    assert bo.delay_for(over) == 1.0
+    assert bo.delay_for(cold) == 1.0
+
+
+def test_resubmit_backoff_caps():
+    bo = ResubmitBackoff()
+    over = Overloaded("queue full", 3.0)
+    delays = [bo.delay_for(over) for _ in range(10)]
+    assert delays[0] == 3.0
+    assert max(delays) == ResubmitBackoff.CAP_S
+    assert delays[-1] == ResubmitBackoff.CAP_S
+    # the hint itself is honored under the cap
+    cold = BucketCold("64x64", 0.25)
+    assert bo.delay_for(cold) == 0.25

@@ -967,3 +967,141 @@ def test_serve_fleet_events_route_through_emit():
     )
     offenders = core.run_checks(project, ["emit-routing"])
     assert not offenders, "\n".join(f.render() for f in offenders)
+
+
+def test_set_replica_count_grow_shrink(tmp_path):
+    """The elasticity actuator end to end (ISSUE 17 tentpole): grow
+    1 -> 2 spawns a second replica onto the next free slice
+    synchronously, shrink 2 -> 1 drain-then-retires (never a kill —
+    the retired replica's in-flight work completes or requeues), and
+    a re-grow resurrects the retired slot on a fresh generation.
+    Requests keep being served across every transition, zero lost."""
+    d = _bank()
+    fleet = _fleet(d, _cfg(), tmp_path, replicas=1)
+    try:
+        for i, (x, m) in enumerate(_reqs(4, seed=3)):
+            assert fleet.submit(
+                x * m, mask=m, key=f"g0-{i}"
+            ).result(timeout=120) is not None
+
+        r = fleet.set_replica_count(2, reason="test_grow")
+        assert r == {"from_n": 1, "to_n": 2}
+        snap = fleet.control_snapshot()
+        assert snap["live_replicas"] == 2
+        assert fleet.replica_target == 2
+        for i, (x, m) in enumerate(_reqs(4, seed=4)):
+            assert fleet.submit(
+                x * m, mask=m, key=f"g1-{i}"
+            ).result(timeout=120) is not None
+
+        r = fleet.set_replica_count(1, reason="test_shrink")
+        assert r == {"from_n": 2, "to_n": 1}
+        assert fleet.replica_target == 1
+        # drain-then-retire completes asynchronously
+        deadline = time.monotonic() + 60
+        retired = []
+        while time.monotonic() < deadline and not retired:
+            retired = [
+                e for e in obs.read_events(str(tmp_path))
+                if e["type"] == "fleet_replica_retired"
+            ]
+            time.sleep(0.02)
+        assert retired, "shrink never retired a replica"
+        assert "scale_down" in retired[-1]["reason"]
+        for i, (x, m) in enumerate(_reqs(4, seed=5)):
+            assert fleet.submit(
+                x * m, mask=m, key=f"s0-{i}"
+            ).result(timeout=120) is not None
+        assert fleet.control_snapshot()["live_replicas"] == 1
+
+        # resurrect the retired slot: same id, next generation
+        fleet.set_replica_count(2, reason="test_regrow")
+        assert fleet.control_snapshot()["live_replicas"] == 2
+        for i, (x, m) in enumerate(_reqs(4, seed=6)):
+            assert fleet.submit(
+                x * m, mask=m, key=f"g2-{i}"
+            ).result(timeout=120) is not None
+        st = fleet.stats()
+    finally:
+        fleet.close()
+    assert st["n_requests"] == 16 and st["n_failed"] == 0
+    events = obs.read_events(str(tmp_path))
+    scales = [e for e in events if e["type"] == "fleet_scale"]
+    assert [(e["from_n"], e["to_n"]) for e in scales] == [
+        (1, 2), (2, 1), (1, 2)
+    ]
+    gens = [
+        e.get("generation")
+        for e in events
+        if e["type"] == "fleet_replica_ready"
+    ]
+    assert max(g for g in gens if g is not None) >= 1  # resurrection
+
+
+def test_ceiling_recomputed_on_replica_death(tmp_path, monkeypatch):
+    """ISSUE 17 satellite: the derived admission ceiling must be
+    recomputed on EVERY replica lifecycle transition. Kill one of two
+    replicas (no restart budget -> abandoned): the abandon transition
+    itself must re-derive and emit ``fleet_ceiling`` with
+    live_replicas=1 and a LOWER ceiling — a fleet that keeps admitting
+    at 2-replica capacity into 1 replica melts down."""
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_KILL_REQ", "4")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_KILL_REPLICA", "0")
+    faults.reset()
+    d = _bank()
+    fleet = _fleet(
+        d, _cfg(max_it=40), tmp_path, replicas=2, max_restarts=0,
+        max_queue_depth=None, min_queue_depth=4, max_queue_s=2.0,
+    )
+    try:
+        # a small first wave measures rates WITHOUT reaching replica
+        # 0's 4th take, so the 2-replica ceiling is derived first
+        for i, (x, m) in enumerate(_reqs(3, seed=11)):
+            fleet.submit(x * m, mask=m, key=f"w0-{i}").result(
+                timeout=300
+            )
+        deadline = time.monotonic() + 30
+        pre = []
+        while time.monotonic() < deadline and not pre:
+            pre = [
+                e for e in obs.read_events(str(tmp_path))
+                if e["type"] == "fleet_ceiling"
+                and e["live_replicas"] == 2
+            ]
+            time.sleep(0.02)
+        assert pre, "2-replica ceiling never derived"
+
+        # now push replica 0 over its kill threshold; requeue hands
+        # its stranded work to the survivor, so nothing is lost
+        dead = False
+        for wave in range(12):
+            for i, (x, m) in enumerate(_reqs(4, seed=20 + wave)):
+                fleet.submit(
+                    x * m, mask=m, key=f"w{wave + 1}-{i}"
+                ).result(timeout=300)
+            dead = any(
+                e["type"] == "fleet_replica_abandoned"
+                for e in obs.read_events(str(tmp_path))
+            )
+            if dead:
+                break
+        assert dead, "the kill fault never abandoned replica 0"
+
+        deadline = time.monotonic() + 30
+        post = []
+        while time.monotonic() < deadline and not post:
+            post = [
+                e for e in obs.read_events(str(tmp_path))
+                if e["type"] == "fleet_ceiling"
+                and e["live_replicas"] == 1
+            ]
+            time.sleep(0.02)
+    finally:
+        fleet.close()
+    assert post, "no ceiling recompute on the abandon transition"
+    pre_ceiling = max(e["ceiling"] for e in pre)
+    assert post[-1]["ceiling"] < pre_ceiling, (
+        f"ceiling must drop with the lost replica: "
+        f"{post[-1]['ceiling']} !< {pre_ceiling}"
+    )
+    assert post[-1]["source"] == "serving_bound"
