@@ -348,11 +348,15 @@ def robust_band(
     values: Iterable[float],
     mad_k: Optional[float] = None,
     frac: Optional[float] = None,
+    abs_floor: float = 0.0,
 ) -> Optional[Dict[str, float]]:
     """Median / MAD / lower-band of a history sample (None when
     empty). ``mad_k`` defaults to CCSC_PERF_GATE_MAD, ``frac`` (the
     minimum relative drop treated as regression) to
-    CCSC_PERF_GATE_FRAC."""
+    CCSC_PERF_GATE_FRAC. ``abs_floor`` is an ABSOLUTE minimum-drop
+    floor in the value's own unit — the quality gate's dB band uses
+    it with ``frac=0`` because a relative fraction of a log-domain
+    quantity (dB) is meaningless as a tolerance."""
     vals = sorted(float(v) for v in values)
     if not vals:
         return None
@@ -367,7 +371,9 @@ def robust_band(
 
     med = _median(vals)
     mad = _median(sorted(abs(v - med) for v in vals))
-    lo = med - max(mad_k * _MAD_SIGMA * mad, frac * abs(med))
+    lo = med - max(
+        mad_k * _MAD_SIGMA * mad, frac * abs(med), float(abs_floor)
+    )
     return {
         "n": len(vals),
         "median": med,

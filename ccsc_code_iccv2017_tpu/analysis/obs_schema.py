@@ -136,6 +136,33 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     "bank_plan_evict": _s("replica_id", "digest", "bucket"),
     "tenant_reject": _s("replica_id", "tenant", "queue_depth",
                         "quota"),
+    # -- quality observatory (serve.quality; emitted through the
+    # engine/fleet emit wrappers). quality_breach is a tenant's
+    # declared dB floor violated (TenantSpec.min_psnr_db, the
+    # slo_breach discipline); quality_histogram is the periodic
+    # per-(bank, tenant, bucket) dB snapshot; quality_solve_diag the
+    # per-bucket on-device solve diagnostics (objective split,
+    # stop-reason fractions, nonfinite count); quality_probe /
+    # quality_probe_breach the golden-probe verdicts;
+    # quality_drift a bank's rolling served dB below its ledger
+    # band; quality_demote_advice the advisory demotion signal a
+    # registry/controller (or operator) consumes -------------------
+    "quality_breach": _s("replica_id", "tenant", "min_psnr_db",
+                         "observed_db", "n"),
+    "quality_histogram": _s("replica_id", "bank_id", "tenant",
+                            "bucket", "counts", "n"),
+    "quality_solve_diag": _s("replica_id", "bucket", "n",
+                             "iters_mean", "tol_stop_frac",
+                             "nonfinite"),
+    "quality_probe": _s("replica_id", "probe", "bank_id", "digest",
+                        "status", "db"),
+    "quality_probe_breach": _s("replica_id", "probe", "bank_id",
+                               "digest", "db", "ref_db"),
+    "quality_drift": _s("replica_id", "bank_id", "digest",
+                        "rolling_db", "band_lo", "n_history"),
+    "quality_demote_advice": _s("replica_id", "bank_id",
+                                "from_digest", "to_digest",
+                                "reason"),
     # -- workload capture + replay (serve.capture, serve.replay).
     # capture_* events are session-scope (emitted by the recorder
     # through the fleet/engine emit wrapper); replay_* events live in

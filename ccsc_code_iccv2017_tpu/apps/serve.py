@@ -263,6 +263,20 @@ def build_parser() -> argparse.ArgumentParser:
         "file every few seconds (scrape-less environments)",
     )
     p.add_argument(
+        "--probe-dir", default=None, metavar="DIR",
+        help="golden-probe store (serve.quality.ProbeSet): "
+        "deterministic probe requests with content-addressed "
+        "reference outcomes, scheduled through idle replicas every "
+        "--probe-interval-s; a probe regression emits "
+        "quality_probe_breach + an advisory demotion signal. "
+        "Default: CCSC_PROBE_DIR env; '' disables",
+    )
+    p.add_argument(
+        "--probe-interval-s", type=float, default=None,
+        help="seconds between golden-probe sweeps (fleet mode; "
+        "default CCSC_PROBE_INTERVAL_S env, unset/0 = probes off)",
+    )
+    p.add_argument(
         "--capture-dir", default=None,
         help="durably record every admitted request (arrival time, "
         "payloads content-addressed by sha256, outcome digest + PSNR "
@@ -465,6 +479,8 @@ def main(argv=None):
                 metricsd_snapshot=args.metricsd_snapshot,
                 capture_dir=args.capture_dir,
                 tenants=tenants,
+                probe_dir=args.probe_dir,
+                probe_interval_s=args.probe_interval_s,
             ),
             host=args.host_id,
             metrics_dir=args.metrics_dir,
@@ -518,6 +534,8 @@ def main(argv=None):
                 metricsd_snapshot=args.metricsd_snapshot,
                 capture_dir=args.capture_dir,
                 tenants=tenants,
+                probe_dir=args.probe_dir,
+                probe_interval_s=args.probe_interval_s,
             ),
         )
         print(

@@ -384,6 +384,16 @@ class SolveConfig:
     # cached in the store) or once per serving engine
     # (ServeConfig.tune); the resolved config runs with tune='off'.
     tune: str = "off"
+    # On-device solve diagnostics (models.reconstruct.SolveExtras):
+    # the final iterate's objective split (data residual vs L1) and
+    # nonfinite code count, computed inside the solve program and
+    # riding the result pytree to the caller's existing readback
+    # fence. Unlike track_objective this is NOT per-iteration — one
+    # crop+multiply on the already-carried reconstruction, no extra
+    # Dz pass, no extra dispatch. Off by default (the historical
+    # program is bit-exactly unchanged); serve.QualityMonitor folds
+    # the readback into quality_solve_diag events.
+    track_diagnostics: bool = False
 
     def __post_init__(self):
         if self.tune not in ("off", "auto", "sweep"):
@@ -699,6 +709,15 @@ class TenantSpec:
     slo_p99_ms: Optional[float] = None
     quota: Optional[int] = None
     weight: float = 1.0
+    # Declared served-quality floor (dB): the tenant's median
+    # valid-region PSNR must stay at or above this; judged by the
+    # quality monitor (serve.quality.QualityMonitor) with the SLO
+    # breach discipline — `quality_breach` events, re-fire dedup.
+    # None = no floor declared (same no-env-fallback stance as the
+    # latency targets: a fleet-wide knob must not become every
+    # tenant's quality contract). Only requests carrying ground
+    # truth (x_orig) count toward the floor.
+    min_psnr_db: Optional[float] = None
 
     def __post_init__(self):
         if not self.tenant or not isinstance(self.tenant, str):
@@ -706,7 +725,7 @@ class TenantSpec:
                 f"tenant must be a non-empty string, got "
                 f"{self.tenant!r}"
             )
-        for fname in ("slo_p50_ms", "slo_p99_ms"):
+        for fname in ("slo_p50_ms", "slo_p99_ms", "min_psnr_db"):
             v = getattr(self, fname)
             if v is not None and v <= 0:
                 raise ValueError(
@@ -859,8 +878,29 @@ class FleetConfig:
     # With tenants declared, submit(..., tenant=...) must name one of
     # them (or None for untenanted traffic).
     tenants: Optional[Tuple[TenantSpec, ...]] = None
+    # Golden-probe store (serve.quality.ProbeSet): a directory of
+    # deterministic probe requests + content-addressed reference
+    # outcomes (capture payload-store layout). None = the
+    # CCSC_PROBE_DIR env knob; "" = explicitly off (the capture_dir
+    # convention). Auto-generated on first use when the directory
+    # has no probes yet.
+    probe_dir: Optional[str] = None
+    # Probe cadence in seconds: the fleet serves every probe through
+    # idle capacity at this interval and scores it bit-exact + in dB
+    # against the stored reference for the live bank digest;
+    # regressions emit quality_probe_breach + a demotion advisory.
+    # None = CCSC_PROBE_INTERVAL_S (unset/0 = probing off).
+    probe_interval_s: Optional[float] = None
 
     def __post_init__(self):
+        if (
+            self.probe_interval_s is not None
+            and self.probe_interval_s < 0
+        ):
+            raise ValueError(
+                f"probe_interval_s must be >= 0, got "
+                f"{self.probe_interval_s}"
+            )
         for fname in ("slo_p50_ms", "slo_p99_ms"):
             v = getattr(self, fname)
             if v is not None and v <= 0:

@@ -374,7 +374,14 @@ class WorkloadRecorder:
         """Record one delivered result: the outcome digest (sha256 of
         the reconstruction bytes — the bit-parity oracle replay checks
         against), valid-region PSNR, and client-visible latency.
-        Never raises (same hot-path contract as
+
+        ``psnr`` MUST be the shared
+        :func:`serve.quality.valid_region_psnr` value (the engine's
+        dispatch path computes exactly that) — replay's cross-bucket
+        verifier and the shadow scorer recompute with the same
+        function and compare against this recorded dB, rounded to
+        6 decimals here (tests/test_quality.py pins the
+        bit-equality). Never raises (same hot-path contract as
         :meth:`record_submit`)."""
         # the sampler's verdict is deterministic per key, so the
         # outcome follows its request's fate even when a worker

@@ -55,6 +55,7 @@ import numpy as np
 
 from ..config import ProblemGeom, ServeConfig, SolveConfig
 from ..utils import trace as trace_util
+from . import quality as _quality_mod
 from . import slo as _slo
 
 
@@ -311,20 +312,11 @@ def pick_bucket(
     )
 
 
-def _valid_region_psnr(
-    rec: np.ndarray, ref: np.ndarray, radius: Tuple[int, ...]
-) -> float:
-    """PSNR of the cropped (request-shaped) reconstruction against its
-    ground truth, with the same psf-radius border crop as common.psnr —
-    the in-solve trace averages over the whole BUCKET canvas, which
-    dilutes the MSE of a padded request with unconstrained pad pixels."""
-    nd = len(radius)
-    sl = tuple(
-        slice(r, s - r) for r, s in zip(radius, rec.shape[-nd:])
-    )
-    sl = (Ellipsis, *sl)
-    mse = float(np.mean((rec[sl] - ref[sl]) ** 2))
-    return float(10.0 * np.log10(1.0 / max(mse, 1e-12)))
+# THE valid-region PSNR implementation lives in serve.quality (shared
+# with capture/replay verification and the probe/shadow scorers — one
+# definition, so a recorded dB and a recomputed dB can never drift);
+# the historical private name stays importable for existing callers.
+_valid_region_psnr = _quality_mod.valid_region_psnr
 
 
 class CodecEngine:
@@ -416,6 +408,13 @@ class CodecEngine:
                 serve_cfg.slo_p50_ms, serve_cfg.slo_p99_ms
             ),
             check_s=serve_cfg.slo_check_s,
+        )
+        # quality plane (serve.quality): per-(bank, tenant, bucket)
+        # dB histograms + per-bucket solve diagnostics, same check
+        # cadence as the SLO monitor. Floors/drift live at the fleet
+        # scope (the engine has no tenant specs or ledger context).
+        self._quality = _quality_mod.QualityMonitor(
+            check_s=serve_cfg.slo_check_s
         )
         self._slo_profile_dir = (
             serve_cfg.slo_profile_dir
@@ -576,6 +575,7 @@ class CodecEngine:
         from ..models.reconstruct import (
             ReconResult,
             ReconTrace,
+            SolveExtras,
             _reconstruct_impl,
             build_plan,
         )
@@ -635,9 +635,20 @@ class CodecEngine:
                 in_specs=(bs, bs, bs, bs, rep),
                 # every result leaf carries the slot axis first
                 # (vmap), sharded like the inputs; traces are
-                # per-slot too, so nothing is replicated back
+                # per-slot too, so nothing is replicated back. With
+                # solve diagnostics on, the trace carries the extras
+                # subtree (per-slot scalars, sharded the same way);
+                # off, the None default is an empty pytree subtree
+                # and the historical spec matches exactly.
                 out_specs=ReconResult(
-                    bs, bs, ReconTrace(bs, bs, bs, bs)
+                    bs,
+                    bs,
+                    ReconTrace(
+                        bs, bs, bs, bs,
+                        SolveExtras(bs, bs, bs)
+                        if cfg.track_diagnostics
+                        else None,
+                    ),
                 ),
                 # the while_loop carry mixes varying (data-derived)
                 # and invarying (zero-init) components; skip vma
@@ -1306,7 +1317,7 @@ class CodecEngine:
                     self._dispatch_digest = None
 
     def _dispatch(self, key, batch: List[_Pending], depth_after: int):
-        from ..models.reconstruct import ReconTrace
+        from ..models.reconstruct import ReconTrace, SolveExtras
         from ..utils import perfmodel
 
         jnp = self._jnp
@@ -1376,6 +1387,38 @@ class CodecEngine:
         recon = np.asarray(out.recon)
         z = np.asarray(out.z) if self.serve_cfg.return_codes else None
 
+        # on-device solve diagnostics (SolveConfig.track_diagnostics):
+        # the extras subtree rides the result pytree, so these
+        # readbacks land at the fence already paid above — no extra
+        # dispatch, asserted by tests/test_quality.py. Filler slots
+        # are excluded (their zero-data solves are not diagnostics).
+        extras = getattr(out.trace, "extras", None)
+        if extras is not None:
+            ex_fid = np.asarray(extras.obj_fid)[: len(batch)]
+            ex_l1 = np.asarray(extras.obj_l1)[: len(batch)]
+            ex_nonf = np.asarray(extras.nonfinite)[: len(batch)]
+        else:
+            ex_fid = ex_l1 = ex_nonf = None
+        self._quality.observe_solve(
+            name,
+            iters[: len(batch)],
+            self.cfg.max_it,
+            obj_fid=ex_fid,
+            obj_l1=ex_l1,
+            nonfinite=ex_nonf,
+        )
+
+        # the dispatch's digest binding ends HERE: the solve is read
+        # back and the plan is never consulted again, so the digest
+        # must be unreferenced before any future resolves — a client
+        # that calls publish_bank the moment its result lands has to
+        # see the superseded digest retirable (the hot-swap sweep
+        # contract; the worker loop's finally-clear is the backstop
+        # for the raising paths above)
+        with self._cv:
+            if self._dispatch_digest == digest:
+                self._dispatch_digest = None
+
         max_it = int(iters[: len(batch)].max()) if len(batch) else 0
         for i, p in enumerate(batch):
             crop = tuple(slice(0, s) for s in p.spatial)
@@ -1388,6 +1431,9 @@ class CodecEngine:
                 psnr[i] if tracked else np.zeros_like(psnr[i]),
                 diff[i],
                 np.int32(n_it),
+                SolveExtras(ex_fid[i], ex_l1[i], ex_nonf[i])
+                if ex_fid is not None
+                else None,
             )
             final_psnr = (
                 _valid_region_psnr(rec_i, p.x_orig, geom.psf_radius)
@@ -1399,6 +1445,12 @@ class CodecEngine:
             self._slo.observe("queue", wait_s * 1e3)
             self._slo.observe("solve", dt * 1e3)
             self._slo.observe("total", latency * 1e3)
+            self._quality.observe(
+                final_psnr,
+                bank_id=p.bank_id,
+                tenant=p.tenant,
+                bucket=name,
+            )
             # span emission is RETROSPECTIVE (start+end written
             # together with measured times): a replica killed
             # mid-dispatch can never leave an orphan span_start in
@@ -1507,6 +1559,17 @@ class CodecEngine:
         if breaches and self._slo_profile_dir and not self._profiled:
             self._profiled = True
             self._profile_armed = self._slo_profile_dir
+        # the quality plane's cadence-gated flush rides the same
+        # dispatch tail (the engine declares no floors — breaches
+        # are a fleet-scope concern — but histograms + solve
+        # diagnostics land here)
+        q_breaches, q_snaps, q_diags = self._quality.tick()
+        for br in q_breaches:
+            self._emit("quality_breach", **br)
+        for sn in q_snaps:
+            self._emit("quality_histogram", **sn)
+        for dg in q_diags:
+            self._emit("quality_solve_diag", **dg)
 
     # ------------------------------------------------------------------
     def stats(self) -> Dict[str, float]:
@@ -1552,6 +1615,18 @@ class CodecEngine:
             "histograms": [
                 ("latency_ms", {"phase": sn["phase"]}, sn)
                 for sn in self._slo.raw_snapshots()
+            ]
+            + [
+                (
+                    "psnr_db",
+                    {
+                        "bank_id": sn["bank_id"],
+                        "tenant": sn["tenant"],
+                        "bucket": sn["bucket"],
+                    },
+                    sn,
+                )
+                for sn in self._quality.raw_snapshots()
             ],
         }
 
@@ -1896,6 +1971,13 @@ class CodecEngine:
                     _breaches, snaps = slo_mon.final()
                     for sn in snaps:
                         self._emit("slo_histogram", **sn)
+                q_mon = getattr(self, "_quality", None)
+                if q_mon is not None and run.active:
+                    _qb, q_snaps, q_diags = q_mon.final()
+                    for sn in q_snaps:
+                        self._emit("quality_histogram", **sn)
+                    for dg in q_diags:
+                        self._emit("quality_solve_diag", **dg)
                 st = self.stats()
                 run.close(
                     status="ok",

@@ -97,6 +97,7 @@ from ..utils import env as _env
 from ..utils import trace as trace_util
 from . import capture as _capture
 from . import metricsd as _metricsd_mod
+from . import quality as _quality
 from . import registry as _registry
 from . import slo as _slo
 from . import tenancy as _tenancy
@@ -448,6 +449,36 @@ class ServeFleet:
                 fleet_cfg.slo_p50_ms, fleet_cfg.slo_p99_ms
             )
         )
+        # quality plane (serve.quality): per-(bank, tenant, bucket)
+        # dB histograms, declared tenant floors
+        # (TenantSpec.min_psnr_db), and the per-bank drift watch
+        # judged against kind=quality ledger history. Checked on the
+        # monitor thread beside the SLO tick; golden probes (below)
+        # run on their own thread at probe_interval_s.
+        self._quality = _quality.QualityMonitor(
+            specs=fleet_cfg.tenants,
+            drift_band_for=self._quality_drift_band,
+        )
+        # advisory demotion signals (quality_demote_advice): appended
+        # on probe regression / drift, deduped per (bank, digest,
+        # reason) excursion; a registry/controller — or the chaos
+        # harness — consumes them via quality_advice()
+        self._quality_advice: List[Dict] = []
+        self._advice_seen: set = set()
+        # bank_id -> the digest it routed to BEFORE the latest swap
+        # (the advisory's to_digest — what a demotion restores)
+        self._bank_prev: Dict[Optional[str], str] = {}
+        self._n_probe_failures = 0
+        self._probe_set: Optional[_quality.ProbeSet] = None
+        self._probe_seq = 0
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_dir = _quality.resolve_probe_dir(
+            fleet_cfg.probe_dir
+        )
+        _pi = fleet_cfg.probe_interval_s
+        if _pi is None:
+            _pi = _env.env_float("CCSC_PROBE_INTERVAL_S")
+        self._probe_interval_s = float(_pi) if _pi else 0.0
         self._metricsd = None
         self._capture: Optional[_capture.WorkloadRecorder] = None
         self._t_start = time.time()
@@ -539,6 +570,13 @@ class ServeFleet:
                 daemon=True,
             )
             self._monitor.start()
+            if self._probe_interval_s > 0 and self._probe_dir:
+                self._probe_thread = threading.Thread(
+                    target=self._probe_loop,
+                    name="ccsc-fleet-probes",
+                    daemon=True,
+                )
+                self._probe_thread.start()
             self._start_metricsd()
         except BaseException:
             with self._close_lock:
@@ -639,6 +677,7 @@ class ServeFleet:
                 "requeued_total": self._n_requeued,
                 "duplicates_suppressed_total": self._n_duplicates,
                 "failed_total": self._n_failed,
+                "probe_failures_total": self._n_probe_failures,
             }
             n_live = sum(
                 1 for r in self._replicas
@@ -655,6 +694,9 @@ class ServeFleet:
                 "replica_target": self._replica_target,
                 "overload_rung": self._rung,
                 "banks": len(self._bank_routes),
+                # tenants currently judged below their declared dB
+                # floor (ccsc_quality_breach — 0 is healthy)
+                "quality_breach": self._quality.n_breached,
             }
             gauges.update(self._ctrl_gauges)
             # per-tenant labeled series: the shared constructor
@@ -673,6 +715,17 @@ class ServeFleet:
                 sn,
             )
             for sn in self._tenant_slos.raw_snapshots()
+        ] + [
+            (
+                "psnr_db",
+                {
+                    "bank_id": sn["bank_id"],
+                    "tenant": sn["tenant"],
+                    "bucket": sn["bucket"],
+                },
+                sn,
+            )
+            for sn in self._quality.raw_snapshots()
         ]
         return {
             "counters": counters,
@@ -1203,6 +1256,28 @@ class ServeFleet:
         # the tenant's OWN histogram: per-tenant p50/p99 vs declared
         # targets, untouched by other tenants' bursts
         self._tenant_slos.observe(req.tenant, lat * 1e3)
+        # quality plane: fold the delivered valid-region dB (None on
+        # requests without ground truth — a no-op) into the
+        # per-(bank, tenant, bucket) histograms and the bank's drift
+        # watch; a drift excursion fires here (the monitor returns
+        # the records, nothing is emitted under its lock) and also
+        # raises a demotion advisory
+        if res.psnr is not None:
+            with self._cv:
+                q_digest = self._bank_routes.get(req.bank_id)
+            for fire in self._quality.observe(
+                res.psnr,
+                bank_id=req.bank_id,
+                tenant=req.tenant,
+                bucket=res.bucket,
+                digest=q_digest,
+            ):
+                self._emit(
+                    "quality_drift", replica_id=None, **fire
+                )
+                self._advise_demotion(
+                    req.bank_id, fire.get("digest"), "drift"
+                )
         try:
             req.future.set_result(res)
         except InvalidStateError:
@@ -1236,9 +1311,12 @@ class ServeFleet:
             requeued=req.attempts > 1,
             tenant=req.tenant, bank_id=req.bank_id,
         )
-        if self._capture is not None:
+        if self._capture is not None and not req.key.startswith(
+            _quality.PROBE_KEY_PREFIX
+        ):
             # outcome digest pairs the delivered bytes with the
             # captured request — the bit-parity oracle replay checks
+            # (probe keys skipped, mirroring the submit-side guard)
             self._capture.record_outcome(
                 req.key, res.recon, res.psnr, lat * 1e3, res.bucket,
                 iters=int(res.trace.num_iters),
@@ -1516,6 +1594,165 @@ class ServeFleet:
                 self._emit("slo_breach", replica_id=None, **br)
             for sn in t_snaps:
                 self._emit("slo_histogram", replica_id=None, **sn)
+            # quality plane: tenant dB floors vs declared
+            # min_psnr_db (quality_breach, the slo_breach
+            # discipline), periodic per-(bank, tenant, bucket) dB
+            # snapshots, and the per-bucket solve diagnostics
+            q_breaches, q_snaps, q_diags = self._quality.tick(now)
+            for br in q_breaches:
+                self._emit("quality_breach", replica_id=None, **br)
+            for sn in q_snaps:
+                self._emit(
+                    "quality_histogram", replica_id=None, **sn
+                )
+            for dg in q_diags:
+                self._emit(
+                    "quality_solve_diag", replica_id=None, **dg
+                )
+
+    # -- quality plane (serve.quality) ---------------------------------
+    def _quality_drift_band(
+        self, bank_id: Optional[str], digest: str
+    ) -> Optional[Dict[str, float]]:
+        """The drift watch's historical band for one bank: the
+        quality band over EVERY kind=quality ledger record of this
+        bank id and workload — deliberately across digests, so a
+        freshly-swapped rotten bank is judged against the good
+        history it replaced, not its own. None (no ledger / thin
+        history) leaves that bank unwatched."""
+        try:
+            from ..analysis import ledger as _ledger
+            from ..tune import store as tune_store
+
+            if not _ledger.enabled():
+                return None
+            workload = tune_store.solve_workload(self.geom)
+            bank_key = bank_id or "default"
+            vals = [
+                float(r["value"])
+                for r in _ledger.Ledger().read()
+                if r.get("kind") == "quality"
+                and r.get("workload") == workload
+                and (r.get("knobs") or {}).get("bank") == bank_key
+            ]
+            min_history = _env.env_int("CCSC_PERF_GATE_MIN_HISTORY")
+            if len(vals) < min_history:
+                return None
+            return _quality.quality_band(vals)
+        except Exception:  # pragma: no cover - defensive
+            return None
+
+    def _advise_demotion(
+        self,
+        bank_id: Optional[str],
+        from_digest: Optional[str],
+        reason: str,
+    ) -> None:
+        """Record + emit one advisory demotion signal: the bank's
+        served quality regressed (probe or drift evidence) and the
+        previously-routed digest — if the fleet saw one — is the
+        restoration candidate. ADVISORY by design: the fleet never
+        swaps a bank on its own (a flapping probe must not flap
+        production routing); a registry/controller or operator
+        consumes quality_advice() and decides. Deduped per
+        (bank, digest, reason)."""
+        key = (bank_id, from_digest, reason)
+        with self._cv:
+            if key in self._advice_seen:
+                return
+            self._advice_seen.add(key)
+            advice = {
+                "bank_id": bank_id,
+                "from_digest": from_digest,
+                "to_digest": self._bank_prev.get(bank_id),
+                "reason": reason,
+                "t": time.time(),
+            }
+            self._quality_advice.append(advice)
+        self._emit(
+            "quality_demote_advice",
+            replica_id=None,
+            bank_id=bank_id,
+            from_digest=from_digest,
+            to_digest=advice["to_digest"],
+            reason=reason,
+        )
+
+    def quality_advice(self) -> List[Dict]:
+        """Advisory demotion signals accumulated so far (newest
+        last) — each carries bank_id, the regressing from_digest,
+        the restoration to_digest (the digest the bank routed to
+        before its last swap, None if never swapped), and the
+        evidence reason ('probe' | 'drift')."""
+        with self._cv:
+            return list(self._quality_advice)
+
+    def _probe_loop(self) -> None:
+        """Golden probes through idle capacity: every
+        probe_interval_s, serve the deterministic probe set against
+        every routed bank id and judge each result bit-exact + in dB
+        against the stored reference for the bank's CURRENT digest
+        (serve.quality.ProbeSet). Skipped while the queue has real
+        work — probes ride idle replicas only. A regression emits
+        quality_probe_breach and raises a demotion advisory."""
+        while not self._stop_monitor.wait(self._probe_interval_s):
+            with self._cv:
+                busy = len(self._queue) > 0
+                bank_ids = list(self._bank_routes)
+            if busy or self._close_started:
+                continue
+            try:
+                self._run_probes(bank_ids)
+            except Exception:
+                # a probe failure (draining fleet, bucket rebuild)
+                # must never take the probe thread down — the next
+                # interval retries
+                continue
+
+    def _run_probes(self, bank_ids) -> None:
+        if self._probe_set is None:
+            # auto-generate on first use: deterministic payloads per
+            # configured bucket, idempotent on an existing store.
+            # Content is synthesized through the PINNED bank — the
+            # only content whose served dB ranks banks (synth_probe)
+            self._probe_set = _quality.ProbeSet.generate(
+                self._probe_dir, self.geom, self.buckets,
+                d=self._d,
+            )
+        for bank_id in bank_ids:
+            self._probe_seq += 1
+            verdicts = self._probe_set.run(
+                self,
+                bank_id=bank_id,
+                key_seq=self._probe_seq,
+                timeout=600.0,
+            )
+            for v in verdicts:
+                self._emit(
+                    "quality_probe",
+                    replica_id=None,
+                    probe=v["probe"],
+                    bank_id=v["bank_id"],
+                    digest=v["digest"],
+                    status=v["status"],
+                    db=v["db"],
+                    ref_db=v["ref_db"],
+                )
+                if v["status"] == "regressed":
+                    with self._cv:
+                        self._n_probe_failures += 1
+                    self._emit(
+                        "quality_probe_breach",
+                        replica_id=None,
+                        probe=v["probe"],
+                        bank_id=v["bank_id"],
+                        digest=v["digest"],
+                        db=v["db"],
+                        ref_db=v["ref_db"],
+                    )
+                    self._advise_demotion(
+                        bank_id, v["digest"], "probe"
+                    )
 
     def _refresh_ceiling(self, force: bool = False) -> None:
         """Recompute the derived admission ceiling NOW (satellite fix,
@@ -2324,10 +2561,14 @@ class ServeFleet:
             span_id=qspan, parent_span=req.root_span,
             ts=req.queue_t, attempt=1,
         )
-        if self._capture is not None:
+        if self._capture is not None and not req.key.startswith(
+            _quality.PROBE_KEY_PREFIX
+        ):
             # durable workload record of the ADMITTED request —
             # outside the fleet lock (sha256 + file append must not
-            # serialize submitters against the workers)
+            # serialize submitters against the workers). Golden
+            # probes are excluded: synthetic quality traffic must
+            # not pollute the replayable workload.
             self._capture.record_submit(
                 req.key, req.trace_id, b32, mask=mask32,
                 smooth_init=smooth32, x_orig=xorig32,
@@ -2360,6 +2601,7 @@ class ServeFleet:
     def publish_bank(
         self, bank_id: Optional[str], d,
         tenant: Optional[str] = None,
+        quality_check: Optional[bool] = None,
     ) -> Tuple[Optional[str], str]:
         """Fleet-wide zero-downtime hot-swap: make ``d`` servable on
         EVERY replica, then atomically route ``bank_id`` (None = the
@@ -2380,13 +2622,26 @@ class ServeFleet:
         republishes every retained bank before taking work
         (``_spawn_replica``), and requeued requests re-serve against
         their admission-time digest on any survivor. Returns
-        ``(old_digest, new_digest)``."""
+        ``(old_digest, new_digest)``.
+
+        ``quality_check`` (None = the ``CCSC_QUALITY_GATE`` flag)
+        arms the publish-time quality gate: the candidate digest's
+        ``kind=quality`` ledger history (shadow scores from
+        ``serve.quality.score_bank``) is judged against the live
+        history's quality band and a regression raises
+        :class:`~.quality.QualityGateError` BEFORE any replica sees
+        the bank — the held-out-parity publish guard online
+        dictionary learning rides on."""
         from ..utils import validate
 
         if self._close_started:
             raise RuntimeError("fleet is closed")
         validate.check_filters(d, self.geom)
         digest = _registry.bank_digest(d)
+        if quality_check is None:
+            quality_check = _env.env_flag("CCSC_QUALITY_GATE")
+        if quality_check:
+            _quality.gate_publish(digest, bank_id=bank_id)
         arr = np.asarray(d)
         with self._cv:
             if self._close_started:
@@ -2414,6 +2669,12 @@ class ServeFleet:
             if self._close_started:
                 raise RuntimeError("fleet is closed")
             self._bank_routes[bank_id] = digest
+            # the demotion advisory's restoration target: what this
+            # bank served BEFORE this flip (no-op on a republish of
+            # the same digest — a refresh must not make a bank its
+            # own rollback)
+            if old is not None and old != digest:
+                self._bank_prev[bank_id] = old
         self._emit(
             "bank_swap", replica_id=None,
             bank_id=bank_id, old_digest=old, new_digest=digest,
@@ -2655,6 +2916,11 @@ class ServeFleet:
                 time.sleep(0.02)
             self._stop_monitor.set()
             self._monitor.join(timeout=5.0)
+            # the probe thread shares _stop_monitor but a sweep in
+            # flight holds result futures — give it the same drain
+            # grace as a worker before engines close under it
+            if self._probe_thread is not None:
+                self._probe_thread.join(timeout=60.0)
             # the recycle walker polls _close_started at 50ms — join
             # it so it cannot be alive at interpreter exit
             if self._recycle_thread is not None:
@@ -2798,6 +3064,18 @@ class ServeFleet:
                 _t_breaches, t_snaps = self._tenant_slos.final()
                 for sn in t_snaps:
                     self._emit("slo_histogram", replica_id=None, **sn)
+                # ... and the quality plane's closing flush: one
+                # complete quality_histogram per (bank, tenant,
+                # bucket) plus the accumulated solve diagnostics
+                _qb, q_snaps, q_diags = self._quality.final()
+                for sn in q_snaps:
+                    self._emit(
+                        "quality_histogram", replica_id=None, **sn
+                    )
+                for dg in q_diags:
+                    self._emit(
+                        "quality_solve_diag", replica_id=None, **dg
+                    )
             if not self._run.closed:
                 st = self.stats()
                 self._ledger_append(st)

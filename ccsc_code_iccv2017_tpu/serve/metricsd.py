@@ -60,7 +60,11 @@ __all__ = [
 _PREFIX = "ccsc"
 # exposition format version, stamped into every snapshot/scrape:
 # 2 = per-tenant labeled counter series (serve.tenancy) added
-SNAPSHOT_FORMAT = 2
+# 3 = quality plane series (serve.quality): ccsc_psnr_db histograms,
+#     ccsc_probe_failures_total, ccsc_quality_breach. Purely
+#     additive — parse_snapshot_stamp and every format-2 series are
+#     byte-identical, so format-2 readers keep parsing format-3 files
+SNAPSHOT_FORMAT = 3
 
 
 def resolve_endpoint(
@@ -242,7 +246,14 @@ class StreamMetrics:
             "rejected_total": 0,
             "duplicates_suppressed_total": 0,
             "slo_breaches_total": 0,
+            "probe_failures_total": 0,
         }
+        # quality plane folds (serve.quality): breached tenant floors
+        # (gauge parity with the live fleet's n_breached — a floor
+        # never un-breaches within a run) and the newest psnr_db
+        # histogram per (bank, tenant, bucket, replica)
+        self._breached_tenants: set = set()
+        self._qhists: Dict[Tuple, Dict] = {}
         # a fleet dir carries BOTH record kinds for one delivery —
         # fleet_request at the top level, serve_request in the
         # replica's stream — so the two are counted separately and
@@ -310,6 +321,20 @@ class StreamMetrics:
                         rec.get("tenant"),
                     )
                     self._hists[key] = rec
+                elif kind == "quality_probe_breach":
+                    self._counters["probe_failures_total"] += 1
+                elif kind == "quality_breach":
+                    t = rec.get("tenant")
+                    if t:
+                        self._breached_tenants.add(t)
+                elif kind == "quality_histogram":
+                    qkey = (
+                        rec.get("bank_id"),
+                        rec.get("tenant"),
+                        rec.get("bucket"),
+                        rec.get("replica_id"),
+                    )
+                    self._qhists[qkey] = rec
             hists = []
             for (phase, rid, tenant), rec in sorted(
                 self._hists.items(), key=lambda kv: str(kv[0])
@@ -320,6 +345,20 @@ class StreamMetrics:
                 if tenant is not None:
                     labels["tenant"] = tenant
                 hists.append(("latency_ms", labels, rec))
+            # psnr_db series mirror the live metrics() label shape
+            # ({bank_id, tenant, bucket}); a replica label is added
+            # only for replica-scope rows so the fleet-scope series
+            # renders identically to the in-memory source
+            for (bank, tenant, bucket, rid), rec in sorted(
+                self._qhists.items(), key=lambda kv: str(kv[0])
+            ):
+                labels = {
+                    "bank_id": bank, "tenant": tenant,
+                    "bucket": bucket,
+                }
+                if rid is not None:
+                    labels["replica"] = rid
+                hists.append(("psnr_db", labels, rec))
             counters = dict(self._counters)
             counters["requests_total"] = (
                 self._n_fleet_req
@@ -331,7 +370,9 @@ class StreamMetrics:
             )
             return {
                 "counters": counters,
-                "gauges": {},
+                "gauges": {
+                    "quality_breach": len(self._breached_tenants),
+                },
                 "labeled_counters": labeled,
                 "histograms": hists,
             }
@@ -419,8 +460,9 @@ class MetricsD:
         stamp = [
             # snapshot-format version stamp: readers that care about
             # the exposition shape (format 2 added labeled per-tenant
-            # counter series) can branch on it; parse_snapshot_stamp
-            # ignores it — the freshness contract is unchanged
+            # counter series, format 3 the quality plane series) can
+            # branch on it; parse_snapshot_stamp ignores it — the
+            # freshness contract is unchanged
             "# TYPE ccsc_snapshot_format gauge",
             f"ccsc_snapshot_format {SNAPSHOT_FORMAT}",
             "# TYPE ccsc_snapshot_timestamp_seconds gauge",

@@ -167,6 +167,22 @@ class BankRegistry:
             if r["bank_id"] == bank_id
         ]
 
+    def previous(self, bank_id: str) -> Optional[BankManifest]:
+        """The manifest published immediately BEFORE the current one
+        for ``bank_id`` — the rollback target a quality demotion
+        advisory (``quality_demote_advice``) points back to. Skips
+        records carrying the same digest as the head (a refresh
+        republish must not become its own rollback target). None when
+        the bank has no distinct prior digest."""
+        hist = self.history(bank_id)
+        if not hist:
+            return None
+        head = hist[-1]["digest"]
+        for rec in reversed(hist[:-1]):
+            if rec["digest"] != head:
+                return rec
+        return None
+
     def resolve(self, bank_id: str) -> BankManifest:
         """The NEWEST manifest for ``bank_id`` (latest record wins —
         re-publishing a bank id under a new digest is the hot-swap

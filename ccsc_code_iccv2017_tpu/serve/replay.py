@@ -47,6 +47,7 @@ import numpy as np
 
 from ..utils import env as _env
 from . import capture as _capture
+from . import quality as _quality
 from . import slo as _slo
 
 __all__ = ["ReplayDriver", "generate_diurnal"]
@@ -125,14 +126,35 @@ class ReplayDriver:
                 else "mismatch"
             )
         rec_psnr = out.get("psnr")
-        if rec_psnr is not None and res.psnr is not None:
+        got_db = res.psnr
+        if got_db is None and rec_psnr is not None:
+            # the served result carries no dB (the replay submit
+            # dropped x_orig, or the target predates PSNR plumbing):
+            # recompute with the SAME shared quality.valid_region_psnr
+            # the recorder quoted, from the captured ground truth
+            x_orig = self._payload(req.get("x_orig"))
+            radius = self._psf_radius()
+            if x_orig is not None and radius is not None:
+                got_db = _quality.valid_region_psnr(
+                    res.recon, x_orig, radius
+                )
+        if rec_psnr is not None and got_db is not None:
             return (
                 "match_psnr"
-                if abs(float(res.psnr) - float(rec_psnr))
+                if abs(float(got_db) - float(rec_psnr))
                 <= self.psnr_tol
                 else "mismatch"
             )
         return "unverified"
+
+    def _psf_radius(self) -> Optional[Tuple[int, ...]]:
+        # the capture meta's problem geometry (capture._write_meta)
+        # gives the psf-radius border the recorder's dB crop used
+        g = (self.meta or {}).get("geom") or {}
+        sup = g.get("spatial_support")
+        if not sup:
+            return None
+        return tuple(int(s) // 2 for s in sup)
 
     # -- the replay ----------------------------------------------------
     def replay(

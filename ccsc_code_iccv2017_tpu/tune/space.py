@@ -141,6 +141,7 @@ NON_TUNED_SOLVE: Dict[str, str] = {
     "verbose": "operational",
     "track_objective": "operational",
     "track_psnr": "operational",
+    "track_diagnostics": "operational (quality observatory readback)",
     "use_pallas": "deprecated no-op (r5 demotion)",
     "metrics_dir": "operational",
     "tune": "operational (the autotuner's own switch)",

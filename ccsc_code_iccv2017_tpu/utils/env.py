@@ -233,6 +233,33 @@ REGISTRY: Dict[str, Knob] = _knobs(
      "environments (fallback of FleetConfig.metricsd_snapshot)"),
     ("CCSC_METRICSD_INTERVAL_S", "float", 5.0, "serve.metricsd",
      "snapshot-file rewrite cadence in seconds"),
+    # -- quality observatory (serve.quality, scripts/quality_gate.py)
+    ("CCSC_QUALITY_CHECK_S", "float", 5.0, "serve.quality",
+     "quality floor check + quality_histogram/quality_solve_diag "
+     "snapshot cadence in seconds"),
+    ("CCSC_QUALITY_DRIFT_WINDOW", "int", 5, "serve.quality",
+     "rolling served-request window of the per-bank quality drift "
+     "watch (the rolling median dB is compared to the bank's ledger "
+     "quality band)"),
+    ("CCSC_QUALITY_GATE_DB", "float", 1.0,
+     "serve.quality, scripts/quality_gate.py",
+     "absolute dB floor of the quality regression band: a candidate "
+     "bank (or drifting live bank) regresses when it falls more than "
+     "max(MAD band, this many dB) below the live history median"),
+    ("CCSC_QUALITY_GATE", "flag", False, "serve.fleet",
+     "arm the publish-time quality gate: publish_bank refuses a "
+     "candidate digest whose kind=quality ledger history regresses "
+     "below the live band (fallback of the quality_check kwarg)"),
+    ("CCSC_PROBE_DIR", "path", None, "serve.quality, serve.fleet",
+     "golden-probe store directory (fallback of "
+     "FleetConfig.probe_dir; unset = no probe store)"),
+    ("CCSC_PROBE_INTERVAL_S", "float", None,
+     "serve.quality, serve.fleet",
+     "golden-probe cadence in seconds (fallback of "
+     "FleetConfig.probe_interval_s; unset/0 = probing off)"),
+    ("CCSC_PROBE_DB_TOL", "float", 0.5, "serve.quality",
+     "dB tolerance of a non-bit-exact probe against its stored "
+     "reference before it counts as regressed"),
     # -- performance observatory (analysis.ledger, utils.memwatch,
     # scripts/perf_gate.py) ------------------------------------------
     ("CCSC_PERF_LEDGER", "path", None,

@@ -44,6 +44,17 @@ restores them):
                       digests, pre-swap results bit-identical to a
                       fresh old-bank engine and post-swap results to
                       a fresh new-bank engine
+  bank_rot            (script mode only) quality-observatory chaos: a
+                      DEGRADED bank (atoms collapsed to one blur) is
+                      hot-swapped under two-tenant traffic — the
+                      golden probes flag the rot digest within ~one
+                      probe interval (quality_probe_breach), the
+                      drift watch flags the served-dB excursion vs
+                      the seeded ledger history (quality_drift), the
+                      demotion advisory names the prior digest and
+                      acting on it swaps the good bank back; zero
+                      lost requests, pre/post results bit-identical
+                      to fresh engines, zero new XLA compiles
   host_kill           (script mode only) whole-host chaos: 2 federated
                       fleet PROCESSES drain a shared file-lease queue
                       (serve.dqueue / serve.federation); one is
@@ -587,6 +598,265 @@ def scenario_bank_swap():
         f"served={len(pre_r) + len(post_r)}/16, dead={len(dead)}, "
         f"swap={old_dg}->{new_dg} (events={len(swaps)}), "
         f"alpha_parity={alpha_ok}, beta_pre={beta_pre_ok}, "
+        f"beta_post={beta_post_ok}"
+    )
+
+
+def scenario_bank_rot():
+    """Quality-observatory chaos (serve.quality): a fleet serves
+    two-tenant traffic when one tenant's bank is hot-swapped for a
+    DEGRADED one (every atom collapsed to the same blur — the
+    degenerate-retrain rot the probe plane exists to catch). Must
+    hold: the golden probes flag the rot digest within ~one probe
+    interval (``quality_probe_breach``), the drift watch flags the
+    served-dB excursion against the seeded ledger history
+    (``quality_drift``), a demotion advisory names the prior digest
+    as the rollback target, acting on it swaps the good bank back,
+    zero requests are lost throughout, pre-rot and post-demotion
+    results are bit-identical to a fresh good-bank engine, and the
+    whole episode triggers ZERO new XLA compiles (plan builds on the
+    rot digest are jitted; the bucket programs are digest-canonical).
+    """
+    import time
+
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.analysis import ledger as ledger_mod
+    from ccsc_code_iccv2017_tpu.config import (
+        FleetConfig,
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+        TenantSpec,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import CodecEngine, ServeFleet
+    from ccsc_code_iccv2017_tpu.serve import quality as quality_mod
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    geom = ProblemGeom(spatial_support=(5, 5), num_filters=8)
+
+    def norm(d):
+        return d / np.linalg.norm(
+            d.reshape(8, -1), axis=1
+        ).reshape(8, 1, 1)
+
+    r = np.random.default_rng(1)
+    d_good = norm(r.standard_normal((8, 5, 5)).astype(np.float32))
+    rr = np.random.default_rng(99)
+    d_rot = norm(
+        np.stack([
+            np.ones((5, 5), np.float32)
+            + 0.01 * rr.standard_normal((5, 5)).astype(np.float32)
+            for _ in range(8)
+        ])
+    )
+    # max_it matters: at 3 iterations every bank reconstructs equally
+    # badly; by 16 the solve exploits the bank's structure and the
+    # good-vs-rot dB gap opens past the probe tolerance. track_psnr:
+    # verbose="none" untracks PSNR by default, and an untracked
+    # delivery (psnr=None) never reaches the drift watch
+    cfg = SolveConfig(
+        max_it=16, tol=0.0, verbose="none", track_psnr=True,
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    tenants = (
+        TenantSpec(tenant="alpha", bank_id="bank-live"),
+        TenantSpec(tenant="beta"),  # rides the pinned default bank
+    )
+    radius = geom.psf_radius
+    # served content synthesized THROUGH the good bank: the only
+    # content whose served dB actually ranks banks (quality.synth_probe)
+    xs_a = [
+        quality_mod.synth_probe(d_good, (12, 12), seed=100 + i)
+        for i in range(6)
+    ]
+    xs_b = [
+        quality_mod.synth_probe(d_good, (12, 12), seed=200 + i)
+        for i in range(3)
+    ]
+
+    # bit-parity oracles + the good bank's served-dB baseline that
+    # seeds the drift watch's ledger history
+    def oracle(d, items):
+        eng = CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
+        try:
+            return [eng.reconstruct(x) for x in items]
+        finally:
+            eng.close()
+
+    o_alpha = oracle(d_good, xs_a)
+    o_beta = oracle(d_good, xs_b)
+    o_rot = oracle(d_rot, xs_a)
+    good_dbs = [
+        quality_mod.valid_region_psnr(res.recon, x, radius)
+        for res, x in zip(o_alpha, xs_a)
+    ]
+
+    probe_interval = 0.35
+    with tempfile.TemporaryDirectory() as tmp:
+        mdir = os.path.join(tmp, "metrics")
+        pdir = os.path.join(tmp, "probes")
+        lpath = os.path.join(tmp, "ledger.jsonl")
+        led = ledger_mod.Ledger(lpath)
+        for db in good_dbs:
+            rec = ledger_mod.normalize_record(
+                kind="quality", value=round(float(db), 4), unit="db",
+                knobs={"bank": "bank-live"}, source="chaos_seed",
+                **quality_mod._quality_key_fields(geom, scfg.buckets),
+            )
+            led.append(rec)
+        # drift window 3: the scenario serves 6 rot-digest requests;
+        # the default window of 5 needs a longer excursion than this
+        # smoke's traffic to pull the rolling median under the band
+        with _fault(
+            CCSC_PERF_LEDGER=lpath, CCSC_QUALITY_DRIFT_WINDOW=3,
+        ):
+            fleet = ServeFleet(
+                d_good, ReconstructionProblem(geom), cfg, scfg,
+                FleetConfig(
+                    replicas=2, metrics_dir=mdir, min_queue_depth=64,
+                    restart_backoff_s=0.05, verbose="none",
+                    tenants=tenants, probe_dir=pdir,
+                    probe_interval_s=probe_interval,
+                ),
+            )
+            old_dg, _ = None, None
+            _, good_dg = fleet.publish_bank("bank-live", d_good)
+            # pre-rot traffic: both tenants, ground truth attached so
+            # the monitor folds served dB
+            pre = [
+                fleet.submit(x, x_orig=x, tenant="alpha",
+                             key=f"pre-a{i}")
+                for i, x in enumerate(xs_a)
+            ] + [
+                fleet.submit(x, x_orig=x, tenant="beta",
+                             key=f"pre-b{i}")
+                for i, x in enumerate(xs_b)
+            ]
+            pre_r = [f.result(timeout=180) for f in pre]
+            # idle gap: let the probe sweeps seal references for the
+            # default bank and link bank-live to the shared digest
+            deadline = time.time() + 20 * probe_interval
+            while time.time() < deadline:
+                evs = obs.read_events(mdir, recursive=True)
+                if any(
+                    e.get("type") == "quality_probe"
+                    and e.get("bank_id") == "bank-live"
+                    for e in evs
+                ):
+                    break
+                time.sleep(0.1)
+            # ROT: the degraded bank lands on bank-live
+            t_rot = time.time()
+            _, rot_dg = fleet.publish_bank("bank-live", d_rot)
+            # queue stays idle -> the next probe sweep must flag it
+            advice = []
+            deadline = time.time() + 20 * probe_interval
+            while time.time() < deadline:
+                advice = fleet.quality_advice()
+                if advice:
+                    break
+                time.sleep(0.05)
+            t_detect = time.time() - t_rot
+            # rot-digest traffic: drift watch judges the served dB
+            # against the seeded good-bank history
+            mid = [
+                fleet.submit(x, x_orig=x, tenant="alpha",
+                             key=f"mid-a{i}")
+                for i, x in enumerate(xs_a)
+            ]
+            mid_r = [f.result(timeout=180) for f in mid]
+            # act on the advisory: swap the retained good bank back
+            # (the fleet never swaps on its own — the operator, or the
+            # controller harness, consumes quality_advice())
+            _, back_dg = fleet.publish_bank("bank-live", d_good)
+            post = [
+                fleet.submit(x, x_orig=x, tenant="alpha",
+                             key=f"post-a{i}")
+                for i, x in enumerate(xs_a)
+            ] + [
+                fleet.submit(x, x_orig=x, tenant="beta",
+                             key=f"post-b{i}")
+                for i, x in enumerate(xs_b)
+            ]
+            post_r = [f.result(timeout=180) for f in post]
+            fleet.close()
+        events = obs.read_events(mdir, recursive=True)
+
+    breaches = [
+        e for e in events
+        if e.get("type") == "quality_probe_breach"
+        and e.get("digest") == rot_dg
+    ]
+    drifts = [
+        e for e in events
+        if e.get("type") == "quality_drift"
+        and e.get("digest") == rot_dg
+    ]
+    compiles_after = [
+        e for e in events
+        if e.get("kind") == "compile" and e.get("t", 0) > t_rot
+    ]
+    adv = [
+        a for a in advice
+        if a.get("bank_id") == "bank-live"
+        and a.get("reason") == "probe"
+        and a.get("from_digest") == rot_dg
+    ]
+    advice_ok = bool(adv) and adv[0].get("to_digest") == good_dg
+    # one probe interval + the sweep's own solve time
+    detect_ok = bool(adv) and t_detect <= probe_interval + 2.0
+    n_a = len(xs_a)
+    alpha_pre_ok = all(
+        np.array_equal(got.recon, want.recon)
+        for got, want in zip(pre_r[:n_a], o_alpha)
+    )
+    beta_pre_ok = all(
+        np.array_equal(got.recon, want.recon)
+        for got, want in zip(pre_r[n_a:], o_beta)
+    )
+    rot_ok = all(
+        np.array_equal(got.recon, want.recon)
+        for got, want in zip(mid_r, o_rot)
+    )
+    alpha_post_ok = all(
+        np.array_equal(got.recon, want.recon)
+        for got, want in zip(post_r[:n_a], o_alpha)
+    )
+    beta_post_ok = all(
+        np.array_equal(got.recon, want.recon)
+        for got, want in zip(post_r[n_a:], o_beta)
+    )
+    served = len(pre_r) + len(mid_r) + len(post_r)
+    ok = (
+        served == 2 * (len(xs_a) + len(xs_b)) + len(xs_a)
+        and len(breaches) >= 1
+        and len(drifts) >= 1
+        and advice_ok
+        and detect_ok
+        and back_dg == good_dg
+        and rot_dg != good_dg
+        and len(compiles_after) == 0
+        and alpha_pre_ok
+        and beta_pre_ok
+        and rot_ok
+        and alpha_post_ok
+        and beta_post_ok
+    )
+    return ok, (
+        f"served={served}/{2 * (len(xs_a) + len(xs_b)) + len(xs_a)}, "
+        f"probe_breach={len(breaches)}, drift={len(drifts)}, "
+        f"advice={'ok' if advice_ok else advice}, "
+        f"detect={t_detect:.2f}s (interval {probe_interval}s), "
+        f"demote={rot_dg[:8]}->{back_dg[:8]}, "
+        f"compiles_after_rot={len(compiles_after)}, "
+        f"parity: alpha_pre={alpha_pre_ok} beta_pre={beta_pre_ok} "
+        f"rot={rot_ok} alpha_post={alpha_post_ok} "
         f"beta_post={beta_post_ok}"
     )
 
@@ -1292,6 +1562,10 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
     if subprocess_scenarios:
         scenarios["host_kill"] = scenario_host_kill
         scenarios["scale_up"] = scenario_scale_up
+        # in-process but ~30s of wall clock (probe sweeps at a real
+        # interval + it16 solves): script mode only, run by its own
+        # ci.sh stage ('--only bank_rot', exit 27)
+        scenarios["bank_rot"] = scenario_bank_rot
         # in-process but ~a minute of wall clock (a full diurnal
         # replay): script mode only, same as the subprocess scenarios
         scenarios["autoscale"] = scenario_autoscale

@@ -23,6 +23,13 @@
 #       the diurnal peak / shrink at the trough / brown out, lost a
 #       request, or an injected sensor blackout or wedged actuator
 #       broke the fail-safe contract (serve.controller)
+#   27  the bank-rot chaos leg failed (scripts/chaos_smoke.py
+#       --only bank_rot): a degraded-bank hot-swap was not flagged
+#       by the golden probes within ~one probe interval, the drift
+#       watch missed the served-dB excursion, the demotion advisory
+#       named the wrong rollback digest, a request was lost, served
+#       bytes lost bit-parity, or the episode triggered a new XLA
+#       compile (serve.quality — the quality observatory)
 #   30  scripts/perf_gate.py judged a regression against the durable
 #       perf ledger (skipped silently when no ledger file exists yet
 #       — a young repo must not fail CI on an empty history)
@@ -84,6 +91,9 @@ JAX_PLATFORMS=cpu python scripts/warmup_smoke.py || exit 25
 
 echo "== ci: 2d/3 autoscale leg (scripts/chaos_smoke.py --only autoscale: diurnal replay under the capacity controller)"
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --only autoscale || exit 26
+
+echo "== ci: 2e/3 bank-rot leg (scripts/chaos_smoke.py --only bank_rot: degraded-bank hot-swap vs the quality observatory)"
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --only bank_rot || exit 27
 
 echo "== ci: 3/3 perf regression gate (scripts/perf_gate.py)"
 # resolve the same ledger path perf_gate would; gate only when a

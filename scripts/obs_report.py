@@ -8,6 +8,8 @@ Sections: run header (identity/provenance), phase breakdown
 (SectionTimers drains), step trajectory, roofline trajectory (per-chunk
 it/s, MFU, HBM fraction), compile/recompile table, per-host heartbeat
 timeline, fleet liveness, serving latency, SLO histograms/breaches,
+QUALITY (served dB vs tenant floors, golden-probe timeline, drift
+verdicts, demotion advisories, the shadow-score ledger table),
 TRACES (the N slowest request timelines reassembled from span events),
 checkpoint/recovery/preemption events, final summary. This is the
 dashboard PERF.md sections are written from — and what bench.py points
@@ -881,6 +883,148 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
                 )
             )
 
+    # -- QUALITY: the quality observatory (serve.quality) — served
+    # dB per (bank, tenant, bucket) vs declared tenant floors, solve
+    # diagnostics read back at the dispatch fences, the golden-probe
+    # timeline, drift verdicts vs ledger history, demotion
+    # advisories, and the shadow-score table quality_gate.py judges.
+    q_hists = by.get("quality_histogram", [])
+    q_breach = by.get("quality_breach", [])
+    q_diags = by.get("quality_solve_diag", [])
+    q_probes = by.get("quality_probe", [])
+    q_pbreach = by.get("quality_probe_breach", [])
+    q_drift = by.get("quality_drift", [])
+    q_advice = by.get("quality_demote_advice", [])
+    if q_hists or q_probes or q_drift or q_advice or q_breach:
+        lines.append(_section("QUALITY"))
+        # newest snapshot per (bank, tenant, bucket): cumulative, so
+        # the last record IS the served-dB distribution. dB is
+        # better-is-higher, so the bad tail is the LOW percentiles —
+        # p10 is rendered where a latency section would render p99.
+        newest_q = {}
+        for h in q_hists:
+            key = (
+                h.get("bank_id"), h.get("tenant"), h.get("bucket"),
+                h.get("replica_id"),
+            )
+            newest_q[key] = h
+        breached_tenants = {
+            b.get("tenant"): b for b in q_breach if b.get("tenant")
+        }
+        for key in sorted(
+            newest_q, key=lambda k: tuple(str(x) for x in k)
+        ):
+            bank_id, tenant, bucket, rid = key
+            if rid is not None and (
+                (bank_id, tenant, bucket, None) in newest_q
+            ):
+                continue  # fleet-scope row supersedes replica rows
+            hist = _slo.from_snapshot(newest_q[key])
+            f = lambda v: "—" if v is None else f"{v:.2f}"
+            br = breached_tenants.get(tenant)
+            flag = (
+                f"  <-- BELOW FLOOR {br['min_psnr_db']:g} dB"
+                if br is not None else ""
+            )
+            lines.append(
+                f"  {(bank_id or '<default>'):<12} "
+                f"tenant={tenant or '—':<8} {bucket or '—':<12} "
+                f"n={hist.n}  p50 {f(hist.percentile(0.50))} dB  "
+                f"p10 {f(hist.percentile(0.10))} dB{flag}"
+            )
+        # solve diagnostics: newest per bucket (on-device objective
+        # split + stop reasons, read back at the existing fences)
+        newest_d = {}
+        for d_ in q_diags:
+            newest_d[d_.get("bucket")] = d_
+        for bname in sorted(newest_d, key=str):
+            d_ = newest_d[bname]
+            extra = (
+                f", obj fid/l1 {d_['obj_fid_mean']:.4g}"
+                f"/{d_['obj_l1_mean']:.4g}"
+                if d_.get("obj_fid_mean") is not None else ""
+            )
+            lines.append(
+                f"  solve {bname:<12} n={d_.get('n')}  iters "
+                f"{d_.get('iters_mean')}  tol-stop "
+                f"{100 * (d_.get('tol_stop_frac') or 0):.0f}%  "
+                f"maxit-stop "
+                f"{100 * (d_.get('maxit_stop_frac') or 0):.0f}%  "
+                f"nonfinite {d_.get('nonfinite')}{extra}"
+            )
+        if q_probes:
+            n_st = {}
+            for p_ in q_probes:
+                n_st[p_.get("status", "?")] = (
+                    n_st.get(p_.get("status", "?"), 0) + 1
+                )
+            lines.append(
+                f"  probes        {len(q_probes)} sweep result(s): "
+                + ", ".join(
+                    f"{n_st[s]} {s}" for s in sorted(n_st)
+                )
+            )
+            for p_ in q_pbreach[-5:]:
+                lines.append(
+                    f"    {_fmt_ts(p_['t'])}  BREACH {p_.get('probe')}"
+                    f"  bank {p_.get('bank_id') or '<default>'} @ "
+                    f"{(p_.get('digest') or '?')[:12]}: "
+                    f"{p_.get('db')} dB < ref {p_.get('ref_db')} dB"
+                )
+        for d_ in q_drift[-5:]:
+            lines.append(
+                f"  drift         {_fmt_ts(d_['t'])}  bank "
+                f"{d_.get('bank_id') or '<default>'} @ "
+                f"{(d_.get('digest') or '?')[:12]}: rolling "
+                f"{d_.get('rolling_db')} dB < band lo "
+                f"{d_.get('band_lo')} dB (history median "
+                f"{d_.get('median')} over {d_.get('n_history')})"
+            )
+        for a in q_advice:
+            lines.append(
+                f"  DEMOTE ADVICE {_fmt_ts(a['t'])}  bank "
+                f"{a.get('bank_id') or '<default>'}: "
+                f"{(a.get('from_digest') or '?')[:12]} -> "
+                f"{(a.get('to_digest') or '(no prior digest)')[:12]}"
+                f"  [{a.get('reason')}] — advisory only; the "
+                "operator decides the rollback"
+            )
+        # shadow-score table: the kind=quality ledger history the
+        # publish-time gate judges (scripts/quality_gate.py)
+        if ledger_path and os.path.exists(ledger_path):
+            from ccsc_code_iccv2017_tpu.analysis import (  # noqa: E402
+                ledger as _ledger,
+            )
+            from ccsc_code_iccv2017_tpu.serve import (  # noqa: E402
+                quality as _quality,
+            )
+
+            qled = _ledger.Ledger(ledger_path)
+            for key, recs in sorted(qled.by_key().items()):
+                recs = [
+                    r for r in recs if r.get("kind") == "quality"
+                ]
+                if not recs:
+                    continue
+                band = _quality.quality_band(
+                    [r["value"] for r in recs]
+                )
+                digests = {}
+                for r in recs:
+                    dg = (r.get("digest") or "?")[:12]
+                    digests[dg] = digests.get(dg, 0) + 1
+                lines.append(
+                    f"  shadow scores {key}\n"
+                    f"    n={len(recs)}  newest "
+                    f"{recs[-1]['value']:.2f} dB  median "
+                    f"{(band['median'] if band else 0.0):.2f} dB  "
+                    f"band lo {(band['lo'] if band else 0.0):.2f} dB"
+                    f"  [" + ", ".join(
+                        f"{dg}x{n}"
+                        for dg, n in sorted(digests.items())
+                    ) + "]"
+                )
+
     # -- SNAPSHOT: metrics.prom freshness (serve.metricsd stamp) -----
     if snapshot:
         lines.append(_section("SNAPSHOT"))
@@ -1109,6 +1253,8 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
                  "fleet_replica_restart", "fleet_replica_ready",
                  "fleet_replica_abandoned", "fleet_requeue",
                  "fleet_overload", "bank_swap", "tenant_reject",
+                 "quality_breach", "quality_probe_breach",
+                 "quality_drift", "quality_demote_advice",
                  "fed_join", "fed_leave",
                  "dqueue_requeue", "dqueue_failed",
                  "artifact_fetch", "artifact_publish",

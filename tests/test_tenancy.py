@@ -417,7 +417,10 @@ def test_fleet_metrics_carry_tenant_series(tmp_path):
 
 
 def test_snapshot_format_stamp_parse_unchanged(tmp_path):
-    from ccsc_code_iccv2017_tpu.serve.metricsd import MetricsD
+    from ccsc_code_iccv2017_tpu.serve.metricsd import (
+        SNAPSHOT_FORMAT,
+        MetricsD,
+    )
 
     snap = str(tmp_path / "metrics.prom")
     md = MetricsD(
@@ -428,7 +431,7 @@ def test_snapshot_format_stamp_parse_unchanged(tmp_path):
     ).start()
     md.stop()
     text = open(snap).read()
-    assert "ccsc_snapshot_format 2" in text
+    assert f"ccsc_snapshot_format {SNAPSHOT_FORMAT}" in text
     stamp = parse_snapshot_stamp(snap)  # the unchanged contract
     assert stamp is not None
     assert stamp["run_id"] == "fleet-test-1"
