@@ -94,6 +94,25 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     "fleet_duplicate_suppressed": _s("replica_id", "trace_id",
                                      "key"),
     "fleet_metricsd": _s("replica_id", "port"),
+    # -- request lifecycle (ISSUE 19; serve.fleet, serve.engine,
+    # serve.dqueue, serve.federation). deadline_exceeded is the
+    # expired-request refusal at whichever boundary the request died
+    # at (where = admission | engine | queue | claim | dispatch; the
+    # stamped absolute deadline rides along); request_cancelled the
+    # cooperative pre-dispatch withdrawal of a client-cancelled
+    # future; hedge_spawn/_win/_lost the hedged-attempt lifecycle
+    # (the loser is suppressed by the existing at-most-once fencing,
+    # never double-delivered); fleet_gray_replica the advisory
+    # slow-but-alive signal (sustained latency outlier vs the fleet
+    # median — distinct from the watchdog's stall detector) ----------
+    "deadline_exceeded": _s("where", "deadline"),
+    "request_cancelled": _s("where", "key"),
+    "hedge_spawn": _s("replica_id", "trace_id", "key",
+                      "waited_ms", "hedge_after_ms"),
+    "hedge_win": _s("replica_id", "trace_id", "key"),
+    "hedge_lost": _s("replica_id", "trace_id", "key"),
+    "fleet_gray_replica": _s("replica_id", "p50_ms",
+                             "fleet_p50_ms", "factor"),
     "fleet_replica_dead": _s("replica_id", "reason"),
     "fleet_replica_restart": _s("replica_id", "attempt"),
     "fleet_replica_ready": _s("replica_id", "generation"),

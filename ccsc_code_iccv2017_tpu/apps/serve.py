@@ -141,6 +141,14 @@ def build_parser() -> argparse.ArgumentParser:
         "counts against its quota and SLO histogram); default: "
         "untenanted traffic",
     )
+    p.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="end-to-end per-request deadline budget in ms: requests "
+        "still undelivered past it resolve as DeadlineExceeded "
+        "instead of waiting (terminal — the loop never resubmits an "
+        "expired request). Default: the tenant's deadline= spec, "
+        "else CCSC_REQ_DEADLINE_MS, unset = unbounded",
+    )
     src = p.add_mutually_exclusive_group()
     src.add_argument("--data", help="serve every image in this folder")
     src.add_argument(
@@ -320,7 +328,13 @@ def main(argv=None):
     from ..data.images import load_image_list
     from ..data.native import smooth_fill_batch
     from ..models.reconstruct import ReconstructionProblem
-    from ..serve import BucketCold, CodecEngine, Overloaded, ServeFleet
+    from ..serve import (
+        BucketCold,
+        CodecEngine,
+        DeadlineExceeded,
+        Overloaded,
+        ServeFleet,
+    )
     from ..utils.io_mat import load_filters_2d
 
     from ..utils import env as _env
@@ -623,9 +637,10 @@ def main(argv=None):
     rng = np.random.default_rng(args.seed)
     n_skipped = 0
     n_overloaded = 0
+    n_deadline = 0
 
     def _submit(x, label):
-        nonlocal n_skipped, n_overloaded
+        nonlocal n_skipped, n_overloaded, n_deadline
         mask = (rng.random(x.shape) < args.keep).astype(np.float32)
         sm = smooth_fill_batch(x[None], mask[None])[0]
         backoff = ResubmitBackoff()
@@ -634,7 +649,16 @@ def main(argv=None):
                 fut = engine.submit(
                     x * mask, mask=mask, smooth_init=sm, x_orig=x,
                     tenant=args.request_tenant,
+                    deadline_ms=args.deadline_ms,
                 )
+            except DeadlineExceeded as e:
+                # TERMINAL, unlike the retryable pair below: an
+                # expired budget cannot be fixed by backing off —
+                # a resubmit would only arrive deader. Count it and
+                # move to the next request.
+                print(f"  {label}: DEADLINE EXCEEDED ({e})")
+                n_deadline += 1
+                return None
             except (Overloaded, BucketCold) as e:
                 # explicit backpressure: the fleet told us how long
                 # to back off — honor the (already jittered,
@@ -688,13 +712,26 @@ def main(argv=None):
 
     pending = []
 
+    def _settle(label, fut):
+        # a deadline expiry lands ON THE FUTURE (the serving side
+        # resolved the request without solving it) — terminal for
+        # this request, not for the stream
+        nonlocal n_deadline
+        try:
+            res = fut.result(timeout=600)
+        except DeadlineExceeded as e:
+            print(f"  {label}: DEADLINE EXCEEDED ({e})")
+            n_deadline += 1
+            return
+        _finish(label, res)
+
     def _drain(block=False):
         # print results AS THEY COMPLETE: a long-lived stdin producer
         # must see live output, and holding every Future (+ recon)
         # until EOF would grow without bound
         while pending and (block or pending[0][1].done()):
             label, fut = pending.pop(0)
-            _finish(label, fut.result(timeout=600))
+            _settle(label, fut)
 
     MAX_IN_FLIGHT = 32
     try:
@@ -738,7 +775,7 @@ def main(argv=None):
                 _drain()
                 if len(pending) >= MAX_IN_FLIGHT:
                     label, fut = pending.pop(0)
-                    _finish(label, fut.result(timeout=600))
+                    _settle(label, fut)
                 n += 1
                 if args.limit and n >= args.limit:
                     break
@@ -764,7 +801,8 @@ def main(argv=None):
             f"{stats['n_requests']} requests over "
             f"{engine.replica_target} replica(s), "
             f"{stats['n_requeued']} requeued, "
-            f"{n_overloaded} overload backoff(s), p50 "
+            f"{n_overloaded} overload backoff(s), "
+            f"{n_deadline} deadline-expired, p50 "
             f"{stats['p50_latency_s'] * 1e3:.1f} ms, p99 "
             f"{stats['p99_latency_s'] * 1e3:.1f} ms"
         )

@@ -718,6 +718,14 @@ class TenantSpec:
     # tenant's quality contract). Only requests carrying ground
     # truth (x_orig) count toward the floor.
     min_psnr_db: Optional[float] = None
+    # Default end-to-end deadline (ms) stamped on this tenant's
+    # requests at fleet admission when the submit names none. The
+    # resolution ladder is explicit submit(deadline_ms=) > this >
+    # CCSC_REQ_DEADLINE_MS > no deadline — the env knob here IS a
+    # fallback (unlike the SLO targets) because a deadline is a
+    # safety bound, not a contract: a fleet-wide budget tightening
+    # every tenant is the conservative direction.
+    deadline_ms: Optional[float] = None
 
     def __post_init__(self):
         if not self.tenant or not isinstance(self.tenant, str):
@@ -725,7 +733,9 @@ class TenantSpec:
                 f"tenant must be a non-empty string, got "
                 f"{self.tenant!r}"
             )
-        for fname in ("slo_p50_ms", "slo_p99_ms", "min_psnr_db"):
+        for fname in (
+            "slo_p50_ms", "slo_p99_ms", "min_psnr_db", "deadline_ms"
+        ):
             v = getattr(self, fname)
             if v is not None and v <= 0:
                 raise ValueError(
@@ -891,6 +901,27 @@ class FleetConfig:
     # regressions emit quality_probe_breach + a demotion advisory.
     # None = CCSC_PROBE_INTERVAL_S (unset/0 = probing off).
     probe_interval_s: Optional[float] = None
+    # Request lifecycle (ISSUE 19) --------------------------------
+    # Fleet-wide default end-to-end deadline (ms) for requests whose
+    # submit and tenant name none. None = the CCSC_REQ_DEADLINE_MS
+    # env knob (unset = no deadline).
+    deadline_ms: Optional[float] = None
+    # Hedged attempts against gray replicas: an attempt that has been
+    # in flight longer than hedge_after_ms is re-enqueued on a
+    # DIFFERENT replica; first result wins through the at-most-once
+    # fencing, the loser is suppressed-and-counted. None =
+    # CCSC_HEDGE_AFTER_MS, else adaptive: the hedge_quantile of the
+    # fleet's recent delivery-latency histogram (so "anomalously
+    # slow" tracks the workload instead of a magic number).
+    hedge_after_ms: Optional[float] = None
+    # Latency quantile the adaptive hedge_after derives from. None =
+    # CCSC_HEDGE_QUANTILE (default 0.95).
+    hedge_quantile: Optional[float] = None
+    # Cap on hedges as a fraction of admitted requests — hedging must
+    # never amplify an overload into a retry storm. None =
+    # CCSC_HEDGE_MAX_FRAC (default 0 = hedging OFF; setting this > 0
+    # is how hedging is enabled).
+    hedge_max_frac: Optional[float] = None
 
     def __post_init__(self):
         if (
@@ -901,12 +932,29 @@ class FleetConfig:
                 f"probe_interval_s must be >= 0, got "
                 f"{self.probe_interval_s}"
             )
-        for fname in ("slo_p50_ms", "slo_p99_ms"):
+        for fname in (
+            "slo_p50_ms", "slo_p99_ms", "deadline_ms",
+            "hedge_after_ms",
+        ):
             v = getattr(self, fname)
             if v is not None and v <= 0:
                 raise ValueError(
                     f"{fname} must be > 0 when set, got {v}"
                 )
+        if self.hedge_quantile is not None and not (
+            0.0 < self.hedge_quantile < 1.0
+        ):
+            raise ValueError(
+                f"hedge_quantile must be in (0, 1), got "
+                f"{self.hedge_quantile}"
+            )
+        if self.hedge_max_frac is not None and not (
+            0.0 <= self.hedge_max_frac <= 1.0
+        ):
+            raise ValueError(
+                f"hedge_max_frac must be in [0, 1], got "
+                f"{self.hedge_max_frac}"
+            )
         if self.metricsd_port is not None and self.metricsd_port < 0:
             raise ValueError(
                 f"metricsd_port must be >= 0, got {self.metricsd_port}"

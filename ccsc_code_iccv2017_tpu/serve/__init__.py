@@ -61,6 +61,7 @@ from .dqueue import DurableQueue  # noqa: F401
 from .engine import (  # noqa: F401
     BucketCold,
     CodecEngine,
+    DeadlineExceeded,
     ServedResult,
     enable_compile_cache,
     pick_bucket,

@@ -304,10 +304,18 @@ class DurableQueue:
         x_orig: Optional[np.ndarray] = None,
         trace_id: Optional[str] = None,
         root_span: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> str:
         """Durably enqueue one request; returns the item file name.
         A spent key (already served or already failed) is refused —
-        the key resolved once, ever, anywhere in the pool."""
+        the key resolved once, ever, anywhere in the pool.
+
+        ``deadline`` is the request's ABSOLUTE wall-clock expiry
+        (``time.time()`` seconds, stamped by the client): the item
+        record carries the remaining budget across host boundaries,
+        so a hand-off SHRINKS what is left instead of resetting it,
+        and a claim of an already-expired item resolves it with a
+        durable ``deadline`` error result instead of solving it."""
         if os.path.exists(self._spent_path(key)):
             raise ValueError(
                 f"idempotency key {key!r} is spent (already served or "
@@ -329,6 +337,9 @@ class DurableQueue:
             "max_attempts": self.max_attempts,
             "trace_id": trace_id,
             "root_span": root_span,
+            "deadline": (
+                None if deadline is None else float(deadline)
+            ),
             "b": self._store_array(b),
             "mask": self._store_array(mask),
             "smooth_init": self._store_array(smooth_init),
@@ -394,6 +405,14 @@ class DurableQueue:
                 except OSError:
                     pass
                 continue
+            dl = rec.get("deadline")
+            if dl is not None and time.time() >= float(dl):
+                # the client's end-to-end budget expired while the
+                # item sat queued/handed-off: resolve it durably as
+                # a deadline error INSTEAD of solving — no solve
+                # slot is ever spent on a request nobody waits for
+                self._resolve_expired(rec, dst)
+                continue
             rec["attempts"] = int(rec.get("attempts", 0)) + 1
             rec["lease_host"] = self.host
             rec["lease_epoch"] = self.epoch
@@ -418,6 +437,54 @@ class DurableQueue:
                     self.path, _CORRUPT, os.path.basename(path)
                 ),
             )
+        except OSError:
+            pass
+
+    def _resolve_expired(
+        self, rec: Dict[str, Any], lease_path: str
+    ) -> None:
+        """Durably resolve a claimed-but-expired item: ``deadline``
+        error result + spent marker + lease unlink. The result is
+        first-wins like every other resolution — a racing owner that
+        somehow completed it keeps its record."""
+        key = rec["key"]
+        dl = float(rec["deadline"])
+        err = {
+            "schema": _SCHEMA,
+            "key": key,
+            "status": "deadline",
+            "error": (
+                f"request {key!r} exceeded its deadline "
+                f"({dl:.3f}) before any host could serve it"
+            ),
+            "deadline": dl,
+            "host": self.host,
+            "epoch": self.epoch,
+            "attempts": int(rec.get("attempts", 0)),
+            "t": time.time(),
+        }
+        _publish_json(self._result_path(key), err)
+        if self._mark_spent(key, "deadline"):
+            self._emit(
+                "deadline_exceeded", where="claim",
+                deadline=round(dl, 3), key=key, host=self.host,
+            )
+            if rec.get("trace_id"):
+                # the expiry is this request's terminal ownership
+                # story: written start+end together so the trace
+                # reassembles complete without a live owner
+                trace_util.emit_span(
+                    self._emit,
+                    trace_id=rec["trace_id"],
+                    span="attempt",
+                    parent_span=rec.get("root_span"),
+                    t_start=time.time(),
+                    t_end=time.time(),
+                    status="deadline",
+                    host=self.host,
+                )
+        try:
+            os.unlink(lease_path)
         except OSError:
             pass
 
@@ -604,6 +671,44 @@ class DurableQueue:
                 host=self.host,
                 attempt=int(item.get("attempts", 0)),
             )
+        return True
+
+    def expire(self, item: Dict[str, Any]) -> None:
+        """Resolve one of OUR claimed items as deadline-expired —
+        the drain worker's path when the budget runs out after the
+        claim (e.g. while the item sat deferred behind an Overloaded
+        fleet, or when fleet admission refuses it as already dead)."""
+        self._resolve_expired(
+            item,
+            os.path.join(self._lease_dir(self.host), item["name"]),
+        )
+
+    def cancel(self, key: str) -> bool:
+        """Durable cooperative cancellation of a still-unresolved
+        key: cancelled result record + spent marker. After this, a
+        later claim of the (queued or requeued) item drops it at the
+        spent-key fence instead of solving it — the cross-host twin
+        of the fleet's pre-dispatch cancel sweep. False when the key
+        already resolved (the result stands; cancellation lost the
+        race, which is the at-most-once contract, not an error)."""
+        rec = {
+            "schema": _SCHEMA,
+            "key": key,
+            "status": "cancelled",
+            "host": self.host,
+            "epoch": self.epoch,
+            "t": time.time(),
+        }
+        # first-wins on the RESULT record, same as complete/fail: if
+        # a host already published an outcome, the cancel lost and
+        # that outcome stands (its own _mark_spent follows)
+        if not _publish_json(self._result_path(key), rec):
+            return False
+        self._mark_spent(key, "cancelled")
+        self._emit(
+            "request_cancelled", where="dqueue", key=key,
+            host=self.host,
+        )
         return True
 
     def release(self, item: Dict[str, Any]) -> bool:

@@ -227,6 +227,29 @@ class BucketCold(RuntimeError):
         self.retry_after_s = float(retry_after_s)
 
 
+class DeadlineExceeded(RuntimeError):
+    """Refusal for a request whose end-to-end deadline (absolute
+    wall-clock epoch seconds, stamped at admission) expired before a
+    solve slot would have been spent on it. Raised at every boundary
+    a dead request can be caught at — fleet admission, the engine's
+    pre-dispatch queue sweep, a durable-queue claim — with the same
+    emit-outside-the-lock refusal discipline as ``Overloaded``/
+    ``BucketCold``. Defined here beside ``BucketCold`` for the same
+    reason: the engine must not import the fleet, and both layers
+    refuse with it. Carries the stamped deadline and where the
+    request died (``admission`` | ``engine`` | ``queue`` | ``claim``
+    | ``dispatch``) so the refusal is auditable from the exception
+    alone, matching the ``deadline_exceeded`` obs event."""
+
+    def __init__(self, where: str, deadline: float):
+        super().__init__(
+            f"request deadline expired at {where} (deadline epoch "
+            f"{deadline:.3f}, now past it)"
+        )
+        self.where = where
+        self.deadline = float(deadline)
+
+
 class ServedResult(NamedTuple):
     """One request's result, cropped back to the request shape."""
 
@@ -282,6 +305,11 @@ class _Pending:
     # workload-capture key (serve.capture; standalone engines only):
     # pairs this request's capture record with its outcome digest
     cap_key: Optional[str] = None
+    # absolute end-to-end deadline (wall-clock epoch seconds, the
+    # fleet-admission stamp); None = no deadline. The work loop
+    # expires dead requests BEFORE they cost a solve slot and never
+    # micro-batch-waits past the earliest in-queue deadline.
+    deadline: Optional[float] = None
 
 
 def _bucket_name(slots: int, spatial: Tuple[int, ...]) -> str:
@@ -1104,9 +1132,11 @@ class CodecEngine:
         self, b, mask=None, smooth_init=None, x_orig=None,
         bank_id: Optional[str] = None,
         tenant: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
         _validated: bool = False,
         _trace: Optional[Tuple[str, Optional[str]]] = None,
         _digest: Optional[str] = None,
+        _deadline: Optional[float] = None,
     ) -> "Future[ServedResult]":
         """Enqueue one observation [*reduce, *spatial] (no batch axis);
         returns a Future resolving to :class:`ServedResult`. Only the
@@ -1127,7 +1157,14 @@ class CodecEngine:
         replica handoffs; a standalone submit gets a fresh trace_id
         and the engine emits the root span itself. ``_digest`` is the
         fleet's admission-time digest binding — the fleet owns the
-        routing table, the engine just serves the named plan."""
+        routing table, the engine just serves the named plan.
+        ``deadline_ms`` bounds the request end-to-end (relative,
+        converted to an absolute wall-clock stamp here); ``_deadline``
+        is the fleet/federation-internal ABSOLUTE stamp from the
+        original admission, which cross-layer hand-offs must carry
+        unchanged so the budget shrinks instead of resetting. An
+        already-expired request is refused with
+        :class:`DeadlineExceeded` before it costs anything."""
         from ..utils import validate
 
         if not _validated:
@@ -1135,6 +1172,15 @@ class CodecEngine:
                 b, self.geom, mask=mask, smooth_init=smooth_init,
                 x_orig=x_orig,
             )
+        deadline = _deadline
+        if deadline is None and deadline_ms is not None:
+            deadline = time.time() + float(deadline_ms) / 1e3
+        if deadline is not None and time.time() >= deadline:
+            self._emit(
+                "deadline_exceeded", where="engine",
+                deadline=round(deadline, 3),
+            )
+            raise DeadlineExceeded("engine", deadline)
         if _trace is None:
             trace_id, parent_span, own_root = (
                 trace_util.new_trace_id(), None, True,
@@ -1162,6 +1208,7 @@ class CodecEngine:
             trace_id=trace_id,
             parent_span=parent_span,
             own_root=own_root,
+            deadline=deadline,
         )
         cold_retry: Optional[float] = None
         with self._cv:
@@ -1259,6 +1306,7 @@ class CodecEngine:
     # ------------------------------------------------------------------
     def _work_loop(self):
         while True:
+            expired: List[_Pending] = []
             with self._cv:
                 while not self._closed and self._n_pending == 0:
                     self._cv.wait()
@@ -1269,33 +1317,85 @@ class CodecEngine:
                 # its notify lands us back here with the fresh value
                 max_wait = self._max_wait_s
                 now = time.perf_counter()
-                # deadline-expired buckets flush FIRST: a steady stream
-                # keeping one bucket full must not starve another
-                # bucket's lone request past its max_wait_ms contract
-                ok, ot = None, None
+                # ISSUE 19: expire already-dead requests BEFORE they
+                # cost a solve slot — swept out of the lanes under the
+                # lock, futures failed outside it (refusal discipline:
+                # never emit under a held lock). dl_min is the
+                # earliest surviving deadline; the micro-batch flush
+                # below must never wait past it.
+                wall = time.time()
+                dl_min = None
                 for k, lst in self._pending.items():
-                    if lst and (ot is None or lst[0].t_submit < ot):
-                        ok, ot = k, lst[0].t_submit
-                if self._closed or (ot is not None
-                                    and now >= ot + max_wait):
-                    key = ok
-                else:
-                    key = None
-                    for k, lst in self._pending.items():
-                        # k = ((slots, spatial), digest): a full
-                        # bank-lane flushes immediately
-                        if lst and len(lst) >= k[0][0]:
-                            key = k
-                            break
-                    if key is None:
-                        self._cv.wait(timeout=ot + max_wait - now)
+                    if not lst:
                         continue
-                slots_k = key[0][0]
-                batch = self._pending[key][:slots_k]
-                self._pending[key] = self._pending[key][slots_k:]
-                self._n_pending -= len(batch)
-                depth_after = self._n_pending
-                self._dispatch_digest = key[1]
+                    keep = []
+                    for p in lst:
+                        if p.deadline is not None and wall >= p.deadline:
+                            expired.append(p)
+                        else:
+                            keep.append(p)
+                            if p.deadline is not None:
+                                dl_min = (
+                                    p.deadline if dl_min is None
+                                    else min(dl_min, p.deadline)
+                                )
+                    if len(keep) != len(lst):
+                        self._pending[k] = keep
+                self._n_pending -= len(expired)
+                if expired:
+                    key = None
+                else:
+                    # oldest-lane flush FIRST: a steady stream keeping
+                    # one bucket full must not starve another bucket's
+                    # lone request past its max_wait_ms contract
+                    ok, ot = None, None
+                    for k, lst in self._pending.items():
+                        if lst and (ot is None or lst[0].t_submit < ot):
+                            ok, ot = k, lst[0].t_submit
+                    if self._closed or (ot is not None
+                                        and now >= ot + max_wait):
+                        key = ok
+                    else:
+                        key = None
+                        for k, lst in self._pending.items():
+                            # k = ((slots, spatial), digest): a full
+                            # bank-lane flushes immediately
+                            if lst and len(lst) >= k[0][0]:
+                                key = k
+                                break
+                        if key is None:
+                            t_wait = ot + max_wait - now
+                            if dl_min is not None:
+                                # cap the wait at the earliest
+                                # in-queue deadline: expiry must be
+                                # noticed when it happens, not at the
+                                # micro-batch flush after it
+                                t_wait = min(
+                                    t_wait,
+                                    max(dl_min - wall, 0.0) + 1e-3,
+                                )
+                            self._cv.wait(timeout=t_wait)
+                            continue
+                    slots_k = key[0][0]
+                    batch = self._pending[key][:slots_k]
+                    self._pending[key] = self._pending[key][slots_k:]
+                    self._n_pending -= len(batch)
+                    depth_after = self._n_pending
+                    self._dispatch_digest = key[1]
+            if expired:
+                for p in expired:
+                    # a client-cancelled future is dropped silently
+                    # (its own withdrawal event fires fleet-side); a
+                    # live one fails with the stamped refusal
+                    if p.future.set_running_or_notify_cancel():
+                        p.future.set_exception(
+                            DeadlineExceeded("dispatch", p.deadline)
+                        )
+                        self._emit(
+                            "deadline_exceeded", where="dispatch",
+                            deadline=round(p.deadline, 3),
+                        )
+                continue
             # transition futures to RUNNING; a client-cancelled request
             # is dropped HERE — set_result on a cancelled Future raises
             # InvalidStateError, which would poison its batch siblings

@@ -56,7 +56,12 @@ import numpy as np
 from ..utils import env as _env
 from ..utils import trace as trace_util
 from .dqueue import DurableQueue
-from .fleet import BucketCold, Overloaded, ServeFleet
+from .fleet import (
+    BucketCold,
+    DeadlineExceeded,
+    Overloaded,
+    ServeFleet,
+)
 
 __all__ = [
     "FederatedHost",
@@ -96,6 +101,7 @@ class _PendingReq:
     t_wall: float
     trace_id: str
     root_span: str
+    deadline: Optional[float] = None  # absolute wall clock
 
 
 class FederatedFrontend:
@@ -136,6 +142,7 @@ class FederatedFrontend:
         self.n_submitted = 0
         self.n_delivered = 0
         self.n_failed = 0
+        self.n_cancelled = 0
         self._closed = False
         self._stop = threading.Event()
         self._poller = threading.Thread(
@@ -155,16 +162,32 @@ class FederatedFrontend:
         smooth_init=None,
         x_orig=None,
         key: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
     ) -> "Future[FederatedResult]":
         """Durably enqueue one request for the host pool; returns a
         Future resolved by the poller once ANY host delivers (or the
         pool fails it). A spent key is refused (ValueError) — the
-        cross-host exactly-once-or-error contract."""
+        cross-host exactly-once-or-error contract.
+
+        ``deadline_ms`` (default ``CCSC_REQ_DEADLINE_MS``) is the
+        END-TO-END budget, stamped here as an absolute wall clock on
+        the durable item — every hand-off downstream (claim, fleet
+        admission, engine dispatch) sees the REMAINING budget shrink,
+        and an expired item resolves as a durable ``deadline`` error
+        instead of being solved. Cancelling the returned future is
+        cooperative cancellation: the poller writes a durable cancel
+        marker so no host ever solves the withdrawn request."""
         if self._closed:
             raise RuntimeError("frontend is closed")
+        if deadline_ms is None:
+            deadline_ms = _env.env_float("CCSC_REQ_DEADLINE_MS")
         trace_id = trace_util.new_trace_id()
         root_span = trace_util.new_span_id()
         t_wall = time.time()
+        deadline = (
+            None if deadline_ms is None
+            else t_wall + float(deadline_ms) / 1e3
+        )
         with self._lock:
             self._seq += 1
             if key is None:
@@ -185,6 +208,7 @@ class FederatedFrontend:
                 t_wall=t_wall,
                 trace_id=trace_id,
                 root_span=root_span,
+                deadline=deadline,
             )
             self._pending[key] = req
             self.n_submitted += 1
@@ -201,6 +225,7 @@ class FederatedFrontend:
                 x_orig=x_orig,
                 trace_id=trace_id,
                 root_span=root_span,
+                deadline=deadline,
             )
         except BaseException as e:
             # a refused (spent) or failed durable write un-registers
@@ -221,6 +246,9 @@ class FederatedFrontend:
             span_id=root_span,
             ts=t_wall,
             key=key,
+            deadline=(
+                None if deadline is None else round(deadline, 3)
+            ),
         )
         return req.future
 
@@ -256,10 +284,38 @@ class FederatedFrontend:
     def _poll_once(self) -> int:
         from .dqueue import safe_key
 
+        cancelled: List[_PendingReq] = []
         with self._lock:
+            for ckey, creq in list(self._pending.items()):
+                if creq.future.cancelled():
+                    # cooperative cancellation: the client gave up on
+                    # the future, so withdraw the durable item too —
+                    # without the marker the item would stay live in
+                    # the queue forever and some host would solve
+                    # work nobody awaits
+                    self._pending.pop(ckey, None)
+                    cancelled.append(creq)
             keys = list(self._pending)
+        for creq in cancelled:
+            # durable cancel marker (spent fence): a later claim of
+            # the queued/requeued item refuses it. Resolving the
+            # pending entry keeps key-reuse policy-consistent with
+            # spent keys — a resubmit of the key is refused by the
+            # queue, not silently re-registered.
+            self.queue.cancel(creq.key)
+            with self._lock:
+                self.n_cancelled += 1
+            trace_util.end_span(
+                self._emit,
+                trace_id=creq.trace_id,
+                span=trace_util.ROOT_SPAN,
+                span_id=creq.root_span,
+                status="cancelled",
+                t_start=creq.t_wall,
+                key=creq.key,
+            )
         if not keys:
-            return 0
+            return len(cancelled)
         # one directory scan per tick, then read only the records
         # that actually landed — N pending keys must not cost N
         # open() round trips against a shared (possibly remote)
@@ -310,16 +366,27 @@ class FederatedFrontend:
                 trace_id=req.trace_id,
             )
         elif err is None:
-            err = RuntimeError(
-                rec.get("error")
-                or f"request {req.key!r} failed in the host pool"
-            )
+            if status == "deadline":
+                # the pool durably refused the expired item — the
+                # client sees the SAME exception type the in-process
+                # fleet raises, with the stamped deadline attached
+                err = DeadlineExceeded(
+                    "claim", float(rec.get("deadline") or 0.0)
+                )
+            else:
+                err = RuntimeError(
+                    rec.get("error")
+                    or f"request {req.key!r} failed in the host pool"
+                )
+        span_status = "ok" if ok else (
+            status if status in ("deadline", "cancelled") else "error"
+        )
         trace_util.end_span(
             self._emit,
             trace_id=req.trace_id,
             span=trace_util.ROOT_SPAN,
             span_id=req.root_span,
-            status="ok" if ok else "error",
+            status=span_status,
             t_start=req.t_wall,
             key=req.key,
             attempts=int(rec.get("attempts", 0)),
@@ -375,6 +442,7 @@ class FederatedFrontend:
                 n_submitted=self.n_submitted,
                 n_delivered=self.n_delivered,
                 n_failed=self.n_failed,
+                n_cancelled=self.n_cancelled,
             )
 
     def __enter__(self):
@@ -588,6 +656,15 @@ class FederatedHost:
     def _dispatch(self, item: Dict[str, Any]) -> None:
         from ..utils import validate
 
+        dl = item.get("deadline")
+        dl = None if dl is None else float(dl)
+        if dl is not None and time.time() >= dl:
+            # the budget ran out AFTER our claim (typically while the
+            # item sat deferred behind an Overloaded/BucketCold
+            # fleet): resolve it durably as expired before paying for
+            # the payload loads
+            self.queue.expire(item)
+            return
         try:
             arrays = {
                 f: self.queue.load_array(item.get(f))
@@ -610,7 +687,16 @@ class FederatedHost:
                 smooth_init=arrays["smooth_init"],
                 x_orig=arrays["x_orig"],
                 key=fkey,
+                # ABSOLUTE pass-through: the remaining budget shrinks
+                # through the hand-off instead of resetting
+                _deadline=dl,
             )
+        except DeadlineExceeded:
+            # fleet admission judged it already dead (must be caught
+            # BEFORE the RuntimeError release path — expiry is a
+            # verdict on the request, not on this host's fleet)
+            self.queue.expire(item)
+            return
         except (Overloaded, BucketCold) as e:
             # explicit backpressure: hold OUR lease (heartbeats keep
             # it live) and re-offer after the jittered hint. A
@@ -645,6 +731,12 @@ class FederatedHost:
         self._inflight.pop(item["name"], None)
         try:
             res = fut.result()
+        except DeadlineExceeded:
+            # expired inside the fleet/engine mid-ownership: the
+            # durable resolution says deadline, not error — the
+            # client can tell honesty from failure
+            self.queue.expire(item)
+            return
         except BaseException as e:
             if self._stop.is_set() or self.fleet.closed:
                 # shutdown, not a verdict on the request: hand the

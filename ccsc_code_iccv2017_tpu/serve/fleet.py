@@ -88,7 +88,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future, InvalidStateError
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -104,13 +104,17 @@ from . import tenancy as _tenancy
 from .engine import (
     BucketCold,
     CodecEngine,
+    DeadlineExceeded,
     ServedResult,
     _bucket_name,
     parse_mesh_shape,
     pick_bucket,
 )
 
-__all__ = ["ServeFleet", "Overloaded", "BucketCold", "RUNGS"]
+__all__ = [
+    "ServeFleet", "Overloaded", "BucketCold", "DeadlineExceeded",
+    "RUNGS",
+]
 
 # the overload ladder, least to most drastic
 RUNGS = ("normal", "shed_batching", "reject", "degrade")
@@ -174,6 +178,24 @@ class _FleetRequest:
     t_wall: float = 0.0  # wall-clock submit time (span timestamps)
     queue_t: float = 0.0  # wall-clock start of the open queue episode
     attempt_t: float = 0.0  # wall-clock start of the open ownership
+    # -- request lifecycle (ISSUE 19). deadline is the ABSOLUTE
+    # end-to-end budget (wall-clock epoch seconds) stamped at
+    # admission; None = unbounded. A hedged request exists as TWO
+    # _FleetRequest instances sharing key/future/trace_id/root_span:
+    # the original (hedged=True once its clone is queued) and the
+    # clone (hedge_of=True), each with its own queue/attempt span
+    # slots so both attempts are visible in the reassembled trace.
+    # `primary` points the clone at the original — the shared
+    # root-span claim (root_done) lives on ONE instance so the two
+    # delivery races can never double-end the root. `not_replica`
+    # excludes the clone from the replica whose slow attempt it
+    # hedges against (first result wins through the _delivered
+    # fencing; the loser ends its attempt span `hedge_lost`).
+    deadline: Optional[float] = None
+    hedged: bool = False
+    hedge_of: bool = False
+    not_replica: Optional[int] = None
+    primary: Optional["_FleetRequest"] = None
 
 
 class _Replica:
@@ -407,6 +429,24 @@ class ServeFleet:
         self._n_duplicates = 0
         self._n_rejected = 0
         self._n_failed = 0
+        # -- request lifecycle (ISSUE 19): deadline/cancel/hedge
+        # counters; per-replica recent-latency histograms (engine-
+        # side solve latency, so fleet queueing noise — identical
+        # across replicas — can't mask a gray one) feeding the
+        # adaptive hedge_after quantile and the gray-failure scores
+        self._n_admitted = 0
+        self._n_deadline = 0
+        self._n_cancelled = 0
+        self._n_hedges = 0
+        self._n_hedge_wins = 0
+        self._lat_hist = _slo.Histogram()
+        self._rep_hist: Dict[int, _slo.Histogram] = {}
+        # replica ids currently judged gray (sustained latency
+        # outlier vs the fleet median — slow-but-alive, DISTINCT from
+        # the watchdog's stall detector) + their latest factor; the
+        # fleet_gray_replica advisory fires once per excursion
+        self._gray_now: set = set()
+        self._gray_score: Dict[int, float] = {}
         self._restarts: Dict[int, int] = {}
         self._replicas: List[Optional[_Replica]] = [None] * (
             fleet_cfg.replicas
@@ -678,6 +718,13 @@ class ServeFleet:
                 "duplicates_suppressed_total": self._n_duplicates,
                 "failed_total": self._n_failed,
                 "probe_failures_total": self._n_probe_failures,
+                # request lifecycle (ISSUE 19): rendered as
+                # ccsc_hedges_total / ccsc_hedge_wins_total /
+                # ccsc_deadline_exceeded_total / ccsc_cancelled_total
+                "hedges_total": self._n_hedges,
+                "hedge_wins_total": self._n_hedge_wins,
+                "deadline_exceeded_total": self._n_deadline,
+                "cancelled_total": self._n_cancelled,
             }
             n_live = sum(
                 1 for r in self._replicas
@@ -697,6 +744,9 @@ class ServeFleet:
                 # tenants currently judged below their declared dB
                 # floor (ccsc_quality_breach — 0 is healthy)
                 "quality_breach": self._quality.n_breached,
+                # replicas currently judged gray (slow-but-alive
+                # latency outliers — 0 is healthy)
+                "gray_replicas": len(self._gray_now),
             }
             gauges.update(self._ctrl_gauges)
             # per-tenant labeled series: the shared constructor
@@ -1131,7 +1181,9 @@ class ServeFleet:
                     self._remember(self._failed_keys, r.key)
                     if r.trace_id is not None:
                         att, r.attempt_span = r.attempt_span, None
-                        owed = not r.root_done
+                        pr = r.primary or r
+                        owed = not pr.root_done
+                        pr.root_done = True
                         r.root_done = True
                         fail_spans.append((r, att, r.attempt_t, owed))
                 else:
@@ -1209,6 +1261,10 @@ class ServeFleet:
         att_span = None
         att_t = 0.0
         root_owed = False
+        hedge_won = False
+        lost_span = None
+        lost_rep = None
+        lost_t = 0.0
         with self._cv:
             # a key whose future already carries an error (max_attempts
             # exhausted) is as spent as a served one: recording a late
@@ -1228,16 +1284,39 @@ class ServeFleet:
                         self._tenant_delivered.get(req.tenant, 0) + 1
                     )
                 rep.served += 1
+                # per-replica recent-latency histograms (engine-side
+                # solve time): the gray-failure scores and the
+                # adaptive hedge_after quantile read these
+                self._lat_hist.observe(res.latency_s * 1e3)
+                self._rep_hist.setdefault(
+                    rep.id, _slo.Histogram()
+                ).observe(res.latency_s * 1e3)
+                if req.hedge_of:
+                    # the hedged duplicate beat the original attempt
+                    self._n_hedge_wins += 1
+                    hedge_won = True
                 # claim the open spans under the lock: a racing
-                # requeue/close path can then never double-end them
+                # requeue/close path can then never double-end them.
+                # The root claim goes through the PRIMARY instance so
+                # a hedge pair's two delivery paths can never
+                # double-end the shared root span.
                 if req.trace_id is not None:
                     att_span, req.attempt_span = req.attempt_span, None
                     att_rep = req.attempt_rep
                     att_t = req.attempt_t
-                    root_owed = not req.root_done
+                    pr = req.primary or req
+                    root_owed = not pr.root_done
+                    pr.root_done = True
                     req.root_done = True
             else:
                 self._n_duplicates += 1
+                # a hedge loser's attempt span is still OPEN (neither
+                # requeue nor delivery claimed it): close it as the
+                # suppressed half of the race
+                if (req.hedged or req.hedge_of) and req.attempt_span:
+                    lost_span, req.attempt_span = req.attempt_span, None
+                    lost_rep = req.attempt_rep
+                    lost_t = req.attempt_t
             try:
                 rep.assigned.remove(req)
             except ValueError:
@@ -1251,6 +1330,18 @@ class ServeFleet:
                 trace_id=req.trace_id, key=req.key,
                 failed_key=req.key in self._failed_keys,
             )
+            if lost_span is not None:
+                owner = rep.id if lost_rep is None else lost_rep
+                trace_util.end_span(
+                    self._emit, trace_id=req.trace_id, span="attempt",
+                    span_id=lost_span, parent_span=req.root_span,
+                    replica_id=owner, status="hedge_lost",
+                    ts=time.time(), t_start=lost_t,
+                )
+                self._emit(
+                    "hedge_lost", replica_id=owner,
+                    trace_id=req.trace_id, key=req.key,
+                )
             return
         self._slo.observe("total", lat * 1e3)
         # the tenant's OWN histogram: per-tenant p50/p99 vs declared
@@ -1304,6 +1395,11 @@ class ServeFleet:
                 status="ok", ts=wall, t_start=req.t_wall,
                 attempts=req.attempts,
             )
+        if hedge_won:
+            self._emit(
+                "hedge_win", replica_id=rep.id,
+                trace_id=req.trace_id, key=req.key,
+            )
         self._emit(
             "fleet_request", replica_id=rep.id, trace_id=req.trace_id,
             key=req.key, attempts=req.attempts, bucket=res.bucket,
@@ -1330,6 +1426,8 @@ class ServeFleet:
         # attempt_span_id, req, attempt_no, t_queue) for takes
         dropped: List = []
         taken: List = []
+        expired: List[_FleetRequest] = []
+        cancelled: List[_FleetRequest] = []
         with self._cv:
             while True:
                 if rep.retired:
@@ -1342,6 +1440,7 @@ class ServeFleet:
             # span clock AFTER the wait: this is when the take happens
             wall = time.time()
             batch: List[_FleetRequest] = []
+            skipped: List[_FleetRequest] = []
             while self._queue and len(batch) < self._take_cap:
                 req = self._queue.popleft()
                 if (
@@ -1356,24 +1455,62 @@ class ServeFleet:
                         qs, req.queue_span = req.queue_span, None
                         dropped.append((qs, req, "dropped", False))
                     continue
-                if req.attempts == 0:
+                if req.deadline is not None and wall >= req.deadline:
+                    # already dead: refusing here costs a queue pop,
+                    # solving it would waste a full solve slot. Marked
+                    # failed so a late hedge-twin delivery suppresses
+                    # as a duplicate.
+                    self._index.pop(req.key, None)
+                    self._remember(self._failed_keys, req.key)
+                    self._n_deadline += 1
+                    expired.append(req)
+                    if req.trace_id is not None and req.queue_span:
+                        qs, req.queue_span = req.queue_span, None
+                        pr = req.primary or req
+                        owed = not pr.root_done
+                        pr.root_done = True
+                        req.root_done = True
+                        dropped.append((qs, req, "deadline", owed))
+                    continue
+                if req.attempts == 0 and not req.hedge_of:
                     if not req.future.set_running_or_notify_cancel():
                         self._index.pop(req.key, None)
+                        self._n_cancelled += 1
+                        cancelled.append(req)
                         if req.trace_id is not None and req.queue_span:
                             qs, req.queue_span = req.queue_span, None
-                            owed = not req.root_done
+                            pr = req.primary or req
+                            owed = not pr.root_done
+                            pr.root_done = True
                             req.root_done = True
                             dropped.append(
                                 (qs, req, "cancelled", owed)
                             )
                         continue  # client cancelled while queued
                 elif req.future.cancelled():
+                    # hedge clones share the primary's (already
+                    # running) future, so they always land here; count
+                    # the cancellation once, on the primary instance
                     self._index.pop(req.key, None)
+                    if not req.hedge_of:
+                        self._n_cancelled += 1
+                        cancelled.append(req)
                     if req.trace_id is not None and req.queue_span:
                         qs, req.queue_span = req.queue_span, None
-                        owed = not req.root_done
+                        pr = req.primary or req
+                        owed = not pr.root_done
+                        pr.root_done = True
                         req.root_done = True
                         dropped.append((qs, req, "cancelled", owed))
+                    continue
+                if req.not_replica == rep.id or (
+                    req.hedge_of and rep.id in self._gray_now
+                ):
+                    # a hedge clone must land on a DIFFERENT replica
+                    # than its primary's attempt, and not on one
+                    # currently scored gray — a hedge onto the slow
+                    # replica would be no hedge at all
+                    skipped.append(req)
                     continue
                 req.attempts += 1
                 if req.trace_id is not None:
@@ -1387,6 +1524,12 @@ class ServeFleet:
                     )
                 rep.assigned.append(req)
                 batch.append(req)
+            for r in reversed(skipped):
+                self._queue.appendleft(r)
+            if skipped and not batch:
+                # everything queued was a hedge this replica may not
+                # take — yield briefly instead of busy-spinning
+                self._cv.wait(timeout=0.05)
             rep.req_seq += len(batch)
         for qs, req, status, root_owed in dropped:
             trace_util.end_span(
@@ -1400,6 +1543,29 @@ class ServeFleet:
                     span=trace_util.ROOT_SPAN, span_id=req.root_span,
                     status=status, ts=wall, t_start=req.t_wall,
                 )
+        for req in expired:
+            # fail the future OUTSIDE the lock (done-callbacks run
+            # inline). A hedge twin may have resolved it already —
+            # the spent-key record above is the authoritative fence.
+            try:
+                if req.attempts == 0 and not req.hedge_of:
+                    if not req.future.set_running_or_notify_cancel():
+                        continue  # cancelled first: nothing to fail
+                req.future.set_exception(
+                    DeadlineExceeded("queue", req.deadline)
+                )
+            except InvalidStateError:
+                pass
+            self._emit(
+                "deadline_exceeded", replica_id=rep.id,
+                where="queue", deadline=round(req.deadline, 3),
+                key=req.key, trace_id=req.trace_id,
+            )
+        for req in cancelled:
+            self._emit(
+                "request_cancelled", replica_id=rep.id,
+                where="queue", key=req.key, trace_id=req.trace_id,
+            )
         for qs, att, req, attempt_no, t_queue in taken:
             if qs:
                 trace_util.end_span(
@@ -1430,6 +1596,12 @@ class ServeFleet:
                 dur = faults.engine_hang_request(rep.id, s)
                 if dur > 0:
                     time.sleep(dur)
+                # gray-replica fault: SLOW, not hung — the sleep stays
+                # far under the watchdog floor, so only the hedging /
+                # gray-score plane may react, never the stall plane
+                dur = faults.engine_slow_request(rep.id, s)
+                if dur > 0:
+                    time.sleep(dur)
                 if faults.engine_kill_request(rep.id, s):
                     raise faults.InjectedFault(
                         f"injected engine kill on replica {rep.id} "
@@ -1456,12 +1628,20 @@ class ServeFleet:
                     # current route: a hot-swap between admission and
                     # ownership must not retarget this request
                     _digest=r.digest or None,
+                    # the ABSOLUTE deadline rides along: the engine
+                    # refuses/expires it pre-dispatch instead of
+                    # burning a solve slot on a request nobody waits for
+                    _deadline=r.deadline,
                 )
 
             futs = []
             for r in batch:
                 try:
                     futs.append(_submit_to_engine(r))
+                except DeadlineExceeded as e:
+                    # engine-side admission expiry: terminal for THIS
+                    # request only, never a replica fault
+                    futs.append(e)
                 except validate.CCSCInputError:
                     # a replica registered concurrently with a
                     # publish_bank rollout can miss the new bank
@@ -1476,7 +1656,17 @@ class ServeFleet:
                         raise
                     rep.engine.add_bank(arr)
                     futs.append(_submit_to_engine(r))
-            results = [f.result(timeout=600.0) for f in futs]
+            results = []
+            for f in futs:
+                if isinstance(f, DeadlineExceeded):
+                    results.append(f)
+                    continue
+                try:
+                    results.append(f.result(timeout=600.0))
+                except DeadlineExceeded as e:
+                    # the engine's pre-dispatch sweep expired it while
+                    # queued for a micro-batch — same terminal contract
+                    results.append(e)
         finally:
             rep.watchdog.disarm()
         if rep.watchdog.stalls == stalls_before:
@@ -1491,7 +1681,67 @@ class ServeFleet:
                 self.fleet_cfg.stall_slack * per,
             )
         for req, res in zip(batch, results):
-            self._deliver(rep, req, res)
+            if isinstance(res, DeadlineExceeded):
+                self._fail_request(rep, req, res)
+            else:
+                self._deliver(rep, req, res)
+
+    def _fail_request(
+        self, rep: _Replica, req: _FleetRequest, exc: DeadlineExceeded
+    ) -> None:
+        """Terminal per-request failure (deadline expiry inside the
+        engine): fail the client future and close the spans WITHOUT
+        burning a fleet retry — the request is dead by contract, not
+        by replica fault, so it must never reach _requeue_from."""
+        att_span = None
+        att_t = 0.0
+        root_owed = False
+        with self._cv:
+            dup = (
+                req.key in self._delivered
+                or req.key in self._failed_keys
+            )
+            if not dup:
+                self._remember(self._failed_keys, req.key)
+                self._index.pop(req.key, None)
+                self._n_deadline += 1
+            if req.trace_id is not None and req.attempt_span:
+                att_span, req.attempt_span = req.attempt_span, None
+                att_t = req.attempt_t
+                pr = req.primary or req
+                root_owed = not pr.root_done
+                pr.root_done = True
+                req.root_done = True
+            try:
+                rep.assigned.remove(req)
+            except ValueError:
+                pass  # requeued from under us (stall handoff)
+        if not dup:
+            try:
+                req.future.set_exception(exc)
+            except InvalidStateError:
+                pass  # client cancelled between checks
+        wall = time.time()
+        if att_span is not None:
+            trace_util.end_span(
+                self._emit, trace_id=req.trace_id, span="attempt",
+                span_id=att_span, parent_span=req.root_span,
+                replica_id=rep.id, status="deadline", ts=wall,
+                t_start=att_t,
+            )
+        if root_owed:
+            trace_util.end_span(
+                self._emit, trace_id=req.trace_id,
+                span=trace_util.ROOT_SPAN, span_id=req.root_span,
+                status="deadline", ts=wall, t_start=req.t_wall,
+                attempts=req.attempts,
+            )
+        if not dup:
+            self._emit(
+                "deadline_exceeded", replica_id=rep.id,
+                where=exc.where, deadline=round(exc.deadline, 3),
+                key=req.key, trace_id=req.trace_id,
+            )
 
     def _worker_loop(self, rep: _Replica) -> None:
         while True:
@@ -1608,6 +1858,153 @@ class ServeFleet:
             for dg in q_diags:
                 self._emit(
                     "quality_solve_diag", replica_id=None, **dg
+                )
+            # request lifecycle: gray-failure scores from the
+            # per-replica latency histograms, then hedge any attempt
+            # that has outwaited the hedge threshold
+            self._hedge_and_gray_tick()
+
+    def _hedge_after_ms(self) -> Optional[float]:
+        """The hedge trigger threshold: a stuck attempt older than
+        this gets a second attempt on another replica. Resolution:
+        ``FleetConfig.hedge_after_ms`` > ``CCSC_HEDGE_AFTER_MS`` >
+        the ``hedge_quantile`` (default p95) of the fleet-wide
+        engine-side latency histogram — adaptive, so 'slow' means
+        slow RELATIVE to what this fleet actually serves. None while
+        the histogram is too thin to judge (no hedging yet)."""
+        if self.fleet_cfg.hedge_after_ms is not None:
+            return self.fleet_cfg.hedge_after_ms
+        env_ms = _env.env_float("CCSC_HEDGE_AFTER_MS")
+        if env_ms is not None:
+            return float(env_ms)
+        q = self.fleet_cfg.hedge_quantile
+        if q is None:
+            q = float(_env.env_float("CCSC_HEDGE_QUANTILE"))
+        with self._cv:
+            if self._lat_hist.n < 5:
+                return None
+            return self._lat_hist.percentile(q)
+
+    def _hedge_and_gray_tick(self) -> None:
+        """One monitor-tick pass of the gray-failure plane.
+
+        Gray scoring: a replica whose engine-side latency p50 is
+        ``CCSC_GRAY_FACTOR``x the median of the replica p50s is
+        scored gray — a sustained latency OUTLIER, a weaker (and
+        earlier) signal than the watchdog's hard stall. Gray is
+        advisory: the replica keeps serving, but hedges avoid it and
+        a deduped ``fleet_gray_replica`` event (the recycle hint)
+        marks the excursion.
+
+        Hedging: any in-flight attempt older than the hedge
+        threshold gets ONE duplicate attempt enqueued for a
+        different, non-gray replica — first result wins through the
+        delivery fence, the loser is suppressed-and-counted. Total
+        hedges are capped at ``hedge_max_frac`` of admitted requests
+        so a fleet-wide slowdown cannot double its own load."""
+        gray_factor = float(_env.env_float("CCSC_GRAY_FACTOR"))
+        frac = self.fleet_cfg.hedge_max_frac
+        if frac is None:
+            frac = float(_env.env_float("CCSC_HEDGE_MAX_FRAC"))
+        hedge_ms = self._hedge_after_ms()
+        wall = time.time()
+        gray_events: List[Dict[str, object]] = []
+        spawned: List[Tuple[_FleetRequest, int, float]] = []
+        with self._cv:
+            live = [
+                rep for rep in self._replicas
+                if rep is not None and rep.state == "live"
+            ]
+            # -- gray scores (needs >= 2 replicas for a median) -----
+            p50s = {}
+            for rep in live:
+                h = self._rep_hist.get(rep.id)
+                if h is not None and h.n >= 5:
+                    p = h.percentile(0.5)
+                    if p is not None:
+                        p50s[rep.id] = p
+            if len(p50s) >= 2:
+                med = sorted(p50s.values())[len(p50s) // 2]
+                for rid, p in p50s.items():
+                    factor = p / max(med, 1e-9)
+                    self._gray_score[rid] = round(factor, 3)
+                    if factor >= gray_factor and med > 0:
+                        if rid not in self._gray_now:
+                            # one event per excursion, not per tick
+                            self._gray_now.add(rid)
+                            gray_events.append({
+                                "replica_id": rid,
+                                "p50_ms": round(p, 3),
+                                "fleet_p50_ms": round(med, 3),
+                                "factor": round(factor, 3),
+                            })
+                    else:
+                        self._gray_now.discard(rid)
+            # -- hedge spawns ---------------------------------------
+            if hedge_ms is not None and len(live) >= 2 and frac > 0:
+                budget = frac * max(self._n_admitted, 1)
+                for rep in live:
+                    for req in list(rep.assigned):
+                        if self._n_hedges >= budget:
+                            break
+                        if req.hedged or req.hedge_of:
+                            continue  # one hedge per request, ever
+                        if req.attempt_t <= 0:
+                            continue
+                        waited = (wall - req.attempt_t) * 1e3
+                        if waited < hedge_ms:
+                            continue
+                        if (
+                            req.key in self._delivered
+                            or req.key in self._failed_keys
+                        ):
+                            continue
+                        if req.deadline is not None and (
+                            wall >= req.deadline
+                        ):
+                            continue  # expiry owns it, not hedging
+                        if req.future.cancelled():
+                            continue
+                        clone = _FleetRequest(
+                            key=req.key, b=req.b, mask=req.mask,
+                            smooth_init=req.smooth_init,
+                            x_orig=req.x_orig,
+                            future=req.future,
+                            t_submit=req.t_submit,
+                            tenant=req.tenant, bank_id=req.bank_id,
+                            digest=req.digest,
+                            deadline=req.deadline,
+                            trace_id=req.trace_id,
+                            root_span=req.root_span,
+                            queue_span=trace_util.new_span_id(),
+                            t_wall=req.t_wall, queue_t=wall,
+                            hedged=True, hedge_of=True,
+                            not_replica=rep.id, primary=req,
+                        )
+                        req.hedged = True
+                        # NOT in _index: the key's index entry stays
+                        # the primary's; the clone is reachable only
+                        # through the queue and the shared future
+                        self._queue.append(clone)
+                        self._n_hedges += 1
+                        spawned.append((clone, rep.id, waited))
+                if spawned:
+                    self._cv.notify_all()
+        for ev in gray_events:
+            self._emit("fleet_gray_replica", **ev)
+        for clone, owner, waited in spawned:
+            self._emit(
+                "hedge_spawn", replica_id=owner,
+                trace_id=clone.trace_id, key=clone.key,
+                waited_ms=round(waited, 3),
+                hedge_after_ms=round(hedge_ms, 3),
+            )
+            if clone.trace_id is not None:
+                trace_util.start_span(
+                    self._emit, trace_id=clone.trace_id,
+                    span="queue", span_id=clone.queue_span,
+                    parent_span=clone.root_span, ts=wall,
+                    attempt=2, hedge=True,
                 )
 
     # -- quality plane (serve.quality) ---------------------------------
@@ -2269,6 +2666,15 @@ class ServeFleet:
                 "abandoned": len(self._abandoned),
                 "bound_rps": round(self._bound_rps, 3),
                 "brownout": self._brownout,
+                # request-lifecycle plane: gray excursions and the
+                # hedge/deadline/cancel tallies — the controller and
+                # ops surfaces read recycle hints from here
+                "gray_replicas": sorted(self._gray_now),
+                "gray_scores": dict(self._gray_score),
+                "hedges": self._n_hedges,
+                "hedge_wins": self._n_hedge_wins,
+                "deadline_exceeded": self._n_deadline,
+                "cancelled": self._n_cancelled,
             }
         snap["warm_replicas"] = sum(
             1 for r in live if self._replica_warm(r)
@@ -2310,11 +2716,39 @@ class ServeFleet:
                 continue
         return min(etas) if etas else None
 
+    def _resolve_deadline(
+        self,
+        tenant: Optional[str],
+        deadline_ms: Optional[float],
+        _deadline: Optional[float],
+    ) -> Optional[float]:
+        """Absolute wall-clock deadline of one submission. An
+        internal absolute hand-off wins unconditionally (a cross-host
+        budget must SHRINK through each hop, never reset); else the
+        explicit relative budget, else the tenant's declared default,
+        else the fleet config, else ``CCSC_REQ_DEADLINE_MS``, else
+        None (unbounded — the pre-deadline contract)."""
+        if _deadline is not None:
+            return float(_deadline)
+        if deadline_ms is None:
+            spec = self._tenants.get(tenant)
+            if spec is not None and spec.deadline_ms is not None:
+                deadline_ms = spec.deadline_ms
+            elif self.fleet_cfg.deadline_ms is not None:
+                deadline_ms = self.fleet_cfg.deadline_ms
+            else:
+                deadline_ms = _env.env_float("CCSC_REQ_DEADLINE_MS")
+        if deadline_ms is None:
+            return None
+        return time.time() + float(deadline_ms) / 1e3
+
     def submit(
         self, b, mask=None, smooth_init=None, x_orig=None,
         key: Optional[str] = None,
         bank_id: Optional[str] = None,
         tenant: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        _deadline: Optional[float] = None,
     ) -> "Future[ServedResult]":
         """Enqueue one observation; returns a Future of
         :class:`~.engine.ServedResult`.
@@ -2331,17 +2765,42 @@ class ServeFleet:
         quota). ``bank_id`` routes to a published bank (explicit id >
         the tenant's declared default > the fleet's pinned bank); the
         request binds that bank's DIGEST here, so a concurrent
-        hot-swap never retargets admitted work. Raises
-        :class:`Overloaded` at the admission ceiling OR the tenant's
-        quota (a ``tenant_reject`` — other tenants keep being
-        admitted), :class:`~.engine.BucketCold` while no live replica
-        has warmed the request's bucket yet (staged warmup — carries
-        the same ``retry_after_s`` backoff contract), and
-        ``CCSCInputError`` for malformed requests."""
+        hot-swap never retargets admitted work. ``deadline_ms`` is the
+        request's END-TO-END budget, relative to now (resolution:
+        explicit > ``TenantSpec.deadline_ms`` >
+        ``FleetConfig.deadline_ms`` > ``CCSC_REQ_DEADLINE_MS`` > no
+        deadline); once it expires, the request is refused/failed
+        with :class:`~.engine.DeadlineExceeded` at whatever stage it
+        has reached — it never occupies a solve slot past expiry.
+        ``_deadline`` (internal) is an ABSOLUTE ``time.time()``
+        deadline passed through by cross-host hand-offs so queueing
+        upstream shrinks the remaining budget instead of resetting
+        it. Raises :class:`Overloaded` at the admission ceiling OR
+        the tenant's quota (a ``tenant_reject`` — other tenants keep
+        being admitted), :class:`~.engine.BucketCold` while no live
+        replica has warmed the request's bucket yet (staged warmup —
+        carries the same ``retry_after_s`` backoff contract),
+        :class:`~.engine.DeadlineExceeded` when the budget is already
+        spent at admission, and ``CCSCInputError`` for malformed
+        requests."""
         from ..utils import validate
 
         if self._close_started:
             raise RuntimeError("fleet is closed")
+        deadline = self._resolve_deadline(
+            tenant, deadline_ms, _deadline
+        )
+        if deadline is not None and time.time() >= deadline:
+            # stamped-dead on arrival: refuse before ANY admission
+            # work — the client's budget is spent, honesty beats a
+            # wasted solve
+            with self._cv:
+                self._n_deadline += 1
+            self._emit(
+                "deadline_exceeded", replica_id=None,
+                where="admission", deadline=round(deadline, 3),
+            )
+            raise DeadlineExceeded("admission", deadline)
         validate.check_serve_request(
             b, self.geom, mask=mask, smooth_init=smooth_init,
             x_orig=x_orig,
@@ -2488,6 +2947,7 @@ class ServeFleet:
                     tenant=tenant,
                     bank_id=eff_bank,
                     digest=digest,
+                    deadline=deadline,
                     # span ids are assigned UNDER the lock (cheap id
                     # generation, no I/O) so a worker that takes this
                     # request immediately already sees them; the
@@ -2500,6 +2960,7 @@ class ServeFleet:
                 )
                 self._index[req.key] = req
                 self._queue.append(req)
+                self._n_admitted += 1  # the hedge-rate denominator
                 # snapshot the span ids before releasing the lock: a
                 # worker can take the request (claiming queue_span)
                 # the instant we release
@@ -2550,6 +3011,13 @@ class ServeFleet:
             self._emit, trace_id=req.trace_id,
             span=trace_util.ROOT_SPAN, span_id=req.root_span,
             ts=req.t_wall, key=req.key,
+            # the stamped absolute deadline travels on the root span:
+            # every later deadline_exceeded/cancel/hedge decision is
+            # auditable against it from the event stream alone
+            deadline=(
+                None if req.deadline is None
+                else round(req.deadline, 3)
+            ),
         )
         trace_util.emit_span(
             self._emit, trace_id=req.trace_id, span="admission",
@@ -2975,7 +3443,12 @@ class ServeFleet:
             undelivered: List[_FleetRequest] = []
             shutdown_spans: List = []  # (req, queue_span, attempt_span, root_owed)
             with self._cv:
-                undelivered.extend(self._queue)
+                undelivered.extend(
+                    # a queued hedge clone whose primary already
+                    # delivered is not a casualty — its story closed
+                    r for r in self._queue
+                    if r.key not in self._delivered
+                )
                 self._queue.clear()
                 for rep in self._replicas:
                     if rep is None:
@@ -2990,11 +3463,17 @@ class ServeFleet:
                     if r.trace_id is not None:
                         qs, r.queue_span = r.queue_span, None
                         att, r.attempt_span = r.attempt_span, None
-                        owed = not r.root_done
+                        pr = r.primary or r
+                        owed = not pr.root_done
+                        pr.root_done = True
                         r.root_done = True
                         if qs or att or owed:
                             shutdown_spans.append((r, qs, att, owed))
-                self._n_failed += len(undelivered)
+                # hedge clones share their primary's key: one request,
+                # one failure — don't count the pair twice
+                self._n_failed += sum(
+                    1 for r in undelivered if not r.hedge_of
+                )
             # a shut-down fleet still closes every story: whatever
             # span the request had open ends 'shutdown', so the trace
             # reassembles gap-free even for requests the close failed

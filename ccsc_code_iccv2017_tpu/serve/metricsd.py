@@ -247,6 +247,14 @@ class StreamMetrics:
             "duplicates_suppressed_total": 0,
             "slo_breaches_total": 0,
             "probe_failures_total": 0,
+            # request-lifecycle folds (serve.fleet hedging/deadlines):
+            # same names the live fleet.metrics() surface exports, so
+            # a stream-derived scrape and an in-process scrape render
+            # identical ccsc_* series
+            "hedges_total": 0,
+            "hedge_wins_total": 0,
+            "deadline_exceeded_total": 0,
+            "cancelled_total": 0,
         }
         # quality plane folds (serve.quality): breached tenant floors
         # (gauge parity with the live fleet's n_breached — a floor
@@ -323,6 +331,14 @@ class StreamMetrics:
                     self._hists[key] = rec
                 elif kind == "quality_probe_breach":
                     self._counters["probe_failures_total"] += 1
+                elif kind == "hedge_spawn":
+                    self._counters["hedges_total"] += 1
+                elif kind == "hedge_win":
+                    self._counters["hedge_wins_total"] += 1
+                elif kind == "deadline_exceeded":
+                    self._counters["deadline_exceeded_total"] += 1
+                elif kind == "request_cancelled":
+                    self._counters["cancelled_total"] += 1
                 elif kind == "quality_breach":
                     t = rec.get("tenant")
                     if t:

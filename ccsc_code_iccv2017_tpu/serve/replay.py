@@ -52,10 +52,18 @@ from . import slo as _slo
 
 __all__ = ["ReplayDriver", "generate_diurnal"]
 
-# verification verdicts, strongest to weakest
+# verification verdicts, strongest to weakest. "deadline" is a
+# replayed request whose per-request budget (recorded latency x
+# CCSC_REPLAY_DEADLINE_SLACK) expired — an SLO verdict, distinct
+# from "mismatch" (wrong bytes) and "lost" (no resolution at all)
 STATUSES = (
-    "match_exact", "match_psnr", "unverified", "mismatch", "lost"
+    "match_exact", "match_psnr", "unverified", "mismatch",
+    "deadline", "lost",
 )
+
+# a recorded latency shorter than this still gets a workable budget
+# (warmup jitter on the replay side must not flag honest requests)
+_DEADLINE_FLOOR_MS = 1000.0
 
 
 def _percentiles(lat_ms) -> Tuple[Optional[float], Optional[float]]:
@@ -88,6 +96,13 @@ class ReplayDriver:
             float(psnr_tol)
             if psnr_tol is not None
             else float(_env.env_float("CCSC_REPLAY_PSNR_TOL"))
+        )
+        # deadline plumbing (ISSUE 19): when set, each replayed
+        # request carries budget = max(recorded latency, floor) x
+        # slack instead of the one-size-fits-all 600 s future wait;
+        # None keeps replay deadline-free (the legacy contract)
+        self.deadline_slack = _env.env_float(
+            "CCSC_REPLAY_DEADLINE_SLACK"
         )
         self.meta = _capture.read_meta(capture_dir)
         self.requests = _capture.read_workload(capture_dir)
@@ -146,6 +161,21 @@ class ReplayDriver:
                 else "mismatch"
             )
         return "unverified"
+
+    def _deadline_ms(self, req: Dict[str, Any]) -> Optional[float]:
+        """The replayed request's end-to-end budget: the recorded
+        latency (floored) scaled by ``CCSC_REPLAY_DEADLINE_SLACK``.
+        None when the slack knob is unset (deadline-free replay) or
+        the capture carries no recorded latency to scale."""
+        if self.deadline_slack is None:
+            return None
+        out = req.get("outcome")
+        lat = None if out is None else out.get("latency_ms")
+        if lat is None:
+            return None
+        return max(float(lat), _DEADLINE_FLOOR_MS) * float(
+            self.deadline_slack
+        )
 
     def _psf_radius(self) -> Optional[Tuple[int, ...]]:
         # the capture meta's problem geometry (capture._write_meta)
@@ -217,7 +247,7 @@ class ReplayDriver:
 
     def _submit_one(
         self, target, rkey, arrays, is_fleet, overloaded_cls,
-        bank_id=None, tenant=None,
+        bank_id=None, tenant=None, deadline_ms=None,
     ):
         """Submit with explicit-backpressure retries; returns
         (future, n_overload_backoffs, t_submit). Admission refusals
@@ -236,6 +266,8 @@ class ReplayDriver:
         admitted submit->delivery, and the comparison must too."""
         n_over = 0
         route = {"bank_id": bank_id, "tenant": tenant}
+        # the budget clock starts at the ADMITTED submit, same as
+        # t_sub: backoff sleeps never eat into the request's deadline
         while True:
             t_sub = time.perf_counter()
             try:
@@ -247,6 +279,7 @@ class ReplayDriver:
                             smooth_init=arrays["smooth_init"],
                             x_orig=arrays["x_orig"],
                             key=rkey,
+                            deadline_ms=deadline_ms,
                             **route,
                         ),
                         n_over,
@@ -258,6 +291,7 @@ class ReplayDriver:
                         mask=arrays["mask"],
                         smooth_init=arrays["smooth_init"],
                         x_orig=arrays["x_orig"],
+                        deadline_ms=deadline_ms,
                         **route,
                     ),
                     n_over,
@@ -287,23 +321,29 @@ class ReplayDriver:
                 lag = due - time.perf_counter()
                 if lag > 0:
                     time.sleep(lag)
+            dl_ms = self._deadline_ms(req)
             fut, n_over, t_sub = self._submit_one(
                 target, f"replay-{i:06d}", arrays, is_fleet,
                 overloaded_cls,
                 bank_id=req.get("bank_id"),
                 tenant=req.get("tenant"),
+                deadline_ms=dl_ms,
             )
             n_overloaded += n_over
             if mode == "closed":
-                verdicts.append(self._settle(req, fut, t_sub, timeout_s))
+                verdicts.append(
+                    self._settle(req, fut, t_sub, timeout_s, dl_ms)
+                )
             else:
-                inflight.append((req, fut, t_sub))
+                inflight.append((req, fut, t_sub, dl_ms))
         # submitted payloads now live in the target's own queue; drop
         # the reader cache so delivered requests' arrays can be freed
         self._payloads.clear()
         while inflight:
-            req, fut, t_sub = inflight.pop(0)
-            verdicts.append(self._settle(req, fut, t_sub, timeout_s))
+            req, fut, t_sub, dl_ms = inflight.pop(0)
+            verdicts.append(
+                self._settle(req, fut, t_sub, timeout_s, dl_ms)
+            )
         elapsed = time.perf_counter() - t_start
         return self._report(
             run, verdicts, elapsed, speed, mode, n_overloaded,
@@ -311,13 +351,29 @@ class ReplayDriver:
         )
 
     def _settle(
-        self, req, fut, t_sub, timeout_s
+        self, req, fut, t_sub, timeout_s, deadline_ms=None
     ) -> Tuple[Dict, str, float, Optional[str]]:
         """Wait one future out and reduce it to its verdict
         (status, latency, served bucket) — the result arrays are
-        released here, not carried to the report."""
+        released here, not carried to the report. With deadline
+        plumbing active, the wait is the request's own remaining
+        budget (plus slack for the expiry round trip) instead of the
+        one-size-fits-all ``timeout_s``, and an expiry resolves as
+        the distinct ``deadline`` verdict, never a mismatch."""
+        from .fleet import DeadlineExceeded
+
+        wait_s = timeout_s
+        if deadline_ms is not None:
+            left = deadline_ms / 1e3 - (
+                time.perf_counter() - t_sub
+            )
+            # the serving side expires it; this wait only has to
+            # outlive that expiry landing on the future
+            wait_s = min(timeout_s, max(left, 0.0) + 5.0)
         try:
-            res = fut.result(timeout=timeout_s)
+            res = fut.result(timeout=wait_s)
+        except DeadlineExceeded:
+            return req, "deadline", 0.0, None
         except Exception:
             return req, "lost", 0.0, None
         lat_ms = (time.perf_counter() - t_sub) * 1e3
@@ -332,7 +388,7 @@ class ReplayDriver:
         recorded_lat: List[float] = []
         for req, status, lat_ms, bucket in verdicts:
             counts[status] += 1
-            if status != "lost":
+            if status not in ("lost", "deadline"):
                 replayed_lat.append(lat_ms)
             out = req.get("outcome")
             if out is not None and out.get("latency_ms") is not None:
@@ -360,6 +416,7 @@ class ReplayDriver:
             "n_replayed": n,
             "n_lost": counts["lost"],
             "n_mismatched": counts["mismatch"],
+            "n_deadline": counts["deadline"],
             "n_exact": counts["match_exact"],
             "n_psnr": counts["match_psnr"],
             "n_unverified": counts["unverified"],
@@ -381,6 +438,7 @@ class ReplayDriver:
             n_replayed=n,
             n_lost=report["n_lost"],
             n_mismatched=report["n_mismatched"],
+            n_deadline=report["n_deadline"],
             n_exact=report["n_exact"],
             n_psnr=report["n_psnr"],
             n_unverified=report["n_unverified"],
@@ -409,6 +467,7 @@ class ReplayDriver:
             + f", {report['n_exact']} bit-exact, "
             f"{report['n_psnr']} psnr-matched, "
             f"{report['n_mismatched']} mismatched, "
+            f"{report['n_deadline']} deadline, "
             f"{report['n_lost']} lost",
             tier="brief",
         )

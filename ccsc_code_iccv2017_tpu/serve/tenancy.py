@@ -53,11 +53,12 @@ def parse_tenant_spec(spec: str) -> TenantSpec:
     """Parse a CLI/ops tenant spec string into a
     :class:`~..config.TenantSpec`:
 
-        NAME[:key=value,...]   keys: bank, p50, p99, quota, weight
+        NAME[:key=value,...]   keys: bank, p50, p99, quota, weight,
+                               deadline
 
-    e.g. ``mobile:bank=bank-mobile,p99=250,quota=16,weight=2``.
-    Shared by ``apps/serve.py --tenant`` so the grammar cannot drift
-    between surfaces."""
+    e.g. ``mobile:bank=bank-mobile,p99=250,quota=16,weight=2,
+    deadline=2000``. Shared by ``apps/serve.py --tenant`` so the
+    grammar cannot drift between surfaces."""
     name, _, rest = spec.partition(":")
     name = name.strip()
     kw: Dict[str, object] = {}
@@ -67,6 +68,7 @@ def parse_tenant_spec(spec: str) -> TenantSpec:
         "p99": ("slo_p99_ms", float),
         "quota": ("quota", int),
         "weight": ("weight", float),
+        "deadline": ("deadline_ms", float),
     }
     for part in filter(None, (p.strip() for p in rest.split(","))):
         k, eq, v = part.partition("=")

@@ -225,6 +225,32 @@ REGISTRY: Dict[str, Knob] = _knobs(
      "arm a one-shot xprof capture (utils.profiling.xla_trace) "
      "around the next dispatch after an SLO breach, written here "
      "(fallback of ServeConfig.slo_profile_dir; unset = off)"),
+    ("CCSC_REQ_DEADLINE_MS", "float", None,
+     "serve.fleet, serve.federation",
+     "default end-to-end request deadline in ms stamped at fleet "
+     "admission (fallback of submit(deadline_ms=) and "
+     "TenantSpec.deadline_ms; unset = requests have no deadline)"),
+    ("CCSC_HEDGE_AFTER_MS", "float", None, "serve.fleet",
+     "fixed wait in ms before an in-flight attempt is hedged onto a "
+     "different replica (fallback of FleetConfig.hedge_after_ms; "
+     "unset = derive from the per-replica latency histogram "
+     "quantile, CCSC_HEDGE_QUANTILE)"),
+    ("CCSC_HEDGE_QUANTILE", "float", 0.95, "serve.fleet",
+     "latency-histogram quantile the adaptive hedge_after is derived "
+     "from when no fixed CCSC_HEDGE_AFTER_MS is set"),
+    ("CCSC_HEDGE_MAX_FRAC", "float", 0.0, "serve.fleet",
+     "cap on hedged attempts as a fraction of admitted requests — "
+     "hedging must never amplify overload (0 = hedging off, the "
+     "default: a hedge duplicates work, so the operator opts in)"),
+    ("CCSC_GRAY_FACTOR", "float", 3.0, "serve.fleet",
+     "sustained per-replica p50 latency multiple over the fleet "
+     "median that marks a replica gray (slow-but-alive; feeds hedge "
+     "target selection and the fleet_gray_replica advisory)"),
+    ("CCSC_REPLAY_DEADLINE_SLACK", "float", None, "serve.replay",
+     "per-request replay deadline as a multiple of the recorded "
+     "latency (deadline_ms = max(recorded latency, 1s) * slack; "
+     "unset = replay without deadlines, bounded only by the "
+     "driver-level timeout)"),
     ("CCSC_METRICSD_PORT", "int", None, "serve.metricsd",
      "port of the Prometheus-text metrics endpoint (0 = ephemeral; "
      "fallback of FleetConfig.metricsd_port; unset = no endpoint)"),
@@ -336,6 +362,16 @@ REGISTRY: Dict[str, Knob] = _knobs(
     ("CCSC_FAULT_ENGINE_HANG_REPLICA", "int_list", None,
      "utils.faults",
      "comma list of replica ids armed for the hang fault (unset = "
+     "all)"),
+    ("CCSC_FAULT_ENGINE_SLOW_REQ", "int", None, "utils.faults",
+     "slow a serving replica (gray failure: delayed, not hung — the "
+     "watchdog must stay silent) starting at its k-th taken request"),
+    ("CCSC_FAULT_ENGINE_SLOW_S", "float", 2.0, "utils.faults",
+     "engine slow-fault added latency per request; keep well under "
+     "CCSC_WATCHDOG_MIN_S so the stall detector never fires"),
+    ("CCSC_FAULT_ENGINE_SLOW_REPLICA", "int_list", None,
+     "utils.faults",
+     "comma list of replica ids armed for the slow fault (unset = "
      "all)"),
     ("CCSC_FAULT_CTRL_SENSOR_BLACKOUT", "int", None, "utils.faults",
      "blind the capacity controller's sensors starting at its k-th "

@@ -39,6 +39,13 @@ points, so a test (or ``scripts/chaos_smoke.py`` /
   These fire at most once PER REPLICA (marker
   ``fault-fired-engine_kill-r<id>.json``), so a restarted casualty
   rejoins clean instead of re-dying forever.
+  ``CCSC_FAULT_ENGINE_SLOW_REQ=k`` is the GRAY-failure variant: from
+  the k-th taken request onward an armed replica
+  (``CCSC_FAULT_ENGINE_SLOW_REPLICA``) sleeps
+  ``CCSC_FAULT_ENGINE_SLOW_S`` (default 2.0 — far under the watchdog
+  floor, so the stall detector must NOT fire) on EVERY request:
+  slow-but-alive, the pathology hedged attempts exist for. Sustained,
+  not fire-once; the marker records only the first slowed request.
 - control-plane faults (serve.controller, ISSUE 17):
   ``CCSC_FAULT_CTRL_SENSOR_BLACKOUT=k`` blanks the controller's
   sensor read from its k-th tick for ``CCSC_FAULT_CTRL_BLACKOUT_S``
@@ -83,6 +90,7 @@ __all__ = [
     "hang_tick",
     "engine_kill_request",
     "engine_hang_request",
+    "engine_slow_request",
     "ctrl_sensor_blackout",
     "ctrl_actuator_hang",
     "ctrl_crash_mid_scale",
@@ -291,6 +299,36 @@ def engine_hang_request(replica_id: int, req_seq: int) -> float:
         request_seq=int(req_seq),
         sleep_s=dur,
     )
+    return dur
+
+
+def engine_slow_request(replica_id: int, req_seq: int) -> float:
+    """Serving-fleet GRAY-failure fault: the extra seconds the replica
+    worker should sleep (``CCSC_FAULT_ENGINE_SLOW_S``, default 2.0 —
+    deliberately far under ``CCSC_WATCHDOG_MIN_S`` so the stall
+    detector stays silent) on EVERY request from its
+    ``CCSC_FAULT_ENGINE_SLOW_REQ``-th taken request onward, else 0.0.
+    Unlike kill/hang this is SUSTAINED, not fire-once: a gray replica
+    is slow-but-alive indefinitely — that is the pathology hedged
+    attempts (serve.fleet) exist to route around. The fire-once
+    marker is dropped on the FIRST slowed request only, so the obs
+    stream records that the fault armed without one record per
+    request. ``CCSC_FAULT_ENGINE_SLOW_REPLICA`` restricts which
+    replicas are armed (comma list; unset = all)."""
+    k = _env_int("CCSC_FAULT_ENGINE_SLOW_REQ")
+    if k is None or req_seq < k:
+        return 0.0
+    if not _replica_armed("CCSC_FAULT_ENGINE_SLOW_REPLICA", replica_id):
+        return 0.0
+    dur = _env.env_float("CCSC_FAULT_ENGINE_SLOW_S")
+    name = f"engine_slow-r{int(replica_id)}"
+    if not _fired_before(name):
+        _mark_fired(
+            name,
+            replica_id=int(replica_id),
+            request_seq=int(req_seq),
+            sleep_s=dur,
+        )
     return dur
 
 

@@ -602,6 +602,168 @@ def scenario_bank_swap():
     )
 
 
+def scenario_gray_replica():
+    """Hedged attempts bound the tail against a GRAY replica — slow
+    but alive, the pathology the watchdog cannot see. A 2-replica
+    fleet serves a saturating two-tenant stream while replica 0 runs
+    every request ~10x slow (CCSC_FAULT_ENGINE_SLOW_*, deliberately
+    far under the watchdog floor). Must hold: zero lost requests and
+    exactly-once delivery; the hedged fleet's p99 stays within 3x a
+    healthy no-fault baseline on the same stream while an unhedged
+    control run under the same fault exceeds it; every delivered
+    result is bit-identical to a bare single-engine oracle; the
+    watchdog stays SILENT (zero stall records, zero replica deaths);
+    hedge volume respects hedge_max_frac; and every decided hedge
+    pair reassembles on the stream — winner delivered once, loser
+    suppressed as ``hedge_lost``. The thresholds self-calibrate from
+    the measured healthy p99 so the scenario holds on fast and slow
+    machines alike."""
+    import time as _time
+
+    import numpy as np
+
+    from ccsc_code_iccv2017_tpu.config import (
+        FleetConfig,
+        ProblemGeom,
+        ServeConfig,
+        SolveConfig,
+        TenantSpec,
+    )
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import CodecEngine, ServeFleet
+    from ccsc_code_iccv2017_tpu.serve import slo as _slo
+    from ccsc_code_iccv2017_tpu.utils import obs
+
+    r = np.random.default_rng(0)
+    d = r.normal(size=(4, 3, 3)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    geom = ProblemGeom((3, 3), 4)
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=3, tol=0.0,
+        verbose="none",
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=2.0, verbose="none"
+    )
+    tenants = (TenantSpec(tenant="alpha"), TenantSpec(tenant="beta"))
+    N = 16
+    r2 = np.random.default_rng(3)
+    reqs = []
+    for _ in range(N):
+        x = r2.random((12, 12)).astype(np.float32)
+        m = (r2.random((12, 12)) < 0.5).astype(np.float32)
+        reqs.append((x * m, m))
+    tenant_of = lambda i: "alpha" if i % 2 == 0 else "beta"
+
+    def serve(mdir, **cfg_kw):
+        base = dict(
+            replicas=2, metrics_dir=mdir, min_queue_depth=64,
+            restart_backoff_s=0.05, verbose="none", tenants=tenants,
+            # fast monitor ticks: the hedge plane must react at the
+            # measured-latency scale, not at human heartbeat scale
+            health_interval_s=0.005,
+        )
+        base.update(cfg_kw)
+        fleet = ServeFleet(
+            d, ReconstructionProblem(geom), cfg, scfg,
+            FleetConfig(**base),
+        )
+        try:
+            futs = [
+                fleet.submit(b, mask=m, tenant=tenant_of(i),
+                             key=f"k{i}")
+                for i, (b, m) in enumerate(reqs)
+            ]
+            out = [f.result(timeout=180) for f in futs]
+        finally:
+            fleet.close()  # joins workers: straggler losers settle
+        events = obs.read_events(mdir, recursive=True)
+        lat = _slo.Histogram.of(
+            e["latency_ms"] for e in events
+            if e["type"] == "fleet_request"
+        )
+        p99 = lat.percentile(0.99)
+        return out, (float("inf") if p99 is None else p99), events
+
+    with tempfile.TemporaryDirectory() as root:
+        # 1) healthy baseline on the same stream — no fault; its p99
+        # calibrates the fault magnitude and the hedge threshold
+        _, p99_healthy, _ = serve(os.path.join(root, "m-healthy"))
+        bound = 3.0 * p99_healthy
+        # "10x slow" relative to what this machine actually serves,
+        # capped so a 2-request batch of sleeps stays far under the
+        # 30 s watchdog floor
+        slow_s = min(max(10.0 * p99_healthy / 1e3, 0.5), 8.0)
+        hedge_ms = max(1.0 * p99_healthy, 25.0)
+        fault_env = dict(
+            CCSC_FAULT_ENGINE_SLOW_REQ=1,
+            CCSC_FAULT_ENGINE_SLOW_S=slow_s,
+            CCSC_FAULT_ENGINE_SLOW_REPLICA="0",
+        )
+        # 2) hedged fleet under the gray fault
+        with _fault(**fault_env):
+            t0 = _time.monotonic()
+            hedged, p99_hedged, events = serve(
+                os.path.join(root, "m-hedged"),
+                hedge_after_ms=hedge_ms, hedge_max_frac=0.25,
+            )
+            hedged_wall = _time.monotonic() - t0
+        # 3) unhedged control under the same fault: the tail the
+        # fleet eats WITHOUT the hedge plane
+        with _fault(**fault_env):
+            _, p99_control, _ = serve(
+                os.path.join(root, "m-control"), hedge_max_frac=0.0,
+            )
+
+    # bit-parity oracle: a bare single engine over the same bytes —
+    # a hedged duplicate runs the same plan on the same bank, so the
+    # winner's recon must be bit-identical no matter which attempt won
+    eng = CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
+    try:
+        oracle = [eng.reconstruct(b, mask=m) for b, m in reqs]
+    finally:
+        eng.close()
+    parity = all(
+        np.array_equal(got.recon, want.recon)
+        for got, want in zip(hedged, oracle)
+    )
+
+    served = [e for e in events if e["type"] == "fleet_request"]
+    keys = [e["key"] for e in served]
+    spawns = {e["key"] for e in events if e["type"] == "hedge_spawn"}
+    wins = {e["key"] for e in events if e["type"] == "hedge_win"}
+    losses = {e["key"] for e in events if e["type"] == "hedge_lost"}
+    stalls = [
+        e for e in events
+        if e["type"] in ("stall", "fleet_replica_dead")
+    ]
+    ok = (
+        len(hedged) == N                      # zero lost
+        and sorted(keys) == sorted(f"k{i}" for i in range(N))
+        and len(keys) == len(set(keys))       # exactly once each
+        and parity
+        and not stalls                        # gray, not dead
+        and len(spawns) >= 1                  # hedging actually fired
+        and len(spawns) <= 0.25 * N           # hedge_max_frac cap
+        and wins <= spawns
+        and losses <= spawns
+        and wins == losses                    # every decided pair:
+                                              # winner + suppressed
+                                              # loser, both on stream
+        and p99_hedged <= bound
+        and p99_control > bound
+    )
+    return ok, (
+        f"p99 healthy={p99_healthy:.0f}ms hedged={p99_hedged:.0f}ms "
+        f"control={p99_control:.0f}ms (bound {bound:.0f}ms, "
+        f"slow_s={slow_s:.2f}), hedges={len(spawns)} "
+        f"wins={len(wins)} lost={len(losses)}, parity={parity}, "
+        f"stalls={len(stalls)}, wall={hedged_wall:.1f}s"
+    )
+
+
 def scenario_bank_rot():
     """Quality-observatory chaos (serve.quality): a fleet serves
     two-tenant traffic when one tenant's bank is hot-swapped for a
@@ -1560,6 +1722,10 @@ def run(subprocess_scenarios: bool = True, only=None) -> dict:
         "bank_swap": scenario_bank_swap,
     }
     if subprocess_scenarios:
+        # in-process but latency-calibrated (three fleet runs, one
+        # deliberately ~10x slow): script mode only, run by its own
+        # ci.sh stage ('--only gray_replica', exit 28)
+        scenarios["gray_replica"] = scenario_gray_replica
         scenarios["host_kill"] = scenario_host_kill
         scenarios["scale_up"] = scenario_scale_up
         # in-process but ~30s of wall clock (probe sweeps at a real

@@ -30,6 +30,14 @@
 #       named the wrong rollback digest, a request was lost, served
 #       bytes lost bit-parity, or the episode triggered a new XLA
 #       compile (serve.quality — the quality observatory)
+#   28  the gray-replica chaos leg failed (scripts/chaos_smoke.py
+#       --only gray_replica): with one replica injected ~10x slow
+#       (slow, not hung), hedged attempts did not hold fleet p99
+#       within 3x the healthy baseline, a request was lost or
+#       double-delivered, a hedge pair left an incomplete trace,
+#       hedging exceeded its hedge_max_frac cap, served bytes lost
+#       bit-parity, or the watchdog fired on a non-stall
+#       (serve.fleet — the request-lifecycle plane)
 #   30  scripts/perf_gate.py judged a regression against the durable
 #       perf ledger (skipped silently when no ledger file exists yet
 #       — a young repo must not fail CI on an empty history)
@@ -94,6 +102,9 @@ JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --only autoscale || exit 26
 
 echo "== ci: 2e/3 bank-rot leg (scripts/chaos_smoke.py --only bank_rot: degraded-bank hot-swap vs the quality observatory)"
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --only bank_rot || exit 27
+
+echo "== ci: 2f/3 gray-replica leg (scripts/chaos_smoke.py --only gray_replica: hedged attempts vs a slow-but-alive replica)"
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --only gray_replica || exit 28
 
 echo "== ci: 3/3 perf regression gate (scripts/perf_gate.py)"
 # resolve the same ledger path perf_gate would; gate only when a

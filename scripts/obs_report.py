@@ -619,6 +619,42 @@ def render(events, stale_after=None, n_traces=3, ledger_path=None,
                     f"{agg['req']:4d} request(s), occupancy "
                     f"{100 * agg['occ'] / agg['n']:.0f}%"
                 )
+        # request-lifecycle plane (serve.fleet deadlines/hedging):
+        # how many requests the fleet REFUSED to waste work on
+        # (deadline expiries by lifecycle point, cooperative
+        # cancellations) and what hedging did about gray replicas
+        hsp = by.get("hedge_spawn", [])
+        hwin = by.get("hedge_win", [])
+        hlost = by.get("hedge_lost", [])
+        gray = by.get("fleet_gray_replica", [])
+        if hsp or hwin or hlost or gray:
+            lines.append(
+                f"  hedging       {len(hsp)} hedge(s) spawned, "
+                f"{len(hwin)} won, {len(hlost)} lost "
+                "(duplicates suppressed)"
+            )
+            for g in gray:
+                lines.append(
+                    f"    gray replica {g.get('replica_id')}: p50 "
+                    f"{g.get('p50_ms')} ms vs fleet p50 "
+                    f"{g.get('fleet_p50_ms')} ms "
+                    f"({g.get('factor')}x outlier)"
+                )
+        dle = by.get("deadline_exceeded", [])
+        canc = by.get("request_cancelled", [])
+        if dle or canc:
+            where = {}
+            for e in dle:
+                w = str(e.get("where", "?"))
+                where[w] = where.get(w, 0) + 1
+            by_where = ", ".join(
+                f"{k} {v}" for k, v in sorted(where.items())
+            )
+            lines.append(
+                f"  deadlines     {len(dle)} exceeded"
+                + (f" ({by_where})" if by_where else "")
+                + f", {len(canc)} cancelled"
+            )
         warm = by.get("serve_ready", [])
         if warm:
             w = warm[-1]

@@ -554,3 +554,26 @@ def test_resubmit_backoff_caps():
     # the hint itself is honored under the cap
     cold = BucketCold("64x64", 0.25)
     assert bo.delay_for(cold) == 0.25
+
+
+def test_refusal_trio_deadline_is_terminal():
+    """The refusal trio routes DISTINCTLY in the resubmit loop:
+    Overloaded and BucketCold are retryable (each on its own
+    escalation counter), DeadlineExceeded is terminal — it is not a
+    subclass of either retryable refusal (so it can never match
+    their except clause) and it deliberately carries NO
+    ``retry_after_s`` hint: an expired budget cannot be fixed by
+    waiting, so the backoff machinery must have nothing to honor."""
+    from ccsc_code_iccv2017_tpu.serve.engine import DeadlineExceeded
+
+    dead = DeadlineExceeded("admission", 123.0)
+    assert not isinstance(dead, (Overloaded, BucketCold))
+    assert not hasattr(dead, "retry_after_s")
+    assert dead.where == "admission"
+    assert dead.deadline == 123.0
+    bo = ResubmitBackoff()
+    with pytest.raises(AttributeError):
+        bo.delay_for(dead)  # never reaches the retry path
+    # the terminal refusal leaves the retryable counters untouched
+    assert bo.consec("Overloaded") == 0
+    assert bo.consec("BucketCold") == 0

@@ -323,3 +323,57 @@ def test_leave_releases_leases_and_seal_drained(tmp_path):
     assert client.drained
     # result/spent names are digest-safe for hostile keys
     assert "/" not in safe_key("../../etc/passwd")
+
+
+# ------------------------------- request lifecycle (ISSUE 19)
+
+
+def test_claim_of_expired_item_writes_durable_deadline_result(
+    tmp_path,
+):
+    """The item record carries the ABSOLUTE deadline across hosts: a
+    claim of an already-expired item never hands the payload out —
+    it resolves the key durably (status='deadline' result + spent
+    marker) so every frontend polling the queue sees the same
+    terminal verdict, and a later resubmit of the key is refused."""
+    client = _q(tmp_path, "client")
+    a = _q(tmp_path, "A")
+    a.join()
+    client.submit("late", _x(3), deadline=time.time() + 0.05)
+    client.submit("fine", _x(4))
+    time.sleep(0.1)  # 'late' is now past its budget
+    items = a.claim(limit=4)
+    assert [it["key"] for it in items] == ["fine"]
+    res = client.result("late")
+    assert res is not None and res["status"] == "deadline"
+    assert client.spent("late")
+    st = client.stats()
+    assert st["queued"] == 0 and st["leased"] == 1
+    assert any(
+        e["type"] == "deadline_exceeded" and e.get("where") == "claim"
+        for e in a.events
+    )
+
+
+def test_cancel_writes_durable_marker_and_claim_refuses(tmp_path):
+    """Cooperative cancellation, cross-host: ``cancel`` publishes a
+    durable status='cancelled' result FIRST (the first-wins result
+    record is the decision point) and marks the key spent, so a
+    later claim drops the item instead of solving it — and a cancel
+    that lost the race to a real outcome reports False and leaves
+    the outcome standing."""
+    client = _q(tmp_path, "client")
+    a = _q(tmp_path, "A")
+    a.join()
+    client.submit("bail", _x(5))
+    assert client.cancel("bail") is True
+    res = client.result("bail")
+    assert res is not None and res["status"] == "cancelled"
+    assert client.spent("bail")
+    assert a.claim(limit=4) == []  # spent pre-claim: dropped
+    # cancel after an outcome exists must NOT overwrite it
+    client.submit("served", _x(6))
+    (it,) = a.claim(limit=4)
+    assert a.complete(it, _x(6) * 2)
+    assert client.cancel("served") is False
+    assert client.result("served")["status"] == "ok"

@@ -440,3 +440,184 @@ def test_obs_report_federation_staleness(tmp_path):
     assert "live" in line("hB")
     assert "live" in line("hC") and "left" not in line("hC")
     assert "left" in line("hD")
+
+
+def test_frontend_cancel_writes_durable_marker(tmp_path):
+    """Cooperative cancellation crosses the host boundary: a frontend
+    future cancelled before any claim becomes a durable 'cancelled'
+    result plus spent fence, so a host that shows up later finds
+    nothing to solve — and the withdrawal is counted and span-closed,
+    not silently dropped."""
+    from ccsc_code_iccv2017_tpu.serve.dqueue import DurableQueue
+
+    fe = FederatedFrontend(
+        os.path.join(str(tmp_path), "q"), client="fe0",
+        metrics_dir=os.path.join(str(tmp_path), "m-fe"),
+        verbose="none", poll_s=0.02,
+    )
+    try:
+        b, m, x = _requests(1)[0]
+        f = fe.submit(b, mask=m, x_orig=x, key="bail")
+        assert f.cancel()
+        t_end = time.time() + 10.0
+        while fe.n_cancelled < 1 and time.time() < t_end:
+            time.sleep(0.01)
+        assert fe.n_cancelled == 1
+        probe = DurableQueue(
+            os.path.join(str(tmp_path), "q"), host="probe"
+        )
+        rec = probe.result("bail")
+        assert rec is not None and rec["status"] == "cancelled"
+        assert probe.spent("bail")
+        # the late host's claim refuses the withdrawn item
+        probe.join()
+        assert probe.claim(limit=4) == []
+    finally:
+        fe.close()
+    evs = obs.read_events(str(tmp_path), recursive=True)
+    cc = [e for e in evs if e["type"] == "request_cancelled"]
+    assert cc and cc[0].get("where") == "dqueue"
+    root_ends = [
+        e for e in evs
+        if e["type"] == "span_end"
+        and e.get("span") == trace_util.ROOT_SPAN
+        and e.get("key") == "bail"
+    ]
+    assert [e.get("status") for e in root_ends] == ["cancelled"]
+
+
+def test_cross_host_deadline_writes_durable_result(tmp_path):
+    """An end-to-end budget stamped at the frontend is honoured by a
+    host that arrives only AFTER expiry: the claim resolves the item
+    as a durable 'deadline' result (never leasing a solve slot), and
+    the frontend future raises the SAME DeadlineExceeded the
+    in-process fleet would — where='claim', honesty over a hang."""
+    from ccsc_code_iccv2017_tpu.serve import DeadlineExceeded
+    from ccsc_code_iccv2017_tpu.serve.dqueue import DurableQueue
+
+    fe = FederatedFrontend(
+        os.path.join(str(tmp_path), "q"), client="fe0",
+        metrics_dir=os.path.join(str(tmp_path), "m-fe"),
+        verbose="none", poll_s=0.02,
+    )
+    try:
+        b, m, x = _requests(1)[0]
+        f = fe.submit(
+            b, mask=m, x_orig=x, key="late", deadline_ms=50.0
+        )
+        time.sleep(0.15)  # budget lapses before any host exists
+        ev = []
+        host_q = DurableQueue(
+            os.path.join(str(tmp_path), "q"), host="H0",
+            emit=lambda t, **fi: ev.append(dict(fi, type=t)),
+        )
+        host_q.join()
+        assert host_q.claim(limit=4) == []  # resolved, not leased
+        rec = host_q.result("late")
+        assert rec is not None and rec["status"] == "deadline"
+        assert host_q.spent("late")
+        with pytest.raises(DeadlineExceeded) as ei:
+            f.result(timeout=30)
+        assert ei.value.where == "claim"
+        assert fe.n_failed == 1
+        kinds = [
+            (e["type"], e.get("where")) for e in ev
+            if e["type"] == "deadline_exceeded"
+        ]
+        assert ("deadline_exceeded", "claim") in kinds
+    finally:
+        fe.close()
+    evs = obs.read_events(str(tmp_path), recursive=True)
+    root_ends = [
+        e for e in evs
+        if e["type"] == "span_end"
+        and e.get("span") == trace_util.ROOT_SPAN
+        and e.get("key") == "late"
+    ]
+    assert [e.get("status") for e in root_ends] == ["deadline"]
+
+
+def test_cross_host_hedge_duplicates_suppressed(tmp_path, monkeypatch):
+    """Hedging inside a federated host never double-delivers across
+    the durable layer: with one replica injected slow-but-alive, the
+    host's fleet hedges stuck attempts onto its healthy replica,
+    exactly ONE durable result lands per key (the loser is suppressed
+    by the same spent-key fence and counted hedge_lost), every
+    frontend future resolves once, and the bytes are bit-identical to
+    an unfaulted fleet's serve of the same stream."""
+    from ccsc_code_iccv2017_tpu.models.reconstruct import (
+        ReconstructionProblem,
+    )
+    from ccsc_code_iccv2017_tpu.serve import ServeFleet
+    from ccsc_code_iccv2017_tpu.serve.dqueue import DurableQueue
+
+    d = _bank()
+    geom, cfg, scfg = _cfgs()
+    reqs = _requests(6)
+    # reference BEFORE the fault env lands: an unfaulted plain fleet
+    ref_fleet = ServeFleet(
+        d, ReconstructionProblem(geom), cfg, scfg,
+        FleetConfig(replicas=1, min_queue_depth=64, verbose="none"),
+    )
+    ref = [
+        ref_fleet.reconstruct(b, mask=m, x_orig=x, timeout=180)
+        for b, m, x in reqs
+    ]
+    ref_fleet.close()
+    # replica 0 of the HOST fleet: sustained ~0.8 s/request — slow,
+    # not hung, so the watchdog must stay silent
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_REQ", "1")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_S", "0.8")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_REPLICA", "0")
+    host = FederatedHost(
+        os.path.join(str(tmp_path), "q"), d,
+        ReconstructionProblem(geom), cfg, scfg,
+        FleetConfig(
+            replicas=2, min_queue_depth=64, restart_backoff_s=0.05,
+            hedge_after_ms=120.0, hedge_max_frac=1.0,
+            health_interval_s=0.02, verbose="none",
+        ),
+        host="hostA", metrics_dir=os.path.join(str(tmp_path), "m-host"),
+        heartbeat_s=0.2, ttl_s=1.0, skew_s=0.2, verbose="none",
+    )
+    fe = FederatedFrontend(
+        os.path.join(str(tmp_path), "q"), client="fe0",
+        metrics_dir=os.path.join(str(tmp_path), "m-fe"),
+        verbose="none", poll_s=0.02,
+    )
+    try:
+        futs = [
+            fe.submit(b, mask=m, x_orig=x, key=f"k{i}")
+            for i, (b, m, x) in enumerate(reqs)
+        ]
+        res = [f.result(timeout=180) for f in futs]
+        fe.seal()
+        assert host.serve_until_sealed(timeout=120)
+    finally:
+        host.close()
+        fe.close()
+    for i, (got, want) in enumerate(zip(res, ref)):
+        assert np.array_equal(got.recon, want.recon), f"k{i}"
+    # durable layer: exactly ONE result record per key, all ok
+    probe = DurableQueue(os.path.join(str(tmp_path), "q"), host="probe")
+    names = probe.result_names()
+    assert len(names) == len(reqs)
+    for i in range(len(reqs)):
+        assert probe.result(f"k{i}")["status"] == "ok"
+    evs = obs.read_events(str(tmp_path), recursive=True)
+    by = {}
+    for e in evs:
+        by.setdefault(e["type"], []).append(e)
+    spawns = by.get("hedge_spawn", [])
+    wins = by.get("hedge_win", [])
+    losses = by.get("hedge_lost", [])
+    assert spawns, "the slow replica never provoked a hedge"
+    assert len(wins) == len(losses)  # every win suppressed its loser
+    assert len(spawns) <= len(reqs)  # cap: hedge_max_frac=1.0
+    # slow is not dead: the watchdog must NOT have fired
+    assert not by.get("stall", [])
+    assert not by.get("fleet_replica_dead", [])
+    # every trace reassembles complete across frontend + host streams
+    traces = trace_util.assemble(evs)
+    for r in res:
+        assert traces[r.trace_id].complete

@@ -56,6 +56,11 @@ def _fault_isolation(monkeypatch):
         "CCSC_FAULT_ENGINE_HANG_REQ",
         "CCSC_FAULT_ENGINE_HANG_REPLICA",
         "CCSC_FAULT_ENGINE_HANG_S",
+        "CCSC_FAULT_ENGINE_SLOW_REQ",
+        "CCSC_FAULT_ENGINE_SLOW_REPLICA",
+        "CCSC_FAULT_ENGINE_SLOW_S",
+        "CCSC_REQ_DEADLINE_MS",
+        "CCSC_HEDGE_AFTER_MS",
         "CCSC_FAULT_STATE_DIR",
         "CCSC_WATCHDOG_ACTION",
         "CCSC_WATCHDOG_MIN_S",
@@ -1105,3 +1110,226 @@ def test_ceiling_recomputed_on_replica_death(tmp_path, monkeypatch):
         f"{post[-1]['ceiling']} !< {pre_ceiling}"
     )
     assert post[-1]["source"] == "serving_bound"
+
+
+# ------------------------------- request lifecycle (ISSUE 19)
+
+
+def test_deadline_refused_at_admission(tmp_path):
+    """A request whose budget is already spent at submit is refused
+    with ``DeadlineExceeded(where='admission')`` BEFORE any admission
+    work — asserted from the exception, the live counter, and the
+    event stream (the refusal never becomes a served request)."""
+    from ccsc_code_iccv2017_tpu.serve import DeadlineExceeded
+
+    d = _bank()
+    fleet = _fleet(d, _cfg(), tmp_path, replicas=1)
+    try:
+        x, m = _reqs(1)[0]
+        with pytest.raises(DeadlineExceeded) as ei:
+            fleet.submit(x * m, mask=m, key="doa", deadline_ms=0.0)
+        assert ei.value.where == "admission"
+        assert (
+            fleet.metrics()["counters"]["deadline_exceeded_total"]
+            == 1
+        )
+    finally:
+        fleet.close()
+    events = obs.read_events(str(tmp_path), recursive=True)
+    refusals = [
+        e for e in events if e["type"] == "deadline_exceeded"
+    ]
+    assert len(refusals) == 1
+    assert refusals[0]["where"] == "admission"
+    assert not any(e["type"] == "fleet_request" for e in events)
+
+
+def test_deadline_expires_in_queue_never_occupies_slot(
+    tmp_path, monkeypatch
+):
+    """Deadline honesty at the queue: while the only replica is held
+    by a slow request, a queued request whose budget expires is
+    dropped at the next take (``where='queue'``) — its future fails
+    with DeadlineExceeded, it NEVER occupies a solve slot (no
+    fleet_request, no attempt span), and its root span closes
+    ``deadline``."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    from ccsc_code_iccv2017_tpu.serve import DeadlineExceeded
+
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_REQ", "1")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_S", "1.0")
+    faults.reset()
+    d = _bank()
+    # slots=1: the slow request and the doomed one can never share a
+    # batch, so the expiry deterministically happens at the queue
+    fleet = _fleet(
+        d, _cfg(), tmp_path, replicas=1, buckets=((1, (12, 12)),)
+    )
+    try:
+        (x0, m0), (x1, m1) = _reqs(2)
+        f0 = fleet.submit(x0 * m0, mask=m0, key="slowed")
+        f1 = fleet.submit(
+            x1 * m1, mask=m1, key="doomed", deadline_ms=100.0
+        )
+        assert f0.result(timeout=120) is not None
+        with pytest.raises(DeadlineExceeded) as ei:
+            f1.result(timeout=120)
+        assert ei.value.where == "queue"
+    except FutTimeout:  # pragma: no cover - diagnosis aid
+        pytest.fail("expired request never resolved")
+    finally:
+        fleet.close()
+    events = obs.read_events(str(tmp_path), recursive=True)
+    exp = [
+        e for e in events
+        if e["type"] == "deadline_exceeded"
+        and e.get("key") == "doomed"
+    ]
+    assert len(exp) == 1 and exp[0]["where"] == "queue"
+    assert not any(
+        e["type"] == "fleet_request" and e["key"] == "doomed"
+        for e in events
+    )
+    roots = [
+        e for e in events
+        if e["type"] == "span_end" and e.get("span") == "request"
+        and e.get("status") == "deadline"
+    ]
+    assert len(roots) == 1
+
+
+def test_cancel_withdraws_queued_request(tmp_path, monkeypatch):
+    """Cooperative cancellation: cancelling a future while its
+    request still waits in the fleet queue withdraws it pre-dispatch
+    — counted, span-closed ``cancelled``, never served."""
+    from concurrent.futures import CancelledError
+
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_REQ", "1")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_S", "1.0")
+    faults.reset()
+    d = _bank()
+    fleet = _fleet(
+        d, _cfg(), tmp_path, replicas=1, buckets=((1, (12, 12)),)
+    )
+    try:
+        (x0, m0), (x1, m1) = _reqs(2)
+        f0 = fleet.submit(x0 * m0, mask=m0, key="busy")
+        f1 = fleet.submit(x1 * m1, mask=m1, key="bail")
+        assert f1.cancel()  # still queued: withdrawal must succeed
+        assert f0.result(timeout=120) is not None
+        with pytest.raises(CancelledError):
+            f1.result(timeout=120)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if fleet.control_snapshot()["cancelled"] == 1:
+                break
+            time.sleep(0.02)
+        assert fleet.control_snapshot()["cancelled"] == 1
+        assert fleet.metrics()["counters"]["cancelled_total"] == 1
+    finally:
+        fleet.close()
+    events = obs.read_events(str(tmp_path), recursive=True)
+    cans = [
+        e for e in events if e["type"] == "request_cancelled"
+    ]
+    assert len(cans) == 1 and cans[0]["key"] == "bail"
+    assert cans[0]["where"] == "queue"
+    assert not any(
+        e["type"] == "fleet_request" and e["key"] == "bail"
+        for e in events
+    )
+    roots = [
+        e for e in events
+        if e["type"] == "span_end" and e.get("span") == "request"
+        and e.get("status") == "cancelled"
+    ]
+    assert len(roots) == 1
+
+
+def test_hedge_routes_around_slow_replica_and_suppresses_loser(
+    tmp_path, monkeypatch
+):
+    """Hedged attempts, in-process: with replica 0 slow (not hung),
+    stuck attempts get a duplicate on replica 1; the first result
+    wins, every key is delivered exactly once and bit-identical to a
+    single unfaulted engine, the loser is suppressed-and-counted
+    (``hedge_lost`` event + attempt span), and the hedge volume
+    respects the hedge_max_frac denominator."""
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_REQ", "1")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_S", "1.0")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_SLOW_REPLICA", "0")
+    faults.reset()
+    d = _bank()
+    cfg = _cfg()
+    reqs = _reqs(6)
+    ref = _single_engine_results(d, cfg, reqs)
+    fleet = _fleet(
+        d, cfg, tmp_path, replicas=2, hedge_after_ms=100.0,
+        hedge_max_frac=1.0, health_interval_s=0.02,
+    )
+    try:
+        futs = [
+            fleet.submit(x * m, mask=m, key=f"h{i}")
+            for i, (x, m) in enumerate(reqs)
+        ]
+        res = [f.result(timeout=120) for f in futs]
+        snap = fleet.control_snapshot()
+        assert snap["hedges"] >= 1
+        assert snap["hedges"] <= 1.0 * len(reqs)  # the frac cap
+        assert snap["hedge_wins"] >= 1
+    finally:
+        fleet.close()  # joins workers: straggler losers settle
+    for i in range(len(reqs)):
+        np.testing.assert_array_equal(res[i].recon, ref[i].recon)
+    events = obs.read_events(str(tmp_path), recursive=True)
+    served = [e for e in events if e["type"] == "fleet_request"]
+    keys = [e["key"] for e in served]
+    assert sorted(keys) == sorted(f"h{i}" for i in range(6))
+    assert len(keys) == len(set(keys))  # exactly once each
+    spawns = {
+        e["key"] for e in events if e["type"] == "hedge_spawn"
+    }
+    wins = {e["key"] for e in events if e["type"] == "hedge_win"}
+    losses = {e["key"] for e in events if e["type"] == "hedge_lost"}
+    assert spawns
+    assert wins <= spawns and losses <= spawns
+    assert wins == losses  # every decided pair: winner + loser
+    lost_spans = [
+        e for e in events
+        if e["type"] == "span_end" and e.get("span") == "attempt"
+        and e.get("status") == "hedge_lost"
+    ]
+    assert len(lost_spans) == len(losses)
+
+
+def test_tenant_deadline_default_stamped_on_trace(tmp_path):
+    """``TenantSpec.deadline_ms`` is the tenant's default budget: the
+    resolved ABSOLUTE deadline is stamped on the request's root span
+    at admission (deadline honesty starts at the trace), and a
+    comfortable budget serves normally."""
+    from ccsc_code_iccv2017_tpu.config import TenantSpec
+
+    d = _bank()
+    fleet = _fleet(
+        d, _cfg(), tmp_path, replicas=1,
+        tenants=(
+            TenantSpec(tenant="mobile", deadline_ms=60_000.0),
+        ),
+    )
+    try:
+        x, m = _reqs(1)[0]
+        res = fleet.submit(
+            x * m, mask=m, key="t0", tenant="mobile"
+        ).result(timeout=120)
+        assert res is not None
+    finally:
+        fleet.close()
+    events = obs.read_events(str(tmp_path), recursive=True)
+    roots = [
+        e for e in events
+        if e["type"] == "span_start" and e.get("span") == "request"
+    ]
+    assert len(roots) == 1
+    dl = roots[0].get("deadline")
+    assert dl is not None and dl > time.time() - 120
