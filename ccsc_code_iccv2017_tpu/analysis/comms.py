@@ -1,0 +1,202 @@
+"""The collective-budget audit: static HLO communication accounting
+for AOT serving programs.
+
+The mesh serving path's perf story (PERF.md r9: 0.44-0.51x a single
+device) is a COMMUNICATION story — the freq-sharded solve pays a
+tiled ``all_gather`` at the tail of every z-solve while the batch-only
+mesh program should need no collectives at all (each device solves its
+own slot shard start to finish). Both properties used to be true only
+by inspection; nothing stopped a refactor from quietly re-introducing
+a per-iteration gather or a resharding ``all-reduce`` into the hot
+loop, and the regression would surface as an unattributed throughput
+cliff three rounds later.
+
+This pass makes the property *enforceable*, with the same
+guard-and-demote discipline the autotuner applies to numerics:
+
+- :func:`collective_counts` counts collective op DEFINITIONS in a
+  lowered program's stable HLO text (``compiled.as_text()``) — a
+  STATIC count, so one ``all-gather`` inside a ``while`` body counts
+  once regardless of trip count: the budget bounds the program text,
+  the iteration budget bounds the trip count, and their product
+  bounds the wire traffic.
+- :func:`declared_budget` maps a serving-mesh shape to its declared
+  per-solve budget: a batch-only mesh program declares ZERO (the
+  consensus-free decomposition — every slot's solve decouples), a
+  freq-sharded program declares ``CCSC_COMM_BUDGET_FREQ`` (default 1:
+  the single transpose-style spectrum exchange at the z-solve tail).
+- :func:`audit` is the one verdict call sites use: count, compare,
+  and (when ``CCSC_COMM_BUDGET_ENFORCE``, default on) raise
+  :class:`CommBudgetError` on an overrun. The serve engine runs it on
+  every AOT bucket program at warmup (recording the counts in the
+  ``comm_audit`` obs event and the artifact manifest), and
+  ``scripts/comm_audit.py`` runs it in CI on forced host devices.
+
+Counting is textual on purpose: ``as_text()`` is the stable
+executable dump, needs no XLA internals, and works identically on a
+deserialized artifact-store program and a freshly compiled one.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence
+
+from ..utils import env as _env
+
+__all__ = [
+    "CommBudgetError",
+    "COLLECTIVE_CLASSES",
+    "collective_counts",
+    "program_counts",
+    "declared_budget",
+    "enforce_enabled",
+    "check",
+    "audit",
+    "format_counts",
+]
+
+
+class CommBudgetError(RuntimeError):
+    """An AOT serving program's static HLO collective count exceeded
+    its declared budget (see ``analysis/comms.py``). Raised at warmup
+    — a program that over-communicates must never reach serving — and
+    silenced (audit-and-record only) by ``CCSC_COMM_BUDGET_ENFORCE=0``."""
+
+
+# audit class -> the HLO op mnemonics it counts. Async pairs count the
+# -start half only (the -done is the same logical collective), and
+# reduce-scatter books under the reduce class. Order matters for
+# matching: a longer mnemonic that embeds a shorter one (ragged-all-
+# to-all vs all-to-all) is handled by the word-boundary guard below,
+# not by ordering.
+COLLECTIVE_CLASSES: Dict[str, Sequence[str]] = {
+    "all_gather": ("all-gather", "all-gather-start"),
+    "all_reduce": ("all-reduce", "all-reduce-start", "reduce-scatter"),
+    "all_to_all": ("all-to-all", "ragged-all-to-all"),
+    "collective_permute": (
+        "collective-permute",
+        "collective-permute-start",
+    ),
+}
+
+
+def _op_pattern(mnemonic: str) -> "re.Pattern[str]":
+    # An op DEFINITION in HLO text is the mnemonic immediately
+    # followed by '(' — `f32[8,4]{1,0} all-gather(f32[8,1]{1,0} %x)`.
+    # The preceding guard rejects both identifier tails (`%all-
+    # gather.5` is followed by '.', never '(') and longer mnemonics
+    # that embed this one (`ragged-all-to-all(` must not count as
+    # `all-to-all(`); the trailing literal '(' rejects shorter
+    # prefixes (`all-gather(` never matches inside `all-gather-
+    # start(`).
+    return re.compile(r"(?<![\w-])" + re.escape(mnemonic) + r"\(")
+
+
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Static per-class collective-op counts of an HLO text dump,
+    plus their ``total``. Pure text analysis — safe on any string."""
+    counts: Dict[str, int] = {}
+    total = 0
+    for cls, mnemonics in COLLECTIVE_CLASSES.items():
+        n = sum(
+            len(_op_pattern(m).findall(hlo_text)) for m in mnemonics
+        )
+        counts[cls] = n
+        total += n
+    counts["total"] = total
+    return counts
+
+
+def program_counts(program) -> Optional[Dict[str, int]]:
+    """Counts for a compiled/loaded executable, or None when the
+    program cannot produce a stable text dump (a lazily-jitted
+    function before its first call has nothing to audit)."""
+    as_text = getattr(program, "as_text", None)
+    if as_text is None:
+        return None
+    try:
+        text = as_text()
+    except Exception:  # pragma: no cover - backend-dependent
+        return None
+    if not isinstance(text, str):
+        return None
+    return collective_counts(text)
+
+
+def declared_budget(mesh_shape: Optional[Sequence[int]]) -> int:
+    """The per-solve collective budget a serving-mesh shape declares.
+
+    Batch-only meshes (1-axis, or a 2-axis mesh with a trivial freq
+    axis) declare ZERO: slot solves decouple completely, so ANY
+    collective in the program text is a lowering bug. Freq-sharded
+    meshes declare ``CCSC_COMM_BUDGET_FREQ`` (default 1 — the single
+    spectrum exchange at the z-solve tail; the budget is total ops
+    across all classes, so a freq program that swaps its gather for a
+    gather PLUS a reduce still fails)."""
+    if not mesh_shape:
+        return 0
+    if len(mesh_shape) >= 2 and int(mesh_shape[1]) > 1:
+        return int(_env.env_int("CCSC_COMM_BUDGET_FREQ"))
+    return 0
+
+
+def enforce_enabled() -> bool:
+    return _env.env_flag("CCSC_COMM_BUDGET_ENFORCE")
+
+
+def format_counts(counts: Dict[str, int]) -> str:
+    """Human form for errors/logs: only the nonzero classes."""
+    parts = [
+        f"{cls}={n}"
+        for cls, n in counts.items()
+        if cls != "total" and n
+    ]
+    return ", ".join(parts) if parts else "none"
+
+
+def check(
+    counts: Dict[str, int],
+    mesh_shape: Optional[Sequence[int]],
+    *,
+    bucket: str = "",
+    budget: Optional[int] = None,
+) -> None:
+    """Raise :class:`CommBudgetError` when ``counts`` exceeds the
+    declared budget and enforcement is armed. Callers that need to
+    record the verdict before failing (the engine's ``comm_audit``
+    event) count first, record, then check."""
+    limit = declared_budget(mesh_shape) if budget is None else budget
+    if counts["total"] <= limit or not enforce_enabled():
+        return
+    mesh = "x".join(str(int(a)) for a in mesh_shape or ())
+    raise CommBudgetError(
+        f"bucket program {bucket or '?'} (mesh {mesh or 'none'}) "
+        f"contains {counts['total']} collective HLO op(s) "
+        f"[{format_counts(counts)}] over its declared budget of "
+        f"{limit}. A batch-only mesh program must contain none; a "
+        "freq-sharded program gets CCSC_COMM_BUDGET_FREQ (default "
+        "1: the z-solve tail exchange). Set "
+        "CCSC_COMM_BUDGET_ENFORCE=0 to record without enforcing."
+    )
+
+
+def audit(
+    program,
+    mesh_shape: Optional[Sequence[int]],
+    *,
+    bucket: str = "",
+    budget: Optional[int] = None,
+) -> Optional[Dict[str, int]]:
+    """Audit one AOT program against its declared budget.
+
+    Returns the counts dict (with ``total``), or None when the
+    program has no text dump. Raises :class:`CommBudgetError` on an
+    overrun when enforcement is armed; with ``CCSC_COMM_BUDGET_
+    ENFORCE=0`` the overrun is still visible in the returned counts
+    (callers record them in the obs stream + artifact manifest) but
+    does not fail the caller."""
+    counts = program_counts(program)
+    if counts is None:
+        return None
+    check(counts, mesh_shape, bucket=bucket, budget=budget)
+    return counts

@@ -600,7 +600,11 @@ def append_serve_record(
     dict gains the mesh shape and device count, so the knob digest —
     the ledger's configuration key — separates mesh-serving history
     from single-device history from day one, and ``perf_gate``
-    judges each against its own band."""
+    judges each against its own band. A record carrying the
+    pipelined arm (``pipeline_requests_per_sec``,
+    CCSC_SERVE_PIPELINE > 1) appends a THIRD row the same way — knob
+    dict plus ``pipeline=depth`` — so pipelined-dispatch history
+    accrues and gates under its own key too."""
     chip = rec.get("chip") or rec.get("platform")
     if not enabled() or not chip:
         return None
@@ -637,6 +641,29 @@ def append_serve_record(
                 devices=rec.get("mesh_devices"),
             ),
             value=rec["mesh_requests_per_sec"],
+            unit="requests/sec",
+            git_sha=git_sha,
+            n_compiles=rec.get("n_compiles"),
+            peak_hbm_bytes=rec.get("peak_hbm_bytes"),
+            degraded=bool(degraded),
+            source=source,
+        )
+    if rec.get("pipeline_requests_per_sec") is not None:
+        maybe_append(
+            chip=chip,
+            kind="serve",
+            workload="serve2d",
+            shape_key=rec.get("shape_key", ""),
+            # same symmetric-vocabulary stance as the mesh row: the
+            # pipelined configuration differs from the default by
+            # exactly the pipeline key (the engine's own knob dict
+            # adds it only when depth != 1, so depth-1 history keys
+            # stay untouched)
+            knobs=dict(
+                rec.get("knobs") or {},
+                pipeline=rec.get("pipeline_depth"),
+            ),
+            value=rec["pipeline_requests_per_sec"],
             unit="requests/sec",
             git_sha=git_sha,
             n_compiles=rec.get("n_compiles"),

@@ -76,6 +76,13 @@ EVENT_SCHEMA: Dict[str, FrozenSet[str]] = {
     # replica_id — the engine's _emit stamps one anyway) -------------
     "artifact_fetch": _s("key", "status"),
     "artifact_publish": _s("key", "status"),
+    # comm_audit is the per-bucket collective-budget verdict
+    # (analysis.comms counts collective op definitions in the AOT
+    # program's stable HLO; budget = declared per-solve allowance,
+    # total = measured static count, ok = within budget). Emitted at
+    # warmup for every mesh bucket program; scripts/comm_audit.py and
+    # the ci.sh collective-audit leg re-derive the same verdict ------
+    "comm_audit": _s("bucket", "mesh", "budget", "total", "ok"),
     "warmup_stage": _s("bucket", "stage", "source", "ready_s"),
     "bucket_cold": _s("bucket", "retry_after_s"),
     "serve_request": _s("replica_id", "trace_id", "bucket",

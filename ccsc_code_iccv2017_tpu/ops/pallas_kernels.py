@@ -22,14 +22,18 @@ axon platform rejects complex buffers at kernel boundaries anyway —
 see freq_solvers module docstring). Layout: K on sublanes (padded to a
 multiple of 8), frequency on lanes (tiles of F_TILE).
 
-STATUS: TEST ORACLE, not a production path. On the v5e this kernel
-measured 0.93x the einsum path (onchip_r4.jsonl 'pallas' arm) — XLA
-already fuses the rhs assembly well enough that the z-solve einsum was
-never the bottleneck — so `use_pallas` became a documented no-op and
-the ONE production Pallas path is the fused whole-iteration kernel
-(ops.pallas_fused_z). This kernel is kept as an independent
-implementation of the rank-1 solve, checked against the einsum path by
-tests/test_pallas.py.
+STATUS: MEASURED AUTOTUNER ARM (r10). On the v5e this kernel measured
+0.93x the einsum path (onchip_r4.jsonl 'pallas' arm) and was demoted
+to a test oracle in r5; r10 re-admitted it as a serve-solve autotuner
+knob (tune.space SOLVE_KNOBS `use_pallas`, non-exact, behind the
+numerics guard) so the sweep can re-judge it per chip and shape —
+it is promoted only where it measures faster, and a guard failure
+demotes it durably in the tuning store. freq_solvers.solve_z routes
+here for W == 1, filter-unsharded, static-rho solves; everything else
+falls back to the einsum path. The production Pallas path for
+LEARNING remains the fused whole-iteration kernel
+(ops.pallas_fused_z). tests/test_pallas.py checks this kernel against
+the einsum path as an independent implementation.
 """
 from __future__ import annotations
 

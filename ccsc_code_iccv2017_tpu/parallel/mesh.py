@@ -100,3 +100,18 @@ def shard_blocks(tree, mesh: Mesh):
     the mesh 'block' axis."""
     s = block_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, s), tree)
+
+
+def place_by_specs(tree, spec_tree, mesh: Mesh):
+    """Place every array in ``tree`` per the matching PartitionSpec in
+    ``spec_tree`` (a structurally identical tree whose leaves are
+    specs — e.g. ``models.reconstruct.plan_freq_specs``). The ahead-
+    of-dispatch half of bin-sharded serving plans: the solve factors
+    land on the mesh ONCE at plan install, so a dispatch that feeds
+    them to a shard_map'd program with the same in_specs pays no
+    per-call resharding and no replicated residency."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree,
+        spec_tree,
+    )

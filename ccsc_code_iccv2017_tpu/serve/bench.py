@@ -38,7 +38,18 @@ speedup_mesh_vs_default; the baseline/tuned engines pin
 mesh_shape=() so the env knob cannot leak into them. The mesh
 configuration lands in the perf ledger as its OWN knob-digest key —
 device count in the knob dict — so mesh-serving history accrues and
-gates separately from day one).
+gates separately from day one. The mesh record also carries the
+warmup collective audit [analysis.comms] per bucket, so the ledger
+row's throughput is attributable to a KNOWN communication budget),
+CCSC_SERVE_PIPELINE (depth > 1 — run a PIPELINED engine
+[ServeConfig.pipeline_depth: the worker holds that many launched
+batches in flight, overlapping batch N+1's upload with batch N's
+solve] on the same stream and record pipeline_requests_per_sec /
+speedup_pipeline_vs_default plus a BITWISE parity verdict against
+the default engine's outputs [pipelined dispatch only moves the
+fence, never the math]; the other arms pin pipeline_depth=1 so the
+env knob cannot leak into them. Its own knob-digest ledger row —
+pipeline=depth in the knob dict — accrues and gates separately).
 """
 from __future__ import annotations
 
@@ -147,10 +158,12 @@ def run_serve_workload() -> Dict:
 
     def run_engine(scfg):
         """One engine over the whole stream: build (AOT warmup),
-        submit, drain, close. Shared by the default and tuned engines
-        so their timing/parity protocol cannot drift apart. Returns
-        (results, requests/sec, warmup_s, ready_wallclock, knob_dict).
-        """
+        submit, drain, close. Shared by the default/tuned/mesh/
+        pipelined engines so their timing/parity protocol cannot
+        drift apart. Returns (results, requests/sec, warmup_s,
+        ready_wallclock, knob_dict, comm_counts) — comm_counts is
+        the warmup collective audit per bucket label (mesh engines;
+        empty otherwise)."""
         t0 = time.perf_counter()
         eng = CodecEngine(d, prob, cfg, scfg)
         warmup_s = time.perf_counter() - t0
@@ -160,10 +173,14 @@ def run_serve_workload() -> Dict:
         results = [f.result(timeout=600) for f in futs]
         t_eng = time.perf_counter() - t0
         knobs = dict(eng._knob_dict)
+        comms = {
+            f"{s}@" + "x".join(str(x) for x in sp): dict(c)
+            for (s, sp), c in eng.comm_counts.items()
+        }
         eng.close()
         mw.sample()  # engine drained: peak request-serving state
         rate = len(reqs) / t_eng if t_eng > 0 else 0.0
-        return results, rate, warmup_s, t_ready, knobs
+        return results, rate, warmup_s, t_ready, knobs, comms
 
     def max_rel_err(results):
         # output parity on the valid region (engine pads to buckets;
@@ -181,13 +198,14 @@ def run_serve_workload() -> Dict:
         buckets=buckets, max_wait_ms=wait_ms, metrics_dir=metrics_dir,
         verbose="none",
         compile_cache=_env.env_str("CCSC_COMPILE_CACHE") or None,
-        # the baseline engine is PINNED single-device: with
-        # CCSC_SERVE_MESH armed for the mesh arm below, a
-        # None-mesh_shape baseline would silently become the very
-        # mesh engine it is the baseline for
+        # the baseline engine is PINNED single-device and depth-1:
+        # with CCSC_SERVE_MESH / CCSC_SERVE_PIPELINE armed for the
+        # arms below, a None default would silently become the very
+        # engine it is the baseline for
         mesh_shape=(),
+        pipeline_depth=1,
     )
-    eng_res, eng_rps, t_warmup, t_ready, _ = run_engine(scfg)
+    eng_res, eng_rps, t_warmup, t_ready, _, _ = run_engine(scfg)
     max_rel = max_rel_err(eng_res)
 
     # zero-recompile assertion from the obs event stream: no backend
@@ -238,9 +256,11 @@ def run_serve_workload() -> Dict:
             metrics_dir=metrics2, verbose="none",
             compile_cache=_env.env_str("CCSC_COMPILE_CACHE") or None,
             tune=tune_mode,
-            mesh_shape=(),  # tuned arm stays single-device too
+            # tuned arm stays single-device, depth-1 too
+            mesh_shape=(),
+            pipeline_depth=1,
         )
-        res2, rps2, t_warm2, _, knobs2 = run_engine(scfg2)
+        res2, rps2, t_warm2, _, knobs2, _ = run_engine(scfg2)
         max_rel2 = max_rel_err(res2)
         tuned_fields = {
             "tuned_requests_per_sec": round(rps2, 4),
@@ -283,6 +303,7 @@ def run_serve_workload() -> Dict:
                     _env.env_str("CCSC_COMPILE_CACHE") or None
                 ),
                 mesh_shape=mesh_shape,
+                pipeline_depth=1,  # mesh effect alone
             )
             # build-time refusals surface at engine construction,
             # not config time: the freq axis is checked against the
@@ -291,7 +312,7 @@ def run_serve_workload() -> Dict:
             # this try, so it records mesh_skipped like every other
             # unbackable mesh instead of crashing the bench after
             # the baseline and tuned arms already ran
-            res3, rps3, t_warm3, _, knobs3 = run_engine(scfg3)
+            res3, rps3, t_warm3, _, knobs3, comms3 = run_engine(scfg3)
         except ValueError as e:
             mesh_fields = {"mesh_skipped": str(e)}
         else:
@@ -307,8 +328,49 @@ def run_serve_workload() -> Dict:
                 ),
                 "mesh_warmup_s": round(t_warm3, 3),
                 "mesh_knobs": knobs3,
+                # the warmup collective audit per bucket
+                # (analysis.comms): the ledger row's throughput is
+                # attributable to a KNOWN communication budget —
+                # batch-only meshes must show total=0 everywhere
+                "mesh_collectives": comms3,
                 "mesh_event_stream": metrics3,
             }
+
+    # ---- the PIPELINED engine on the same stream
+    # (CCSC_SERVE_PIPELINE > 1): same buckets, same requests — only
+    # ServeConfig.pipeline_depth differs, so the record's
+    # default-vs-pipelined gap is the measured value of overlapping
+    # batch N+1's host work + upload with batch N's in-flight solve.
+    # The outputs must be BITWISE the default engine's (the fence
+    # only moves later; the programs and their inputs are unchanged)
+    # — recorded as pipeline_bit_identical, not assumed.
+    pipe_depth = _env.env_int("CCSC_SERVE_PIPELINE")
+    pipe_fields = {}
+    if pipe_depth and int(pipe_depth) > 1:
+        metrics4 = tempfile.mkdtemp(prefix="ccsc_serve_pipe_")
+        scfg4 = ServeConfig(
+            buckets=buckets, max_wait_ms=wait_ms,
+            metrics_dir=metrics4, verbose="none",
+            compile_cache=_env.env_str("CCSC_COMPILE_CACHE") or None,
+            mesh_shape=(),  # pipelining effect alone
+            pipeline_depth=int(pipe_depth),
+        )
+        res4, rps4, t_warm4, _, knobs4, _ = run_engine(scfg4)
+        pipe_fields = {
+            "pipeline_depth": int(pipe_depth),
+            "pipeline_requests_per_sec": round(rps4, 4),
+            "speedup_pipeline_vs_default": round(
+                rps4 / eng_rps if eng_rps else 0.0, 3
+            ),
+            "pipeline_bit_identical": all(
+                np.array_equal(a.recon, b.recon)
+                and int(a.trace.num_iters) == int(b.trace.num_iters)
+                for a, b in zip(eng_res, res4)
+            ),
+            "pipeline_warmup_s": round(t_warm4, 3),
+            "pipeline_knobs": knobs4,
+            "pipeline_event_stream": metrics4,
+        }
 
     from ..tune import store as tune_store
 
@@ -370,4 +432,5 @@ def run_serve_workload() -> Dict:
         },
         **tuned_fields,
         **mesh_fields,
+        **pipe_fields,
     }

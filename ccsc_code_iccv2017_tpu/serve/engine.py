@@ -312,6 +312,20 @@ class _Pending:
     deadline: Optional[float] = None
 
 
+class _InFlight(NamedTuple):
+    """One launched-but-not-fenced dispatch (pipelined dispatch,
+    ServeConfig.pipeline_depth): the async program call's result
+    pytree plus everything the completion half needs to fence, read
+    back, and resolve futures. At depth 1 exactly one of these exists
+    for exactly the span of the old synchronous dispatch."""
+
+    key: Tuple  # ((slots, spatial), digest)
+    batch: List[_Pending]
+    depth_after: int
+    out: object  # the in-flight ReconResult (device arrays)
+    t0: float  # perf_counter at launch (batch canvas fill start)
+
+
 def _bucket_name(slots: int, spatial: Tuple[int, ...]) -> str:
     return f"{slots}@" + "x".join(str(s) for s in spatial)
 
@@ -451,6 +465,15 @@ class CodecEngine:
         self._profile_armed: Optional[str] = None
         self._profiled = False
 
+        # pipelined dispatch depth (ServeConfig.pipeline_depth, env
+        # fallback CCSC_SERVE_PIPELINE): how many batches the worker
+        # may hold in flight before fencing the oldest. Depth 1 is
+        # EXACTLY the historical launch-then-fence loop.
+        depth = serve_cfg.pipeline_depth
+        if depth is None:
+            depth = _envmod.env_int("CCSC_SERVE_PIPELINE")
+        self._pipeline_depth = max(1, int(depth or 1))
+
         self.cache_dir = enable_compile_cache(serve_cfg.compile_cache)
         self._run = obs.start_run(
             serve_cfg.metrics_dir,
@@ -544,6 +567,21 @@ class CodecEngine:
                 self._knob_dict["mesh"] = "x".join(
                     str(a) for a in self._mesh_shape
                 )
+                # the DECLARED collective budget (analysis.comms) —
+                # static per topology, so it keys artifact
+                # fingerprints and ledger history stably; MEASURED
+                # counts ride the comm_audit event, the artifact
+                # manifest, and the bench record instead
+                from ..analysis import comms as _comms
+
+                self._knob_dict["comm_budget"] = (
+                    _comms.declared_budget(self._mesh_shape)
+                )
+            if self._pipeline_depth != 1:
+                # only a non-default depth keys the knob dict: depth-1
+                # engines keep their historical knob digest (and so
+                # their perf-ledger history keys) bit-for-bit
+                self._knob_dict["pipeline"] = self._pipeline_depth
             if serve_cfg.replica_id is None:
                 # standalone engines capture their own workload; a
                 # fleet replica's stream is captured ONCE at the
@@ -606,6 +644,7 @@ class CodecEngine:
             SolveExtras,
             _reconstruct_impl,
             build_plan,
+            plan_freq_specs,
         )
 
         import jax
@@ -624,13 +663,17 @@ class CodecEngine:
             # while_loop freezes converged slots, so slot results are
             # bit-identical to a standalone reconstruct() call. On a
             # 2-D mesh the slot's per-frequency solves additionally
-            # shard over the 'freq' axis (the plan's precomputed
-            # factors are sliced per device — same bits per bin).
+            # shard over the 'freq' axis; the plan's solve factors
+            # arrive as this device's own bin shard (kern_presliced:
+            # the program's in_specs partition the kern leaves, see
+            # plan_freq_specs) — same bits per bin, no replicated
+            # kern residency and no in-program slice.
             return _reconstruct_impl(
                 b1[None], None, prob, cfg, m1[None], s1[None], None,
                 x1[None], plan=plan,
                 freq_axis_name="freq" if has_freq else None,
                 num_freq_shards=nf,
+                kern_presliced=has_freq,
             )
 
         def _vmapped(bb, mm, ss, xx, plan):
@@ -638,19 +681,38 @@ class CodecEngine:
                 bb, mm, ss, xx, plan
             )
 
+        # result/trace out-specs of the mesh programs: every result
+        # leaf carries the slot axis first (vmap), sharded like the
+        # inputs; traces are per-slot too, so nothing is replicated
+        # back. With solve diagnostics on, the trace carries the
+        # extras subtree (per-slot scalars, sharded the same way);
+        # off, the None default is an empty pytree subtree and the
+        # historical spec matches exactly.
+        def _mesh_out_specs(bs):
+            return ReconResult(
+                bs,
+                bs,
+                ReconTrace(
+                    bs, bs, bs, bs,
+                    SolveExtras(bs, bs, bs)
+                    if cfg.track_diagnostics
+                    else None,
+                ),
+            )
+
+        self._plan_specs_fn = None
         if mesh is None:
             _bucket_program = _vmapped
-        else:
-            # the mesh bucket program: the slot axis sharded over the
-            # mesh's first axis via shard_map — each device runs the
-            # SAME vmap-of-independent-n=1-solves body over its
-            # slots/batch shard, with the plan (spectra + solve
+        elif not has_freq:
+            # the batch-mesh bucket program: the slot axis sharded
+            # over the mesh's only axis via shard_map — each device
+            # runs the SAME vmap-of-independent-n=1-solves body over
+            # its slots/batch shard, with the plan (spectra + solve
             # factors) replicated. No cross-slot collectives exist in
-            # the body, so per-slot results are bit-identical to the
-            # single-device program's (tests/test_serve_mesh.py); the
-            # optional 'freq' axis adds per-slot tensor parallelism
-            # with one tiled all_gather per iteration (the learner's
-            # block_freq_mesh scheme).
+            # the body — the program lowers to ZERO collective HLO
+            # ops, enforced by the analysis.comms audit at warmup —
+            # so per-slot results are bit-identical to the
+            # single-device program's (tests/test_serve_mesh.py).
             from jax.sharding import PartitionSpec as P
 
             from ..parallel.mesh import shard_map
@@ -661,35 +723,50 @@ class CodecEngine:
                 _vmapped,
                 mesh=mesh,
                 in_specs=(bs, bs, bs, bs, rep),
-                # every result leaf carries the slot axis first
-                # (vmap), sharded like the inputs; traces are
-                # per-slot too, so nothing is replicated back. With
-                # solve diagnostics on, the trace carries the extras
-                # subtree (per-slot scalars, sharded the same way);
-                # off, the None default is an empty pytree subtree
-                # and the historical spec matches exactly.
-                out_specs=ReconResult(
-                    bs,
-                    bs,
-                    ReconTrace(
-                        bs, bs, bs, bs,
-                        SolveExtras(bs, bs, bs)
-                        if cfg.track_diagnostics
-                        else None,
-                    ),
-                ),
+                out_specs=_mesh_out_specs(bs),
                 # the while_loop carry mixes varying (data-derived)
                 # and invarying (zero-init) components; skip vma
                 # tracking like the learner's sharded solver
                 check_vma=False,
             )
+        else:
+            # the (batch, freq) bucket program is built PER BUCKET
+            # (self._program_fn_for, called from _warm_bucket where a
+            # concrete plan exists): its in_specs carry the plan's
+            # own partition-spec tree (plan_freq_specs — kern leaves
+            # sharded by frequency bin), and a spec tree's aux data
+            # (the plan's FreqGeom) is bucket-specific. Each device's
+            # bin slice of the solve factors stays RESIDENT across
+            # dispatches; the program's only collective is the single
+            # tiled all_gather at the z-solve tail (budget 1,
+            # enforced by the analysis.comms audit).
+            _bucket_program = None
+            self._plan_specs_fn = plan_freq_specs
 
-        # the jitted program carries a STABLE name so the compile
-        # monitor's events are filterable by program: "a warm-store
-        # startup performed ZERO bucket compiles" is asserted from
-        # the obs stream by matching fun_name against this
-        with contextlib.suppress(AttributeError):
-            _bucket_program.__name__ = "ccsc_bucket_program"
+        if _bucket_program is not None:
+            # the jitted program carries a STABLE name so the compile
+            # monitor's events are filterable by program: "a
+            # warm-store startup performed ZERO bucket compiles" is
+            # asserted from the obs stream by matching fun_name
+            # against this
+            with contextlib.suppress(AttributeError):
+                _bucket_program.__name__ = "ccsc_bucket_program"
+        self._vmapped_fn = _vmapped
+        self._mesh_out_specs_fn = _mesh_out_specs
+        # the slot-axis sharding every per-dispatch data canvas is
+        # uploaded onto (mesh engines): device_put straight to the
+        # program's in_specs so the async dispatch starts its
+        # host->device transfer immediately — under pipelined dispatch
+        # batch N+1's upload overlaps batch N's solve
+        if mesh is not None:
+            from jax.sharding import (
+                NamedSharding as _NS,
+                PartitionSpec as _P,
+            )
+
+            self._data_sharding = _NS(mesh, _P(mesh.axis_names[0]))
+        else:
+            self._data_sharding = None
 
         # ---- per-bucket plans + AOT-compiled programs --------------
         # Multi-bank serving (serve.registry): plans live in a
@@ -721,6 +798,10 @@ class CodecEngine:
         self._plan_cache = _registry.PlanCache()
         self._programs: Dict[Tuple, object] = {}
         self._bucket_program_fn = _bucket_program
+        # per-bucket measured collective counts (analysis.comms audit
+        # at warmup; surfaced via the comm_counts property and the
+        # bench's ledger rows)
+        self._comm_counts: Dict[Tuple, Dict[str, int]] = {}
 
         # ---- micro-batch queue (BEFORE warmup: under staged warmup
         # the engine serves its hottest bucket while cold programs
@@ -735,12 +816,13 @@ class CodecEngine:
             ((s, sp), default_digest): [] for s, sp in self._buckets
         }
         self._n_pending = 0
-        # digest of the batch the worker is CURRENTLY dispatching
-        # (set under the lock at pop, cleared after the dispatch):
-        # retire_bank must refuse it — the worker fetches the plan
-        # after releasing the queue lock, and a retire in that window
-        # would fail the whole batch
-        self._dispatch_digest: Optional[str] = None
+        # digests of the batches the worker has launched but not yet
+        # released (one list entry PER in-flight batch — pipelined
+        # dispatch can hold pipeline_depth of them, possibly the same
+        # digest twice): retire_bank must refuse them — the worker
+        # consults the plan after releasing the queue lock, and a
+        # retire in that window would fail the whole batch
+        self._dispatch_digests: List[str] = []
         self._closed = False
         # live flush deadline (set_max_wait_ms): the fleet's overload
         # ladder sheds micro-batch waiting without rebuilding engines
@@ -855,6 +937,88 @@ class CodecEngine:
                 return
         self._finish_warmup()
 
+    def _program_fn_for(self, plan):
+        """The bucket-program callable serving ``plan``'s bucket: the
+        shared module-level program when the in_specs don't depend on
+        the plan (single-device vmap; batch-only mesh with the plan
+        replicated), else a per-bucket (batch, freq) shard_map whose
+        in_specs carry this plan's own bin-sharded spec tree
+        (plan_freq_specs) — the spec tree's aux data is the plan's
+        FreqGeom, so it cannot be built before a concrete plan
+        exists. Same-bucket plans of OTHER banks share the program:
+        their pytrees are aux-identical (d_digest canonicalized)."""
+        if self._plan_specs_fn is None:
+            return self._bucket_program_fn
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import shard_map
+
+        bs = P(self._mesh.axis_names[0])
+        fn = shard_map(
+            self._vmapped_fn,
+            mesh=self._mesh,
+            in_specs=(bs, bs, bs, bs, self._plan_specs_fn(plan)),
+            out_specs=self._mesh_out_specs_fn(bs),
+            check_vma=False,
+        )
+        with contextlib.suppress(AttributeError):
+            fn.__name__ = "ccsc_bucket_program"
+        return fn
+
+    def _place_plan(self, plan):
+        """Pre-place a plan onto the (batch, freq) mesh per its
+        bin-sharded spec tree: each device holds only its own
+        frequency bins of the solve factors (spectra replicated for
+        the FFT boundary), resident across dispatches. No-op for
+        single-device and batch-only engines, whose programs place
+        the replicated plan themselves."""
+        if self._plan_specs_fn is None:
+            return plan
+        from ..parallel.mesh import place_by_specs
+
+        return place_by_specs(
+            plan, self._plan_specs_fn(plan), self._mesh
+        )
+
+    def _audit_program(self, program, key, name):
+        """The collective-budget gate (analysis.comms) on one AOT
+        bucket program: count collective op definitions in the stable
+        HLO, record the verdict (``comm_audit`` event + the
+        comm_counts property the bench reads), and — enforcement on
+        (CCSC_COMM_BUDGET_ENFORCE, default) — refuse an overrun with
+        CommBudgetError BEFORE the program can serve. Single-device
+        engines and lazily-jitted programs (no stable text yet) skip
+        silently; returns the counts dict otherwise."""
+        if self._mesh is None:
+            return None
+        from ..analysis import comms as _comms
+
+        counts = _comms.program_counts(program)
+        if counts is None:
+            return None
+        budget = _comms.declared_budget(self._mesh_shape)
+        ok = counts["total"] <= budget
+        self._comm_counts[key] = dict(counts)
+        self._emit(
+            "comm_audit",
+            bucket=name,
+            mesh="x".join(str(a) for a in self._mesh_shape),
+            budget=budget,
+            total=counts["total"],
+            ok=ok,
+            **{k: v for k, v in counts.items() if k != "total"},
+        )
+        _comms.check(
+            counts, self._mesh_shape, bucket=name, budget=budget
+        )
+        return counts
+
+    @property
+    def comm_counts(self) -> Dict[Tuple, Dict[str, int]]:
+        """Measured per-bucket collective counts from the warmup
+        audit (empty for single-device engines / lazy programs)."""
+        return dict(self._comm_counts)
+
     def _warm_bucket(self, key, stage: int, n_stages: int):
         """Make ONE bucket serveable: build its plan, then fetch its
         AOT executable from the artifact store (or live-compile and
@@ -885,6 +1049,10 @@ class CodecEngine:
         # digest-canonical storage: all same-geometry banks share
         # one compiled program per bucket (aux-data equality)
         plan = dataclasses.replace(plan, d_digest="")
+        # bin-sharded residency: on a (batch, freq) mesh the plan's
+        # solve factors land on the mesh NOW (each device holds its
+        # own frequency bins), so dispatches pay no resharding
+        plan = self._place_plan(plan)
         self._plan_cache.put(self._default_digest, key, plan)
 
         from . import artifacts as _artifacts
@@ -934,8 +1102,15 @@ class CodecEngine:
                 fetch_s=fetch_s,
                 store=self._artifacts.path,
             )
+        if program is not None:
+            # a FETCHED program is re-audited locally: the publisher
+            # audited it too, but the budget knobs are this host's
+            # (an overrun refuses before install — the store must not
+            # be able to smuggle an over-communicating program past
+            # the gate)
+            self._audit_program(program, key, name)
         if program is None and serve_cfg.aot_warmup:
-            fn = jax.jit(self._bucket_program_fn)
+            fn = jax.jit(self._program_fn_for(plan))
             shp = jax.ShapeDtypeStruct(
                 (slots, *self.geom.reduce_shape, *spatial),
                 jnp.float32,
@@ -955,6 +1130,10 @@ class CodecEngine:
             )
             if source == "compiled":
                 self._n_compiled += 1
+            # collective-budget gate (analysis.comms): audited BEFORE
+            # publish/install — a program over its declared budget
+            # must neither serve nor enter the shared store
+            counts = self._audit_program(program, key, name)
             if self._artifacts is not None and self._artifact_publish:
                 try:
                     payload = _artifacts.serialize_program(program)
@@ -965,6 +1144,7 @@ class CodecEngine:
                         chip=self._chip,
                         mesh_shape=self._mesh_shape,
                         bucket=name,
+                        collectives=counts,
                     )
                 except Exception as e:
                     # best-effort: a store that cannot serialize this
@@ -975,7 +1155,7 @@ class CodecEngine:
                         tier="always",
                     )
         elif program is None:
-            program = jax.jit(self._bucket_program_fn)
+            program = jax.jit(self._program_fn_for(plan))
         dt = time.perf_counter() - t0
         with self._cv:
             self._programs[key] = program
@@ -1304,13 +1484,45 @@ class CodecEngine:
         return [f.result(timeout=timeout) for f in futs]
 
     # ------------------------------------------------------------------
+    def _release_digest(self, digest: str) -> None:
+        """Drop ONE in-flight reference to ``digest`` (the worker
+        holds one per launched batch; retire_bank refuses digests
+        with live references). Idempotent per reference: the
+        completion path releases early — the moment the plan is no
+        longer consulted — and the worker's backstop release on the
+        error paths then finds nothing to remove."""
+        with self._cv:
+            try:
+                self._dispatch_digests.remove(digest)
+            except ValueError:
+                pass
+
     def _work_loop(self):
+        # pipelined dispatch (ServeConfig.pipeline_depth): up to
+        # ``depth`` launched-but-unfenced dispatches ride in this
+        # deque, oldest first. Launching batch N+1 is pure host work
+        # plus an async device dispatch, so it overlaps batch N's
+        # in-flight solve; the fence (and every trace readback behind
+        # it) happens in _complete, off the launch critical path.
+        # Depth 1 degenerates to launch-then-immediately-complete —
+        # the classic synchronous worker, event for event.
+        inflight: List[_InFlight] = []
+        depth = self._pipeline_depth
         while True:
             expired: List[_Pending] = []
+            key = None
             with self._cv:
-                while not self._closed and self._n_pending == 0:
+                while (
+                    not self._closed
+                    and self._n_pending == 0
+                    and not inflight
+                ):
                     self._cv.wait()
-                if self._closed and self._n_pending == 0:
+                if (
+                    self._closed
+                    and self._n_pending == 0
+                    and not inflight
+                ):
                     return
                 # read under the lock, every pass: set_max_wait_ms
                 # (overload rung 1) retargets the deadline live, and
@@ -1342,9 +1554,7 @@ class CodecEngine:
                     if len(keep) != len(lst):
                         self._pending[k] = keep
                 self._n_pending -= len(expired)
-                if expired:
-                    key = None
-                else:
+                if not expired and self._n_pending:
                     # oldest-lane flush FIRST: a steady stream keeping
                     # one bucket full must not starve another bucket's
                     # lone request past its max_wait_ms contract
@@ -1356,14 +1566,18 @@ class CodecEngine:
                                         and now >= ot + max_wait):
                         key = ok
                     else:
-                        key = None
                         for k, lst in self._pending.items():
                             # k = ((slots, spatial), digest): a full
                             # bank-lane flushes immediately
                             if lst and len(lst) >= k[0][0]:
                                 key = k
                                 break
-                        if key is None:
+                        if key is None and not inflight:
+                            # nothing flushable and nothing in
+                            # flight: sleep. With work IN flight the
+                            # worker never sleeps here — it falls
+                            # through to complete the oldest launch
+                            # (the fence is the productive wait).
                             t_wait = ot + max_wait - now
                             if dl_min is not None:
                                 # cap the wait at the earliest
@@ -1376,12 +1590,13 @@ class CodecEngine:
                                 )
                             self._cv.wait(timeout=t_wait)
                             continue
+                if key is not None:
                     slots_k = key[0][0]
                     batch = self._pending[key][:slots_k]
                     self._pending[key] = self._pending[key][slots_k:]
                     self._n_pending -= len(batch)
                     depth_after = self._n_pending
-                    self._dispatch_digest = key[1]
+                    self._dispatch_digests.append(key[1])
             if expired:
                 for p in expired:
                     # a client-cancelled future is dropped silently
@@ -1396,30 +1611,52 @@ class CodecEngine:
                             deadline=round(p.deadline, 3),
                         )
                 continue
-            # transition futures to RUNNING; a client-cancelled request
-            # is dropped HERE — set_result on a cancelled Future raises
-            # InvalidStateError, which would poison its batch siblings
-            batch = [
-                p for p in batch
-                if p.future.set_running_or_notify_cancel()
-            ]
-            if not batch:
-                continue
-            try:
-                self._dispatch(key, batch, depth_after)
-            except Exception as e:  # pragma: no cover - surfacing path
-                for p in batch:
-                    if not p.future.done():
-                        p.future.set_exception(e)
-                self._emit("serve_error", error=str(e)[:300])
-            finally:
-                with self._cv:
-                    self._dispatch_digest = None
+            if key is not None:
+                # transition futures to RUNNING; a client-cancelled
+                # request is dropped HERE — set_result on a cancelled
+                # Future raises InvalidStateError, which would poison
+                # its batch siblings
+                batch = [
+                    p for p in batch
+                    if p.future.set_running_or_notify_cancel()
+                ]
+                if batch:
+                    try:
+                        inflight.append(
+                            self._launch(key, batch, depth_after)
+                        )
+                    except Exception as e:  # pragma: no cover
+                        for p in batch:
+                            if not p.future.done():
+                                p.future.set_exception(e)
+                        self._emit("serve_error", error=str(e)[:300])
+                        self._release_digest(key[1])
+                else:
+                    self._release_digest(key[1])
+                if len(inflight) < depth:
+                    # room in the pipeline: go look for the next
+                    # batch to upload before paying any fence
+                    continue
+            if inflight:
+                inf = inflight.pop(0)
+                try:
+                    self._complete(inf)
+                except Exception as e:  # pragma: no cover - surfacing
+                    for p in inf.batch:
+                        if not p.future.done():
+                            p.future.set_exception(e)
+                    self._emit("serve_error", error=str(e)[:300])
+                finally:
+                    self._release_digest(inf.key[1])
 
-    def _dispatch(self, key, batch: List[_Pending], depth_after: int):
-        from ..models.reconstruct import ReconTrace, SolveExtras
-        from ..utils import perfmodel
-
+    def _launch(self, key, batch: List[_Pending],
+                depth_after: int) -> _InFlight:
+        """The dispatch half that needs no fence: plan fetch, batch
+        canvas fill, host->device upload (onto the bucket's batch
+        sharding on a mesh engine), and the async program call. With
+        pipeline_depth > 1 this runs while the PREVIOUS batch's solve
+        is still in flight — JAX dispatch is asynchronous, so the
+        returned _InFlight holds device futures, not results."""
         jnp = self._jnp
         bkey, digest = key
         slots, spatial = bkey
@@ -1462,13 +1699,30 @@ class CodecEngine:
             ctx = profiling.xla_trace(prof_dir)
         else:
             ctx = contextlib.nullcontext()
+        if self._data_sharding is not None:
+            # mesh engine: upload straight onto the bucket program's
+            # batch sharding — the shards land on their devices here
+            # (asynchronously, overlapping any in-flight solve under
+            # pipelining) instead of being resharded at call time
+            import jax
+
+            sh = self._data_sharding
+
+            def _put(a):
+                return jax.device_put(a, sh)
+
+        else:
+            _put = jnp.asarray
         try:
             with ctx:
                 out = self._programs[bkey](
-                    jnp.asarray(bb), jnp.asarray(mm), jnp.asarray(ss),
-                    jnp.asarray(xx), plan,
+                    _put(bb), _put(mm), _put(ss), _put(xx), plan,
                 )
-                iters = np.asarray(out.trace.num_iters)  # the fence
+                if prof_dir:
+                    # a profiled dispatch fences INSIDE the capture
+                    # (one-shot; the trace must contain the solve,
+                    # not just its async launch)
+                    np.asarray(out.trace.num_iters)
         finally:
             # the capture is consumed either way (one-shot) — record
             # it even when the profiled solve RAISES: the trace on
@@ -1478,12 +1732,51 @@ class CodecEngine:
                 self._emit(
                     "slo_profile", trace_dir=prof_dir, bucket=name
                 )
+        return _InFlight(key, batch, depth_after, out, t0)
+
+    def _complete(self, inf: _InFlight) -> None:
+        """The dispatch half behind the fence: block on num_iters
+        (THE fence — everything else in the result pytree is ready
+        once it is), read back whatever the tracking flags say anyone
+        consumes, resolve futures, and emit the dispatch tail
+        (spans, SLO/quality ticks, serve_dispatch)."""
+        from ..models.reconstruct import ReconTrace, SolveExtras
+        from ..utils import perfmodel
+
+        key, batch, depth_after, out, t0 = inf
+        bkey, digest = key
+        slots, spatial = bkey
+        geom = self.geom
+        name = _bucket_name(slots, spatial)
+        iters = np.asarray(out.trace.num_iters)  # the fence
         dt = time.perf_counter() - t0
         t_done = time.perf_counter()
 
-        obj = np.asarray(out.trace.obj_vals)
-        psnr = np.asarray(out.trace.psnr_vals)
-        diff = np.asarray(out.trace.diff_vals)
+        # trace readbacks are GATED on the tracking flags: an
+        # untracked trace is device zeros — transferring them every
+        # dispatch buys nothing, so the host substitutes the same
+        # zeros. obj/diff ride track_objective (diff additionally on
+        # the diagnostics flag), psnr rides track_psnr; num_iters
+        # above is always read — it is the fence.
+        n_tr = int(self.cfg.max_it) + 1
+        zeros_tr = None
+        if not (self.cfg.with_objective and self.cfg.with_psnr):
+            zeros_tr = np.zeros((slots, n_tr), np.float32)
+        obj = (
+            np.asarray(out.trace.obj_vals)
+            if self.cfg.with_objective
+            else zeros_tr
+        )
+        psnr = (
+            np.asarray(out.trace.psnr_vals)
+            if self.cfg.with_psnr
+            else zeros_tr
+        )
+        diff = (
+            np.asarray(out.trace.diff_vals)
+            if self.cfg.with_objective or self.cfg.track_diagnostics
+            else zeros_tr
+        )
         recon = np.asarray(out.recon)
         z = np.asarray(out.z) if self.serve_cfg.return_codes else None
 
@@ -1508,16 +1801,15 @@ class CodecEngine:
             nonfinite=ex_nonf,
         )
 
-        # the dispatch's digest binding ends HERE: the solve is read
+        # this dispatch's digest binding ends HERE: the solve is read
         # back and the plan is never consulted again, so the digest
-        # must be unreferenced before any future resolves — a client
+        # reference must drop before any future resolves — a client
         # that calls publish_bank the moment its result lands has to
         # see the superseded digest retirable (the hot-swap sweep
-        # contract; the worker loop's finally-clear is the backstop
-        # for the raising paths above)
-        with self._cv:
-            if self._dispatch_digest == digest:
-                self._dispatch_digest = None
+        # contract; the worker loop's finally-release is the backstop
+        # for the raising paths above). Another in-flight launch on
+        # the same digest holds its OWN reference.
+        self._release_digest(digest)
 
         max_it = int(iters[: len(batch)].max()) if len(batch) else 0
         for i, p in enumerate(batch):
@@ -1793,6 +2085,10 @@ class CodecEngine:
             buckets=self._buckets,
         )
         plan = dataclasses.replace(plan, d_digest="")
+        # bin-sharded residency (freq meshes): rebuilt plans land on
+        # the mesh exactly like warmup-installed ones, so a
+        # rebuild-on-miss dispatch pays no resharding either
+        plan = self._place_plan(plan)
         with self._cv:
             pin = {
                 lane[1]
@@ -1895,7 +2191,7 @@ class CodecEngine:
         with self._cv:
             if digest in self._routes.values():
                 return False
-            if self._dispatch_digest == digest:
+            if digest in self._dispatch_digests:
                 return False
             if any(
                 lane[1] == digest and lst

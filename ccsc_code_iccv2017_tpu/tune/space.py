@@ -33,7 +33,9 @@ from typing import Dict, List, Optional, Tuple
 # Bump when the meaning of a knob or the application mechanics change
 # incompatibly: the code fingerprint below keys every store entry, so
 # old entries stop matching instead of silently configuring new code.
-SPACE_VERSION = 1
+# v2: use_pallas re-admitted as a measured solve knob (r10) — r5-era
+# entries never measured it, so they must stop matching.
+SPACE_VERSION = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,7 +103,11 @@ NON_TUNED_LEARN: Dict[str, str] = {
     "verbose": "operational",
     "track_objective": "operational",
     "compat_coding": "algorithmic (reference-compat semantics)",
-    "use_pallas": "deprecated no-op (r5 demotion)",
+    # the learners' production Pallas path is fused_z (whole-iteration
+    # kernel); the per-solve rank-1 kernel is a SOLVE knob only (r10)
+    "use_pallas": "not a learn knob (fused_z is the learners' "
+                  "Pallas lever; per-solve routing is tuned on the "
+                  "solve side)",
     "max_recoveries": "operational",
     "rho_backoff": "operational",
     "metrics_dir": "operational",
@@ -126,6 +132,16 @@ SOLVE_KNOBS: Dict[str, Knob] = {
         (None, "cholesky", "schur", "newton"),
         workloads=("solve2d+r", "solve3d+r", "solve4d+r"),
     ),
+    # r10 re-admission of the per-solve Pallas rank-1 kernel
+    # (ops.pallas_kernels; demoted to a test oracle in r5 at 0.93x on
+    # the v5e). Non-exact: the fused re/im arithmetic reorders float
+    # ops, so the numerics guard judges every arm that moves it.
+    # solve_z only routes at W == 1 / filter-unsharded; workload
+    # prefixes cannot express "solve2d but NOT solve2d+r1" (prefix
+    # match), so on W > 1 workloads the knob is a warned einsum
+    # fallback no-op — the same noise-winner caveat as herm_inv at
+    # W == 1, accepted because sweep demotion persists either verdict.
+    "use_pallas": Knob((False, True)),
 }
 
 NON_TUNED_SOLVE: Dict[str, str] = {
@@ -142,7 +158,6 @@ NON_TUNED_SOLVE: Dict[str, str] = {
     "track_objective": "operational",
     "track_psnr": "operational",
     "track_diagnostics": "operational (quality observatory readback)",
-    "use_pallas": "deprecated no-op (r5 demotion)",
     "metrics_dir": "operational",
     "tune": "operational (the autotuner's own switch)",
 }

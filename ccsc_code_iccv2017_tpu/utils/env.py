@@ -111,6 +111,24 @@ REGISTRY: Dict[str, Knob] = _knobs(
      "refuse a serving mesh the visible device pool cannot back "
      "(with the forced-host-device recipe in the error); 0 falls "
      "back to a single-device engine with a console note instead"),
+    ("CCSC_SERVE_PIPELINE", "int", 1, "serve.engine, serve.bench",
+     "engine dispatch pipeline depth (fallback of "
+     "ServeConfig.pipeline_depth): 2 double-buffers the dispatch "
+     "worker so batch N+1's host->device upload overlaps batch N's "
+     "in-flight solve with trace readback deferred off the launch "
+     "path (bit-identical results); 1 = the classic fully "
+     "synchronous dispatch"),
+    ("CCSC_COMM_BUDGET_ENFORCE", "flag", True,
+     "analysis.comms, serve.engine",
+     "fail bucket-program warmup (CommBudgetError) when the AOT "
+     "program's static stable-HLO collective count exceeds its "
+     "declared budget (batch-only mesh: 0; freq mesh: "
+     "CCSC_COMM_BUDGET_FREQ); 0 records the audit (comm_audit "
+     "event + artifact manifest) without enforcing"),
+    ("CCSC_COMM_BUDGET_FREQ", "int", 1, "analysis.comms",
+     "declared per-solve collective budget of a freq-sharded "
+     "bucket program (default 1: the single spectrum exchange at "
+     "the z-solve tail); batch-only mesh programs always declare 0"),
     # -- compiled-artifact store + staged warmup (serve.artifacts,
     # serve.engine) ---------------------------------------------------
     ("CCSC_ARTIFACT_STORE", "path", None,
@@ -480,7 +498,7 @@ REGISTRY: Dict[str, Knob] = _knobs(
     ("CCSC_BENCH_INPROCESS", "flag", False, "bench.py",
      "run arms in-process instead of subprocesses"),
     ("CCSC_BENCH_PALLAS", "flag", False, "bench.py",
-     "deprecated use_pallas arm switch"),
+     "use_pallas arm switch (per-solve rank-1 kernel)"),
     ("CCSC_BENCH_FFTPAD", "str", "none", "bench.py",
      "fft_pad arm value"),
     ("CCSC_BENCH_STORAGE", "str", "float32", "bench.py",

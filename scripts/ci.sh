@@ -38,6 +38,13 @@
 #       hedging exceeded its hedge_max_frac cap, served bytes lost
 #       bit-parity, or the watchdog fired on a non-stall
 #       (serve.fleet — the request-lifecycle plane)
+#   29  the collective-audit leg failed (scripts/comm_audit.py on 8
+#       forced host devices): a batch-only mesh bucket program
+#       lowered with a collective HLO op in it, the (batch, freq)
+#       program exceeded its declared budget (CCSC_COMM_BUDGET_FREQ)
+#       or swapped its z-solve-tail all-gather for another op class,
+#       or the gate failed to refuse an injected over-budget count
+#       (analysis.comms — the comm-aware serving contract)
 #   30  scripts/perf_gate.py judged a regression against the durable
 #       perf ledger (skipped silently when no ledger file exists yet
 #       — a young repo must not fail CI on an empty history)
@@ -105,6 +112,10 @@ JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --only bank_rot || exit 27
 
 echo "== ci: 2f/3 gray-replica leg (scripts/chaos_smoke.py --only gray_replica: hedged attempts vs a slow-but-alive replica)"
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --only gray_replica || exit 28
+
+echo "== ci: 2g/3 collective-audit leg (scripts/comm_audit.py: HLO collective budgets of the mesh bucket programs)"
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    JAX_PLATFORMS=cpu python scripts/comm_audit.py || exit 29
 
 echo "== ci: 3/3 perf regression gate (scripts/perf_gate.py)"
 # resolve the same ledger path perf_gate would; gate only when a

@@ -79,6 +79,14 @@ def main(argv=None):
         "or '4x2') and record the default-vs-mesh gap; on CPU run "
         "under XLA_FLAGS=--xla_force_host_platform_device_count=N",
     )
+    ap.add_argument(
+        "--pipeline", type=int, default=None, metavar="DEPTH",
+        help="also run a PIPELINED engine on the same stream "
+        "(CCSC_SERVE_PIPELINE; ServeConfig.pipeline_depth — the "
+        "worker holds DEPTH launched batches in flight, overlapping "
+        "batch N+1's upload with batch N's solve) and record the "
+        "default-vs-pipelined gap plus a bitwise parity verdict",
+    )
     args = ap.parse_args(argv)
     if args.requests is not None:
         os.environ["CCSC_SERVE_REQUESTS"] = str(args.requests)
@@ -88,6 +96,8 @@ def main(argv=None):
         os.environ["CCSC_SERVE_TUNE"] = args.tune
     if args.mesh is not None:
         os.environ["CCSC_SERVE_MESH"] = args.mesh
+    if args.pipeline is not None:
+        os.environ["CCSC_SERVE_PIPELINE"] = str(args.pipeline)
 
     from ccsc_code_iccv2017_tpu.serve.bench import run_serve_workload
     from ccsc_code_iccv2017_tpu.utils import obs
@@ -136,6 +146,14 @@ def main(argv=None):
         )
     elif rec.get("mesh_skipped"):
         print(f"mesh arm skipped: {rec['mesh_skipped']}")
+    if "pipeline_requests_per_sec" in rec:
+        print(
+            f"pipelined engine (depth {rec['pipeline_depth']}) "
+            f"{rec['pipeline_requests_per_sec']} req/s "
+            f"({rec['speedup_pipeline_vs_default']}x the default "
+            "engine; bit-identical: "
+            f"{rec['pipeline_bit_identical']})"
+        )
     return rec
 
 

@@ -1,0 +1,218 @@
+"""The collective-budget gate (analysis.comms, ISSUE 20).
+
+Contracts under test:
+
+- STATIC COUNTING: collective_counts counts op DEFINITIONS in HLO
+  text — word-boundary exact (identifier tails like `%all-gather.5`
+  and longer embedding mnemonics like `ragged-all-to-all(` must not
+  inflate a shorter class), async `-start` halves count once, and
+  reduce-scatter books under the reduce class;
+- DECLARED BUDGETS: batch-only serving meshes declare ZERO, freq
+  meshes declare CCSC_COMM_BUDGET_FREQ (default 1, env-overridable);
+- ENFORCEMENT: check() raises CommBudgetError on an overrun with
+  enforcement armed (the default) and stays silent under
+  CCSC_COMM_BUDGET_ENFORCE=0 — audit-and-record, never serve-and-hide;
+- program_counts returns None for anything without a stable text dump
+  (lazily-jitted callables have nothing to audit);
+- THE ENGINE GATE: a mesh engine whose bucket program "contains" an
+  injected collective (comms.program_counts monkeypatched) refuses to
+  finish warmup with CommBudgetError; with enforcement off it builds
+  and records the failing verdict (comm_audit event, ok=False).
+
+The live end-to-end property — the real batch-mesh program lowering
+to zero collectives on 8 forced host devices — is asserted by
+tests/test_serve_mesh.py (the CCSC_CI_DEVICES leg) and
+scripts/comm_audit.py (the ci.sh exit-29 leg); these tests pin the
+accounting and the refusal machinery around it.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ccsc_code_iccv2017_tpu.analysis import comms
+from ccsc_code_iccv2017_tpu.config import (
+    ProblemGeom,
+    ServeConfig,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+)
+from ccsc_code_iccv2017_tpu.serve import CodecEngine
+from ccsc_code_iccv2017_tpu.utils import obs
+
+needs2 = pytest.mark.skipif(
+    jax.device_count() < 2,
+    reason="needs >= 2 (forced host) devices for a (2,) serving mesh",
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolation(monkeypatch):
+    for v in (
+        "CCSC_COMM_BUDGET_ENFORCE",
+        "CCSC_COMM_BUDGET_FREQ",
+        "CCSC_SERVE_MESH",
+        "CCSC_PERF_LEDGER",
+    ):
+        monkeypatch.delenv(v, raising=False)
+    yield
+
+
+# ------------------------------------------------------ text counting
+
+
+HLO_FIXTURE = """\
+ENTRY %main (p0: f32[8,4]) -> f32[8,8] {
+  %p0 = f32[8,4]{1,0} parameter(0)
+  %ag = f32[8,8]{1,0} all-gather(f32[8,4]{1,0} %p0), dimensions={1}
+  %ags = f32[8,8]{1,0} all-gather-start(f32[8,4]{1,0} %p0)
+  %agd = f32[8,8]{1,0} all-gather-done(f32[8,8]{1,0} %ags)
+  %ar = f32[8,4]{1,0} all-reduce(f32[8,4]{1,0} %p0), to_apply=%add
+  %rs = f32[4,4]{1,0} reduce-scatter(f32[8,4]{1,0} %p0), to_apply=%add
+  %rata = f32[8,4]{1,0} ragged-all-to-all(f32[8,4]{1,0} %p0)
+  %cp = f32[8,4]{1,0} collective-permute(f32[8,4]{1,0} %p0)
+  %use = f32[8,8]{1,0} copy(f32[8,8]{1,0} %all-gather.5)
+}
+"""
+
+
+def test_collective_counts_fixture_word_boundaries():
+    c = comms.collective_counts(HLO_FIXTURE)
+    # all-gather( + all-gather-start( ; NOT all-gather-done( (done is
+    # the same logical collective) and NOT the %all-gather.5 use
+    assert c["all_gather"] == 2
+    # all-reduce( + reduce-scatter(
+    assert c["all_reduce"] == 2
+    # ragged-all-to-all( counts ONCE — not also as all-to-all(
+    assert c["all_to_all"] == 1
+    assert c["collective_permute"] == 1
+    assert c["total"] == 6
+
+
+def test_collective_counts_clean_text_is_zero():
+    c = comms.collective_counts(
+        "ENTRY %main { %p = f32[4]{0} parameter(0)\n"
+        "  %r = f32[4]{0} add(%p, %p) }"
+    )
+    assert c["total"] == 0
+    assert all(v == 0 for k, v in c.items())
+    assert comms.format_counts(c) == "none"
+
+
+def test_declared_budget_mapping(monkeypatch):
+    assert comms.declared_budget(None) == 0
+    assert comms.declared_budget(()) == 0
+    assert comms.declared_budget((4,)) == 0
+    assert comms.declared_budget((4, 1)) == 0  # trivial freq axis
+    assert comms.declared_budget((4, 2)) == 1  # default freq budget
+    monkeypatch.setenv("CCSC_COMM_BUDGET_FREQ", "3")
+    assert comms.declared_budget((4, 2)) == 3
+    assert comms.declared_budget((8,)) == 0  # batch stays zero
+
+
+def test_check_raises_over_budget_and_respects_enforce(monkeypatch):
+    over = comms.collective_counts(HLO_FIXTURE)
+    with pytest.raises(comms.CommBudgetError, match="declared budget"):
+        comms.check(over, (8,), bucket="b8x12x12")
+    # a freq mesh with counts inside its budget passes
+    one = {"all_gather": 1, "all_reduce": 0, "all_to_all": 0,
+           "collective_permute": 0, "total": 1}
+    comms.check(one, (4, 2), bucket="ok")
+    # enforcement off: the overrun is recorded by callers, not raised
+    monkeypatch.setenv("CCSC_COMM_BUDGET_ENFORCE", "0")
+    comms.check(over, (8,), bucket="b8x12x12")
+    assert not comms.enforce_enabled()
+
+
+def test_program_counts_none_without_stable_text():
+    assert comms.program_counts(object()) is None
+
+    class Raises:
+        def as_text(self):
+            raise RuntimeError("no text for you")
+
+    class NotText:
+        def as_text(self):
+            return 7
+
+    assert comms.program_counts(Raises()) is None
+    assert comms.program_counts(NotText()) is None
+
+    class Texty:
+        def as_text(self):
+            return HLO_FIXTURE
+
+    assert comms.program_counts(Texty())["total"] == 6
+
+
+# --------------------------------------------------- the engine gate
+
+
+def _bank(k=4, s=5, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, s, s)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return jnp.asarray(d)
+
+
+def _mesh_engine(tmp_path, **kw):
+    d = _bank()
+    cfg = SolveConfig(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=2, tol=0.0,
+        verbose="none",
+    )
+    scfg = ServeConfig(
+        buckets=((2, (12, 12)),), max_wait_ms=10.0,
+        metrics_dir=str(tmp_path), verbose="none", mesh_shape=(2,),
+        **kw,
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    return CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
+
+
+def _inject_counts(monkeypatch, n=2):
+    injected = {"all_gather": 0, "all_reduce": n, "all_to_all": 0,
+                "collective_permute": 0, "total": n}
+    monkeypatch.setattr(
+        comms, "program_counts", lambda program: dict(injected)
+    )
+    return injected
+
+
+@needs2
+def test_engine_refuses_injected_collective(tmp_path, monkeypatch):
+    """A batch-only mesh program that 'lowers' with a collective in it
+    (injected at the counting seam) must never finish warmup."""
+    _inject_counts(monkeypatch)
+    with pytest.raises(comms.CommBudgetError, match="batch-only"):
+        _mesh_engine(tmp_path)
+
+
+@needs2
+def test_engine_records_failing_verdict_unenforced(
+    tmp_path, monkeypatch,
+):
+    """CCSC_COMM_BUDGET_ENFORCE=0: the over-budget engine builds and
+    serves, but the comm_audit event records ok=False with the real
+    per-class counts — observable, never hidden."""
+    monkeypatch.setenv("CCSC_COMM_BUDGET_ENFORCE", "0")
+    injected = _inject_counts(monkeypatch)
+    eng = _mesh_engine(tmp_path)
+    try:
+        assert all(
+            c["total"] == injected["total"]
+            for c in eng.comm_counts.values()
+        )
+    finally:
+        eng.close()
+    audits = [
+        e for e in obs.read_events(str(tmp_path))
+        if e.get("type") == "comm_audit"
+    ]
+    assert audits, "mesh warmup must emit comm_audit per bucket"
+    assert all(e["ok"] is False for e in audits)
+    assert all(e["budget"] == 0 for e in audits)
+    assert all(e["total"] == injected["total"] for e in audits)
+    assert all(e["all_reduce"] == injected["all_reduce"] for e in audits)

@@ -699,21 +699,25 @@ def test_no_bare_prints_in_package():
 
 
 # ------------------------------------------------------------------
-# use_pallas no-op warning (VERDICT weak #6)
+# use_pallas fallback warning (VERDICT weak #6 discipline, kept
+# through the r10 re-promotion: callers who asked for the Pallas
+# route must hear when it could not engage)
 # ------------------------------------------------------------------
 
-def test_use_pallas_noop_warns_once():
+def test_use_pallas_fallback_warns_once():
     from ccsc_code_iccv2017_tpu.ops import freq_solvers
 
+    # W == 2: a matrix inner inverse — outside the rank-1 kernel's
+    # coverage, so use_pallas=True falls back to the einsum path
     dhat = jnp.asarray(
-        np.random.default_rng(0).normal(size=(2, 1, 5))
-        + 1j * np.random.default_rng(1).normal(size=(2, 1, 5))
+        np.random.default_rng(0).normal(size=(2, 2, 5))
+        + 1j * np.random.default_rng(1).normal(size=(2, 2, 5))
     ).astype(jnp.complex64)
     kern = freq_solvers.precompute_z_kernel(dhat, 1.0)
-    xi1 = jnp.zeros((1, 1, 5), jnp.complex64)
+    xi1 = jnp.zeros((1, 2, 5), jnp.complex64)
     xi2 = jnp.zeros((1, 2, 5), jnp.complex64)
     freq_solvers._use_pallas_warned = False
-    with pytest.warns(UserWarning, match="no-op since the r5 demotion"):
+    with pytest.warns(UserWarning, match="fell back to the einsum"):
         freq_solvers.solve_z(kern, xi1, xi2, 1.0, use_pallas=True)
     # one-time: a second call stays silent
     import warnings as _warnings

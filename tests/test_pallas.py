@@ -1,15 +1,19 @@
-"""ops.pallas_kernels as a TEST ORACLE for the einsum z-solve.
+"""ops.pallas_kernels vs the einsum z-solve.
 
 The per-solve Pallas kernel measured 0.93x the einsum path on the v5e
-(onchip_r4.jsonl 'pallas' arm: the z-solve einsum was never the
-bottleneck), so it is DEMOTED from production — `use_pallas` is a
-documented no-op in freq_solvers.solve_z, and the one production
-Pallas path is the fused whole-iteration kernel (ops.pallas_fused_z,
-tests/test_pallas_fused.py). The kernel stays useful precisely
-because it is an INDEPENDENT implementation of the rank-1
-Sherman-Morrison solve (admm_solve_conv2D_weighted_sampling.m:170-190)
-— these tests check the two against each other (interpret mode on
-CPU).
+(onchip_r4.jsonl 'pallas' arm) and was demoted in r5; r10 re-admitted
+it as a measured serve-solve autotuner arm (tune.space SOLVE_KNOBS)
+behind the numerics guard, and freq_solvers.solve_z routes
+`use_pallas=True` to it for the W == 1 / filter-unsharded /
+static-rho case. The kernel is an INDEPENDENT implementation of the
+rank-1 Sherman-Morrison solve
+(admm_solve_conv2D_weighted_sampling.m:170-190) — these tests check
+the two against each other (interpret mode on CPU), plus the routing
+contract: the routed call agrees with the einsum path to float
+tolerance and IS the kernel bit-for-bit; non-routable calls stay
+bit-identical to the einsum path. The learners' production Pallas
+path remains the fused whole-iteration kernel (ops.pallas_fused_z,
+tests/test_pallas_fused.py).
 """
 import jax.numpy as jnp
 import numpy as np
@@ -78,8 +82,11 @@ def test_pallas_solve_matches_xla_with_extra_diag():
     )
 
 
-def test_use_pallas_is_a_noop():
-    """The demoted knob must not change results (call-site compat)."""
+def test_use_pallas_routes_to_the_kernel():
+    """At W == 1 / unsharded / static rho, use_pallas=True routes:
+    the result is the Pallas kernel's output bit-for-bit and agrees
+    with the einsum path to the kernel's float tolerance (the arm is
+    non-exact — that is why the autotuner guards it)."""
     r = np.random.default_rng(2)
     dhat, xi1, xi2 = _rand_problem(r, 6, 80, 2)
     kern = freq_solvers.precompute_z_kernel(
@@ -90,6 +97,34 @@ def test_use_pallas_is_a_noop():
     )
     b = freq_solvers.solve_z(
         kern, jnp.asarray(xi1)[:, None, :], jnp.asarray(xi2), 0.9,
+        use_pallas=True,
+    )
+    direct = pallas_kernels.solve_z_rank1_pallas(
+        jnp.asarray(dhat), jnp.asarray(xi1), jnp.asarray(xi2), 0.9,
+        dinv=kern.dinv, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(b), np.asarray(direct))
+    np.testing.assert_allclose(
+        np.asarray(b), np.asarray(a), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_use_pallas_falls_back_bit_identical():
+    """Outside the kernel's coverage (here: a traced rho, as inside a
+    jitted solve whose rho is a tracer) the einsum path runs and the
+    result is bit-identical to use_pallas=False."""
+    r = np.random.default_rng(3)
+    dhat, xi1, xi2 = _rand_problem(r, 6, 80, 2)
+    kern = freq_solvers.precompute_z_kernel(
+        jnp.asarray(dhat)[:, None, :], 0.9
+    )
+    rho_traced = jnp.float32(0.9)  # not a python float -> no route
+    a = freq_solvers.solve_z(
+        kern, jnp.asarray(xi1)[:, None, :], jnp.asarray(xi2), rho_traced
+    )
+    freq_solvers._use_pallas_warned = True  # silence; test_obs covers it
+    b = freq_solvers.solve_z(
+        kern, jnp.asarray(xi1)[:, None, :], jnp.asarray(xi2), rho_traced,
         use_pallas=True,
     )
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -224,6 +224,71 @@ def test_mesh_zero_compiles_after_warmup_and_topology_in_stream(
     assert meta.get("serve_devices") == 4
 
 
+# ------------------------------------------------- collective budgets
+
+
+@needs8
+def test_batch_mesh_program_lowers_to_zero_collectives(tmp_path):
+    """The ISSUE-20 acceptance property, asserted live (this test
+    rides the CCSC_CI_DEVICES=8 ci.sh leg): a batch-only mesh bucket
+    program contains ZERO collective HLO ops — the solve factors are
+    replicated small constants and every slot's solve decouples, so
+    any collective in the text is a lowering bug. The comm_audit
+    event records the passing verdict per bucket."""
+    d = _bank()
+    eng = _engine(
+        d, _cfg(max_it=2, tol=0.0), ((8, (12, 12)),),
+        tmp_path=tmp_path, mesh_shape=(8,),
+    )
+    try:
+        counts = eng.comm_counts
+        assert counts, "mesh warmup must audit every bucket program"
+        assert all(c["total"] == 0 for c in counts.values()), counts
+    finally:
+        eng.close()
+    audits = [
+        e for e in obs.read_events(str(tmp_path))
+        if e.get("type") == "comm_audit"
+    ]
+    assert audits
+    assert all(e["ok"] is True for e in audits)
+    assert all(e["budget"] == 0 for e in audits)
+    assert all(e["total"] == 0 for e in audits)
+
+
+@needs8
+def test_freq_mesh_program_meets_declared_budget(tmp_path):
+    """A (batch, freq) program pays its communication in exactly one
+    op class — the z-solve-tail spectrum all-gather — and stays at or
+    under CCSC_COMM_BUDGET_FREQ (default 1) TOTAL ops across classes:
+    a refactor that swaps the gather for a gather plus a reduce fails
+    here before it can land as a throughput cliff."""
+    from ccsc_code_iccv2017_tpu.analysis import comms
+
+    d = _bank()
+    eng = _engine(
+        d, _cfg(max_it=2, tol=0.0), ((4, (24, 24)),),
+        tmp_path=tmp_path, mesh_shape=(2, 2),
+    )
+    try:
+        counts = eng.comm_counts
+        assert counts
+        budget = comms.declared_budget((2, 2))
+        for c in counts.values():
+            assert 0 < c["total"] <= budget, c
+            # all communication is the one gather class
+            assert c["all_gather"] == c["total"], c
+    finally:
+        eng.close()
+    audits = [
+        e for e in obs.read_events(str(tmp_path))
+        if e.get("type") == "comm_audit"
+    ]
+    assert audits
+    assert all(e["ok"] is True for e in audits)
+    assert all(e["budget"] == budget for e in audits)
+
+
 # ---------------------------------------------------------- refusals
 
 

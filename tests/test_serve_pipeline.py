@@ -1,0 +1,295 @@
+"""Pipelined dispatch (ISSUE 20): the engine worker holds up to
+``ServeConfig.pipeline_depth`` launched batches in flight, overlapping
+batch N+1's host->device upload with batch N's solve.
+
+Contracts under test:
+
+- BIT-IDENTITY: a depth-2 engine's results on a heterogeneous stream
+  (multiple buckets, padded requests mixed in) are BITWISE a depth-1
+  engine's — recon, objective/PSNR traces, stopping iteration. The
+  overlap changes WHEN a batch is uploaded, never what the program
+  computes, so this holds exactly (same AOT programs, same batches).
+- LEDGER IDENTITY: only a non-default depth keys the knob dict
+  ("pipeline": depth) — depth-1 engines keep their historical knob
+  digest bit-for-bit, and the bench's pipelined arm accrues its OWN
+  perf-ledger configuration (third row), judged by the same gate.
+- RESOLUTION: ServeConfig.pipeline_depth wins; None falls back to
+  CCSC_SERVE_PIPELINE; invalid depths are refused at config time.
+- FAULTS: a replica killed mid-stream with a prefetched batch in
+  flight loses nothing — the fleet redelivers exactly once and the
+  results stay bit-identical (the in-flight lane is just work the
+  casualty never acked).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ccsc_code_iccv2017_tpu.config import (
+    FleetConfig,
+    ProblemGeom,
+    ServeConfig,
+    SolveConfig,
+)
+from ccsc_code_iccv2017_tpu.models.reconstruct import (
+    ReconstructionProblem,
+)
+from ccsc_code_iccv2017_tpu.serve import CodecEngine, ServeFleet
+from ccsc_code_iccv2017_tpu.utils import faults, obs
+
+
+@pytest.fixture(autouse=True)
+def _isolation(monkeypatch):
+    for v in (
+        "CCSC_SERVE_PIPELINE",
+        "CCSC_SERVE_MESH",
+        "CCSC_FAULT_ENGINE_KILL_REQ",
+        "CCSC_FAULT_ENGINE_KILL_REPLICA",
+        "CCSC_WATCHDOG_MIN_S",
+        "CCSC_WATCHDOG_COMPILE_S",
+        "CCSC_PERF_LEDGER",
+    ):
+        monkeypatch.delenv(v, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _bank(k=6, s=5, seed=0):
+    r = np.random.default_rng(seed)
+    d = r.normal(size=(k, s, s)).astype(np.float32)
+    d /= np.sqrt((d**2).sum(axis=(1, 2), keepdims=True))
+    return jnp.asarray(d)
+
+
+def _cfg(**kw):
+    base = dict(
+        lambda_residual=5.0, lambda_prior=0.3, max_it=6, tol=1e-4,
+        verbose="none", track_objective=True, track_psnr=True,
+    )
+    base.update(kw)
+    return SolveConfig(**base)
+
+
+def _req(size, seed=1, keep=0.5):
+    r = np.random.default_rng(seed)
+    x = r.random((size, size)).astype(np.float32)
+    m = (r.random((size, size)) < keep).astype(np.float32)
+    return x, m
+
+
+def _engine(d, cfg, buckets, tmp_path=None, **kw):
+    scfg = ServeConfig(
+        buckets=buckets,
+        max_wait_ms=kw.pop("max_wait_ms", 5.0),
+        metrics_dir=str(tmp_path) if tmp_path is not None else None,
+        verbose="none",
+        mesh_shape=kw.pop("mesh_shape", ()),
+        **kw,
+    )
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    return CodecEngine(d, ReconstructionProblem(geom), cfg, scfg)
+
+
+def _serve_all(eng, reqs):
+    futs = [eng.submit(x * m, mask=m, x_orig=x) for x, m in reqs]
+    return [f.result(timeout=300) for f in futs]
+
+
+# -------------------------------------------------------- bit parity
+
+
+def test_depth2_bit_identical_to_depth1_hetero_stream():
+    """The tentpole parity contract on a heterogeneous stream: two
+    buckets, off-bucket (padded) sizes mixed in, enough requests that
+    the depth-2 worker actually holds a second batch in flight."""
+    d = _bank()
+    cfg = _cfg()
+    buckets = ((2, (16, 16)), (2, (24, 24)))
+    sizes = [16, 24, 12, 24, 16, 20, 24, 16, 12, 20, 24, 16]
+    reqs = [_req(sz, seed=300 + i) for i, sz in enumerate(sizes)]
+
+    ref_eng = _engine(d, cfg, buckets, pipeline_depth=1)
+    try:
+        ref = _serve_all(ref_eng, reqs)
+    finally:
+        ref_eng.close()
+
+    pipe_eng = _engine(d, cfg, buckets, pipeline_depth=2)
+    try:
+        out = _serve_all(pipe_eng, reqs)
+    finally:
+        pipe_eng.close()
+
+    for a, b in zip(ref, out):
+        np.testing.assert_array_equal(a.recon, b.recon)
+        np.testing.assert_array_equal(
+            np.asarray(a.trace.obj_vals), np.asarray(b.trace.obj_vals)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.trace.psnr_vals),
+            np.asarray(b.trace.psnr_vals),
+        )
+        assert int(a.trace.num_iters) == int(b.trace.num_iters)
+
+
+# ------------------------------------------- knob identity/resolution
+
+
+def _ready_knobs(tmp_path, **kw):
+    d = _bank(k=4, s=3)
+    eng = _engine(
+        d, _cfg(max_it=2, tol=0.0, track_psnr=False),
+        ((2, (12, 12)),), tmp_path, **kw,
+    )
+    eng.close()
+    ready = [
+        e for e in obs.read_events(str(tmp_path))
+        if e.get("type") == "serve_ready"
+    ]
+    assert ready
+    return ready[-1]["knobs"]
+
+
+def test_depth1_keeps_historical_knob_digest(tmp_path):
+    knobs = _ready_knobs(tmp_path, pipeline_depth=1)
+    assert "pipeline" not in knobs
+
+
+def test_nondefault_depth_keys_knob_dict(tmp_path):
+    knobs = _ready_knobs(tmp_path, pipeline_depth=3)
+    assert knobs["pipeline"] == 3
+
+
+def test_env_fallback_and_config_priority(tmp_path, monkeypatch):
+    monkeypatch.setenv("CCSC_SERVE_PIPELINE", "2")
+    knobs = _ready_knobs(tmp_path / "env", pipeline_depth=None)
+    assert knobs["pipeline"] == 2
+    # an explicit config depth wins over the env
+    knobs = _ready_knobs(tmp_path / "cfg", pipeline_depth=1)
+    assert "pipeline" not in knobs
+
+
+def test_invalid_depth_refused():
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        ServeConfig(buckets=((2, (12, 12)),), pipeline_depth=0)
+
+
+# ------------------------------------------------------------- faults
+
+
+def test_fleet_kill_with_prefetched_batch_zero_lost(
+    tmp_path, monkeypatch,
+):
+    """Kill a pipelined replica on its first taken request: the
+    in-flight lane (a launched-but-unacked second batch) is redelivered
+    by the fleet exactly once, bit-identical to an unfaulted engine."""
+    monkeypatch.setenv("CCSC_SERVE_PIPELINE", "2")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_KILL_REQ", "1")
+    monkeypatch.setenv("CCSC_FAULT_ENGINE_KILL_REPLICA", "0")
+    monkeypatch.setenv("CCSC_WATCHDOG_MIN_S", "0.4")
+    monkeypatch.setenv("CCSC_WATCHDOG_COMPILE_S", "0.4")
+    faults.reset()
+    d = _bank(k=4, s=3)
+    cfg = _cfg(max_it=4, tol=0.0, track_psnr=False)
+    buckets = ((4, (12, 12)),)
+    reqs = [_req(12, seed=400 + i) for i in range(10)]
+
+    geom = ProblemGeom(d.shape[1:], d.shape[0])
+    ref_eng = CodecEngine(
+        d, ReconstructionProblem(geom), cfg,
+        ServeConfig(
+            buckets=buckets, max_wait_ms=2.0, verbose="none",
+            pipeline_depth=1,
+        ),
+    )
+    try:
+        futs = [ref_eng.submit(x * m, mask=m) for x, m in reqs]
+        ref = [f.result(timeout=180) for f in futs]
+    finally:
+        ref_eng.close()
+
+    fleet = ServeFleet(
+        d, ReconstructionProblem(geom), cfg,
+        ServeConfig(buckets=buckets, max_wait_ms=2.0, verbose="none"),
+        FleetConfig(
+            replicas=2, min_queue_depth=64, restart_backoff_s=0.05,
+            heartbeat_s=0.2, health_interval_s=0.05, verbose="none",
+            metrics_dir=str(tmp_path),
+        ),
+    )
+    try:
+        futs = [
+            fleet.submit(x * m, mask=m, key=f"p{i}")
+            for i, (x, m) in enumerate(reqs)
+        ]
+        res = [f.result(timeout=300) for f in futs]
+        assert len(res) == 10
+        for i in range(10):
+            np.testing.assert_array_equal(res[i].recon, ref[i].recon)
+            assert int(res[i].trace.num_iters) == int(
+                ref[i].trace.num_iters
+            )
+    finally:
+        fleet.close()
+
+    events = obs.read_events(str(tmp_path), recursive=True)
+    dead = [e for e in events if e["type"] == "fleet_replica_dead"]
+    assert any(e["replica_id"] == 0 for e in dead)
+    served = [
+        e["key"] for e in events if e["type"] == "fleet_request"
+    ]
+    assert sorted(served) == sorted(f"p{i}" for i in range(10))
+
+
+# ----------------------------------------------------- ledger + gate
+
+
+def test_pipeline_record_is_its_own_ledger_configuration(
+    tmp_path, monkeypatch,
+):
+    """append_serve_record with a pipelined arm writes a THIRD-row
+    class of its own: default + pipeline knob digests stay distinct,
+    each accrues history, and an injected 0.5x pipelined record is a
+    regression against the pipeline key's band (perf_gate exit-1)."""
+    from ccsc_code_iccv2017_tpu.analysis import ledger
+
+    path = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("CCSC_PERF_LEDGER", path)
+    base = {
+        "chip": "cpu",
+        "shape_key": "solve2d:k32:s7x7:sz64x64",
+        "knobs": {"requests": 16, "slots": 4},
+        "n_compiles": 3,
+        "pipeline_depth": 2,
+    }
+    for v_def, v_pipe in ((2.0, 2.6), (2.05, 2.62), (1.98, 2.58)):
+        rec = dict(
+            base,
+            engine_requests_per_sec=v_def,
+            pipeline_requests_per_sec=v_pipe,
+        )
+        assert ledger.append_serve_record(rec) is not None
+    rows = ledger.Ledger(path).read()
+    assert len(rows) == 6
+    keys = {ledger.record_key(r) for r in rows}
+    assert len(keys) == 2  # default + pipeline configurations
+    pipe_rows = [
+        r for r in rows if (r.get("knobs") or {}).get("pipeline") == 2
+    ]
+    assert len(pipe_rows) == 3
+    assert all(r["value"] > 2.5 for r in pipe_rows)
+    # gate: an injected 0.5x record under the PIPELINE key regresses
+    led = ledger.Ledger(path)
+    bad = ledger.normalize_record(
+        chip="cpu", kind="serve", workload="serve2d",
+        shape_key=base["shape_key"],
+        knobs=dict(base["knobs"], pipeline=2),
+        value=1.3, unit="requests/sec",
+    )
+    verdicts = ledger.gate(led, record=bad)
+    assert any(not v["ok"] for v in verdicts), verdicts
+    # ...and a value inside the band passes
+    good = dict(bad, value=2.61)
+    assert all(v["ok"] for v in ledger.gate(led, record=good))
